@@ -1,0 +1,44 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the reference's
+(92lqllearning/Paddle) capability surface.
+
+Compute path: jax/XLA (+ Pallas kernels); eager DyGraph autograd on a vjp
+tape; jitted functional training steps for performance; distribution via
+jax.sharding Mesh + XLA collectives over ICI.
+"""
+from __future__ import annotations
+
+from . import autograd, dtype as _dtype_module, framework
+from .autograd import enable_grad, no_grad, set_grad_enabled, grad
+from .dtype import (bfloat16, bool_, complex64, complex128, finfo, float16,
+                    float32, float64, iinfo, int8, int16, int32, int64, uint8)
+from .framework import (CPUPlace, CUDAPlace, Generator, Place, TPUPlace,
+                        XLAPlace, device_guard, get_default_dtype, get_device,
+                        seed, set_default_dtype, set_device)
+from .tensor import Parameter, Tensor
+
+# full op surface (also attaches Tensor methods/operators)
+from .ops import *  # noqa: F401,F403
+from .ops import linalg
+
+bool = bool_  # paddle.bool
+
+__version__ = '0.1.0'
+
+disable_static = lambda *a, **k: None  # DyGraph is the only eager mode here
+enable_static = lambda *a, **k: None
+
+in_dynamic_mode = lambda: True
+
+
+def is_grad_enabled():
+    return autograd.is_grad_enabled()
+
+
+def get_flags(flags=None):
+    from . import flags as _flags
+    return _flags.get_flags(flags)
+
+
+def set_flags(flags):
+    from . import flags as _flags
+    return _flags.set_flags(flags)

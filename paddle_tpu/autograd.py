@@ -1,0 +1,214 @@
+"""DyGraph autograd: a per-op tape over pure jax functions.
+
+TPU-native replacement for the reference's eager autograd engine
+(upstream: paddle/fluid/eager/ + C++ grad-node graph). Instead of hand-written
+grad kernels, every op records a `jax.vjp` at forward time; backward() walks
+the tape in reverse, feeding cotangents through the stored vjp closures.
+The jitted training path (paddle_tpu.jit) bypasses the tape entirely and
+differentiates the whole step functionally with jax.grad.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+        self.functional = False  # inside functional capture: never record
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled and not _state.functional
+
+
+@contextlib.contextmanager
+def no_grad():
+    old = _state.enabled
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = old
+
+
+@contextlib.contextmanager
+def enable_grad():
+    old = _state.enabled
+    _state.enabled = True
+    try:
+        yield
+    finally:
+        _state.enabled = old
+
+
+@contextlib.contextmanager
+def functional_scope():
+    """Inside jit capture: ops must stay pure, tape off."""
+    old = _state.functional
+    _state.functional = True
+    try:
+        yield
+    finally:
+        _state.functional = old
+
+
+set_grad_enabled = enable_grad  # reference-compat alias
+
+
+def _float0_zero(leaf):
+    return np.zeros(np.shape(leaf), dtype=jax.dtypes.float0)
+
+
+_node_counter = [0]
+
+
+class Node:
+    """One recorded op: inputs (Tensor refs), vjp closure, output metadata."""
+
+    __slots__ = ('inputs', 'vjp_fn', 'out_avals', 'out_treedef', 'name',
+                 '_order')
+
+    def __init__(self, inputs, vjp_fn, out_avals, out_treedef, name=''):
+        self.inputs = inputs          # list[Tensor] participating inputs
+        self.vjp_fn = vjp_fn          # cotangents(pytree) -> tuple of input cotangents
+        self.out_avals = out_avals    # list of (shape, dtype) per output leaf
+        self.out_treedef = out_treedef
+        self.name = name
+        _node_counter[0] += 1
+        self._order = _node_counter[0]
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = ()
+
+
+def backward(outputs, grad_tensors=None, retain_graph=False):
+    """Reverse-accumulate gradients from `outputs` into leaf .grad slots.
+
+    Mirrors Tensor.backward()/paddle.autograd.backward semantics: scalar
+    outputs seed with ones; non-scalars require explicit grad_tensors.
+    """
+    from .tensor import Tensor  # cycle-free at call time
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(outputs)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Cotangents for graph-internal tensors are keyed by
+    # (id(producing_node), output_leaf_index) — nodes are held strongly for
+    # the whole walk, so no id-reuse hazard. Leaves accumulate straight into
+    # .grad via _accumulate_grad.
+    cot: dict = {}
+
+    def add_cot(tensor, value):
+        key = (id(tensor._node), tensor._leaf_index)
+        if key in cot:
+            cot[key] = cot[key] + value
+        else:
+            cot[key] = value
+
+    roots = []
+    for out, g in zip(outputs, grad_tensors):
+        if out.stop_gradient:
+            continue
+        if g is None:
+            if out.size != 1:
+                raise RuntimeError(
+                    'grad can be implicitly created only for scalar outputs; '
+                    'pass grad_tensors for non-scalar outputs')
+            g_val = jnp.ones(out.shape, out.dtype)
+        else:
+            g_val = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        if out._node is None:
+            out._accumulate_grad(g_val)
+        else:
+            add_cot(out, g_val)
+            roots.append(out)
+
+    # Topological walk: collect reachable nodes by DFS over producer links,
+    # then process in reverse creation order.
+    seen_nodes = []
+    seen_ids = set()
+    stack = [t._node for t in roots if t._node is not None]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen_ids:
+            continue
+        seen_ids.add(id(node))
+        seen_nodes.append(node)
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in seen_ids:
+                stack.append(t._node)
+    seen_nodes.sort(key=lambda n: n._order)
+
+    for node in reversed(seen_nodes):
+        # Assemble output cotangents (zeros / float0 where untouched).
+        leaves = []
+        any_set = False
+        for i, (shape, dt) in enumerate(node.out_avals):
+            g = cot.pop((id(node), i), None)
+            if g is not None:
+                any_set = True
+                leaves.append(g)
+            elif jnp.issubdtype(dt, jnp.inexact):
+                leaves.append(jnp.zeros(shape, dt))
+            else:
+                leaves.append(np.zeros(shape, dtype=jax.dtypes.float0))
+        if not any_set:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                'trying to backward through the graph a second time '
+                '(set retain_graph=True on the first backward)')
+        out_cot = jax.tree_util.tree_unflatten(node.out_treedef, leaves)
+        in_cots = node.vjp_fn(out_cot)
+        for t, g in zip(node.inputs, in_cots):
+            if t.stop_gradient:
+                continue
+            if g is not None and np.dtype(getattr(g, 'dtype', np.float32)) != jax.dtypes.float0:
+                if t._node is None:
+                    t._accumulate_grad(g)
+                else:
+                    add_cot(t, g)
+        if not retain_graph:
+            node.release()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=True):
+    """paddle.grad: return grads of `outputs` w.r.t. `inputs` (no .grad mutation)."""
+    from .tensor import Tensor
+
+    single = isinstance(inputs, Tensor)
+    inputs_l = [inputs] if single else list(inputs)
+    saved = [(t.grad, t.stop_gradient) for t in inputs_l]
+    for t in inputs_l:
+        t.grad = None
+        t.stop_gradient = False
+    try:
+        backward(outputs, grad_outputs, retain_graph=retain_graph or create_graph)
+        grads = []
+        for t in inputs_l:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError('an input was unused in the graph')
+                grads.append(None)
+            else:
+                grads.append(t.grad)
+    finally:
+        for t, (g, sg) in zip(inputs_l, saved):
+            t.grad, t.stop_gradient = g, sg
+    return grads[0] if single else grads

@@ -1,0 +1,80 @@
+"""Dtype system.
+
+Mirrors the reference's dtype surface (upstream: paddle/phi/common/data_type.h)
+with jax/numpy dtypes as the carrier. TPU-first: bfloat16 is a first-class
+compute dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects are jnp dtypes so they flow straight into jax ops.
+import jax
+
+_X64 = bool(jax.config.jax_enable_x64)
+
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+uint8 = jnp.dtype(jnp.uint8)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+# TPU-first: 64-bit types are canonicalized to 32-bit unless jax x64 is
+# enabled (TPUs have no fast 64-bit path; the reference's int64 indices map
+# to int32 on-device the same way XLA does).
+float64 = jnp.dtype(jnp.float64) if _X64 else float32
+int64 = jnp.dtype(jnp.int64) if _X64 else int32
+complex128 = jnp.dtype(jnp.complex128) if _X64 else complex64
+
+_NAME_TO_DTYPE = {
+    'float16': float16, 'fp16': float16, 'half': float16,
+    'bfloat16': bfloat16, 'bf16': bfloat16,
+    'float32': float32, 'fp32': float32, 'float': float32,
+    'float64': float64, 'fp64': float64, 'double': float64,
+    'int8': int8, 'int16': int16, 'int32': int32, 'int64': int64,
+    'uint8': uint8, 'bool': bool_,
+    'complex64': complex64, 'complex128': complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str / np.dtype / jnp type) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f'unsupported dtype name: {dtype!r}') from None
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = jnp.dtype(dtype)
+    if d == bfloat16:
+        return 'bfloat16'
+    return d.name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def is_inexact(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.inexact)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(np.dtype(convert_dtype(dtype)))

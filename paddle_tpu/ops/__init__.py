@@ -1,0 +1,123 @@
+"""Aggregate op namespace + Tensor method/operator attachment.
+
+Mirrors how the reference monkey-patches math methods onto Tensor
+(upstream: python/paddle/tensor/__init__.py tensor_method_func list).
+"""
+from __future__ import annotations
+
+from ..tensor import Tensor
+from . import creation, linalg, manipulation, math, reduction, search
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+_METHOD_SOURCES = (math, reduction, manipulation, linalg, search)
+
+_METHOD_NAMES = [
+    # math
+    'add', 'subtract', 'multiply', 'divide', 'floor_divide', 'mod', 'remainder',
+    'pow', 'maximum', 'minimum', 'exp', 'expm1', 'log', 'log2', 'log10',
+    'log1p', 'sqrt', 'rsqrt', 'abs', 'neg', 'sign', 'sin', 'cos', 'tan',
+    'asin', 'acos', 'atan', 'sinh', 'cosh', 'tanh', 'asinh', 'acosh', 'atanh',
+    'erf', 'erfinv', 'floor', 'ceil', 'round', 'trunc', 'frac', 'reciprocal',
+    'square', 'sigmoid', 'clip', 'lerp', 'scale', 'increment', 'digamma',
+    'lgamma', 'nan_to_num', 'logit', 'atan2', 'outer', 'inner', 'logaddexp',
+    'equal', 'not_equal', 'greater_than', 'greater_equal', 'less_than',
+    'less_equal', 'equal_all', 'allclose', 'isclose', 'logical_and',
+    'logical_or', 'logical_xor', 'logical_not', 'bitwise_and', 'bitwise_or',
+    'bitwise_xor', 'bitwise_not', 'isnan', 'isinf', 'isfinite', 'deg2rad',
+    'rad2deg', 'conj', 'real', 'imag', 'angle',
+    # reduction
+    'sum', 'mean', 'prod', 'max', 'min', 'amax', 'amin', 'all', 'any',
+    'std', 'var', 'median', 'quantile', 'logsumexp', 'cumsum', 'cumprod',
+    'cummax', 'cummin', 'count_nonzero', 'nansum', 'nanmean',
+    # manipulation
+    'reshape', 'reshape_', 'flatten', 'squeeze', 'unsqueeze', 'transpose',
+    't', 'moveaxis', 'swapaxes', 'split', 'chunk', 'unbind', 'tile', 'expand',
+    'expand_as', 'broadcast_to', 'flip', 'roll', 'rot90', 'gather',
+    'gather_nd', 'scatter', 'scatter_', 'scatter_nd_add', 'index_select',
+    'index_sample', 'index_add', 'index_put', 'take_along_axis',
+    'put_along_axis', 'repeat_interleave', 'pad', 'diagonal', 'kron', 'diff',
+    'as_complex', 'as_real', 'slice', 'strided_slice',
+    # linalg
+    'matmul', 'mm', 'bmm', 'dot', 'mv', 'norm', 'dist', 'cross', 'histogram',
+    'matrix_power', 'cholesky', 'inv',
+    # search
+    'argmax', 'argmin', 'topk', 'sort', 'argsort', 'where', 'nonzero',
+    'masked_select', 'masked_fill', 'unique', 'unique_consecutive',
+    'searchsorted', 'kthvalue', 'mode', 'isin',
+]
+
+
+def _attach_methods():
+    for name in _METHOD_NAMES:
+        for src in _METHOD_SOURCES:
+            fn = getattr(src, name, None)
+            if fn is not None:
+                setattr(Tensor, name, fn)
+                break
+
+    # creation-style helpers as methods
+    Tensor.zeros_like = lambda self, dtype=None: creation.zeros_like(self, dtype)
+    Tensor.ones_like = lambda self, dtype=None: creation.ones_like(self, dtype)
+    Tensor.fill_ = _fill_
+
+    # python operators
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(s, o)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__mod__ = lambda s, o: math.mod(s, o)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__invert__ = lambda s: math.logical_not(s)
+    Tensor.__eq__ = lambda s, o: math.equal(s, o)
+    Tensor.__ne__ = lambda s, o: math.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: math.less_than(s, o)
+    Tensor.__le__ = lambda s, o: math.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: math.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: math.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: math.logical_and(s, o)
+    Tensor.__or__ = lambda s, o: math.logical_or(s, o)
+    Tensor.__xor__ = lambda s, o: math.logical_xor(s, o)
+
+    # in-place arithmetic (functional rebind underneath)
+    Tensor.add_ = lambda s, o: s._rebind(math.add(s, o))
+    Tensor.subtract_ = lambda s, o: s._rebind(math.subtract(s, o))
+    Tensor.multiply_ = lambda s, o: s._rebind(math.multiply(s, o))
+    Tensor.divide_ = lambda s, o: s._rebind(math.divide(s, o))
+    Tensor.scale_ = lambda s, *a, **k: s._rebind(math.scale(s, *a, **k))
+    Tensor.clip_ = lambda s, *a, **k: s._rebind(math.clip(s, *a, **k))
+    Tensor.exp_ = lambda s: s._rebind(math.exp(s))
+    Tensor.sqrt_ = lambda s: s._rebind(math.sqrt(s))
+    Tensor.zero_ = lambda s: _fill_(s, 0)
+
+    Tensor.__iadd__ = lambda s, o: s._rebind(math.add(s, o))
+    Tensor.__isub__ = lambda s, o: s._rebind(math.subtract(s, o))
+    Tensor.__imul__ = lambda s, o: s._rebind(math.multiply(s, o))
+    Tensor.__itruediv__ = lambda s, o: s._rebind(math.divide(s, o))
+
+    # transpose property
+    Tensor.T = property(lambda s: manipulation.t(s))
+
+
+def _fill_(t, v):
+    import jax.numpy as jnp
+    t._data = jnp.full_like(t._data, v)
+    t._node = None
+    return t
+
+
+_attach_methods()

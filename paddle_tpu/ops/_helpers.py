@@ -1,0 +1,22 @@
+"""Op-definition helpers: wrap pure jax-level functions into Tensor ops."""
+from __future__ import annotations
+
+import functools
+
+from ..tensor import Tensor, apply_op, to_jax
+
+
+def defop(fn=None, *, name=None):
+    """Decorator: `fn` is written against raw jax values; the wrapper accepts
+    Tensors anywhere, routes through apply_op (autograd tape), and tolerates
+    the reference API's trailing `name=` kwarg."""
+    def deco(f):
+        opname = name or f.__name__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            kwargs.pop('name', None)
+            return apply_op(f, *args, _name=opname, **kwargs)
+        wrapper.__wrapped_jax__ = f
+        return wrapper
+    return deco(fn) if fn is not None else deco

@@ -1,0 +1,252 @@
+"""Creation ops (upstream: paddle/tensor/creation.py, phi full/empty kernels).
+
+All creators produce leaf Tensors (no tape nodes). Random creators draw from
+the global stateless-PRNG generator so they are reproducible and trace-safe.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..dtype import convert_dtype, int64 as INT64
+from ..tensor import Tensor, Parameter, to_jax
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default or framework.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(to_jax(s)) if not isinstance(s, (int, np.integer)) else int(s)
+                 for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        val = data.value
+    else:
+        val = data
+    if dtype is not None:
+        arr = jnp.asarray(val, _dt(dtype))
+    else:
+        # Match the reference default: python floats → default float dtype.
+        if isinstance(val, (bool, np.bool_)):
+            arr = jnp.asarray(val)
+        elif isinstance(val, (int, np.integer)):
+            arr = jnp.asarray(val, INT64 if abs(int(val)) > 2**31 - 1 else jnp.int32)
+        elif isinstance(val, float):
+            arr = jnp.asarray(val, framework.get_default_dtype())
+        else:
+            a = np.asarray(val)
+            if a.dtype == np.float64:
+                a = a.astype(np.dtype(framework.get_default_dtype()))
+            arr = jnp.asarray(a)
+    if place is not None and hasattr(place, 'jax_device'):
+        arr = jax.device_put(arr, place.jax_device())
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = to_jax(fill_value)
+    if dtype is None and isinstance(fill_value, (bool, int)) \
+            and not isinstance(fill_value, np.inexact):
+        return Tensor(jnp.full(_shape(shape), fv))
+    return Tensor(jnp.full(_shape(shape), fv, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(to_jax(x), dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(to_jax(x), dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(to_jax(x), to_jax(fill_value),
+                                dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = to_jax(start), to_jax(end), to_jax(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py = (start, end, step)
+        dtype = (framework.get_default_dtype()
+                 if any(isinstance(v, float) for v in py) else INT64)
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(to_jax(start), to_jax(stop), int(to_jax(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(to_jax(start), to_jax(stop), int(to_jax(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    v = to_jax(x)
+    if v.ndim == 1 and padding_value != 0:
+        n = v.shape[0] + abs(offset)
+        base = jnp.full((n, n), to_jax(padding_value), v.dtype)
+        d = jnp.diag(v, k=offset)
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        return Tensor(jnp.where(mask, d, base))
+    return Tensor(jnp.diag(v, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(to_jax(x), k=offset))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[to_jax(a) for a in args], indexing='ij')
+    return [Tensor(o) for o in outs]
+
+
+def tril(x, diagonal=0, name=None):
+    from ._helpers import defop
+    return defop(lambda v: jnp.tril(v, k=diagonal), name='tril')(x)
+
+
+def triu(x, diagonal=0, name=None):
+    from ._helpers import defop
+    return defop(lambda v: jnp.triu(v, k=diagonal), name='triu')(x)
+
+
+def tril_indices(row, col, offset=0, dtype='int64'):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt(dtype)))
+
+
+def triu_indices(row, col, offset=0, dtype='int64'):
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt(dtype)))
+
+
+def assign(x, output=None):
+    val = jnp.asarray(to_jax(x))
+    if output is not None:
+        output._data = val
+        output._node = None
+        return output
+    return Tensor(val)
+
+
+def clone(x):
+    return x.clone() if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def numel(x):
+    return Tensor(jnp.asarray(int(np.prod(np.shape(to_jax(x)))), INT64))
+
+
+# -- random creators -------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    k = framework.next_rng_key()
+    return Tensor(jax.random.uniform(k, _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    k = framework.next_rng_key()
+    return Tensor(jax.random.normal(k, _shape(shape), _dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype='int64', name=None):
+    if high is None:
+        low, high = 0, low
+    k = framework.next_rng_key()
+    return Tensor(jax.random.randint(k, _shape(shape), low, high, _dt(dtype)))
+
+
+def randperm(n, dtype='int64', name=None):
+    k = framework.next_rng_key()
+    return Tensor(jax.random.permutation(k, n).astype(_dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = np.broadcast_shapes(np.shape(to_jax(mean)), np.shape(to_jax(std)))
+    k = framework.next_rng_key()
+    dt = framework.get_default_dtype()
+    sample = jax.random.normal(k, _shape(shape) if shape else (), dt)
+    return Tensor(sample * jnp.asarray(to_jax(std), dt) + jnp.asarray(to_jax(mean), dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    k = jax.random.key(seed) if seed else framework.next_rng_key()
+    return Tensor(jax.random.uniform(k, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def bernoulli(x, name=None):
+    k = framework.next_rng_key()
+    p = to_jax(x)
+    return Tensor(jax.random.bernoulli(k, p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    k = framework.next_rng_key()
+    p = to_jax(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(k, logits, axis=-1,
+                                     shape=(*p.shape[:-1], num_samples))
+    else:
+        g = -jnp.log(-jnp.log(jax.random.uniform(k, p.shape)))
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(INT64))
+
+
+def create_parameter(shape, dtype=None, default_initializer=None,
+                     is_bias=False, attr=None, name=None):
+    dt = _dt(dtype)
+    if default_initializer is not None:
+        init = default_initializer(_shape(shape), dt)
+        val = to_jax(init)
+    elif is_bias:
+        val = jnp.zeros(_shape(shape), dt)
+    else:
+        # Xavier-uniform default, matching the reference's default for weights.
+        fan_in = _shape(shape)[0] if shape else 1
+        fan_out = _shape(shape)[-1] if shape else 1
+        limit = float(np.sqrt(6.0 / max(1, fan_in + fan_out)))
+        val = jax.random.uniform(framework.next_rng_key(), _shape(shape), dt,
+                                 minval=-limit, maxval=limit)
+    return Parameter(val, name=name or '')

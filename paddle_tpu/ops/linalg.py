@@ -1,0 +1,183 @@
+"""Linear algebra (upstream: paddle/phi/kernels/matmul_kernel.cu, paddle/tensor/linalg.py).
+
+matmul is THE op on TPU: it lowers to MXU systolic-array tiles. We keep it a
+single jnp.matmul call (optionally transposed via lax transpose fusion) so XLA
+picks the best tiling; bf16 inputs hit the MXU natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import defop
+from ..dtype import int64 as INT64, float64 as FLOAT64
+from ..tensor import Tensor, to_jax
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return defop(f, name='matmul')(x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return defop(lambda a, b: jnp.einsum('bij,bjk->bik', a, b), name='bmm')(x, y)
+
+
+def dot(x, y, name=None):
+    return defop(lambda a, b: jnp.sum(a * b, axis=-1), name='dot')(x, y)
+
+
+def mv(x, vec, name=None):
+    return defop(lambda a, v: a @ v, name='mv')(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return defop(lambda i, a, b: beta * i + alpha * (a @ b), name='addmm')(input, x, y)
+
+
+def einsum(equation, *operands, name=None):
+    ops = [to_jax(o) for o in operands]
+    return defop(lambda *vs: jnp.einsum(equation, *vs), name='einsum')(*operands)
+
+
+def norm(x, p='fro', axis=None, keepdim=False, name=None):
+    def f(v):
+        if axis is None and p in ('fro', 2):
+            return jnp.sqrt(jnp.sum(jnp.square(v)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == 'fro':
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax, keepdims=keepdim))
+        if p in (np.inf, 'inf', float('inf')):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p in (-np.inf, float('-inf')):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return defop(f, name='norm')(x)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(defop(lambda a, b: a - b, name='sub')(x, y), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis if axis != 9 else next(
+            (i for i, s in enumerate(a.shape) if s == 3), -1)
+        return jnp.cross(a, b, axis=ax)
+    return defop(f, name='cross')(x, y)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h.astype(INT64)
+    return defop(f, name='histogram')(input)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def f(v, w):
+        return jnp.bincount(v, weights=w, minlength=minlength,
+                            length=int(np.asarray(v).max()) + 1 if v.size else minlength)
+    # eager-only (dynamic output length)
+    v = to_jax(x)
+    w = to_jax(weights) if weights is not None else None
+    length = max(int(np.asarray(v).max(initial=-1)) + 1, minlength)
+    return Tensor(jnp.bincount(v, weights=w, length=length))
+
+
+def matrix_power(x, n, name=None):
+    return defop(lambda v: jnp.linalg.matrix_power(v, n), name='matrix_power')(x)
+
+
+# namespace `paddle.linalg.*` (upstream: python/paddle/tensor/linalg.py)
+
+cholesky = defop(lambda x, upper=False:
+                 jnp.linalg.cholesky(x).swapaxes(-1, -2).conj() if upper
+                 else jnp.linalg.cholesky(x), name='cholesky')
+inv = defop(lambda x: jnp.linalg.inv(x), name='inv')
+det = defop(lambda x: jnp.linalg.det(x), name='det')
+slogdet = defop(lambda x: tuple(jnp.linalg.slogdet(x)), name='slogdet')
+pinv = defop(lambda x, rcond=1e-15, hermitian=False:
+             jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian), name='pinv')
+solve = defop(lambda a, b: jnp.linalg.solve(a, b), name='solve')
+lstsq = defop(lambda a, b, rcond=None: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+              name='lstsq')
+matrix_rank = defop(lambda x, tol=None, hermitian=False:
+                    jnp.linalg.matrix_rank(x, rtol=tol), name='matrix_rank')
+
+
+def qr(x, mode='reduced', name=None):
+    out = defop(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), name='qr')(x)
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    def f(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, vh
+    return defop(f, name='svd')(x)
+
+
+def eigh(x, UPLO='L', name=None):
+    return defop(lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)),
+                 name='eigh')(x)
+
+
+def eigvalsh(x, UPLO='L', name=None):
+    return defop(lambda v: jnp.linalg.eigvalsh(v), name='eigvalsh')(x)
+
+
+def eig(x, name=None):
+    # general eig is CPU-only in XLA; compute on host
+    v = np.asarray(to_jax(x))
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigvals(x, name=None):
+    v = np.asarray(to_jax(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return defop(lambda a, b: jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular), name='triangular_solve')(x, y)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return defop(lambda b, l: jax.scipy.linalg.cho_solve((l, not upper), b),
+                 name='cholesky_solve')(x, y)
+
+
+def cond(x, p=None, name=None):
+    return defop(lambda v: jnp.linalg.cond(v, p=p), name='cond')(x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def f(v, fw, aw):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+    return defop(f, name='cov')(x, fweights, aweights)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return defop(lambda v: jnp.corrcoef(v, rowvar=rowvar), name='corrcoef')(x)
+
+
+def multi_dot(x, name=None):
+    return defop(lambda vs: jnp.linalg.multi_dot(vs), name='multi_dot')(list(x))

@@ -1,0 +1,364 @@
+"""Shape/layout manipulation ops (upstream: paddle/tensor/manipulation.py).
+
+Paddle-specific semantics preserved: reshape's 0 = "copy input dim",
+expand's -1 = "keep dim", gather = take-along-axis-0 rows, etc.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import defop
+from ..tensor import Tensor, to_jax
+
+
+def _norm_shape(shape, in_shape):
+    shape = [int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape]
+    return [in_shape[i] if s == 0 else s for i, s in enumerate(shape)]
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape.value)]
+    return defop(lambda v: v.reshape(_norm_shape(shape, v.shape)),
+                 name='reshape')(x)
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        a = start_axis % nd if nd else 0
+        b = stop_axis % nd if nd else 0
+        new = list(v.shape[:a]) + [-1] + list(v.shape[b + 1:])
+        return v.reshape(new)
+    return defop(f, name='flatten')(x)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return defop(f, name='squeeze')(x)
+
+
+def unsqueeze(x, axis, name=None):
+    def f(v):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        final = v.ndim + len(axes)
+        out = v
+        for a in sorted(int(a) % final for a in axes):
+            out = jnp.expand_dims(out, a)
+        return out
+    return defop(f, name='unsqueeze')(x)
+
+
+def transpose(x, perm, name=None):
+    return defop(lambda v: jnp.transpose(v, [int(p) for p in perm]),
+                 name='transpose')(x)
+
+
+def t(x, name=None):
+    return defop(lambda v: v.T if v.ndim >= 2 else v, name='t')(x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return defop(lambda v: jnp.moveaxis(v, source, destination),
+                 name='moveaxis')(x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return defop(lambda v: jnp.swapaxes(v, axis1, axis2), name='swapaxes')(x)
+
+
+def concat(x, axis=0, name=None):
+    return defop(lambda vs, ax: jnp.concatenate(vs, axis=int(to_jax(ax)) if not isinstance(ax, int) else ax),
+                 name='concat')(list(x), axis)
+
+
+def stack(x, axis=0, name=None):
+    return defop(lambda vs: jnp.stack(vs, axis=axis), name='stack')(list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    def f(v):
+        ax = int(axis) % v.ndim
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=ax))
+        secs = list(num_or_sections)
+        total = v.shape[ax]
+        known = builtins.sum(s for s in secs if s != -1)
+        secs = [s if s != -1 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1]
+        return tuple(jnp.split(v, idx, axis=ax))
+    return list(defop(f, name='split')(x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    def f(v):
+        ax = int(axis) % v.ndim
+        return tuple(jnp.squeeze(s, ax) for s in jnp.split(v, v.shape[ax], axis=ax))
+    return list(defop(f, name='unbind')(x))
+
+
+def tile(x, repeat_times, name=None):
+    rt = [int(r) for r in (repeat_times if isinstance(repeat_times, (list, tuple))
+                           else [repeat_times])]
+    return defop(lambda v: jnp.tile(v, rt), name='tile')(x)
+
+
+def expand(x, shape, name=None):
+    def f(v):
+        tgt = [int(s) for s in shape]
+        # -1 keeps the input dim (right-aligned, reference semantics)
+        offset = len(tgt) - v.ndim
+        out = [v.shape[i - offset] if s == -1 else s for i, s in enumerate(tgt)]
+        return jnp.broadcast_to(v, out)
+    return defop(f, name='expand')(x)
+
+
+def expand_as(x, y, name=None):
+    return defop(lambda v, w: jnp.broadcast_to(v, w.shape), name='expand_as')(x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return defop(lambda v: jnp.broadcast_to(v, [int(s) for s in shape]),
+                 name='broadcast_to')(x)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = defop(lambda vs: tuple(jnp.broadcast_arrays(*vs)),
+                 name='broadcast_tensors')(list(inputs))
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return defop(lambda v: jnp.flip(v, axis=tuple(axes)), name='flip')(x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return defop(lambda v: jnp.roll(v, shifts, axis=axis), name='roll')(x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return defop(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), name='rot90')(x)
+
+
+def gather(x, index, axis=0, name=None):
+    def f(v, i, ax):
+        ax = int(to_jax(ax)) if not isinstance(ax, int) else ax
+        return jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=ax)
+    return defop(f, name='gather')(x, index, axis)
+
+
+def gather_nd(x, index, name=None):
+    def f(v, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v[idx]
+    return defop(f, name='gather_nd')(x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        # reference semantics: zero target rows then accumulate
+        zeroed = v.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return defop(f, name='scatter')(x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v.at[idx].add(u)
+    return defop(f, name='scatter_nd_add')(x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(i, u):
+        base = jnp.zeros([int(s) for s in shape], u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return base.at[idx].add(u)
+    return defop(f, name='scatter_nd')(index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return defop(lambda v, i: jnp.take(v, i, axis=int(axis)),
+                 name='index_select')(x, index)
+
+
+def index_sample(x, index, name=None):
+    return defop(lambda v, i: jnp.take_along_axis(v, i, axis=1),
+                 name='index_sample')(x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(v, i, u):
+        sl = [slice(None)] * v.ndim
+        vm = jnp.moveaxis(v, int(axis), 0)
+        out = vm.at[i].add(jnp.moveaxis(u, int(axis), 0))
+        return jnp.moveaxis(out, 0, int(axis))
+    return defop(f, name='index_add')(x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(v, idx_list, u):
+        idx = tuple(idx_list)
+        return v.at[idx].add(u) if accumulate else v.at[idx].set(u)
+    return defop(f, name='index_put')(x, list(indices), value)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def f(v, i):
+        if broadcast:
+            tgt = list(v.shape)
+            tgt[axis] = i.shape[axis]
+            i = jnp.broadcast_to(i, tgt)
+        return jnp.take_along_axis(v, i, axis=axis)
+    return defop(f, name='take_along_axis')(arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce='assign', name=None):
+    def f(v, i, u):
+        u = jnp.broadcast_to(jnp.asarray(u, v.dtype), i.shape)
+        dims = list(range(v.ndim))
+        dims.remove(axis % v.ndim)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing='ij')
+        full_idx = []
+        k = 0
+        for d in range(v.ndim):
+            if d == axis % v.ndim:
+                full_idx.append(i)
+            else:
+                full_idx.append(grids[d])
+        if reduce == 'assign':
+            return v.at[tuple(full_idx)].set(u)
+        if reduce == 'add':
+            return v.at[tuple(full_idx)].add(u)
+        if reduce in ('mul', 'multiply'):
+            return v.at[tuple(full_idx)].multiply(u)
+        raise ValueError(f'unknown reduce {reduce!r}')
+    return defop(f, name='put_along_axis')(arr, indices, values)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def f(v, r):
+        return jnp.repeat(v, r, axis=axis)
+    return defop(f, name='repeat_interleave')(x, repeats)
+
+
+def pad(x, pad, mode='constant', value=0.0, data_format='NCHW', name=None):
+    def f(v, p):
+        p = [int(q) for q in (np.asarray(to_jax(p)).tolist()
+                              if not isinstance(p, (list, tuple)) else p)]
+        nd = v.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # reference layout: pads innermost dims, [left, right, top, bottom, ...]
+            npairs = len(p) // 2
+            width = [(0, 0)] * nd
+            if mode == 'constant' and len(p) == 4 and nd == 4 and data_format == 'NCHW':
+                width[2] = (p[2], p[3])
+                width[3] = (p[0], p[1])
+            elif len(p) == 4 and nd == 4 and data_format == 'NHWC':
+                width[1] = (p[2], p[3])
+                width[2] = (p[0], p[1])
+            else:
+                for k in range(npairs):
+                    width[nd - 1 - k] = (p[2 * k], p[2 * k + 1])
+        jmode = {'constant': 'constant', 'reflect': 'reflect',
+                 'replicate': 'edge', 'circular': 'wrap'}[mode]
+        if jmode == 'constant':
+            return jnp.pad(v, width, mode='constant',
+                           constant_values=jnp.asarray(value, v.dtype))
+        return jnp.pad(v, width, mode=jmode)
+    return defop(f, name='pad')(x, pad)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return defop(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+                 name='diagonal')(x)
+
+
+def kron(x, y, name=None):
+    return defop(lambda a, b: jnp.kron(a, b), name='kron')(x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    def f(v, pre, app):
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return defop(f, name='diff')(x, prepend, append)
+
+
+def as_complex(x, name=None):
+    return defop(lambda v: jax.lax.complex(v[..., 0], v[..., 1]),
+                 name='as_complex')(x)
+
+
+def as_real(x, name=None):
+    return defop(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                 name='as_real')(x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def slice(x, axes, starts, ends, name=None):
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[int(ax)] = builtins.slice(int(to_jax(s)), int(to_jax(e)))
+        return v[tuple(idx)]
+    return defop(f, name='slice')(x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
+        return v[tuple(idx)]
+    return defop(f, name='strided_slice')(x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def f(v):
+        offs = [int(o) for o in (offsets or [0] * v.ndim)]
+        shp = [int(s) if int(s) != -1 else v.shape[i] - offs[i]
+               for i, s in enumerate(shape or v.shape)]
+        idx = tuple(builtins.slice(o, o + s) for o, s in zip(offs, shp))
+        return v[idx]
+    return defop(f, name='crop')(x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        size = index_num // nshards
+        lo = shard_id * size
+        ok = (v >= lo) & (v < lo + size)
+        return jnp.where(ok, v - lo, ignore_value)
+    return defop(f, name='shard_index')(input)

@@ -1,0 +1,157 @@
+"""Elementwise math / comparison / logical ops.
+
+TPU-native rebuild of the reference's elementwise phi kernels
+(upstream: paddle/phi/kernels/elementwise_*, activation_kernel.cu).
+Each op is a pure jnp function; XLA fuses chains of these into the
+surrounding matmuls, so no hand-written fusion is needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import defop
+
+# -- binary elementwise ----------------------------------------------------
+
+add = defop(lambda x, y: jnp.add(x, y), name='add')
+subtract = defop(lambda x, y: jnp.subtract(x, y), name='subtract')
+multiply = defop(lambda x, y: jnp.multiply(x, y), name='multiply')
+divide = defop(lambda x, y: jnp.true_divide(x, y), name='divide')
+floor_divide = defop(lambda x, y: jnp.floor_divide(x, y), name='floor_divide')
+mod = defop(lambda x, y: jnp.mod(x, y), name='mod')
+remainder = mod
+floor_mod = mod
+pow = defop(lambda x, y: jnp.power(x, y), name='pow')
+maximum = defop(lambda x, y: jnp.maximum(x, y), name='maximum')
+minimum = defop(lambda x, y: jnp.minimum(x, y), name='minimum')
+fmax = defop(lambda x, y: jnp.fmax(x, y), name='fmax')
+fmin = defop(lambda x, y: jnp.fmin(x, y), name='fmin')
+atan2 = defop(lambda x, y: jnp.arctan2(x, y), name='atan2')
+hypot = defop(lambda x, y: jnp.hypot(x, y), name='hypot')
+copysign = defop(lambda x, y: jnp.copysign(x, y), name='copysign')
+nextafter = defop(lambda x, y: jnp.nextafter(x, y), name='nextafter')
+ldexp = defop(lambda x, y: jnp.ldexp(x, y), name='ldexp')
+heaviside = defop(lambda x, y: jnp.heaviside(x, y), name='heaviside')
+gcd = defop(lambda x, y: jnp.gcd(x, y), name='gcd')
+lcm = defop(lambda x, y: jnp.lcm(x, y), name='lcm')
+inner = defop(lambda x, y: jnp.inner(x, y), name='inner')
+outer = defop(lambda x, y: jnp.outer(x, y), name='outer')
+logaddexp = defop(lambda x, y: jnp.logaddexp(x, y), name='logaddexp')
+
+# -- unary elementwise -----------------------------------------------------
+
+exp = defop(lambda x: jnp.exp(x), name='exp')
+expm1 = defop(lambda x: jnp.expm1(x), name='expm1')
+log = defop(lambda x: jnp.log(x), name='log')
+log2 = defop(lambda x: jnp.log2(x), name='log2')
+log10 = defop(lambda x: jnp.log10(x), name='log10')
+log1p = defop(lambda x: jnp.log1p(x), name='log1p')
+sqrt = defop(lambda x: jnp.sqrt(x), name='sqrt')
+rsqrt = defop(lambda x: jax.lax.rsqrt(x), name='rsqrt')
+abs = defop(lambda x: jnp.abs(x), name='abs')
+neg = defop(lambda x: jnp.negative(x), name='neg')
+sign = defop(lambda x: jnp.sign(x), name='sign')
+sin = defop(lambda x: jnp.sin(x), name='sin')
+cos = defop(lambda x: jnp.cos(x), name='cos')
+tan = defop(lambda x: jnp.tan(x), name='tan')
+asin = defop(lambda x: jnp.arcsin(x), name='asin')
+acos = defop(lambda x: jnp.arccos(x), name='acos')
+atan = defop(lambda x: jnp.arctan(x), name='atan')
+sinh = defop(lambda x: jnp.sinh(x), name='sinh')
+cosh = defop(lambda x: jnp.cosh(x), name='cosh')
+tanh = defop(lambda x: jnp.tanh(x), name='tanh')
+asinh = defop(lambda x: jnp.arcsinh(x), name='asinh')
+acosh = defop(lambda x: jnp.arccosh(x), name='acosh')
+atanh = defop(lambda x: jnp.arctanh(x), name='atanh')
+erf = defop(lambda x: jax.lax.erf(x), name='erf')
+erfinv = defop(lambda x: jax.lax.erf_inv(x), name='erfinv')
+floor = defop(lambda x: jnp.floor(x), name='floor')
+ceil = defop(lambda x: jnp.ceil(x), name='ceil')
+round = defop(lambda x: jnp.round(x), name='round')
+trunc = defop(lambda x: jnp.trunc(x), name='trunc')
+frac = defop(lambda x: x - jnp.trunc(x), name='frac')
+reciprocal = defop(lambda x: jnp.reciprocal(x), name='reciprocal')
+square = defop(lambda x: jnp.square(x), name='square')
+digamma = defop(lambda x: jax.lax.digamma(x), name='digamma')
+lgamma = defop(lambda x: jax.lax.lgamma(x), name='lgamma')
+i0 = defop(lambda x: jax.scipy.special.i0(x), name='i0')
+i1 = defop(lambda x: jax.scipy.special.i1(x), name='i1')
+sigmoid = defop(lambda x: jax.nn.sigmoid(x), name='sigmoid')
+logit = defop(lambda x, eps=None:
+              jax.scipy.special.logit(jnp.clip(x, eps, 1 - eps) if eps else x),
+              name='logit')
+deg2rad = defop(lambda x: jnp.deg2rad(x), name='deg2rad')
+rad2deg = defop(lambda x: jnp.rad2deg(x), name='rad2deg')
+angle = defop(lambda x: jnp.angle(x), name='angle')
+conj = defop(lambda x: jnp.conj(x), name='conj')
+real = defop(lambda x: jnp.real(x), name='real')
+imag = defop(lambda x: jnp.imag(x), name='imag')
+nan_to_num = defop(lambda x, nan=0.0, posinf=None, neginf=None:
+                   jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf),
+                   name='nan_to_num')
+
+
+def clip(x, min=None, max=None, name=None):
+    return defop(lambda v, lo, hi: jnp.clip(v, lo, hi), name='clip')(x, min, max)
+
+
+def lerp(x, y, weight, name=None):
+    return defop(lambda a, b, w: a + w * (b - a), name='lerp')(x, y, weight)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return defop(lambda v: scale_b * jnp.tanh(scale_a * v), name='stanh')(x)
+
+
+def rsqrt_(x):
+    return x._rebind(rsqrt(x))
+
+
+# -- scale / increment (reference: scale_kernel) ---------------------------
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(v, s, b):
+        s = jnp.asarray(s, v.dtype)
+        b = jnp.asarray(b, v.dtype)
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+    return defop(f, name='scale')(x, scale, bias)
+
+
+def increment(x, value=1.0, name=None):
+    return defop(lambda v: v + jnp.asarray(value, v.dtype), name='increment')(x)
+
+
+# -- comparisons (non-differentiable outputs) ------------------------------
+
+equal = defop(lambda x, y: jnp.equal(x, y), name='equal')
+not_equal = defop(lambda x, y: jnp.not_equal(x, y), name='not_equal')
+greater_than = defop(lambda x, y: jnp.greater(x, y), name='greater_than')
+greater_equal = defop(lambda x, y: jnp.greater_equal(x, y), name='greater_equal')
+less_than = defop(lambda x, y: jnp.less(x, y), name='less_than')
+less_equal = defop(lambda x, y: jnp.less_equal(x, y), name='less_equal')
+equal_all = defop(lambda x, y: jnp.array_equal(x, y), name='equal_all')
+allclose = defop(lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+                 jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 name='allclose')
+isclose = defop(lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+                jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                name='isclose')
+
+logical_and = defop(lambda x, y: jnp.logical_and(x, y), name='logical_and')
+logical_or = defop(lambda x, y: jnp.logical_or(x, y), name='logical_or')
+logical_xor = defop(lambda x, y: jnp.logical_xor(x, y), name='logical_xor')
+logical_not = defop(lambda x: jnp.logical_not(x), name='logical_not')
+
+bitwise_and = defop(lambda x, y: jnp.bitwise_and(x, y), name='bitwise_and')
+bitwise_or = defop(lambda x, y: jnp.bitwise_or(x, y), name='bitwise_or')
+bitwise_xor = defop(lambda x, y: jnp.bitwise_xor(x, y), name='bitwise_xor')
+bitwise_not = defop(lambda x: jnp.bitwise_not(x), name='bitwise_not')
+bitwise_left_shift = defop(lambda x, y: jnp.left_shift(x, y), name='bitwise_left_shift')
+bitwise_right_shift = defop(lambda x, y: jnp.right_shift(x, y), name='bitwise_right_shift')
+
+isnan = defop(lambda x: jnp.isnan(x), name='isnan')
+isinf = defop(lambda x: jnp.isinf(x), name='isinf')
+isfinite = defop(lambda x: jnp.isfinite(x), name='isfinite')
+isreal = defop(lambda x: jnp.isreal(x), name='isreal')

@@ -1,0 +1,126 @@
+"""Reduction ops (upstream: paddle/phi/kernels/reduce_*).
+
+Paddle semantics: `axis=None` reduces all dims; `keepdim=False` default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import defop
+from ..dtype import convert_dtype, int64 as INT64
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _red(jfn, name):
+    def f(x, axis=None, keepdim=False, dtype=None):
+        out = jfn(x, axis=_ax(axis), keepdims=keepdim)
+        if dtype is not None:
+            out = out.astype(convert_dtype(dtype))
+        return out
+    return defop(f, name=name)
+
+
+sum = _red(jnp.sum, 'sum')
+mean = _red(jnp.mean, 'mean')
+prod = _red(jnp.prod, 'prod')
+max = _red(jnp.max, 'max')
+min = _red(jnp.min, 'min')
+amax = max
+amin = min
+all = _red(jnp.all, 'all')
+any = _red(jnp.any, 'any')
+nansum = _red(jnp.nansum, 'nansum')
+nanmean = _red(jnp.nanmean, 'nanmean')
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return defop(lambda v: jnp.std(v, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), name='std')(x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return defop(lambda v: jnp.var(v, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), name='var')(x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return defop(lambda v: jnp.median(v, axis=_ax(axis), keepdims=keepdim),
+                 name='median')(x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return defop(lambda v: jnp.quantile(v, jnp.asarray(q), axis=_ax(axis),
+                                        keepdims=keepdim), name='quantile')(x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return defop(lambda v: jax.scipy.special.logsumexp(
+        v, axis=_ax(axis), keepdims=keepdim), name='logsumexp')(x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            out = jnp.cumsum(v)
+        else:
+            out = jnp.cumsum(v, axis=int(axis))
+        if dtype is not None:
+            out = out.astype(convert_dtype(dtype))
+        return out
+    return defop(f, name='cumsum')(x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def f(v):
+        if dim is None:
+            out = jnp.cumprod(v.reshape(-1))
+        else:
+            out = jnp.cumprod(v, axis=int(dim))
+        if dtype is not None:
+            out = out.astype(convert_dtype(dtype))
+        return out
+    return defop(f, name='cumprod')(x)
+
+
+def cummax(x, axis=None, dtype='int64', name=None):
+    def f(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        idx = jnp.argmax(
+            jnp.cumsum(jnp.asarray(vv == vals, jnp.int32), axis=ax) * 0 + (vv == vals),
+            axis=ax)
+        # indices: last position achieving the running max
+        n = vv.shape[ax]
+        pos = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
+        achieved = jnp.where(vv == vals, pos, -1)
+        inds = jax.lax.associative_scan(jnp.maximum, achieved, axis=ax)
+        return vals, inds.astype(convert_dtype(dtype))
+    return defop(f, name='cummax')(x)
+
+
+def cummin(x, axis=None, dtype='int64', name=None):
+    def f(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=ax)
+        n = vv.shape[ax]
+        pos = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
+        achieved = jnp.where(vv == vals, pos, -1)
+        inds = jax.lax.associative_scan(jnp.maximum, achieved, axis=ax)
+        return vals, inds.astype(convert_dtype(dtype))
+    return defop(f, name='cummin')(x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return defop(lambda v: jnp.count_nonzero(v, axis=_ax(axis), keepdims=keepdim)
+                 .astype(INT64), name='count_nonzero')(x)

@@ -1,0 +1,167 @@
+"""Search / sort / selection ops (upstream: paddle/tensor/search.py, top_k kernels).
+
+topk/sort lower to XLA's sort HLO (bitonic on TPU). Dynamic-shape ops
+(nonzero, masked_select, unique) are eager-only by nature — under jit the
+reference has the same restriction via DyGraph fallback.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import defop
+from ..dtype import convert_dtype, int64 as INT64
+from ..tensor import Tensor, to_jax
+
+
+def argmax(x, axis=None, keepdim=False, dtype='int64', name=None):
+    def f(v):
+        out = jnp.argmax(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(convert_dtype(dtype))
+    return defop(f, name='argmax')(x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype='int64', name=None):
+    def f(v):
+        out = jnp.argmin(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(convert_dtype(dtype))
+    return defop(f, name='argmin')(x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    def f(v, kk):
+        kk = int(to_jax(kk))
+        ax = int(axis) % v.ndim
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vv, kk)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(INT64))
+    return defop(f, name='topk')(x, k)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    return defop(f, name='sort')(x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=True)
+        return (jnp.flip(idx, axis=axis) if descending else idx).astype(INT64)
+    return defop(f, name='argsort')(x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return defop(lambda c, a, b: jnp.where(c, a, b), name='where')(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(to_jax(x))  # dynamic shape: eager/host only
+    idx = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, INT64)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1), INT64))
+
+
+def masked_select(x, mask, name=None):
+    v = np.asarray(to_jax(x))
+    m = np.asarray(to_jax(mask))
+    return Tensor(jnp.asarray(v[np.broadcast_to(m, v.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    return defop(lambda v, m, val: jnp.where(m, jnp.asarray(to_jax(val), v.dtype), v),
+                 name='masked_fill')(x, mask, value)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype='int64', name=None):
+    v = np.asarray(to_jax(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(res[0]))]
+    i = 1
+    if return_index:
+        out.append(Tensor(jnp.asarray(res[i], INT64))); i += 1
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(res[i].reshape(-1), INT64))); i += 1
+    if return_counts:
+        out.append(Tensor(jnp.asarray(res[i], INT64))); i += 1
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       name=None):
+    v = np.asarray(to_jax(x)).reshape(-1) if axis is None else np.asarray(to_jax(x))
+    keep = np.concatenate([[True], v[1:] != v[:-1]]) if v.size else np.array([], bool)
+    vals = v[keep]
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv, INT64)))
+    if return_counts:
+        pos = np.flatnonzero(keep)
+        cnt = np.diff(np.append(pos, v.size))
+        outs.append(Tensor(jnp.asarray(cnt, INT64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(s, v):
+        side = 'right' if right else 'left'
+        out = jnp.searchsorted(s, v, side=side)
+        return out.astype(jnp.int32 if out_int32 else INT64)
+    return defop(f, name='searchsorted')(sorted_sequence, values)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        ax = int(axis) % v.ndim
+        vals = jnp.sort(v, axis=ax)
+        idxs = jnp.argsort(v, axis=ax, stable=True)
+        taken_v = jnp.take(vals, k - 1, axis=ax)
+        taken_i = jnp.take(idxs, k - 1, axis=ax)
+        if keepdim:
+            taken_v = jnp.expand_dims(taken_v, ax)
+            taken_i = jnp.expand_dims(taken_i, ax)
+        return taken_v, taken_i.astype(INT64)
+    return defop(f, name='kthvalue')(x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(to_jax(x))
+    ax = int(axis) % v.ndim
+    sv = np.sort(v, axis=ax)
+
+    def pick(a):
+        vals, counts = np.unique(a, return_counts=True)
+        m = vals[np.argmax(counts)]
+        idx = np.max(np.nonzero(a == m)[0]) if (a == m).any() else 0
+        return m, idx
+    out_v = np.apply_along_axis(lambda a: pick(a)[0], ax, v)
+    out_i = np.apply_along_axis(lambda a: pick(a)[1], ax, v)
+    if keepdim:
+        out_v, out_i = np.expand_dims(out_v, ax), np.expand_dims(out_i, ax)
+    return Tensor(jnp.asarray(out_v)), Tensor(jnp.asarray(out_i, INT64))
+
+
+def is_empty(x):
+    return Tensor(jnp.asarray(int(np.prod(np.shape(to_jax(x)))) == 0))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return defop(lambda a, b: jnp.isin(a, b, invert=invert), name='isin')(x, test_x)

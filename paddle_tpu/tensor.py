@@ -1,0 +1,345 @@
+"""Tensor: the user-facing array type, backed by jax.Array.
+
+TPU-native analogue of the reference's DenseTensor + eager Tensor
+(upstream: paddle/phi/core/dense_tensor.h, python/paddle/tensor/).
+Immutable jax arrays underneath; "in-place" APIs rebind the handle.
+Every op flows through `apply_op`, which runs the pure jax function and,
+when gradients are required, records a jax.vjp closure on the tape.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd, framework
+from .dtype import convert_dtype, dtype_name
+
+_tree = jax.tree_util
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class Tensor:
+    __slots__ = ('_data', 'stop_gradient', 'grad', '_node', '_leaf_index',
+                 'name', 'persistable', '__weakref__')
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = '',
+                 _node=None, _leaf_index: int = 0):
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = _node
+        self._leaf_index = _leaf_index
+        self.name = name
+        self.persistable = False
+
+    # -- raw value ---------------------------------------------------------
+    @property
+    def value(self):
+        return self._data
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    def numel(self):
+        return self.size
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._data.devices())[0]
+            plat = getattr(dev, 'platform', 'cpu')
+            kind = 'tpu' if plat in ('tpu', 'axon') else plat
+            cls = framework.TPUPlace if kind == 'tpu' else framework.CPUPlace
+            return cls(getattr(dev, 'id', 0))
+        except Exception:
+            return framework.get_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item() if hasattr(self._data, 'item') else np.asarray(self._data).item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError('len() of a 0-d tensor')
+        return self._data.shape[0]
+
+    def __index__(self):
+        return int(self.item())
+
+    # numpy interop (lets np.asarray(tensor) work)
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def _accumulate_grad(self, g_val):
+        if self.grad is None:
+            self.grad = Tensor(jnp.asarray(g_val, self.dtype))
+        else:
+            self.grad = Tensor(self.grad._data + jnp.asarray(g_val, self.dtype))
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return apply_op(lambda x: x + jnp.zeros((), x.dtype), self, _name='clone')
+
+    def _rebind(self, result: 'Tensor'):
+        """Adopt an op result in place (functional backing for mutating APIs)."""
+        self._data = result._data
+        self._node = result._node
+        self._leaf_index = result._leaf_index
+        if self._node is not None:
+            self.stop_gradient = False
+        return self
+
+    # -- dtype/device movement --------------------------------------------
+    def astype(self, dtype):
+        dt = convert_dtype(dtype)
+        return apply_op(lambda x: x.astype(dt), self, _name='astype')
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and (a in ('cpu', 'tpu', 'gpu') or ':' in a):
+                name, _, idx = a.partition(':')
+                name = {'gpu': 'tpu', 'xla': 'tpu'}.get(name, name)
+                place = (framework.CPUPlace if name == 'cpu' else framework.TPUPlace)(int(idx or 0))
+                out = Tensor(jax.device_put(out._data, place.jax_device()),
+                             stop_gradient=out.stop_gradient)
+            else:
+                out = out.astype(a)
+        return out
+
+    def cpu(self):
+        return self.to('cpu')
+
+    def cuda(self, *a, **k):  # reference-compat: accelerate place
+        return self.to('tpu')
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, idx):
+        return apply_op(lambda x, i: x[_unwrap_index(i)], self, _IndexBox(idx),
+                        _name='getitem')
+
+    def __setitem__(self, idx, value):
+        if isinstance(value, Tensor):
+            res = apply_op(
+                lambda x, i, v: x.at[_unwrap_index(i)].set(v.astype(x.dtype)),
+                self, _IndexBox(idx), value, _name='setitem')
+        else:
+            val = np.asarray(value)
+            res = apply_op(
+                lambda x, i: x.at[_unwrap_index(i)].set(jnp.asarray(val, x.dtype)),
+                self, _IndexBox(idx), _name='setitem')
+        self._rebind(res)
+
+    # -- printing ----------------------------------------------------------
+    def __repr__(self):
+        try:
+            vals = np.asarray(self._data)
+            body = np.array2string(vals, precision=4, threshold=40)
+        except Exception:
+            body = '<traced>'
+        return (f'Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}, '
+                f'place={self.place}, stop_gradient={self.stop_gradient},\n'
+                f'       {body})')
+
+    __str__ = __repr__
+
+    def __hash__(self):
+        return id(self)
+
+
+class Parameter(Tensor):
+    """Trainable leaf tensor (upstream: paddle/fluid/framework.py Parameter)."""
+    __slots__ = ('trainable', 'optimize_attr', 'regularizer', 'initializer_info')
+
+    def __init__(self, data, name: str = '', trainable: bool = True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {'learning_rate': 1.0}
+        self.regularizer = None
+        self.persistable = True
+
+
+class _IndexBox:
+    """Carries an arbitrary index expression through tree_flatten, exposing
+    any Tensor components inside it as differentiable-op inputs (they are
+    integer tensors, so they simply flow as non-differentiable leaves)."""
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+def _unwrap_index(box):
+    def unwrap(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (tuple,)):
+            return tuple(unwrap(x) for x in i)
+        if isinstance(i, list):
+            return jnp.asarray(i) if i and not any(
+                isinstance(x, (slice, type(None), type(Ellipsis))) for x in i
+            ) else [unwrap(x) for x in i]
+        return i
+    return unwrap(box.idx if isinstance(box, _IndexBox) else box)
+
+
+_tree.register_pytree_node(
+    _IndexBox,
+    lambda b: (tuple(_collect_tensors_in_index(b.idx)), b.idx),
+    lambda idx, kids: _IndexBox(_restore_tensors_in_index(idx, list(kids))),
+)
+
+
+def _collect_tensors_in_index(idx):
+    out = []
+
+    def walk(i):
+        if isinstance(i, Tensor):
+            out.append(i)
+        elif isinstance(i, (tuple, list)):
+            for x in i:
+                walk(x)
+    walk(idx)
+    return out
+
+
+def _restore_tensors_in_index(idx, kids):
+    def walk(i):
+        if isinstance(i, Tensor):
+            v = kids.pop(0)
+            return v if isinstance(v, Tensor) else Tensor(v)
+        if isinstance(i, tuple):
+            return tuple(walk(x) for x in i)
+        if isinstance(i, list):
+            return [walk(x) for x in i]
+        return i
+    return walk(idx)
+
+
+# ---------------------------------------------------------------------------
+# The universal op dispatcher
+# ---------------------------------------------------------------------------
+
+
+def apply_op(fn: Callable, *args, _name: str = '', **kwargs):
+    """Run pure jax `fn` over (args, kwargs), unwrapping Tensors.
+
+    Records a tape Node (with a forward-time jax.vjp) iff grad is enabled and
+    some Tensor input requires grad. Returns Tensor-wrapped outputs mirroring
+    fn's output pytree.
+    """
+    leaves, treedef = _tree.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    tensors = [leaves[i] for i in t_idx]
+    vals = [t._data for t in tensors]
+
+    def pure(*vs):
+        # Rebuild args with raw jax values in Tensor slots; fn receives raw
+        # values wherever Tensors were passed.
+        ls = list(leaves)
+        for i, v in zip(t_idx, vs):
+            ls[i] = v
+        a, k = _tree.tree_unflatten(treedef, ls)
+        return fn(*a, **k)
+
+    record = autograd.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensors)
+
+    if record:
+        out, vjp_fn = jax.vjp(pure, *vals)
+    else:
+        out = pure(*vals)
+
+    out_leaves, out_td = _tree.tree_flatten(out)
+    node = None
+    if record:
+        node = autograd.Node(
+            tensors, vjp_fn,
+            [(tuple(np.shape(l)), jnp.dtype(getattr(l, 'dtype', np.result_type(l))))
+             for l in out_leaves],
+            out_td, name=_name)
+    wrapped = [
+        Tensor(l,
+               stop_gradient=(not record) or not jnp.issubdtype(
+                   jnp.dtype(getattr(l, 'dtype', np.result_type(l))), jnp.inexact),
+               _node=node, _leaf_index=i)
+        if not isinstance(l, Tensor) else l
+        for i, l in enumerate(out_leaves)
+    ]
+    return _tree.tree_unflatten(out_td, wrapped)
+
+
+def to_jax(x):
+    """Unwrap Tensor → jax value (pass-through otherwise)."""
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(x, stop_gradient=True):
+    return Tensor(jnp.asarray(x), stop_gradient=stop_gradient)

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4).
+
+Must set env before jax initializes its backends, hence module-level.
+"""
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+# The image preloads a TPU-tunnel plugin that rewrites jax_platforms at
+# startup; override it back to cpu before the backend initializes.
+jax.config.update('jax_platforms', 'cpu')
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as paddle
+    paddle.seed(42)
+    np.random.seed(42)
+    yield

@@ -1,0 +1,135 @@
+"""DyGraph autograd: tape backward vs jax.grad ground truth."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def npt(x):
+    return np.asarray(x.numpy())
+
+
+def test_simple_chain():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x + x).sum()
+    y.backward()
+    assert np.allclose(npt(x.grad), [5.0, 7.0])  # 2x + 1
+
+
+def test_matmul_grad_vs_jax():
+    a = np.random.randn(3, 4).astype('float32')
+    b = np.random.randn(4, 2).astype('float32')
+    pa = paddle.to_tensor(a, stop_gradient=False)
+    pb = paddle.to_tensor(b, stop_gradient=False)
+    loss = paddle.matmul(pa, pb).sum()
+    loss.backward()
+    ga, gb = jax.grad(lambda x, y: (x @ y).sum(), argnums=(0, 1))(
+        jnp.asarray(a), jnp.asarray(b))
+    assert np.allclose(npt(pa.grad), ga, atol=1e-5)
+    assert np.allclose(npt(pb.grad), gb, atol=1e-5)
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    b = paddle.to_tensor([10.0, 20.0], stop_gradient=False)
+    (x + b).sum().backward()
+    assert np.allclose(npt(x.grad), np.ones((2, 2)))
+    assert np.allclose(npt(b.grad), [2.0, 2.0])  # summed over broadcast dim
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    assert np.allclose(npt(x.grad), [5.0])
+
+
+def test_reuse_in_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x  # used twice below
+    z = (y + y).sum()
+    z.backward()
+    assert np.allclose(npt(x.grad), [8.0])  # d/dx 2x^2
+
+
+def test_no_grad_blocks_tape():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_stop_gradient_cuts_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    d = y.detach()
+    z = (d * x).sum()
+    z.backward()
+    assert np.allclose(npt(x.grad), [6.0])  # only through the second factor
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([[4.0, 1.0, 3.0]], stop_gradient=False)
+    vals, idx = paddle.topk(x, 2, axis=1)
+    vals.sum().backward()
+    assert np.allclose(npt(x.grad), [[1.0, 0.0, 1.0]])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    (x[1:] * 2).sum().backward()
+    assert np.allclose(npt(x.grad), [0.0, 2.0, 2.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y.sum(), [x])
+    assert np.allclose(npt(g), [12.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_nonscalar_backward_needs_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    try:
+        y.backward()
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    assert np.allclose(npt(x.grad), [2.0, 1.0])
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()  # second time ok with retained graph from first call
+    assert np.allclose(npt(x.grad), [4.0])
+
+
+def test_deep_chain_and_mixed_ops():
+    x = paddle.to_tensor(np.linspace(0.1, 1, 8).astype('float32'),
+                         stop_gradient=False)
+    y = paddle.tanh(paddle.exp(x * 0.5) + paddle.log(x))
+    loss = (y * y).mean()
+    loss.backward()
+
+    def ref(v):
+        yy = jnp.tanh(jnp.exp(v * 0.5) + jnp.log(v))
+        return (yy * yy).mean()
+    g = jax.grad(ref)(jnp.asarray(npt(x)))
+    assert np.allclose(npt(x.grad), g, atol=1e-5)
+
+
+def test_grad_through_reshape_transpose_concat():
+    a = np.random.randn(2, 6).astype('float32')
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = paddle.reshape(x, [3, 4]).transpose([1, 0])
+    z = paddle.concat([y, y], axis=0)
+    z.sum().backward()
+    assert np.allclose(npt(x.grad), 2 * np.ones((2, 6)))

@@ -1,0 +1,222 @@
+"""Tensor surface: creation, math, manipulation, search, linalg vs numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def npt(x):
+    return np.asarray(x.numpy())
+
+
+class TestCreation:
+    def test_to_tensor_dtypes(self):
+        assert paddle.to_tensor(1.5).dtype == paddle.float32
+        assert paddle.to_tensor(3).dtype == paddle.int32
+        assert paddle.to_tensor([True]).dtype == paddle.bool
+        t = paddle.to_tensor(np.ones((2, 3)))  # f64 → default f32
+        assert t.dtype == paddle.float32
+
+    def test_basic_creators(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert npt(paddle.ones([2])).tolist() == [1.0, 1.0]
+        assert npt(paddle.full([2], 7, 'int32')).tolist() == [7, 7]
+        assert npt(paddle.arange(5)).tolist() == [0, 1, 2, 3, 4]
+        assert np.allclose(npt(paddle.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+        assert np.allclose(npt(paddle.eye(3)), np.eye(3))
+
+    def test_like_creators(self):
+        x = paddle.ones([2, 2], 'float32')
+        assert npt(paddle.zeros_like(x)).sum() == 0
+        assert npt(paddle.full_like(x, 5)).sum() == 20
+
+    def test_random_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([4])
+        paddle.seed(7)
+        b = paddle.randn([4])
+        assert np.allclose(npt(a), npt(b))
+
+    def test_tril_triu(self):
+        x = paddle.ones([3, 3])
+        assert npt(paddle.tril(x)).sum() == 6
+        assert npt(paddle.triu(x, 1)).sum() == 3
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = paddle.to_tensor([1.0, 4.0, 9.0])
+        b = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert np.allclose(npt(a + b), [2, 6, 12])
+        assert np.allclose(npt(a - b), [0, 2, 6])
+        assert np.allclose(npt(a * b), [1, 8, 27])
+        assert np.allclose(npt(a / b), [1, 2, 3])
+        assert np.allclose(npt(a ** 0.5), [1, 2, 3])
+        assert np.allclose(npt(paddle.sqrt(a)), [1, 2, 3])
+        assert np.allclose(npt(paddle.maximum(a, b)), [1, 4, 9])
+        assert np.allclose(npt(-a), [-1, -4, -9])
+        assert np.allclose(npt(abs(paddle.to_tensor([-2.0]))), [2])
+
+    def test_scalar_broadcast(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        assert np.allclose(npt(a + 1), [2, 3])
+        assert np.allclose(npt(2 * a), [2, 4])
+        assert np.allclose(npt(1 / a), [1, 0.5])
+        assert np.allclose(npt(10 - a), [9, 8])
+
+    def test_comparisons(self):
+        a = paddle.to_tensor([1, 2, 3])
+        assert npt(a > 1).tolist() == [False, True, True]
+        assert npt(paddle.equal(a, a)).all()
+
+    def test_clip_scale(self):
+        a = paddle.to_tensor([-1.0, 0.5, 2.0])
+        assert np.allclose(npt(paddle.clip(a, 0.0, 1.0)), [0, 0.5, 1])
+        assert np.allclose(npt(paddle.scale(a, 2.0, bias=1.0)), [-1, 2, 5])
+
+    def test_inplace(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        a.add_(paddle.to_tensor([1.0, 1.0]))
+        assert np.allclose(npt(a), [2, 3])
+        a += 1
+        assert np.allclose(npt(a), [3, 4])
+
+
+class TestReduction:
+    def test_reductions(self):
+        x = paddle.to_tensor(np.arange(6, dtype='float32').reshape(2, 3))
+        assert paddle.sum(x).item() == 15
+        assert np.allclose(npt(paddle.mean(x, axis=0)), [1.5, 2.5, 3.5])
+        assert paddle.max(x).item() == 5
+        assert np.allclose(npt(paddle.sum(x, axis=1, keepdim=True)),
+                           [[3], [12]])
+        assert abs(paddle.std(x).item() - np.std(np.arange(6), ddof=1)) < 1e-5
+        assert np.allclose(npt(paddle.cumsum(x, axis=1)),
+                           np.cumsum(np.arange(6).reshape(2, 3), axis=1))
+        assert abs(paddle.logsumexp(x).item()
+                   - np.log(np.exp(np.arange(6)).sum())) < 1e-4
+
+
+class TestManipulation:
+    def test_reshape_zero_copy_dim(self):
+        x = paddle.ones([2, 3, 4])
+        assert paddle.reshape(x, [0, 12]).shape == [2, 12]
+        assert paddle.reshape(x, [-1]).shape == [24]
+
+    def test_transpose_concat_split(self):
+        x = paddle.to_tensor(np.arange(6).reshape(2, 3))
+        assert paddle.transpose(x, [1, 0]).shape == [3, 2]
+        c = paddle.concat([x, x], axis=0)
+        assert c.shape == [4, 3]
+        parts = paddle.split(c, 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == [2, 3]
+        parts = paddle.split(c, [1, -1], axis=0)
+        assert parts[1].shape == [3, 3]
+
+    def test_squeeze_unsqueeze_expand(self):
+        x = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(x).shape == [3]
+        assert paddle.squeeze(x, axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(x, [0, 4]).shape == [1, 1, 3, 1, 1]
+        assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]
+        assert paddle.expand(paddle.ones([1, 3]), [4, -1]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12, dtype='float32').reshape(4, 3))
+        idx = paddle.to_tensor([0, 2])
+        g = paddle.gather(x, idx, axis=0)
+        assert np.allclose(npt(g), [[0, 1, 2], [6, 7, 8]])
+        upd = paddle.ones([2, 3])
+        s = paddle.scatter(x, idx, upd)
+        assert np.allclose(npt(s)[0], [1, 1, 1])
+        assert np.allclose(npt(s)[1], [3, 4, 5])
+
+    def test_take_along_put_along(self):
+        x = paddle.to_tensor([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        i = paddle.to_tensor([[2], [0]])
+        t = paddle.take_along_axis(x, i, axis=1, broadcast=False)
+        assert np.allclose(npt(t), [[3], [4]])
+
+    def test_tile_flip_roll_pad(self):
+        x = paddle.to_tensor([[1.0, 2.0]])
+        assert paddle.tile(x, [2, 2]).shape == [2, 4]
+        assert np.allclose(npt(paddle.flip(x, axis=1)), [[2, 1]])
+        assert np.allclose(npt(paddle.roll(x, 1, axis=1)), [[2, 1]])
+        p = paddle.pad(paddle.ones([1, 1, 2, 2]), [1, 1, 0, 0])
+        assert p.shape == [1, 1, 2, 4]
+
+    def test_getitem_setitem(self):
+        x = paddle.to_tensor(np.arange(12, dtype='float32').reshape(3, 4))
+        assert np.allclose(npt(x[1]), [4, 5, 6, 7])
+        assert np.allclose(npt(x[:, 1]), [1, 5, 9])
+        assert np.allclose(npt(x[0:2, 1:3]), [[1, 2], [5, 6]])
+        idx = paddle.to_tensor([0, 2])
+        assert np.allclose(npt(x[idx]), [[0, 1, 2, 3], [8, 9, 10, 11]])
+        y = x.clone()
+        y[0, 0] = -1.0
+        assert npt(y)[0, 0] == -1 and npt(x)[0, 0] == 0
+
+
+class TestSearchLinalg:
+    def test_matmul_variants(self):
+        a = np.random.randn(2, 3, 4).astype('float32')
+        b = np.random.randn(2, 4, 5).astype('float32')
+        pa, pb = paddle.to_tensor(a), paddle.to_tensor(b)
+        assert np.allclose(npt(paddle.matmul(pa, pb)), a @ b, atol=1e-5)
+        assert np.allclose(npt(paddle.bmm(pa, pb)), a @ b, atol=1e-5)
+        at = np.random.randn(4, 2).astype('float32')
+        assert np.allclose(
+            npt(paddle.matmul(paddle.to_tensor(at), pb[0], transpose_x=True)),
+            at.T @ b[0], atol=1e-5)
+
+    def test_einsum_norm(self):
+        a = np.random.randn(3, 4).astype('float32')
+        pa = paddle.to_tensor(a)
+        assert np.allclose(npt(paddle.einsum('ij->ji', pa)), a.T)
+        assert abs(paddle.norm(pa).item() - np.linalg.norm(a)) < 1e-4
+
+    def test_topk_sort_argmax(self):
+        x = paddle.to_tensor([3.0, 1.0, 2.0])
+        v, i = paddle.topk(x, 2)
+        assert npt(v).tolist() == [3, 2] and npt(i).tolist() == [0, 2]
+        assert npt(paddle.sort(x)).tolist() == [1, 2, 3]
+        assert npt(paddle.argsort(x)).tolist() == [1, 2, 0]
+        assert paddle.argmax(x).item() == 0
+        v, i = paddle.topk(x, 1, largest=False)
+        assert npt(v).tolist() == [1]
+
+    def test_where_unique(self):
+        x = paddle.to_tensor([1, 2, 2, 3])
+        u = paddle.unique(x)
+        assert npt(u).tolist() == [1, 2, 3]
+        w = paddle.where(x > 1, x, paddle.zeros_like(x))
+        assert npt(w).tolist() == [0, 2, 2, 3]
+
+    def test_linalg_namespace(self):
+        a = np.random.randn(4, 4).astype('float32')
+        spd = a @ a.T + 4 * np.eye(4, dtype='float32')
+        pa = paddle.to_tensor(spd)
+        l = paddle.linalg.cholesky(pa)
+        assert np.allclose(npt(l) @ npt(l).T, spd, atol=1e-3)
+        inv = paddle.linalg.inv(pa)
+        assert np.allclose(npt(inv) @ spd, np.eye(4), atol=1e-3)
+
+
+class TestTensorAPI:
+    def test_metadata(self):
+        x = paddle.ones([2, 3], 'bfloat16')
+        assert x.shape == [2, 3] and x.ndim == 2 and x.size == 6
+        assert x.dtype == paddle.bfloat16
+        assert x.numel() == 6
+
+    def test_astype_numpy_item(self):
+        x = paddle.to_tensor([1.7])
+        assert x.astype('int32').numpy()[0] == 1
+        assert abs(float(x) - 1.7) < 1e-6
+
+    def test_detach_clone(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        d = x.detach()
+        assert d.stop_gradient
+        c = x.clone()
+        assert not c.stop_gradient
