@@ -4,8 +4,17 @@ TPU-native replacement for the reference's eager autograd engine
 (upstream: paddle/fluid/eager/ + C++ grad-node graph). Instead of hand-written
 grad kernels, every op records a `jax.vjp` at forward time; backward() walks
 the tape in reverse, feeding cotangents through the stored vjp closures.
-The jitted training path (paddle_tpu.jit) bypasses the tape entirely and
-differentiates the whole step functionally with jax.grad.
+
+Design notes:
+- Node inputs are `InputRef` snapshots (target tensor + its node/leaf-index/
+  stop_gradient *at record time*), so "in-place" rebinds of the live Tensor
+  cannot sever or corrupt the recorded graph.
+- `grad(..., create_graph=True)` supports true higher-order differentiation:
+  the recorded primal closures are replayed into one pure function of the
+  requested inputs, and its vjp is evaluated *through the tape* (apply_op),
+  so the returned grads are themselves differentiable — recursively.
+- The jitted training path (paddle_tpu.jit) bypasses the tape entirely and
+  differentiates whole steps functionally with jax.grad.
 """
 from __future__ import annotations
 
@@ -65,22 +74,43 @@ def functional_scope():
 set_grad_enabled = enable_grad  # reference-compat alias
 
 
-def _float0_zero(leaf):
-    return np.zeros(np.shape(leaf), dtype=jax.dtypes.float0)
+def _is_float0(g) -> bool:
+    return np.dtype(getattr(g, 'dtype', np.float32)) == jax.dtypes.float0
 
 
 _node_counter = [0]
 
 
+class InputRef:
+    """Snapshot of one Tensor input at record time.
+
+    Backward keys cotangents off the *recorded* producing node, and leaf
+    accumulation routes to the original tensor object — so later in-place
+    rebinds of the live Tensor leave the recorded graph intact
+    (fix for the round-1 tape-severing bug).
+    """
+
+    __slots__ = ('target', 'node', 'leaf_index', 'stop_gradient', 'data')
+
+    def __init__(self, t):
+        self.target = t
+        self.node = t._node
+        self.leaf_index = t._leaf_index
+        self.stop_gradient = t.stop_gradient
+        self.data = t._data
+
+
 class Node:
-    """One recorded op: inputs (Tensor refs), vjp closure, output metadata."""
+    """One recorded op: input refs, vjp closure, replayable primal, metadata."""
 
-    __slots__ = ('inputs', 'vjp_fn', 'out_avals', 'out_treedef', 'name',
-                 '_order')
+    __slots__ = ('inputs', 'vjp_fn', 'primal_fn', 'out_avals', 'out_treedef',
+                 'name', '_order')
 
-    def __init__(self, inputs, vjp_fn, out_avals, out_treedef, name=''):
-        self.inputs = inputs          # list[Tensor] participating inputs
+    def __init__(self, inputs, vjp_fn, primal_fn, out_avals, out_treedef,
+                 name=''):
+        self.inputs = inputs          # list[InputRef]
         self.vjp_fn = vjp_fn          # cotangents(pytree) -> tuple of input cotangents
+        self.primal_fn = primal_fn    # pure fn(*input_vals) -> output pytree
         self.out_avals = out_avals    # list of (shape, dtype) per output leaf
         self.out_treedef = out_treedef
         self.name = name
@@ -89,14 +119,35 @@ class Node:
 
     def release(self):
         self.vjp_fn = None
+        self.primal_fn = None
         self.inputs = ()
 
 
-def backward(outputs, grad_tensors=None, retain_graph=False):
-    """Reverse-accumulate gradients from `outputs` into leaf .grad slots.
+def _collect_nodes(root_nodes):
+    """All recorded ancestors of `root_nodes`, sorted by creation order."""
+    seen_nodes, seen_ids = [], set()
+    stack = [n for n in root_nodes if n is not None]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen_ids:
+            continue
+        seen_ids.add(id(node))
+        seen_nodes.append(node)
+        for ref in node.inputs:
+            if ref.node is not None and id(ref.node) not in seen_ids:
+                stack.append(ref.node)
+    seen_nodes.sort(key=lambda n: n._order)
+    return seen_nodes
 
-    Mirrors Tensor.backward()/paddle.autograd.backward semantics: scalar
-    outputs seed with ones; non-scalars require explicit grad_tensors.
+
+def backward(outputs, grad_tensors=None, retain_graph=False, capture=None,
+             frozen_ids=()):
+    """Reverse-accumulate gradients from `outputs`.
+
+    With capture=None (public Tensor.backward path): grads accumulate into
+    leaf `.grad` slots. With capture={id(tensor): None, ...} (paddle.grad
+    path): no `.grad` mutation; cotangent sums for the requested tensors are
+    collected into the dict instead.
     """
     from .tensor import Tensor  # cycle-free at call time
 
@@ -107,20 +158,16 @@ def backward(outputs, grad_tensors=None, retain_graph=False):
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
 
+    def cap_add(tid, value):
+        prev = capture.get(tid)
+        capture[tid] = value if prev is None else prev + value
+
     # Cotangents for graph-internal tensors are keyed by
     # (id(producing_node), output_leaf_index) — nodes are held strongly for
-    # the whole walk, so no id-reuse hazard. Leaves accumulate straight into
-    # .grad via _accumulate_grad.
+    # the whole walk, so no id-reuse hazard.
     cot: dict = {}
 
-    def add_cot(tensor, value):
-        key = (id(tensor._node), tensor._leaf_index)
-        if key in cot:
-            cot[key] = cot[key] + value
-        else:
-            cot[key] = value
-
-    roots = []
+    root_nodes = []
     for out, g in zip(outputs, grad_tensors):
         if out.stop_gradient:
             continue
@@ -132,29 +179,17 @@ def backward(outputs, grad_tensors=None, retain_graph=False):
             g_val = jnp.ones(out.shape, out.dtype)
         else:
             g_val = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        if capture is not None and id(out) in capture:
+            cap_add(id(out), g_val)
         if out._node is None:
-            out._accumulate_grad(g_val)
+            if capture is None:
+                out._accumulate_grad(g_val)
         else:
-            add_cot(out, g_val)
-            roots.append(out)
+            key = (id(out._node), out._leaf_index)
+            cot[key] = cot[key] + g_val if key in cot else g_val
+            root_nodes.append(out._node)
 
-    # Topological walk: collect reachable nodes by DFS over producer links,
-    # then process in reverse creation order.
-    seen_nodes = []
-    seen_ids = set()
-    stack = [t._node for t in roots if t._node is not None]
-    while stack:
-        node = stack.pop()
-        if node is None or id(node) in seen_ids:
-            continue
-        seen_ids.add(id(node))
-        seen_nodes.append(node)
-        for t in node.inputs:
-            if t._node is not None and id(t._node) not in seen_ids:
-                stack.append(t._node)
-    seen_nodes.sort(key=lambda n: n._order)
-
-    for node in reversed(seen_nodes):
+    for node in reversed(_collect_nodes(root_nodes)):
         # Assemble output cotangents (zeros / float0 where untouched).
         leaves = []
         any_set = False
@@ -175,40 +210,142 @@ def backward(outputs, grad_tensors=None, retain_graph=False):
                 '(set retain_graph=True on the first backward)')
         out_cot = jax.tree_util.tree_unflatten(node.out_treedef, leaves)
         in_cots = node.vjp_fn(out_cot)
-        for t, g in zip(node.inputs, in_cots):
-            if t.stop_gradient:
+        for ref, g in zip(node.inputs, in_cots):
+            if ref.stop_gradient or g is None or _is_float0(g):
                 continue
-            if g is not None and np.dtype(getattr(g, 'dtype', np.float32)) != jax.dtypes.float0:
-                if t._node is None:
-                    t._accumulate_grad(g)
-                else:
-                    add_cot(t, g)
+            if id(ref.target) in frozen_ids:  # no_grad_vars: cut here
+                continue
+            if capture is not None and id(ref.target) in capture:
+                cap_add(id(ref.target), g)
+            if ref.node is not None:
+                key = (id(ref.node), ref.leaf_index)
+                cot[key] = cot[key] + g if key in cot else g
+            elif capture is None:
+                ref.target._accumulate_grad(g)
         if not retain_graph:
             node.release()
 
 
-def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
-         create_graph=False, allow_unused=True):
-    """paddle.grad: return grads of `outputs` w.r.t. `inputs` (no .grad mutation)."""
-    from .tensor import Tensor
+def _build_pure(outputs, inputs, frozen_ids=()):
+    """Replay the recorded subgraph into a pure fn(*input_vals) -> out_vals.
 
+    Replays every recorded ancestor of `outputs`; wherever an InputRef's
+    target is one of `inputs`, the caller-supplied value is substituted —
+    cutting the graph there so the result is a function of exactly those
+    inputs (everything else enters as a recorded-constant snapshot).
+    `frozen_ids` (no_grad_vars) are forced to their recorded snapshots.
+    """
+    input_pos = {id(t): i for i, t in enumerate(inputs)}
+    nodes = _collect_nodes(
+        [t._node for t in outputs if t._node is not None and id(t) not in input_pos])
+    for n in nodes:
+        if n.primal_fn is None:
+            raise RuntimeError(
+                'create_graph=True requires the recorded graph to be alive; '
+                'it was already freed by a prior backward '
+                '(use retain_graph=True there)')
+
+    def f(*xvals):
+        env = {}
+
+        def lookup(tid, node, leaf_index, const):
+            if tid in input_pos:
+                return xvals[input_pos[tid]]
+            if tid in frozen_ids:
+                return const
+            if node is not None and (id(node), leaf_index) in env:
+                return env[(id(node), leaf_index)]
+            return const
+
+        for node in nodes:
+            invals = [lookup(id(r.target), r.node, r.leaf_index, r.data)
+                      for r in node.inputs]
+            out = node.primal_fn(*invals)
+            out_leaves, _ = jax.tree_util.tree_flatten(out)
+            for i, leaf in enumerate(out_leaves):
+                env[(id(node), i)] = leaf
+        return tuple(
+            lookup(id(t), t._node, t._leaf_index, t._data) for t in outputs)
+
+    reachable = set(input_pos) & (
+        {id(r.target) for n in nodes for r in n.inputs} | {id(t) for t in outputs})
+    return f, reachable
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad: grads of `outputs` w.r.t. `inputs` (no .grad mutation).
+
+    create_graph=True returns grads recorded on the tape (differentiable
+    again — arbitrary order), via pure-replay + jax.vjp through apply_op.
+    """
+    from .tensor import Tensor, apply_op
+
+    single_out = isinstance(outputs, Tensor)
+    outputs_l = [outputs] if single_out else list(outputs)
     single = isinstance(inputs, Tensor)
     inputs_l = [inputs] if single else list(inputs)
-    saved = [(t.grad, t.stop_gradient) for t in inputs_l]
-    for t in inputs_l:
-        t.grad = None
-        t.stop_gradient = False
-    try:
-        backward(outputs, grad_outputs, retain_graph=retain_graph or create_graph)
+    frozen_ids = frozenset(
+        id(t) for t in (no_grad_vars or ()))
+
+    def seed_for(out, g):
+        if g is not None:
+            return g
+        if out.size != 1:
+            raise RuntimeError(
+                'grad can be implicitly created only for scalar outputs; '
+                'pass grad_outputs for non-scalar outputs')
+        return Tensor(jnp.ones(out.shape, out.dtype))
+
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs_l)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    if not create_graph:
+        capture = {id(t): None for t in inputs_l}
+        backward(outputs_l, grad_outputs, retain_graph=retain_graph,
+                 capture=capture, frozen_ids=frozen_ids)
         grads = []
         for t in inputs_l:
-            if t.grad is None:
+            v = capture[id(t)]
+            if v is None:
                 if not allow_unused:
-                    raise RuntimeError('an input was unused in the graph')
+                    raise RuntimeError(
+                        'one of the inputs was not used in the graph; '
+                        'set allow_unused=True to return None for it')
                 grads.append(None)
             else:
-                grads.append(t.grad)
-    finally:
-        for t, (g, sg) in zip(inputs_l, saved):
-            t.grad, t.stop_gradient = g, sg
+                grads.append(Tensor(v))
+        return grads[0] if single else grads
+
+    # -- higher-order path --------------------------------------------------
+    # Dedupe inputs: jax.vjp splits the cotangent across duplicate arg slots,
+    # but paddle semantics give each duplicate the full gradient.
+    uniq, uniq_pos = [], {}
+    for t in inputs_l:
+        if id(t) not in uniq_pos:
+            uniq_pos[id(t)] = len(uniq)
+            uniq.append(t)
+
+    f, reachable = _build_pure(outputs_l, uniq, frozen_ids=frozen_ids)
+    unused_ids = {id(t) for t in uniq if id(t) not in reachable}
+    if unused_ids and not allow_unused:
+        raise RuntimeError(
+            'one of the inputs was not used in the graph; '
+            'set allow_unused=True to return None for it')
+
+    cots = [seed_for(o, g) for o, g in zip(outputs_l, grad_outputs)]
+    n_in = len(uniq)
+
+    def hg(*vals):
+        xs, cs = vals[:n_in], vals[n_in:]
+        _, vjp_f = jax.vjp(f, *xs)
+        return vjp_f(tuple(cs))
+
+    res = apply_op(hg, *uniq, *cots, _name='grad')
+    res = list(res) if isinstance(res, (tuple, list)) else [res]
+    grads = [None if id(t) in unused_ids else res[uniq_pos[id(t)]]
+             for t in inputs_l]
     return grads[0] if single else grads

@@ -1,0 +1,84 @@
+"""Global FLAGS_* registry (upstream: paddle/phi/core/flags.cc, paddle.get_flags).
+
+A dict-backed registry with the reference's getter/setter API. Flags that
+have a TPU-native effect are wired where they land (e.g. determinism is
+inherent to the stateless threefry PRNG; `FLAGS_check_nan_inf` is consumed
+by paddle_tpu.debug).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+_FLAGS: Dict[str, Any] = {
+    # determinism: stateless PRNG + XLA make runs reproducible by default
+    'FLAGS_deterministic': True,
+    'FLAGS_cudnn_deterministic': True,
+    'FLAGS_embedding_deterministic': 1,
+    # numerics monitoring (consumed by paddle_tpu.debug.check_numerics)
+    'FLAGS_check_nan_inf': False,
+    'FLAGS_check_nan_inf_level': 0,
+    # allocator knobs: PjRt owns device memory; kept for API parity
+    'FLAGS_fraction_of_gpu_memory_to_use': 0.92,
+    'FLAGS_allocator_strategy': 'auto_growth',
+    'FLAGS_eager_delete_tensor_gb': 0.0,
+    # misc parity flags
+    'FLAGS_use_mkldnn': False,
+    'FLAGS_paddle_num_threads': 1,
+    'FLAGS_benchmark': False,
+    'FLAGS_cudnn_exhaustive_search': False,
+    'FLAGS_conv_workspace_size_limit': 512,
+    'FLAGS_max_inplace_grad_add': 0,
+    'FLAGS_log_level': 0,
+}
+
+
+def _canon(name: str) -> str:
+    return name if name.startswith('FLAGS_') else 'FLAGS_' + name
+
+
+def get_flags(flags: Optional[Union[str, Iterable[str]]] = None) -> Dict[str, Any]:
+    """Return {flag: value}. `flags` may be one name, a list, or None (all)."""
+    if flags is None:
+        return dict(_FLAGS)
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = _canon(f)
+        if key not in _FLAGS:
+            raise ValueError(f'Flag {f!r} is not registered')
+        out[key] = _FLAGS[key]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set registered flags from a {name: value} dict."""
+    if not isinstance(flags, dict):
+        raise TypeError('set_flags expects a dict of {flag_name: value}')
+    for f, v in flags.items():
+        key = _canon(f)
+        if key not in _FLAGS:
+            raise ValueError(f'Flag {f!r} is not registered')
+        _FLAGS[key] = v
+
+
+def register_flag(name: str, default: Any) -> None:
+    """Register a new flag (env var FLAGS_x overrides the default)."""
+    key = _canon(name)
+    if key in _FLAGS:
+        return
+    env = os.environ.get(key)
+    if env is None:
+        _FLAGS[key] = default
+    elif isinstance(default, bool):
+        _FLAGS[key] = env.strip().lower() in ('1', 'true', 'yes', 'on')
+    elif isinstance(default, (int, float)):
+        _FLAGS[key] = type(default)(env)
+    else:
+        _FLAGS[key] = env
+
+
+def flag(name: str) -> Any:
+    """Internal fast-path getter."""
+    return _FLAGS[_canon(name)]

@@ -53,12 +53,18 @@ _METHOD_NAMES = [
 
 
 def _attach_methods():
+    missing = []
     for name in _METHOD_NAMES:
         for src in _METHOD_SOURCES:
             fn = getattr(src, name, None)
             if fn is not None:
                 setattr(Tensor, name, fn)
                 break
+        else:
+            missing.append(name)
+    if missing:  # strict: a listed method that resolves nowhere is a bug
+        raise ImportError(
+            f'Tensor methods listed in _METHOD_NAMES are unresolved: {missing}')
 
     # creation-style helpers as methods
     Tensor.zeros_like = lambda self, dtype=None: creation.zeros_like(self, dtype)

@@ -234,6 +234,14 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
     return Tensor(out.astype(INT64))
 
 
+def one_hot(x, num_classes, name=None):
+    """One-hot encode integer labels → float (upstream: paddle.nn.functional.one_hot)."""
+    from ._helpers import defop
+    dt = framework.get_default_dtype()
+    return defop(lambda v: jax.nn.one_hot(v, int(to_jax(num_classes)), dtype=dt),
+                 name='one_hot')(x)
+
+
 def create_parameter(shape, dtype=None, default_initializer=None,
                      is_bias=False, attr=None, name=None):
     dt = _dt(dtype)

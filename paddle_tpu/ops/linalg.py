@@ -46,7 +46,6 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 
 
 def einsum(equation, *operands, name=None):
-    ops = [to_jax(o) for o in operands]
     return defop(lambda *vs: jnp.einsum(equation, *vs), name='einsum')(*operands)
 
 
@@ -88,9 +87,6 @@ def histogram(input, bins=100, min=0, max=0, name=None):
 
 
 def bincount(x, weights=None, minlength=0, name=None):
-    def f(v, w):
-        return jnp.bincount(v, weights=w, minlength=minlength,
-                            length=int(np.asarray(v).max()) + 1 if v.size else minlength)
     # eager-only (dynamic output length)
     v = to_jax(x)
     w = to_jax(weights) if weights is not None else None

@@ -96,9 +96,6 @@ def cummax(x, axis=None, dtype='int64', name=None):
         ax = 0 if axis is None else int(axis)
         vv = v.reshape(-1) if axis is None else v
         vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
-        idx = jnp.argmax(
-            jnp.cumsum(jnp.asarray(vv == vals, jnp.int32), axis=ax) * 0 + (vv == vals),
-            axis=ax)
         # indices: last position achieving the running max
         n = vv.shape[ax]
         pos = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])

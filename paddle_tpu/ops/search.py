@@ -40,6 +40,10 @@ def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
         vv = jnp.moveaxis(v, ax, -1)
         if largest:
             vals, idx = jax.lax.top_k(vv, kk)
+        elif jnp.issubdtype(v.dtype, jnp.integer) or jnp.issubdtype(v.dtype, jnp.bool_):
+            # negation overflows at INT_MIN / wraps unsigned; ~v is safe
+            _, idx = jax.lax.top_k(~vv, kk)
+            vals = jnp.take_along_axis(vv, idx, axis=-1)
         else:
             vals, idx = jax.lax.top_k(-vv, kk)
             vals = -vals
@@ -47,17 +51,30 @@ def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
     return defop(f, name='topk')(x, k)
 
 
+def _desc_key(v):
+    """Key whose stable ascending sort is a stable *descending* sort of v.
+
+    Integers use bit-inversion (~v = -v-1, monotone decreasing, no overflow
+    at INT_MIN); bools likewise; floats use negation.
+    """
+    if jnp.issubdtype(v.dtype, jnp.integer) or jnp.issubdtype(v.dtype, jnp.bool_):
+        return ~v
+    return -v
+
+
 def sort(x, axis=-1, descending=False, name=None):
     def f(v):
-        out = jnp.sort(v, axis=axis)
-        return jnp.flip(out, axis=axis) if descending else out
+        if not descending:
+            return jnp.sort(v, axis=axis, stable=True)
+        idx = jnp.argsort(_desc_key(v), axis=axis, stable=True)
+        return jnp.take_along_axis(v, idx, axis=axis)
     return defop(f, name='sort')(x)
 
 
 def argsort(x, axis=-1, descending=False, name=None):
     def f(v):
-        idx = jnp.argsort(v, axis=axis, stable=True)
-        return (jnp.flip(idx, axis=axis) if descending else idx).astype(INT64)
+        key = _desc_key(v) if descending else v
+        return jnp.argsort(key, axis=axis, stable=True).astype(INT64)
     return defop(f, name='argsort')(x)
 
 

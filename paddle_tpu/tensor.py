@@ -320,8 +320,10 @@ def apply_op(fn: Callable, *args, _name: str = '', **kwargs):
     out_leaves, out_td = _tree.tree_flatten(out)
     node = None
     if record:
+        # Snapshot inputs (InputRef) so later in-place rebinds of the live
+        # Tensors can't sever or re-key the recorded graph.
         node = autograd.Node(
-            tensors, vjp_fn,
+            [autograd.InputRef(t) for t in tensors], vjp_fn, pure,
             [(tuple(np.shape(l)), jnp.dtype(getattr(l, 'dtype', np.result_type(l))))
              for l in out_leaves],
             out_td, name=_name)

@@ -1,0 +1,117 @@
+"""Round-2 autograd regressions: in-place tape integrity, higher-order grad,
+grad-of-intermediate, flags, one_hot, strict method attachment.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_inplace_add_keeps_leaf_grad():
+    # round-1 bug: x += y on a leaf severed the tape and left x.grad None
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    x += y
+    loss = (x * x).sum()
+    loss.backward()
+    # x_new = x_old + y; d loss/d x_old = 2*x_new, same for y
+    np.testing.assert_allclose(x.grad.numpy(), [8.0, 12.0])
+    np.testing.assert_allclose(y.grad.numpy(), [8.0, 12.0])
+
+
+def test_setitem_on_nonleaf_keeps_upstream_grads():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    h = x * 2.0
+    h[0] = paddle.to_tensor(5.0)
+    loss = h.sum()
+    loss.backward()
+    # h = [5, 2*x1, 2*x2]: grad x = [0, 2, 2]
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_grad_of_intermediate():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x * x
+    y = h.sum()
+    (gh,) = paddle.grad(y, [h])
+    np.testing.assert_allclose(gh.numpy(), [1.0, 1.0])
+    # and .grad of x untouched by paddle.grad
+    assert x.grad is None
+
+
+def test_second_order_grad():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x * x  # y = x^3
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 27.0)  # 3x^2
+    assert not g1.stop_gradient
+    (g2,) = paddle.grad(g1, [x], create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), 18.0)  # 6x
+    (g3,) = paddle.grad(g2, [x])
+    np.testing.assert_allclose(g3.numpy(), 6.0)
+
+
+def test_second_order_multivar():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    w = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    y = (x * x * w).sum()
+    gx, gw = paddle.grad(y, [x, w], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [6.0, 16.0])    # 2xw
+    np.testing.assert_allclose(gw.numpy(), [1.0, 4.0])     # x^2
+    (gxx,) = paddle.grad(gx.sum(), [x])
+    np.testing.assert_allclose(gxx.numpy(), [6.0, 8.0])    # 2w
+
+
+def test_grad_unused_raises_and_allow_unused():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    z = paddle.to_tensor(1.0, stop_gradient=False)
+    y = x * 2.0
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [z], retain_graph=True)
+    gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), 2.0)
+    assert gz is None
+
+
+def test_backward_twice_raises_without_retain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)  # accumulated twice
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_flags_roundtrip():
+    f = paddle.get_flags(['FLAGS_check_nan_inf'])
+    assert f == {'FLAGS_check_nan_inf': False}
+    paddle.set_flags({'FLAGS_check_nan_inf': True})
+    assert paddle.get_flags('FLAGS_check_nan_inf')['FLAGS_check_nan_inf'] is True
+    paddle.set_flags({'FLAGS_check_nan_inf': False})
+    with pytest.raises(ValueError):
+        paddle.set_flags({'FLAGS_not_a_flag': 1})
+
+
+def test_one_hot():
+    x = paddle.to_tensor([0, 2, 1])
+    oh = paddle.one_hot(x, 3)
+    np.testing.assert_allclose(
+        oh.numpy(), [[1, 0, 0], [0, 0, 1], [0, 1, 0]])
+
+
+def test_all_listed_methods_attached():
+    from paddle_tpu.ops import _METHOD_NAMES
+    for name in _METHOD_NAMES:
+        assert callable(getattr(paddle.Tensor, name, None)), name
+
+
+def test_sort_descending_stable_and_unsigned_topk():
+    x = paddle.to_tensor(np.array([3, 1, 250, 7], np.uint8))
+    vals, idx = paddle.topk(x, 2, largest=False)
+    np.testing.assert_array_equal(vals.numpy(), [1, 3])
+    np.testing.assert_array_equal(idx.numpy(), [1, 0])
+    # stable descending argsort: ties keep original order
+    y = paddle.to_tensor([2.0, 1.0, 2.0, 3.0])
+    ids = paddle.argsort(y, descending=True)
+    np.testing.assert_array_equal(ids.numpy(), [3, 0, 2, 1])
