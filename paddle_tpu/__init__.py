@@ -20,6 +20,9 @@ from .tensor import Parameter, Tensor
 from .ops import *  # noqa: F401,F403
 from .ops import linalg
 
+from . import nn
+from .nn.layer import ParamAttr
+
 bool = bool_  # paddle.bool
 
 __version__ = '0.1.0'

@@ -1,0 +1,105 @@
+"""Gradient clipping (upstream: python/paddle/nn/clip.py).
+
+Clip objects transform a list of (param, grad) pairs; the optimizer applies
+them before the update. They also expose a pure-pytree form
+(`apply_pytree`) used inside the jitted train step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def apply_pytree(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(jnp.clip(g.value, self.min, self.max)))
+                if g is not None else (p, g) for p, g in params_grads]
+
+    def apply_pytree(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2-norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+        return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(self._clip_one(g.value)))
+                if g is not None else (p, g) for p, g in params_grads]
+
+    def apply_pytree(self, grads):
+        return jax.tree_util.tree_map(self._clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global L2-norm clip across all grads (the pretraining default)."""
+
+    def __init__(self, clip_norm, group_name='default_group',
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _scale(self, leaves):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        gn = jnp.sqrt(sq)
+        return jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+
+    def __call__(self, params_grads):
+        gs = [g.value for _, g in params_grads if g is not None]
+        if not gs:
+            return params_grads
+        s = self._scale(gs)
+        return [(p, Tensor((g.value.astype(jnp.float32) * s).astype(g.dtype)))
+                if g is not None else (p, g) for p, g in params_grads]
+
+    def apply_pytree(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        if not leaves:
+            return grads
+        s = self._scale(leaves)
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * s).astype(g.dtype), grads)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style utility over .grad slots; returns the total norm."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float('inf'):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g.value)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.value.astype(jnp.float32)),
+                                  norm_type)) for g in grads),
+            1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = (p.grad.value.astype(jnp.float32)
+                            * scale).astype(p.grad.dtype)
+    return Tensor(total)

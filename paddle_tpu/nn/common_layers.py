@@ -1,0 +1,437 @@
+"""Common paddle.nn layers: Linear, Embedding, Dropout, activations,
+containers, shape utilities.
+
+Upstream: python/paddle/nn/layer/common.py, container.py, activation.py.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+
+from .. import framework
+from ..tensor import Parameter, Tensor
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, ParamAttr
+
+
+class Linear(Layer):
+    """y = xW + b with W: [in_features, out_features] (reference layout;
+    upstream python/paddle/nn/layer/common.py:Linear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr)
+        self.bias = self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f'in={self.in_features}, out={self.out_features}'
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        if padding_idx is not None:
+            self.weight._data = self.weight._data.at[padding_idx].set(0.0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self):
+        return f'{self.num_embeddings}, {self.embedding_dim}'
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode='upscale_in_train', name=None):
+        super().__init__()
+        self.p, self.axis, self.mode = p, axis, mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, axis=self.axis, training=self.training,
+                         mode=self.mode)
+
+    def extra_repr(self):
+        return f'p={self.p}'
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format='NCHW', name=None):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format='NCDHW', name=None):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0:
+            return x
+        # selu-preserving dropout
+        alpha_p = -1.7580993408473766
+        q = 1 - self.p
+        key = framework.next_rng_key()
+        from ..tensor import apply_op
+        import jax
+
+        def f(v):
+            keep = jax.random.bernoulli(key, q, v.shape)
+            a = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
+            b = -a * alpha_p * (1 - q)
+            return a * jnp.where(keep, v, alpha_p) + b
+        return apply_op(f, x, _name='alpha_dropout')
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        return x.flatten(self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode='nearest',
+                 align_corners=False, align_mode=0, data_format='NCHW',
+                 name=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+        self.align_mode, self.data_format = align_mode, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.align_corners, self.align_mode,
+                             self.data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format='NCHW',
+                 name=None):
+        super().__init__(size, scale_factor, 'bilinear', True, 0, data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format='NCHW',
+                 name=None):
+        super().__init__(size, scale_factor, 'nearest', False, 0, data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format='NCHW', name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode='constant', value=0.0, data_format=None,
+                 name=None):
+        super().__init__()
+        self.padding = [padding] * self._n2 if isinstance(padding, int) \
+            else list(padding)
+        self.mode, self.value = mode, value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value)
+
+
+class Pad1D(_PadNd):
+    _n2 = 2
+
+
+class Pad2D(_PadNd):
+    _n2 = 4
+
+
+class Pad3D(_PadNd):
+    _n2 = 6
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format='NCHW', name=None):
+        super().__init__(padding, 'constant', 0.0, data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes, self.strides = kernel_sizes, strides
+        self.paddings, self.dilations = paddings, dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr)
+        self.bias = self.create_parameter((out_features,), attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+# -- activation layers ------------------------------------------------------
+
+
+def _act_layer(fname, cls_name, **fixed):
+    fn = getattr(F, fname)
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            kwargs.pop('name', None)
+            self._args, self._kwargs = args, {**fixed, **kwargs}
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+    _Act.__name__ = cls_name
+    _Act.__qualname__ = cls_name
+    return _Act
+
+
+ReLU = _act_layer('relu', 'ReLU')
+ReLU6 = _act_layer('relu6', 'ReLU6')
+GELU = _act_layer('gelu', 'GELU')
+Silu = _act_layer('silu', 'Silu')
+Swish = _act_layer('silu', 'Swish')
+Sigmoid = _act_layer('sigmoid', 'Sigmoid')
+Tanh = _act_layer('tanh', 'Tanh')
+LeakyReLU = _act_layer('leaky_relu', 'LeakyReLU')
+ELU = _act_layer('elu', 'ELU')
+SELU = _act_layer('selu', 'SELU')
+CELU = _act_layer('celu', 'CELU')
+Hardswish = _act_layer('hardswish', 'Hardswish')
+Hardsigmoid = _act_layer('hardsigmoid', 'Hardsigmoid')
+Hardtanh = _act_layer('hardtanh', 'Hardtanh')
+Hardshrink = _act_layer('hardshrink', 'Hardshrink')
+Softshrink = _act_layer('softshrink', 'Softshrink')
+Tanhshrink = _act_layer('tanhshrink', 'Tanhshrink')
+Mish = _act_layer('mish', 'Mish')
+Softplus = _act_layer('softplus', 'Softplus')
+Softsign = _act_layer('softsign', 'Softsign')
+LogSigmoid = _act_layer('logsigmoid', 'LogSigmoid')
+GLU = _act_layer('glu', 'GLU')
+Softmax = _act_layer('softmax', 'Softmax')
+LogSoftmax = _act_layer('log_softmax', 'LogSoftmax')
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format='NCHW', name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self.data_format)
+
+
+# -- containers -------------------------------------------------------------
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], collections.OrderedDict):
+            for name, l in layers[0].items():
+                self.add_sublayer(name, l)
+        else:
+            for i, l in enumerate(layers):
+                if isinstance(l, (tuple, list)) and len(l) == 2:
+                    self.add_sublayer(l[0], l[1])
+                else:
+                    self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers)
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers)
+        return self._sub_layers[keys[idx]]
+
+    def __setitem__(self, idx, layer):
+        keys = list(self._sub_layers)
+        self._sub_layers[keys[idx]] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+    def insert(self, index, layer):
+        items = list(self._sub_layers.values())
+        items.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(items):
+            self._sub_layers[str(i)] = l
+
+    def forward(self, *a, **k):
+        raise NotImplementedError('LayerList is a container')
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = sublayers.items() if hasattr(sublayers, 'items') else sublayers
+        for k, v in items:
+            self.add_sublayer(k, v)
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        return self._sub_layers.pop(key)
+
+    def forward(self, *a, **k):
+        raise NotImplementedError('LayerDict is a container')
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        keys = list(self._parameters)
+        return self._parameters[keys[idx]]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, p):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self

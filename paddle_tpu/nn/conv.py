@@ -1,0 +1,121 @@
+"""Convolution layers (upstream: python/paddle/nn/layer/conv.py).
+
+Weights use the reference layout [out_c, in_c/groups, *kernel]; transpose
+convs use [in_c, out_c/groups, *kernel]. Compute lowers to
+lax.conv_general_dilated — the XLA conv op TPU tiles onto the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+
+def _ntuple(v, n):
+    return (int(v),) * n if isinstance(v, (int, np.integer)) \
+        else tuple(int(i) for i in v)
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, n,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode='zeros', weight_attr=None, bias_attr=None,
+                 data_format=None, transpose=False, output_padding=0):
+        super().__init__()
+        self.in_channels, self.out_channels = in_channels, out_channels
+        self.kernel_size = _ntuple(kernel_size, n)
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+        self.padding_mode = padding_mode
+        self.data_format = data_format
+        self.output_padding = output_padding
+        self._n = n
+        self._transpose = transpose
+        if transpose:
+            wshape = (in_channels, out_channels // groups) + self.kernel_size
+        else:
+            wshape = (out_channels, in_channels // groups) + self.kernel_size
+        fan_in = (in_channels // groups) * int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            wshape, attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in,
+                                                 negative_slope=np.sqrt(5.0),
+                                                 nonlinearity='leaky_relu'))
+        self.bias = self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def extra_repr(self):
+        return (f'{self.in_channels}, {self.out_channels}, '
+                f'kernel_size={self.kernel_size}, stride={self.stride}')
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format='NCL'):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format='NCHW'):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format='NCDHW'):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format='NCL'):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, 'zeros', weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups, self.data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format='NCHW'):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, 'zeros', weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups, self.data_format)

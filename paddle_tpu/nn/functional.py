@@ -1,0 +1,1014 @@
+"""paddle.nn.functional — TPU-native functional ops.
+
+Upstream: python/paddle/nn/functional/*.py (activation.py, common.py,
+conv.py, loss.py, norm.py, pooling.py). All ops are pure jax under the
+hood (XLA fuses elementwise chains into surrounding matmuls/convs); they
+flow through the autograd tape via apply_op, and trace cleanly under jit.
+Convolutions use lax.conv_general_dilated in NCHW/NCL layouts; pooling uses
+lax.reduce_window — both map directly onto TPU MXU/VPU tiling.
+"""
+from __future__ import annotations
+
+import math as _math
+import numbers
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..dtype import convert_dtype
+from ..ops._helpers import defop
+from ..tensor import Tensor, apply_op, to_jax
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+def relu(x, name=None):
+    return defop(jax.nn.relu, name='relu')(x)
+
+
+def relu_(x):
+    return x._rebind(relu(x))
+
+
+def relu6(x, name=None):
+    return defop(lambda v: jnp.clip(v, 0, 6), name='relu6')(x)
+
+
+def gelu(x, approximate=False, name=None):
+    return defop(lambda v: jax.nn.gelu(v, approximate=bool(approximate)),
+                 name='gelu')(x)
+
+
+def silu(x, name=None):
+    return defop(jax.nn.silu, name='silu')(x)
+
+
+swish = silu
+
+
+def sigmoid(x, name=None):
+    return defop(jax.nn.sigmoid, name='sigmoid')(x)
+
+
+def tanh(x, name=None):
+    return defop(jnp.tanh, name='tanh')(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return defop(lambda v: jnp.where(v >= 0, v, negative_slope * v),
+                 name='leaky_relu')(x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return defop(lambda v: jax.nn.elu(v, alpha), name='elu')(x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return defop(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                 name='selu')(x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return defop(lambda v: jax.nn.celu(v, alpha), name='celu')(x)
+
+
+def hardswish(x, name=None):
+    return defop(lambda v: v * jnp.clip(v + 3, 0, 6) / 6, name='hardswish')(x)
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return defop(lambda v: jnp.clip(slope * v + offset, 0, 1),
+                 name='hardsigmoid')(x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return defop(lambda v: jnp.clip(v, min, max), name='hardtanh')(x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return defop(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0),
+                 name='hardshrink')(x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return defop(
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0)),
+        name='softshrink')(x)
+
+
+def tanhshrink(x, name=None):
+    return defop(lambda v: v - jnp.tanh(v), name='tanhshrink')(x)
+
+
+def mish(x, name=None):
+    return defop(lambda v: v * jnp.tanh(jax.nn.softplus(v)), name='mish')(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return defop(
+        lambda v: jnp.where(beta * v > threshold, v,
+                            jnp.log1p(jnp.exp(beta * v)) / beta),
+        name='softplus')(x)
+
+
+def softsign(x, name=None):
+    return defop(lambda v: v / (1 + jnp.abs(v)), name='softsign')(x)
+
+
+def logsigmoid(x, name=None):
+    return defop(jax.nn.log_sigmoid, name='log_sigmoid')(x)
+
+
+def glu(x, axis=-1, name=None):
+    def f(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return defop(f, name='glu')(x)
+
+
+def prelu(x, weight, data_format='NCHW', name=None):
+    def f(v, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            ch_axis = 1 if data_format[1] == 'C' else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(v >= 0, v, wb * v)
+    return defop(f, name='prelu')(x, weight)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return defop(f, name='softmax')(x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+    return defop(f, name='log_softmax')(x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = framework.next_rng_key()
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            onehot = jax.nn.one_hot(idx, y.shape[axis], dtype=y.dtype,
+                                    axis=axis)
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+    return defop(f, name='gumbel_softmax')(x)
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding / common
+# ---------------------------------------------------------------------------
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shape [in, out] (paddle convention)."""
+    if bias is None:
+        return defop(lambda v, w: v @ w, name='linear')(x, weight)
+    return defop(lambda v, w, b: v @ w + b, name='linear')(x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            pi = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (ids == pi)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return defop(f, name='embedding')(x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from ..ops import creation
+    return creation.one_hot(x, num_classes)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode='upscale_in_train',
+            name=None):
+    if not training or p == 0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if p == 1:
+        return defop(lambda v: jnp.zeros_like(v), name='dropout')(x)
+    key = framework.next_rng_key()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == 'upscale_in_train':
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+    return defop(f, name='dropout')(x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format='NCHW', name=None):
+    ax = [0, 1] if data_format == 'NCHW' else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format='NCDHW', name=None):
+    ax = [0, 1] if data_format == 'NCDHW' else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True)
+        return v / jnp.maximum(n, epsilon)
+    return defop(f, name='normalize')(x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *pd):
+        k = l.shape[-1]
+        smooth = pd[0] if pd else jnp.full((k,), 1.0 / k, l.dtype)
+        return (1 - epsilon) * l + epsilon * smooth
+    args = (label,) if prior_dist is None else (label, prior_dist)
+    return defop(f, name='label_smooth')(*args)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return defop(f, name='cosine_similarity')(x1, x2)
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    def f(v):
+        m = int(maxlen) if maxlen is not None else int(np.asarray(to_jax(x)).max())
+        rng = jnp.arange(m)
+        return (rng[None, :] < v[..., None]).astype(convert_dtype(dtype))
+    return defop(f, name='sequence_mask')(x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bb):
+        out = jnp.einsum('bi,oij,bj->bo', a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    args = (x1, x2, weight) if bias is None else (x1, x2, weight, bias)
+    return defop(f, name='bilinear')(*args)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, numbers.Integral):
+        normalized_shape = (int(normalized_shape),)
+    n_axes = len(tuple(normalized_shape))
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        mu = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v - mu), axis=axes, keepdims=True)
+        out = (v - mu) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]; i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return defop(f, name='layer_norm')(*args)
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, axis=-1, name=None):
+    """Root-mean-square norm (Llama-style; fused by XLA, pallas kernel on TPU)."""
+    from ..ops import pallas as _pallas
+
+    def f(v, *wb):
+        out = _pallas.rms_norm(v, epsilon=epsilon, axis=axis)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]; i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return defop(f, name='rms_norm')(*args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format='NCHW', use_global_stats=None, name=None):
+    """BN over the channel axis. In training mode the running stats tensors
+    are updated in place (matching the reference's mutable-state semantics);
+    under jit the updated values flow out via functional_state buffers."""
+    ch_axis = 1 if data_format.startswith('NC') and to_jax(x).ndim > 1 else -1
+    use_batch = training and not use_global_stats
+
+    def stats_f(v):
+        axes = tuple(i for i in range(v.ndim) if i != ch_axis % v.ndim)
+        mu = jnp.mean(v, axis=axes)
+        var = jnp.mean(jnp.square(v), axis=axes) - jnp.square(mu)
+        return mu, var
+
+    if use_batch:
+        mu_t, var_t = apply_op(stats_f, x, _name='bn_stats')
+        n = to_jax(x).size // to_jax(x).shape[ch_axis]
+        unbiased = var_t * (n / max(n - 1, 1))
+        running_mean._data = (momentum * to_jax(running_mean)
+                              + (1 - momentum) * to_jax(mu_t))
+        running_var._data = (momentum * to_jax(running_var)
+                             + (1 - momentum) * to_jax(unbiased))
+        mean_arg, var_arg = mu_t, var_t
+    else:
+        mean_arg, var_arg = running_mean, running_var
+
+    def f(v, mu, var, *wb):
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        out = (v - mu.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x, mean_arg, var_arg] + [t for t in (weight, bias) if t is not None]
+    return defop(f, name='batch_norm')(*args)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format='NCHW', name=None):
+    def f(v, *wb):
+        if data_format != 'NCHW' and not data_format.startswith('NC'):
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[0], v.shape[1]
+        g = int(num_groups)
+        vv = v.reshape((n, g, c // g) + v.shape[2:])
+        axes = tuple(range(2, vv.ndim))
+        mu = jnp.mean(vv, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(vv - mu), axis=axes, keepdims=True)
+        out = ((vv - mu) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        shape = [1] * v.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if data_format != 'NCHW' and not data_format.startswith('NC'):
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return defop(f, name='group_norm')(*args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format='NCHW', name=None):
+    def f(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        mu = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v - mu), axis=axes, keepdims=True)
+        out = (v - mu) * jax.lax.rsqrt(var + eps)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return defop(f, name='instance_norm')(*args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format='NCHW', name=None):
+    def f(v):
+        sq = jnp.square(v)
+        half = size // 2
+        pad = [(0, 0)] * v.ndim
+        pad[1] = (half, size - half - 1)
+        sq = jnp.pad(sq, pad)
+        acc = sum(jax.lax.slice_in_dim(sq, i, i + v.shape[1], axis=1)
+                  for i in range(size))
+        return v / jnp.power(k + alpha * acc / size, beta)
+    return defop(f, name='local_response_norm')(x)
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _tuplize(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    return v if len(v) == n else tuple(v) * (n // len(v))
+
+
+def _conv_padding(padding, n, stride, dilation, ksize):
+    """Normalize paddle padding spec → lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # [[0,0],[0,0],[lo,hi],...] form
+    flat = [p for p in padding if isinstance(p, (list, tuple))]
+    if flat:
+        return [(int(p[0]), int(p[1])) for p in flat[-n:]]
+    raise ValueError(f'bad padding {padding!r}')
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             channel_last=False, name='conv'):
+    stride_t = _tuplize(stride, n)
+    dil_t = _tuplize(dilation, n)
+
+    def f(v, w, *b):
+        pad = _conv_padding(padding, n, stride_t, dil_t, w.shape[2:])
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        spatial = ''.join('DHW'[3 - n:][i] for i in range(n))
+        dn = jax.lax.conv_dimension_numbers(
+            v.shape, w.shape,
+            ('NC' + spatial, 'OI' + spatial, 'NC' + spatial))
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride_t, padding=pad,
+            rhs_dilation=dil_t, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            out = out + b[0].reshape((1, -1) + (1,) * n)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return defop(f, name=name)(*args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCL', name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    channel_last=(data_format == 'NLC'), name='conv1d')
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCHW', name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    channel_last=(data_format == 'NHWC'), name='conv2d')
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCDHW', name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last=(data_format == 'NDHWC'), name='conv3d')
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, channel_last, name):
+    stride_t = _tuplize(stride, n)
+    dil_t = _tuplize(dilation, n)
+    opad_t = _tuplize(output_padding, n)
+
+    def f(v, w, *b):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        pad = _conv_padding(padding, n, stride_t, dil_t, w.shape[2:])
+        if isinstance(pad, str):
+            pads = [(0, 0)] * n if pad == 'VALID' else None
+            if pads is None:
+                raise ValueError('SAME padding unsupported for conv_transpose')
+            pad = pads
+        # gradient-of-conv formulation: lhs-dilate the input by stride
+        k = [(w.shape[2 + i] - 1) * dil_t[i] + 1 for i in range(n)]
+        tpad = [(k[i] - 1 - pad[i][0], k[i] - 1 - pad[i][1] + opad_t[i])
+                for i in range(n)]
+        spatial = ''.join('DHW'[3 - n:][i] for i in range(n))
+        # weight layout is [in, out//groups, *k] for paddle conv_transpose
+        w_t = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            gi = w.shape[0] // groups
+            w_t = w_t.reshape((groups, gi) + w_t.shape[1:])
+            w_t = jnp.moveaxis(w_t, 2, 1).reshape(
+                (groups * w.shape[1], gi) + w.shape[2:])
+        else:
+            w_t = jnp.swapaxes(w_t, 0, 1)
+        dn = jax.lax.conv_dimension_numbers(
+            v.shape, w_t.shape,
+            ('NC' + spatial, 'OI' + spatial, 'NC' + spatial))
+        out = jax.lax.conv_general_dilated(
+            v, w_t, window_strides=(1,) * n, padding=tpad,
+            lhs_dilation=stride_t, rhs_dilation=dil_t,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape((1, -1) + (1,) * n)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return defop(f, name=name)(*args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format='NCL', name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format == 'NLC',
+                              'conv1d_transpose')
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format='NCHW', output_size=None, name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format == 'NHWC',
+                              'conv2d_transpose')
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def _pool_nd(x, kernel, stride, padding, n, reducer, init, ceil_mode=False,
+             count_include_pad=True, average=False, name='pool'):
+    k_t = _tuplize(kernel, n)
+    s_t = _tuplize(stride if stride is not None else kernel, n)
+
+    def f(v):
+        pad = _conv_padding(padding, n, s_t, (1,) * n, k_t)
+        if isinstance(pad, str):
+            raise ValueError('str padding unsupported in pool')
+        window = (1, 1) + k_t
+        strides = (1, 1) + s_t
+        pads = [(0, 0), (0, 0)] + list(pad)
+        out = jax.lax.reduce_window(v, init, reducer, window, strides, pads)
+        if average:
+            if count_include_pad and any(p != (0, 0) for p in pad):
+                out = out / float(np.prod(k_t))
+            else:
+                ones = jnp.ones(v.shape, v.dtype)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                            strides, pads)
+                out = out / cnt
+        return out
+    return defop(f, name=name)(x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
+                    -jnp.inf, ceil_mode, name='max_pool1d')
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCHW', name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
+                    -jnp.inf, ceil_mode, name='max_pool2d')
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCDHW', name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
+                    -jnp.inf, ceil_mode, name='max_pool3d')
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0,
+                    ceil_mode, count_include_pad=not exclusive, average=True,
+                    name='avg_pool1d')
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCHW',
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                    ceil_mode, count_include_pad=not exclusive, average=True,
+                    name='avg_pool2d')
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCDHW',
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0,
+                    ceil_mode, count_include_pad=not exclusive, average=True,
+                    name='avg_pool3d')
+
+
+def _adaptive_pool(x, output_size, n, maximum, name):
+    def f(v):
+        out_sz = _tuplize(output_size, n)
+        spatial = v.shape[-n:]
+        # integer bucketing identical to the reference's adaptive pooling
+        res = v
+        for d in range(n):
+            in_d = spatial[d]
+            out_d = out_sz[d]
+            axis = v.ndim - n + d
+            starts = [int(_math.floor(i * in_d / out_d)) for i in range(out_d)]
+            ends = [int(_math.ceil((i + 1) * in_d / out_d)) for i in range(out_d)]
+            pieces = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(res, s, e, axis=axis)
+                red = (jnp.max if maximum else jnp.mean)(seg, axis=axis,
+                                                         keepdims=True)
+                pieces.append(red)
+            res = jnp.concatenate(pieces, axis=axis)
+        return res
+    return defop(f, name=name)(x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, False, 'adaptive_avg_pool1d')
+
+
+def adaptive_avg_pool2d(x, output_size, data_format='NCHW', name=None):
+    return _adaptive_pool(x, output_size, 2, False, 'adaptive_avg_pool2d')
+
+
+def adaptive_avg_pool3d(x, output_size, data_format='NCDHW', name=None):
+    return _adaptive_pool(x, output_size, 3, False, 'adaptive_avg_pool3d')
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, True, 'adaptive_max_pool1d')
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, True, 'adaptive_max_pool2d')
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+
+def pad(x, pad, mode='constant', value=0.0, data_format='NCHW', name=None):
+    """Pad the last len(pad)//2 dims, innermost-first (reference layout)."""
+    pad_l = [int(p) for p in (pad.tolist() if hasattr(pad, 'tolist') else pad)]
+
+    def f(v):
+        if len(pad_l) == 2 * v.ndim:
+            cfg = [(pad_l[2 * i], pad_l[2 * i + 1]) for i in range(v.ndim)]
+        else:
+            # innermost-dim-first pairs, padding the last k dims
+            k = len(pad_l) // 2
+            cfg = [(0, 0)] * (v.ndim - k) + [
+                (pad_l[2 * (k - 1 - i)], pad_l[2 * (k - 1 - i) + 1])
+                for i in range(k)]
+        jmode = {'constant': 'constant', 'reflect': 'reflect',
+                 'replicate': 'edge', 'circular': 'wrap'}[mode]
+        if jmode == 'constant':
+            return jnp.pad(v, cfg, mode=jmode,
+                           constant_values=np.asarray(value, v.dtype))
+        return jnp.pad(v, cfg, mode=jmode)
+    return defop(f, name='pad')(x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (NCHW → [N, C*kh*kw, L]) via conv_general_dilated_patches."""
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    d = _tuplize(dilations, 2)
+
+    def f(v):
+        pd = _conv_padding(paddings, 2, s, d, k)
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s, padding=pd,
+            rhs_dilation=d, dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        n = v.shape[0]
+        return patches.reshape(n, patches.shape[1], -1)
+    return defop(f, name='unfold')(x)
+
+
+def pixel_shuffle(x, upscale_factor, data_format='NCHW', name=None):
+    r = int(upscale_factor)
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = v.transpose(0, 1, 4, 2, 5, 3)
+        return v.reshape(n, c // (r * r), h * r, w * r)
+    return defop(f, name='pixel_shuffle')(x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format='NCHW', name=None):
+    r = int(downscale_factor)
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        v = v.transpose(0, 1, 3, 5, 2, 4)
+        return v.reshape(n, c * r * r, h // r, w // r)
+    return defop(f, name='pixel_unshuffle')(x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode='nearest',
+                align_corners=False, align_mode=0, data_format='NCHW',
+                name=None):
+    def f(v):
+        spatial_in = v.shape[2:]
+        if size is not None:
+            out_sz = _tuplize(size, len(spatial_in))
+        else:
+            sf = scale_factor
+            if isinstance(sf, (int, float)):
+                sf = [sf] * len(spatial_in)
+            out_sz = tuple(int(s * f_) for s, f_ in zip(spatial_in, sf))
+        if mode == 'nearest':
+            return jax.image.resize(v, v.shape[:2] + out_sz, method='nearest')
+        if mode in ('bilinear', 'linear', 'trilinear', 'bicubic'):
+            if not align_corners:
+                meth = 'cubic' if mode == 'bicubic' else 'linear'
+                return jax.image.resize(v, v.shape[:2] + out_sz, method=meth)
+            # align_corners=True: explicit gather-based linear interp
+            out = v
+            for d, o in enumerate(out_sz):
+                axis = 2 + d
+                in_d = out.shape[axis]
+                if o == 1 or in_d == 1:
+                    idx = jnp.zeros((o,), jnp.float32)
+                else:
+                    idx = jnp.arange(o) * ((in_d - 1) / (o - 1))
+                lo = jnp.floor(idx).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, in_d - 1)
+                w_hi = (idx - lo).astype(v.dtype)
+                a = jnp.take(out, lo, axis=axis)
+                b_ = jnp.take(out, hi, axis=axis)
+                shape = [1] * out.ndim
+                shape[axis] = o
+                w_hi = w_hi.reshape(shape)
+                out = a * (1 - w_hi) + b_ * w_hi
+            return out
+        raise ValueError(f'unsupported interpolate mode {mode!r}')
+    return defop(f, name='interpolate')(x)
+
+
+def upsample(x, size=None, scale_factor=None, mode='nearest',
+             align_corners=False, align_mode=0, data_format='NCHW', name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+def _reduce(v, reduction):
+    if reduction == 'mean':
+        return jnp.mean(v)
+    if reduction == 'sum':
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction='mean', soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def f(logits, lab, *w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        nclass = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            per = -jnp.sum(soft * logp, axis=axis)
+            return _reduce(per, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logp.ndim:  # trailing [..., 1] label layout
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis)
+        per = -jnp.squeeze(picked, axis)
+        if label_smoothing:
+            smooth = -jnp.mean(logp, axis=axis)
+            per = (1 - label_smoothing) * per + label_smoothing * smooth
+        if w:
+            cw = jnp.take(w[0], safe)
+            per = per * cw
+            per = jnp.where(valid, per, 0.0)
+            if reduction == 'mean':
+                return jnp.sum(per) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, cw, 0.0)), 1e-12)
+            return _reduce(per, reduction)
+        per = jnp.where(valid, per, 0.0)
+        if reduction == 'mean':
+            denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+            return jnp.sum(per) / denom
+        return _reduce(per, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return defop(f, name='cross_entropy')(*args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False, name=None):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction='none', axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction='mean',
+             name=None):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        per = -jnp.squeeze(picked, 1)
+        if w:
+            cw = jnp.take(w[0], safe)
+            per = per * cw
+            per = jnp.where(valid, per, 0.0)
+            if reduction == 'mean':
+                return jnp.sum(per) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, cw, 0.0)), 1e-12)
+            return _reduce(per, reduction)
+        per = jnp.where(valid, per, 0.0)
+        if reduction == 'mean':
+            denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+            return jnp.sum(per) / denom
+        return _reduce(per, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return defop(f, name='nll_loss')(*args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction='mean',
+                         name=None):
+    def f(p, y, *w):
+        eps = 1e-12
+        per = -(y * jnp.log(jnp.maximum(p, eps))
+                + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            per = per * w[0]
+        return _reduce(per, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return defop(f, name='binary_cross_entropy')(*args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction='mean', pos_weight=None,
+                                     name=None):
+    def f(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            logsig = -jax.nn.log_sigmoid(z)       # -log σ(z)
+            logsig_neg = -jax.nn.log_sigmoid(-z)  # -log(1-σ(z))
+            base = y * pw * logsig + (1 - y) * logsig_neg
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return defop(f, name='bce_with_logits')(*args)
+
+
+def mse_loss(input, label, reduction='mean', name=None):
+    return defop(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 name='mse_loss')(input, label)
+
+
+def l1_loss(input, label, reduction='mean', name=None):
+    return defop(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 name='l1_loss')(input, label)
+
+
+def smooth_l1_loss(input, label, reduction='mean', delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        per = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # reference multiplies by delta (huber): loss = delta * huber_delta
+        per = per * delta
+        return _reduce(per, reduction)
+    return defop(f, name='smooth_l1_loss')(input, label)
+
+
+def kl_div(input, label, reduction='mean', log_target=False, name=None):
+    def f(logp, q):
+        tgt = jnp.exp(q) if log_target else q
+        logt = q if log_target else jnp.log(jnp.maximum(q, 1e-12))
+        per = tgt * (logt - logp)
+        if reduction == 'batchmean':
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+    return defop(f, name='kl_div')(input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction='mean',
+                        name=None):
+    def f(a, b, y):
+        per = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(per, reduction)
+    return defop(f, name='margin_ranking_loss')(input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction='mean', name=None):
+    def f(a, y):
+        per = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(per, reduction)
+    return defop(f, name='hinge_embedding_loss')(input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction='sum', name=None):
+    def f(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm:
+            per = per / nrm[0]
+        return _reduce(per, reduction)
+    args = (logit, label) if normalizer is None else (logit, label, normalizer)
+    return defop(f, name='sigmoid_focal_loss')(*args)
+
+
+def square_error_cost(input, label, name=None):
+    return defop(lambda a, b: jnp.square(a - b), name='square_error_cost')(
+        input, label)
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Fused attention. Layout [batch, seq, heads, head_dim] (reference
+    paddle.nn.functional.scaled_dot_product_attention). On TPU this lowers
+    to the pallas flash-attention kernel; elsewhere to an XLA softmax chain.
+    """
+    from ..ops import pallas as _pallas
+    drop_key = framework.next_rng_key() if (dropout_p and training) else None
+
+    def f(q, k, v, *m):
+        mask = m[0] if m else None
+        return _pallas.flash_attention(
+            q, k, v, mask=mask, causal=is_causal,
+            dropout_p=dropout_p if training else 0.0, dropout_key=drop_key)
+    args = (query, key, value) if attn_mask is None else (
+        query, key, value, attn_mask)
+    return defop(f, name='scaled_dot_product_attention')(*args)
+
+
+# aliases the reference exposes
+def alltoall(*a, **k):  # placed in distributed; import-compat shim
+    from .. import distributed
+    return distributed.alltoall(*a, **k)

@@ -1,0 +1,209 @@
+"""paddle.nn.initializer — weight initializers.
+
+Upstream: python/paddle/nn/initializer/*.py. Each initializer is a callable
+`(shape, dtype) -> jax array`, drawing from the global stateless PRNG so
+initialization is reproducible from `paddle.seed`.
+
+Fan computation follows the reference: for Linear-style [in, out] weights
+fan_in/fan_out are the first/last dims; conv kernels [out_c, in_c, *k]
+multiply by the receptive-field size.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+
+
+def _fans(shape):
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    rf = int(np.prod(shape[2:]))  # conv kernels: [out, in, *spatial]
+    return shape[1] * rf, shape[0] * rf
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(shape, self.value,
+                        dtype or framework.get_default_dtype())
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        k = framework.next_rng_key()
+        return (jax.random.normal(k, shape, jnp.float32) * self.std
+                + self.mean).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    """Normal truncated to ±2σ (reference semantics)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        k = framework.next_rng_key()
+        s = jax.random.truncated_normal(k, -2.0, 2.0, shape, jnp.float32)
+        return (s * self.std + self.mean).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        k = framework.next_rng_key()
+        return jax.random.uniform(k, shape, jnp.float32, self.low,
+                                  self.high).astype(dt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / max(1, fi + fo))
+        k = framework.next_rng_key()
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(dt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / max(1, fi + fo))
+        k = framework.next_rng_key()
+        return jax.random.uniform(k, shape, jnp.float32, -limit,
+                                  limit).astype(dt)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity='relu'):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) \
+            if self.nonlinearity in ('relu', 'leaky_relu') else 1.0
+        std = gain / math.sqrt(max(1, fi))
+        k = framework.next_rng_key()
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(dt)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity='relu'):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) \
+            if self.nonlinearity in ('relu', 'leaky_relu') else 1.0
+        limit = gain * math.sqrt(3.0 / max(1, fi))
+        k = framework.next_rng_key()
+        return jax.random.uniform(k, shape, jnp.float32, -limit,
+                                  limit).astype(dt)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        shape = tuple(int(s) for s in shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        k = framework.next_rng_key()
+        a = jax.random.normal(k, (max(rows, cols), min(rows, cols)),
+                              jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))  # unique decomposition
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dt)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel init."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        shape = tuple(int(s) for s in shape)
+        out_c, in_c = shape[0], shape[1]
+        w = np.zeros(shape, np.float32)
+        centers = [s // 2 for s in shape[2:]]
+        per = out_c // self.groups
+        for i in range(out_c):
+            ch = i % in_c if in_c else 0
+            w[(i, ch) + tuple(centers)] = 1.0
+        return jnp.asarray(w, dt)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        v = self.value
+        arr = np.asarray(v.numpy() if hasattr(v, 'numpy') else v)
+        if tuple(arr.shape) != tuple(int(s) for s in shape):
+            raise ValueError(
+                f'Assign initializer shape {arr.shape} != param shape {shape}')
+        return jnp.asarray(arr, dt)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == 'tanh':
+        return 5.0 / 3
+    if nonlinearity == 'relu':
+        return math.sqrt(2.0)
+    if nonlinearity == 'leaky_relu':
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == 'selu':
+        return 3.0 / 4
+    return 1.0

@@ -1,0 +1,371 @@
+"""paddle.nn.Layer — the module base class.
+
+Upstream: python/paddle/nn/layer/layers.py (Layer). Parameters are leaf
+Tensors; sublayers form a tree; state_dict round-trips through plain dicts
+of numpy-convertible tensors. The jit path (paddle_tpu.jit) pulls the
+parameter/buffer pytree out of a Layer and runs forward functionally.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..dtype import convert_dtype
+from ..tensor import Parameter, Tensor
+from . import initializer as I
+
+
+class ParamAttr:
+    """Parameter configuration (upstream: python/paddle/base/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None or isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        raise TypeError(f'cannot convert {attr!r} to ParamAttr')
+
+
+_layer_name_counts: Dict[str, int] = collections.defaultdict(int)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype='float32'):
+        # use object.__setattr__: our __setattr__ needs these dicts to exist
+        d = self.__dict__
+        d['_parameters'] = collections.OrderedDict()
+        d['_buffers'] = collections.OrderedDict()
+        d['_non_persistable_buffer_names'] = set()
+        d['_sub_layers'] = collections.OrderedDict()
+        d['training'] = True
+        d['_dtype'] = convert_dtype(dtype) if dtype is not None else None
+        d['_forward_pre_hooks'] = collections.OrderedDict()
+        d['_forward_post_hooks'] = collections.OrderedDict()
+        d['_hook_id'] = 0
+        scope = name_scope or type(self).__name__.lower()
+        idx = _layer_name_counts[scope]
+        _layer_name_counts[scope] += 1
+        d['_full_name'] = f'{scope}_{idx}'
+
+    # -- attribute routing --------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get('_parameters')
+        subs = self.__dict__.get('_sub_layers')
+        bufs = self.__dict__.get('_buffers')
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError('call super().__init__() first')
+            for store in (subs, bufs):
+                if store is not None and name in store:
+                    del store[name]
+            params[name] = value
+        elif isinstance(value, Layer):
+            if subs is None:
+                raise RuntimeError('call super().__init__() first')
+            for store in (params, bufs):
+                if store is not None and name in store:
+                    del store[name]
+            subs[name] = value
+        elif bufs is not None and name in bufs:
+            bufs[name] = value
+        elif params is not None and name in params and value is None:
+            params[name] = None
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ('_parameters', '_buffers', '_sub_layers'):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f'{type(self).__name__!r} object has no attribute {name!r}')
+
+    def __delattr__(self, name):
+        for store in ('_parameters', '_buffers', '_sub_layers'):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) \
+            + list(self._buffers) + list(self._sub_layers)
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dt = convert_dtype(dtype) if dtype is not None else (
+            self._dtype or framework.get_default_dtype())
+        init = None
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        elif is_bias:
+            init = I.Constant(0.0)
+        else:
+            init = I.XavierUniform()
+        shape = tuple(int(s) for s in shape)
+        val = init(shape, dt)
+        p = Parameter(val, name=(attr.name if attr else None) or '',
+                      trainable=(attr.trainable if attr else True))
+        if attr is not None:
+            p.optimize_attr['learning_rate'] = attr.learning_rate
+            p.regularizer = attr.regularizer
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError('add_parameter expects a Parameter')
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        if not isinstance(sublayer, Layer):
+            raise TypeError('add_sublayer expects a Layer')
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            raise TypeError('register_buffer expects a Tensor')
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- traversal ----------------------------------------------------------
+    def children(self) -> Iterator['Layer']:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False) -> List['Layer']:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix='', include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            p = f'{prefix}.{name}' if prefix else name
+            yield from l.named_sublayers(prefix=p, include_self=True,
+                                         layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix='', include_sublayers=True):
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f'{lp}.{name}' if lp else name), p
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix='', include_sublayers=True):
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f'{lp}.{name}' if lp else name), b
+
+    # -- mode / apply / dtype ----------------------------------------------
+    def train(self):
+        for _, l in self.named_sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for _, l in self.named_sublayers(include_self=True):
+            l.training = False
+        return self
+
+    def apply(self, fn: Callable[['Layer'], None]):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            for _, l in self.named_sublayers(include_self=True):
+                for k, p in l._parameters.items():
+                    if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                        p._data = p._data.astype(dt)
+                for k, b in l._buffers.items():
+                    if b is not None and jnp.issubdtype(b.dtype, jnp.floating):
+                        b._data = b._data.astype(dt)
+                l.__dict__['_dtype'] = dt
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype='float32')
+
+    def bfloat16(self):
+        return self.to(dtype='bfloat16')
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix='', use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip('.'),
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(
+                prefix=structured_name_prefix.rstrip('.'),
+                include_sublayers=include_sublayers):
+            short = name.rsplit('.', 1)[-1]
+            owner = self
+            if '.' in name:
+                # locate owning layer to check persistability
+                path = name.rsplit('.', 1)[0]
+                for ln, l in self.named_sublayers(include_self=True):
+                    if ln == path:
+                        owner = l
+                        break
+            if short in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Load a state dict; returns (missing_keys, unexpected_keys)."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for k, v in state_dict.items():
+            if k in own:
+                matched[k] = v
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        for k, v in matched.items():
+            tgt = own[k]
+            val = v.value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(val.shape) != tuple(tgt._data.shape):
+                raise ValueError(
+                    f'shape mismatch for {k}: got {tuple(val.shape)}, '
+                    f'expected {tuple(tgt._data.shape)}')
+            tgt._data = jnp.asarray(val, tgt.dtype)
+            tgt._node = None
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self.__dict__['_hook_id'] += 1
+        hid = self._hook_id
+        self._forward_pre_hooks[hid] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        self.__dict__['_hook_id'] += 1
+        hid = self._hook_id
+        self._forward_post_hooks[hid] = hook
+        return HookRemoveHelper(self._forward_post_hooks, hid)
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f'{type(self).__name__} must implement forward()')
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            res = hook(self, inputs)
+            if res is not None:
+                inputs = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, out)
+            if res is not None:
+                out = res
+        return out
+
+    # -- misc ---------------------------------------------------------------
+    def full_name(self):
+        return self._full_name
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self):
+        return ''
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self.named_children():
+            child = repr(l).split('\n')
+            child = [child[0]] + ['  ' + c for c in child[1:]]
+            lines.append(f'  ({name}): ' + '\n'.join(child))
+        body = ('\n'.join(lines) + '\n') if lines else ''
+        inner = extra if not lines else (extra + '\n' if extra else '')
+        return f'{type(self).__name__}({inner}{body})' if (lines or extra) \
+            else f'{type(self).__name__}()'
+
+
+class HookRemoveHelper:
+    def __init__(self, store, hid):
+        self._store = store
+        self._hid = hid
+
+    def remove(self):
+        self._store.pop(self._hid, None)

@@ -1,0 +1,208 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (upstream: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is a `lax.scan` (single compiled loop body, no
+Python unrolling), run once per layer per direction. Gate layouts follow
+the reference: LSTM chunks [i, f, g, o]; GRU chunks [r, z, c].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import apply_op
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+
+def _uniform_init(hidden_size):
+    import math
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class _RNNBase(Layer):
+    GATES = 1  # multiplier for gate-stacked weights
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction='forward', time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ('bidirect', 'bidirectional')
+        ndir = 2 if self.bidirectional else 1
+        init = _uniform_init(hidden_size)
+        g = self.GATES
+        for layer in range(num_layers):
+            for d in range(ndir):
+                sfx = f'_l{layer}' + ('_reverse' if d else '')
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                self.add_parameter(
+                    'weight_ih' + sfx,
+                    self.create_parameter((g * hidden_size, in_sz),
+                                          default_initializer=init))
+                self.add_parameter(
+                    'weight_hh' + sfx,
+                    self.create_parameter((g * hidden_size, hidden_size),
+                                          default_initializer=init))
+                self.add_parameter(
+                    'bias_ih' + sfx,
+                    self.create_parameter((g * hidden_size,),
+                                          default_initializer=init))
+                self.add_parameter(
+                    'bias_hh' + sfx,
+                    self.create_parameter((g * hidden_size,),
+                                          default_initializer=init))
+
+    # cell: (carry, x_t, wih, whh, bih, bhh) -> (carry, out_t)
+    @staticmethod
+    def _cell(carry, xt, wih, whh, bih, bhh):
+        raise NotImplementedError
+
+    def _init_carry(self, batch, dtype):
+        raise NotImplementedError
+
+    def _carry_h(self, carry):
+        return carry
+
+    def forward(self, x, initial_states=None, sequence_length=None):
+        ndir = 2 if self.bidirectional else 1
+        H = self.hidden_size
+        batch_axis = 1 if self.time_major else 0
+
+        layer_in = x
+        finals = []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(ndir):
+                sfx = f'_l{layer}' + ('_reverse' if d else '')
+                wih = getattr(self, 'weight_ih' + sfx)
+                whh = getattr(self, 'weight_hh' + sfx)
+                bih = getattr(self, 'bias_ih' + sfx)
+                bhh = getattr(self, 'bias_hh' + sfx)
+                idx = layer * ndir + d
+
+                init_state = None
+                if initial_states is not None:
+                    if isinstance(initial_states, (tuple, list)):
+                        init_state = tuple(s[idx] for s in initial_states)
+                    else:
+                        init_state = (initial_states[idx],)
+
+                cell = type(self)._cell
+                reverse = bool(d)
+                time_major = self.time_major
+
+                def f(v, wi, wh, bi, bh, *init_vals):
+                    seq = v if time_major else jnp.swapaxes(v, 0, 1)
+                    if reverse:
+                        seq = jnp.flip(seq, axis=0)
+                    b = seq.shape[1]
+                    if init_vals:
+                        carry = tuple(init_vals)
+                        if len(carry) == 1:
+                            carry = carry[0]
+                    else:
+                        carry = self._init_carry(b, v.dtype)
+
+                    def step(c, xt):
+                        return cell(c, xt, wi, wh, bi, bh)
+                    carry, ys = jax.lax.scan(step, carry, seq)
+                    if reverse:
+                        ys = jnp.flip(ys, axis=0)
+                    if not time_major:
+                        ys = jnp.swapaxes(ys, 0, 1)
+                    return ys, carry
+
+                args = [layer_in, wih, whh, bih, bhh]
+                if init_state is not None:
+                    args += list(init_state)
+                ys, carry = apply_op(f, *args, _name=type(self).__name__.lower())
+                outs.append(ys)
+                finals.append(carry)
+            layer_out = outs[0] if ndir == 1 else \
+                apply_op(lambda a, b: jnp.concatenate([a, b], axis=-1),
+                         outs[0], outs[1], _name='concat')
+            if self.dropout and layer < self.num_layers - 1:
+                layer_out = F.dropout(layer_out, self.dropout,
+                                      training=self.training)
+            layer_in = layer_out
+
+        # stack final states: [num_layers*ndir, batch, hidden]
+        if isinstance(finals[0], tuple):
+            n_state = len(finals[0])
+            stacked = tuple(
+                apply_op(lambda *hs: jnp.stack(hs, axis=0),
+                         *[fc[i] for fc in finals], _name='stack')
+                for i in range(n_state))
+            final_state = stacked if n_state > 1 else stacked[0]
+        else:
+            final_state = apply_op(lambda *hs: jnp.stack(hs, axis=0),
+                                   *finals, _name='stack')
+        return layer_out, final_state
+
+
+class SimpleRNN(_RNNBase):
+    GATES = 1
+
+    def __init__(self, *args, activation='tanh', **kwargs):
+        self._act = activation
+        super().__init__(*args, **kwargs)
+        type(self)._cell = staticmethod(
+            _simple_cell_tanh if activation == 'tanh' else _simple_cell_relu)
+
+    def _init_carry(self, batch, dtype):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+
+def _simple_cell_tanh(h, xt, wih, whh, bih, bhh):
+    h2 = jnp.tanh(xt @ wih.T + bih + h @ whh.T + bhh)
+    return h2, h2
+
+
+def _simple_cell_relu(h, xt, wih, whh, bih, bhh):
+    h2 = jax.nn.relu(xt @ wih.T + bih + h @ whh.T + bhh)
+    return h2, h2
+
+
+class LSTM(_RNNBase):
+    GATES = 4
+
+    @staticmethod
+    def _cell(carry, xt, wih, whh, bih, bhh):
+        h, c = carry
+        z = xt @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    def _init_carry(self, batch, dtype):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)
+
+
+class GRU(_RNNBase):
+    GATES = 3
+
+    @staticmethod
+    def _cell(h, xt, wih, whh, bih, bhh):
+        xz = xt @ wih.T + bih
+        hz = h @ whh.T + bhh
+        xr, xu, xc = jnp.split(xz, 3, axis=-1)
+        hr, hu, hc = jnp.split(hz, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        u = jax.nn.sigmoid(xu + hu)
+        c = jnp.tanh(xc + r * hc)
+        h2 = u * h + (1 - u) * c
+        return h2, h2
+
+    def _init_carry(self, batch, dtype):
+        return jnp.zeros((batch, self.hidden_size), dtype)

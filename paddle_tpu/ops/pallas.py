@@ -1,0 +1,86 @@
+"""Hot fused ops: TPU pallas kernels with XLA fallbacks.
+
+Upstream analogue: the reference's hand-fused CUDA kernels
+(paddle/phi/kernels/fusion/gpu/*, flash-attn integration). Here the
+default path is plain jax — XLA already fuses normalization chains into
+adjacent matmuls — and the pallas kernels (ops/pallas_kernels.py) take
+over on real TPU backends for the attention inner loop, where manual
+VMEM blocking beats the XLA-generated schedule.
+
+All functions in this module operate on raw jax arrays (they are called
+from inside apply_op bodies / jitted train steps).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(None)
+def _pallas_enabled() -> bool:
+    if os.environ.get('PADDLE_TPU_DISABLE_PALLAS'):
+        return False
+    try:
+        return jax.default_backend() == 'tpu'
+    except Exception:
+        return False
+
+
+def rms_norm(v, epsilon=1e-6, axis=-1):
+    """x / sqrt(mean(x^2) + eps). XLA fuses this; kept as the single
+    choke-point so a pallas kernel can slot in for very wide rows."""
+    ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis, keepdims=True)
+    return (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+
+
+def _attention_xla(q, k, v, mask=None, causal=False, dropout_p=0.0,
+                   dropout_key=None):
+    """Reference attention in [B, S, H, D] layout (paddle SDPA convention)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kv_heads = k.shape[2]
+    if kv_heads != h:  # GQA: broadcast kv heads across query groups
+        rep = h // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    # [B, H, Sq, Sk]
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        idx_q = jnp.arange(sq)[:, None] + (sk - sq)
+        idx_k = jnp.arange(sk)[None, :]
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+        logits = jnp.where(idx_k <= idx_q, logits, neg)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits,
+                               jnp.asarray(jnp.finfo(jnp.float32).min))
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum('bhqk,bkhd->bqhd', probs.astype(q.dtype), v)
+    return out
+
+
+def flash_attention(q, k, v, mask=None, causal=False, dropout_p=0.0,
+                    dropout_key=None):
+    """Dispatch: pallas flash kernel on TPU (no mask/dropout path), XLA
+    softmax-attention otherwise."""
+    if (_pallas_enabled() and mask is None and dropout_p == 0.0
+            and q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0
+            and q.shape[-1] in (64, 128, 256)):
+        try:
+            from . import pallas_kernels
+            return pallas_kernels.flash_attention(q, k, v, causal=causal)
+        except Exception:
+            pass  # fall back to XLA on any kernel/shape issue
+    return _attention_xla(q, k, v, mask=mask, causal=causal,
+                         dropout_p=dropout_p, dropout_key=dropout_key)

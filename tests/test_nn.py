@@ -1,0 +1,338 @@
+"""nn stack tests: functional parity vs numpy/torch, layer round-trips
+(SURVEY.md §4 test_nn_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip('torch')
+import torch.nn.functional as TF  # noqa: E402
+
+
+def t2n(x):
+    return x.numpy()
+
+
+def assert_close(a, b, tol=1e-5):
+    np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+class TestFunctionalParity:
+    def _cmp(self, ours, theirs, x, tol=1e-5, **kw):
+        a = ours(paddle.to_tensor(x), **kw).numpy()
+        b = theirs(torch.tensor(x), **kw).numpy()
+        assert_close(a, b, tol)
+
+    def test_activations(self):
+        x = np.random.randn(4, 7).astype(np.float32)
+        for ours, theirs in [
+            (F.relu, TF.relu), (F.relu6, TF.relu6), (F.silu, TF.silu),
+            (F.sigmoid, torch.sigmoid), (F.tanh, torch.tanh),
+            (F.elu, TF.elu), (F.selu, TF.selu), (F.celu, TF.celu),
+            (F.hardswish, TF.hardswish), (F.hardsigmoid, TF.hardsigmoid),
+            (F.mish, TF.mish), (F.softplus, TF.softplus),
+            (F.softsign, TF.softsign), (F.leaky_relu, TF.leaky_relu),
+            (F.hardshrink, TF.hardshrink), (F.softshrink, TF.softshrink),
+            (F.tanhshrink, TF.tanhshrink), (F.logsigmoid, TF.logsigmoid),
+        ]:
+            self._cmp(ours, theirs, x)
+
+    def test_gelu(self):
+        x = np.random.randn(4, 7).astype(np.float32)
+        assert_close(F.gelu(paddle.to_tensor(x)).numpy(),
+                     TF.gelu(torch.tensor(x)).numpy(), 1e-5)
+        assert_close(F.gelu(paddle.to_tensor(x), approximate=True).numpy(),
+                     TF.gelu(torch.tensor(x), approximate='tanh').numpy(),
+                     1e-5)
+
+    def test_softmax_family(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        assert_close(F.softmax(paddle.to_tensor(x)).numpy(),
+                     TF.softmax(torch.tensor(x), dim=-1).numpy())
+        assert_close(F.log_softmax(paddle.to_tensor(x)).numpy(),
+                     TF.log_softmax(torch.tensor(x), dim=-1).numpy())
+
+    def test_linear(self):
+        x = np.random.randn(2, 4).astype(np.float32)
+        w = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(3).astype(np.float32)
+        ours = F.linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                        paddle.to_tensor(b)).numpy()
+        assert_close(ours, x @ w + b)
+
+    def test_embedding_padding_idx(self):
+        w = np.random.randn(5, 3).astype(np.float32)
+        ids = np.array([[0, 1], [4, 1]])
+        out = F.embedding(paddle.to_tensor(ids), paddle.to_tensor(w),
+                          padding_idx=1).numpy()
+        assert_close(out[0, 0], w[0])
+        assert np.all(out[0, 1] == 0)
+        assert np.all(out[1, 1] == 0)
+
+    def test_layer_norm(self):
+        x = np.random.randn(2, 3, 8).astype(np.float32)
+        w = np.random.rand(8).astype(np.float32)
+        b = np.random.randn(8).astype(np.float32)
+        ours = F.layer_norm(paddle.to_tensor(x), 8, paddle.to_tensor(w),
+                            paddle.to_tensor(b)).numpy()
+        theirs = TF.layer_norm(torch.tensor(x), (8,), torch.tensor(w),
+                               torch.tensor(b)).numpy()
+        assert_close(ours, theirs, 1e-4)
+
+    def test_rms_norm(self):
+        x = np.random.randn(2, 8).astype(np.float32)
+        out = F.rms_norm(paddle.to_tensor(x)).numpy()
+        expect = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        assert_close(out, expect, 1e-5)
+
+    def test_group_norm(self):
+        x = np.random.randn(2, 6, 4, 4).astype(np.float32)
+        w = np.random.rand(6).astype(np.float32)
+        b = np.random.randn(6).astype(np.float32)
+        ours = F.group_norm(paddle.to_tensor(x), 3, paddle.to_tensor(w),
+                            paddle.to_tensor(b)).numpy()
+        theirs = TF.group_norm(torch.tensor(x), 3, torch.tensor(w),
+                               torch.tensor(b)).numpy()
+        assert_close(ours, theirs, 1e-4)
+
+    def test_batch_norm_train_and_eval(self):
+        x = np.random.randn(4, 3, 5, 5).astype(np.float32)
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        tbn = torch.nn.BatchNorm2d(3, momentum=0.1)  # torch momentum is 1-m
+        with torch.no_grad():
+            tbn.weight.copy_(torch.tensor(bn.weight.numpy()))
+            tbn.bias.copy_(torch.tensor(bn.bias.numpy()))
+        out = bn(paddle.to_tensor(x)).numpy()
+        tout = tbn(torch.tensor(x)).detach().numpy()
+        assert_close(out, tout, 1e-4)
+        assert_close(bn._mean.numpy(), tbn.running_mean.numpy(), 1e-4)
+        assert_close(bn._variance.numpy(), tbn.running_var.numpy(), 1e-4)
+        bn.eval(); tbn.eval()
+        assert_close(bn(paddle.to_tensor(x)).numpy(),
+                     tbn(torch.tensor(x)).detach().numpy(), 1e-4)
+
+    def test_conv2d(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(5, 3, 3, 3).astype(np.float32) * 0.1
+        b = np.random.randn(5).astype(np.float32)
+        ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        paddle.to_tensor(b), stride=2, padding=1).numpy()
+        theirs = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                           stride=2, padding=1).numpy()
+        assert_close(ours, theirs, 1e-4)
+
+    def test_conv2d_groups_dilation(self):
+        x = np.random.randn(1, 4, 9, 9).astype(np.float32)
+        w = np.random.randn(8, 2, 3, 3).astype(np.float32) * 0.1
+        ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        groups=2, dilation=2).numpy()
+        theirs = TF.conv2d(torch.tensor(x), torch.tensor(w), groups=2,
+                           dilation=2).numpy()
+        assert_close(ours, theirs, 1e-4)
+
+    def test_conv2d_transpose(self):
+        x = np.random.randn(1, 4, 5, 5).astype(np.float32)
+        w = np.random.randn(4, 3, 3, 3).astype(np.float32) * 0.1
+        ours = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                  stride=2, padding=1,
+                                  output_padding=1).numpy()
+        theirs = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                     stride=2, padding=1,
+                                     output_padding=1).numpy()
+        assert_close(ours, theirs, 1e-4)
+
+    def test_pools(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        assert_close(
+            F.max_pool2d(paddle.to_tensor(x), 2).numpy(),
+            TF.max_pool2d(torch.tensor(x), 2).numpy())
+        assert_close(
+            F.avg_pool2d(paddle.to_tensor(x), 2).numpy(),
+            TF.avg_pool2d(torch.tensor(x), 2).numpy())
+        assert_close(
+            F.adaptive_avg_pool2d(paddle.to_tensor(x), 3).numpy(),
+            TF.adaptive_avg_pool2d(torch.tensor(x), 3).numpy(), 1e-4)
+        assert_close(
+            F.adaptive_max_pool2d(paddle.to_tensor(x), 3).numpy(),
+            TF.adaptive_max_pool2d(torch.tensor(x), 3).numpy(), 1e-4)
+
+    def test_interpolate(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        assert_close(
+            F.interpolate(paddle.to_tensor(x), scale_factor=2).numpy(),
+            TF.interpolate(torch.tensor(x), scale_factor=2).numpy())
+        assert_close(
+            F.interpolate(paddle.to_tensor(x), size=7, mode='bilinear',
+                          align_corners=True).numpy(),
+            TF.interpolate(torch.tensor(x), size=7, mode='bilinear',
+                           align_corners=True).numpy(), 1e-4)
+
+    def test_pad_modes(self):
+        x = np.random.randn(1, 2, 3, 3).astype(np.float32)
+        for mode, tmode in [('constant', 'constant'), ('reflect', 'reflect'),
+                            ('replicate', 'replicate')]:
+            assert_close(
+                F.pad(paddle.to_tensor(x), [1, 2, 1, 0], mode=mode).numpy(),
+                TF.pad(torch.tensor(x), (1, 2, 1, 0), mode=tmode).numpy())
+
+    def test_cross_entropy(self):
+        logits = np.random.randn(6, 5).astype(np.float32)
+        labels = np.array([0, 4, 2, 1, 3, 2])
+        assert_close(
+            F.cross_entropy(paddle.to_tensor(logits),
+                            paddle.to_tensor(labels)).numpy(),
+            TF.cross_entropy(torch.tensor(logits),
+                             torch.tensor(labels)).numpy(), 1e-5)
+        # ignore_index + weight
+        labels2 = np.array([0, -100, 2, 1, -100, 2])
+        w = np.random.rand(5).astype(np.float32) + 0.5
+        assert_close(
+            F.cross_entropy(paddle.to_tensor(logits),
+                            paddle.to_tensor(labels2),
+                            weight=paddle.to_tensor(w)).numpy(),
+            TF.cross_entropy(torch.tensor(logits), torch.tensor(labels2),
+                             weight=torch.tensor(w)).numpy(), 1e-5)
+        # label smoothing
+        assert_close(
+            F.cross_entropy(paddle.to_tensor(logits),
+                            paddle.to_tensor(labels),
+                            label_smoothing=0.1).numpy(),
+            TF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                             label_smoothing=0.1).numpy(), 1e-5)
+
+    def test_bce(self):
+        p = np.random.rand(8).astype(np.float32) * 0.9 + 0.05
+        y = (np.random.rand(8) > 0.5).astype(np.float32)
+        assert_close(
+            F.binary_cross_entropy(paddle.to_tensor(p),
+                                   paddle.to_tensor(y)).numpy(),
+            TF.binary_cross_entropy(torch.tensor(p), torch.tensor(y)).numpy(),
+            1e-5)
+        z = np.random.randn(8).astype(np.float32)
+        assert_close(
+            F.binary_cross_entropy_with_logits(
+                paddle.to_tensor(z), paddle.to_tensor(y)).numpy(),
+            TF.binary_cross_entropy_with_logits(
+                torch.tensor(z), torch.tensor(y)).numpy(), 1e-5)
+
+    def test_misc_losses(self):
+        a = np.random.randn(7).astype(np.float32)
+        b = np.random.randn(7).astype(np.float32)
+        assert_close(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                     TF.mse_loss(torch.tensor(a), torch.tensor(b)).numpy())
+        assert_close(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                     TF.l1_loss(torch.tensor(a), torch.tensor(b)).numpy())
+        assert_close(
+            F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            TF.smooth_l1_loss(torch.tensor(a), torch.tensor(b)).numpy(), 1e-5)
+        logp = TF.log_softmax(torch.tensor(a), dim=-1).numpy()
+        q = TF.softmax(torch.tensor(b), dim=-1).numpy()
+        assert_close(
+            F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(q)).numpy(),
+            TF.kl_div(torch.tensor(logp), torch.tensor(q)).numpy(), 1e-5)
+
+    def test_sdpa_vs_torch(self):
+        q = np.random.randn(2, 6, 4, 8).astype(np.float32)
+        k = np.random.randn(2, 6, 4, 8).astype(np.float32)
+        v = np.random.randn(2, 6, 4, 8).astype(np.float32)
+        ours = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True).numpy()
+        # torch layout is [b, h, s, d]
+        tq, tk, tv = (torch.tensor(x.transpose(0, 2, 1, 3)) for x in (q, k, v))
+        theirs = TF.scaled_dot_product_attention(
+            tq, tk, tv, is_causal=True).numpy().transpose(0, 2, 1, 3)
+        assert_close(ours, theirs, 1e-4)
+
+    def test_sequence_mask_onehot(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([1, 3])), maxlen=4)
+        np.testing.assert_array_equal(
+            m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+class TestLayers:
+    def test_grad_flow_through_block(self):
+        blk = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        x = paddle.randn([2, 5, 16])
+        x.stop_gradient = False
+        out = blk(x)
+        out.mean().backward()
+        for n, p in blk.named_parameters():
+            assert p.grad is not None, n
+
+    def test_state_dict_roundtrip_values(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert set(sd) == {'0.weight', '0.bias', '2.weight', '2.bias'}
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        x = paddle.randn([3, 4])
+        assert_close(m(x).numpy(), m2(x).numpy())
+
+    def test_named_parameters_and_buffers(self):
+        bn = nn.BatchNorm2D(4)
+        names = dict(bn.named_parameters())
+        assert 'weight' in names and 'bias' in names
+        bufs = dict(bn.named_buffers())
+        assert '_mean' in bufs and '_variance' in bufs
+        sd = bn.state_dict()
+        assert '_mean' in sd  # buffers persist in state_dict
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert all(not l.training for l in m.sublayers())
+        m.train()
+        assert all(l.training for l in m.sublayers())
+
+    def test_hooks(self):
+        lin = nn.Linear(3, 3)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(paddle.randn([1, 3]))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.randn([1, 3]))
+        assert calls == [1]
+
+    def test_mha_cache_decode(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        mha.eval()
+        x = paddle.randn([1, 4, 16])
+        full = mha(x, x, x,
+                   attn_mask=None)
+        # incremental: feed tokens one by one with cache, causal equivalence
+        cache = mha.gen_cache(paddle.randn([1, 0, 16]))
+        outs = []
+        for i in range(4):
+            step = x[:, i:i + 1, :]
+            o, cache = mha(step, step, step, cache=cache)
+            outs.append(o.numpy())
+        # last token attends to all previous: equals causal full attention row
+        full_causal = F.scaled_dot_product_attention(
+            mha._split(mha.q_proj(x)), mha._split(mha.k_proj(x)),
+            mha._split(mha.v_proj(x)), is_causal=True)
+        import jax.numpy as jnp
+        merged = full_causal.numpy().reshape(1, 4, 16)
+        expect = mha.out_proj(paddle.to_tensor(merged)).numpy()
+        got = np.concatenate(outs, axis=1)
+        assert_close(got, expect, 1e-4)
+
+    def test_initializers_stats(self):
+        paddle.seed(3)
+        w = nn.initializer.KaimingNormal()((256, 128))
+        std = float(np.std(np.asarray(w)))
+        assert abs(std - np.sqrt(2.0 / 256)) < 0.01
+        q = nn.initializer.Orthogonal()((64, 64))
+        qq = np.asarray(q)
+        assert_close(qq @ qq.T, np.eye(64), 1e-4)
+
+    def test_clip_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        g1 = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        pg = clip([(None, g1)])
+        _, g = pg[0]
+        assert_close(np.linalg.norm(g.numpy()), 1.0, 1e-5)
