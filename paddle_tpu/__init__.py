@@ -20,8 +20,11 @@ from .tensor import Parameter, Tensor
 from .ops import *  # noqa: F401,F403
 from .ops import linalg
 
+from . import jit
 from . import nn
+from . import optimizer
 from .nn.layer import ParamAttr
+from .optimizer import L1Decay, L2Decay
 
 bool = bool_  # paddle.bool
 

@@ -1,0 +1,288 @@
+"""paddle.jit — to_static + donated jitted TrainStep.
+
+Upstream: python/paddle/jit/ (ProgramTranslator → static graph). The
+TPU-native design needs no custom IR: a Layer is *functionalized* — its
+parameter/buffer pytree is pulled out (`functional_state`), the forward is
+re-run with traced values bound in (`functional_call`) under
+`autograd.functional_scope()` (tape off, ops stay pure jax), and the whole
+training step is one `jax.jit` with params/opt-state/buffers donated, so
+XLA updates weights in place in HBM. RNG inside the trace comes from
+`Generator.trace_scope` keyed by the step counter — dropout is
+deterministic per step and replays identically on recompilation.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd, framework
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+_tree = jax.tree_util
+
+
+class InputSpec:
+    """Shape/dtype spec (upstream: paddle.static.InputSpec); None dims are
+    dynamic-batch buckets — each concrete size triggers one compilation."""
+
+    def __init__(self, shape, dtype='float32', name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f'InputSpec(shape={self.shape}, dtype={self.dtype})'
+
+
+def functional_state(layer: Layer):
+    """Pull (params, buffers) as flat {name: raw jax array} dicts."""
+    params = {n: p.value for n, p in layer.named_parameters()
+              if not p.stop_gradient}
+    frozen = {n: p.value for n, p in layer.named_parameters()
+              if p.stop_gradient}
+    buffers = {n: b.value for n, b in layer.named_buffers()}
+    return params, frozen, buffers
+
+
+def _bind(layer: Layer, params, frozen, buffers):
+    """Swap traced values into the live tensors; returns restore info."""
+    saved = []
+    pmap = dict(layer.named_parameters())
+    bmap = dict(layer.named_buffers())
+    for name, val in {**params, **frozen}.items():
+        t = pmap[name]
+        saved.append((t, t._data, t._node))
+        t._data = val
+        t._node = None
+    for name, val in buffers.items():
+        t = bmap[name]
+        saved.append((t, t._data, t._node))
+        t._data = val
+        t._node = None
+    return saved, bmap
+
+
+def _unbind(saved):
+    for t, data, node in saved:
+        t._data = data
+        t._node = node
+
+
+def functional_call(layer: Layer, params, frozen, buffers, args, kwargs,
+                    rng_key=None):
+    """Run layer's forward with the given state bound in, purely.
+
+    Returns (output pytree of raw values, new buffer dict) — buffer
+    mutations (BN running stats) are captured as outputs.
+    """
+    saved, bmap = _bind(layer, params, frozen, buffers)
+    try:
+        ctx = framework.default_generator.trace_scope(rng_key) \
+            if rng_key is not None else _null_ctx()
+        with ctx, autograd.functional_scope():
+            wrapped_args = _tree.tree_map(
+                lambda v: Tensor(v) if not isinstance(v, Tensor) else v, args)
+            out = layer(*wrapped_args, **kwargs)
+        out_vals = _tree.tree_map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        new_buffers = {n: bmap[n]._data for n in buffers}
+        return out_vals, new_buffers
+    finally:
+        _unbind(saved)
+
+
+@contextlib.contextmanager
+def _null_ctx():
+    yield
+
+
+class StaticLayer:
+    """A Layer (or function) compiled to one XLA program per input shape
+    (the product of @to_static)."""
+
+    def __init__(self, fn_or_layer, input_spec=None):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        self._is_layer = isinstance(fn_or_layer, Layer)
+        if self._is_layer:
+            self._jitted = jax.jit(self._layer_pure)
+        else:
+            self._jitted = jax.jit(self._fn_pure)
+
+    def _layer_pure(self, params, frozen, buffers, key, args, kwargs):
+        return functional_call(self._target, params, frozen, buffers,
+                               args, kwargs, rng_key=key)
+
+    def _fn_pure(self, key, args, kwargs):
+        with framework.default_generator.trace_scope(key), \
+                autograd.functional_scope():
+            wrapped = _tree.tree_map(lambda v: Tensor(v), args)
+            out = self._target(*wrapped, **kwargs)
+        return _tree.tree_map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    def __call__(self, *args, **kwargs):
+        arg_vals = _tree.tree_map(
+            lambda v: v.value if isinstance(v, Tensor) else jnp.asarray(v),
+            args, is_leaf=lambda v: isinstance(v, Tensor))
+        key = framework.next_rng_key()
+        if self._is_layer:
+            params, frozen, buffers = functional_state(self._target)
+            out_vals, new_bufs = self._jitted(params, frozen, buffers, key,
+                                              arg_vals, kwargs)
+            bmap = dict(self._target.named_buffers())
+            for n, v in new_bufs.items():
+                bmap[n]._data = v
+        else:
+            out_vals = self._jitted(key, arg_vals, kwargs)
+        return _tree.tree_map(Tensor, out_vals)
+
+    # passthroughs so a converted Layer still looks like one
+    def __getattr__(self, name):
+        return getattr(self._target, name)
+
+
+def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
+    """Convert a Layer or function to a compiled static form."""
+    def deco(f):
+        if isinstance(f, Layer):
+            return StaticLayer(f, input_spec)
+        wrapper = StaticLayer(f, input_spec)
+        functools.update_wrapper(wrapper, f,
+                                 assigned=('__name__', '__doc__'),
+                                 updated=())
+        return wrapper
+    return deco(function) if function is not None else deco
+
+
+class TrainStep:
+    """One donated, jitted training step (upstream analogue: the
+    to_static-converted train loop body; SURVEY.md §3 'Jitted train step').
+
+    step(params, opt_state, buffers, key, lr, batch) compiles once per batch
+    shape; params/opt_state/buffers are donated so XLA aliases them in HBM.
+    """
+
+    def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
+                 extra_metrics: Optional[Callable] = None):
+        self.layer = layer
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._opt_state = None
+        self._frozen = None
+        self._step_key_root = framework.default_generator.root_key
+        self._n_calls = 0
+        self.compile_count = 0
+
+        def step_fn(params, opt_state, buffers, key, lr, batch):
+            self.compile_count += 1  # python-level: counts traces, not runs
+
+            def loss_of(pv):
+                inputs, labels = batch
+
+                def fwd(args):
+                    out, new_bufs = functional_call(
+                        self.layer, pv, self._frozen, buffers,
+                        args if isinstance(args, tuple) else (args,), {},
+                        rng_key=key)
+                    return out, new_bufs
+                out, new_bufs = fwd(inputs)
+                with autograd.functional_scope():
+                    wrapped_out = _tree.tree_map(Tensor, out)
+                    wrapped_lab = _tree.tree_map(
+                        lambda v: Tensor(v) if not isinstance(v, Tensor)
+                        else v, labels)
+                    loss_t = self.loss_fn(wrapped_out, wrapped_lab)
+                loss_v = loss_t.value if isinstance(loss_t, Tensor) else loss_t
+                return loss_v, new_bufs
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt = self.optimizer.apply_gradients(
+                grads, params, opt_state, lr)
+            return loss, new_params, new_opt, new_bufs
+
+        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    def __call__(self, inputs, labels):
+        params, frozen, buffers = functional_state(self.layer)
+        self._frozen = frozen
+        if self._opt_state is None:
+            self._opt_state = self.optimizer.init_state(params)
+        key = jax.random.fold_in(self._step_key_root, self._n_calls)
+        self._n_calls += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        batch = (
+            _tree.tree_map(lambda v: v.value if isinstance(v, Tensor)
+                           else jnp.asarray(v), inputs,
+                           is_leaf=lambda v: isinstance(v, Tensor)),
+            _tree.tree_map(lambda v: v.value if isinstance(v, Tensor)
+                           else jnp.asarray(v), labels,
+                           is_leaf=lambda v: isinstance(v, Tensor)))
+        loss, new_params, self._opt_state, new_bufs = self._jitted(
+            params, self._opt_state, buffers, key, lr, batch)
+        # write back into the live Layer
+        pmap = dict(self.layer.named_parameters())
+        for n, v in new_params.items():
+            pmap[n]._data = v
+            pmap[n]._node = None
+        bmap = dict(self.layer.named_buffers())
+        for n, v in new_bufs.items():
+            bmap[n]._data = v
+        return Tensor(loss)
+
+
+def save(layer, path, input_spec=None, **config):
+    """Persist a (Static)Layer's state for deployment: parameters + buffers
+    as npz plus a spec manifest. (The compiled XLA executable itself is
+    rebuilt on load-side jit — PjRt compilation caches make this cheap.)"""
+    import json
+    import os
+    target = layer._target if isinstance(layer, StaticLayer) else layer
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    arrays = {f'param::{n}': np.asarray(p.value)
+              for n, p in target.named_parameters()}
+    arrays.update({f'buffer::{n}': np.asarray(b.value)
+                   for n, b in target.named_buffers()})
+    np.savez(path + '.pdiparams.npz', **arrays)
+    manifest = {
+        'class': type(target).__name__,
+        'input_spec': [
+            {'shape': list(s.shape), 'dtype': str(s.dtype)}
+            for s in (input_spec or [])],
+    }
+    with open(path + '.pdmodel.json', 'w') as f:
+        json.dump(manifest, f)
+
+
+def load(path, layer=None):
+    """Restore state saved by jit.save into `layer` (the architecture is
+    rebuilt from code, reference `paddle.jit.load`'s TranslatedLayer role)."""
+    data = np.load(path + '.pdiparams.npz')
+    if layer is None:
+        raise ValueError(
+            'paddle_tpu.jit.load needs the layer instance to restore into '
+            '(XLA programs are recompiled from code, not deserialized)')
+    target = layer._target if isinstance(layer, StaticLayer) else layer
+    sd = {}
+    for k in data.files:
+        kind, name = k.split('::', 1)
+        sd[name] = data[k]
+    target.set_state_dict(sd)
+    return layer if isinstance(layer, StaticLayer) else StaticLayer(layer)
+
+
+def not_to_static(fn):
+    fn.__jit_skip__ = True
+    return fn
+
+
+def enable_to_static(flag=True):
+    pass  # always-on eager→jit conversion path

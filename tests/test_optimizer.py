@@ -1,0 +1,234 @@
+"""Optimizer tests: closed-form single-step checks vs reference formulas,
+LR schedules, multi-precision, MLP overfit (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.optimizer import (SGD, Adam, AdamW, Adagrad, Lamb, Momentum,
+                                  RMSProp, lr)
+
+
+def make_param(val):
+    p = paddle.Parameter(paddle.to_tensor(val).value)
+    return p
+
+
+def set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, np.float32))
+
+
+class TestClosedForm:
+    def test_sgd(self):
+        p = make_param(np.array([1.0, 2.0], np.float32))
+        set_grad(p, [0.5, -1.0])
+        SGD(learning_rate=0.1, parameters=[p]).step()
+        np.testing.assert_allclose(p.numpy(), [0.95, 2.1], rtol=1e-6)
+
+    def test_sgd_weight_decay(self):
+        p = make_param(np.array([1.0], np.float32))
+        set_grad(p, [0.0])
+        SGD(learning_rate=0.1, parameters=[p], weight_decay=0.5).step()
+        # g_eff = 0 + 0.5*1 = 0.5 -> p = 1 - 0.1*0.5
+        np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-6)
+
+    def test_momentum(self):
+        p = make_param(np.array([1.0], np.float32))
+        opt = Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        set_grad(p, [1.0]); opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+        set_grad(p, [1.0]); opt.step()
+        # v = 0.9*1 + 1 = 1.9 -> p = 0.9 - 0.19
+        np.testing.assert_allclose(p.numpy(), [0.71], rtol=1e-6)
+
+    def test_adagrad(self):
+        p = make_param(np.array([1.0], np.float32))
+        opt = Adagrad(learning_rate=0.1, parameters=[p], epsilon=1e-6)
+        set_grad(p, [2.0]); opt.step()
+        np.testing.assert_allclose(p.numpy(), [1 - 0.1 * 2 / 2], rtol=1e-5)
+
+    def test_rmsprop(self):
+        p = make_param(np.array([1.0], np.float32))
+        opt = RMSProp(learning_rate=0.1, rho=0.9, epsilon=1e-6,
+                      parameters=[p])
+        set_grad(p, [1.0]); opt.step()
+        ms = 0.1
+        expect = 1 - 0.1 * 1 / np.sqrt(ms + 1e-6)
+        np.testing.assert_allclose(p.numpy(), [expect], rtol=1e-5)
+
+    def test_adam(self):
+        p = make_param(np.array([1.0], np.float32))
+        opt = Adam(learning_rate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                   parameters=[p])
+        set_grad(p, [1.0]); opt.step()
+        m, v = 0.1, 0.001
+        lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        expect = 1 - lr_t * m / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), [expect], rtol=1e-5)
+
+    def test_adamw_decoupled(self):
+        p1 = make_param(np.array([1.0], np.float32))
+        p2 = make_param(np.array([1.0], np.float32))
+        set_grad(p1, [1.0]); set_grad(p2, [1.0])
+        Adam(learning_rate=0.1, parameters=[p1], weight_decay=0.0).step()
+        AdamW(learning_rate=0.1, parameters=[p2], weight_decay=0.1).step()
+        # adamw subtracts lr*coeff*p extra
+        np.testing.assert_allclose(
+            p2.numpy(), p1.numpy() - 0.1 * 0.1 * 1.0, rtol=1e-5)
+
+    def test_adamw_vs_torch(self):
+        torch = pytest.importorskip('torch')
+        w0 = np.random.randn(4, 3).astype(np.float32)
+        g = np.random.randn(4, 3).astype(np.float32)
+        p = make_param(w0)
+        opt = AdamW(learning_rate=0.01, parameters=[p], weight_decay=0.05)
+        tp = torch.nn.Parameter(torch.tensor(w0))
+        topt = torch.optim.AdamW([tp], lr=0.01, weight_decay=0.05)
+        for _ in range(3):
+            set_grad(p, g); opt.step()
+            tp.grad = torch.tensor(g); topt.step()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), atol=1e-5)
+
+    def test_lamb_trust_ratio(self):
+        p = make_param(np.array([3.0, 4.0], np.float32))
+        opt = Lamb(learning_rate=0.1, lamb_weight_decay=0.0, parameters=[p],
+                   epsilon=0.0)
+        set_grad(p, [1.0, 1.0]); opt.step()
+        # m_hat=g, v_hat=g^2 -> r = sign(g) = [1,1]; trust = 5/sqrt(2)
+        trust = 5 / np.sqrt(2)
+        np.testing.assert_allclose(
+            p.numpy(), [3 - 0.1 * trust, 4 - 0.1 * trust], rtol=1e-5)
+
+    def test_multi_precision_master_weights(self):
+        w = np.full((4,), 1.0, np.float32)
+        p = paddle.Parameter(paddle.to_tensor(w).astype('bfloat16').value)
+        opt = SGD(learning_rate=1e-3, parameters=[p], multi_precision=True)
+        for _ in range(10):
+            p.grad = paddle.to_tensor(np.full((4,), 1e-3, np.float32))
+            opt.step()
+        # bf16 alone can't resolve 1 - 1e-6*10 steps; master fp32 can
+        master = np.asarray(opt._slots[id(p)]['master'])
+        np.testing.assert_allclose(master, 1.0 - 1e-5, rtol=1e-6)
+        assert str(p.dtype) == 'bfloat16'
+
+    def test_grad_clip_in_optimizer(self):
+        p = make_param(np.array([0.0], np.float32))
+        opt = SGD(learning_rate=1.0, parameters=[p],
+                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        set_grad(p, [10.0]); opt.step()
+        np.testing.assert_allclose(p.numpy(), [-1.0], rtol=1e-5)
+
+
+class TestFunctionalAPI:
+    def test_pytree_matches_eager(self):
+        import jax.numpy as jnp
+        w = np.random.randn(3, 3).astype(np.float32)
+        g = np.random.randn(3, 3).astype(np.float32)
+        # eager
+        p = make_param(w)
+        eager = Adam(learning_rate=0.01, parameters=[p])
+        set_grad(p, g); eager.step()
+        # functional
+        fn_opt = Adam(learning_rate=0.01)
+        state = fn_opt.init_state({'w': jnp.asarray(w)})
+        new_p, state = fn_opt.apply_gradients(
+            {'w': jnp.asarray(g)}, {'w': jnp.asarray(w)}, state, 0.01)
+        np.testing.assert_allclose(p.numpy(), np.asarray(new_p['w']),
+                                   rtol=1e-6)
+        assert int(state['step']) == 1
+
+
+class TestLRSchedulers:
+    def test_noam(self):
+        s = lr.NoamDecay(d_model=512, warmup_steps=4000, learning_rate=1.0)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        assert vals[1] < vals[4]  # warming up
+
+    def test_cosine(self):
+        s = lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_linear_warmup_then_constant(self):
+        s = lr.LinearWarmup(learning_rate=0.5, warmup_steps=5, start_lr=0.0,
+                            end_lr=0.5)
+        seen = []
+        for _ in range(8):
+            seen.append(s())
+            s.step()
+        np.testing.assert_allclose(seen[:5], [0.0, 0.1, 0.2, 0.3, 0.4],
+                                   rtol=1e-5)
+        assert seen[6] == 0.5
+
+    def test_step_decay_multistep(self):
+        s = lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.1)
+        vals = []
+        for _ in range(5):
+            vals.append(round(s(), 6))
+            s.step()
+        assert vals == [1.0, 1.0, 0.1, 0.1, 0.01]
+
+    def test_scheduler_in_optimizer(self):
+        p = make_param(np.array([1.0], np.float32))
+        sched = lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        opt = SGD(learning_rate=sched, parameters=[p])
+        set_grad(p, [1.0]); opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+        sched.step()
+        set_grad(p, [1.0]); opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.85], rtol=1e-6)
+
+    def test_reduce_on_plateau(self):
+        s = lr.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+        for m in [1.0, 1.0, 1.0]:
+            s.step(metrics=m)
+        assert s() == 0.5
+
+    def test_state_dict_roundtrip(self):
+        s = lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        for _ in range(3):
+            s.step()
+        sd = s.state_dict()
+        s2 = lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        s2.set_state_dict(sd)
+        assert s2() == s()
+
+
+class TestEndToEnd:
+    def test_mlp_overfit(self):
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(2, 32), nn.Tanh(), nn.Linear(32, 1))
+        opt = Adam(learning_rate=0.05, parameters=net.parameters())
+        x = paddle.randn([64, 2])
+        y = (x[:, 0:1] * x[:, 1:2])  # xor-ish smooth target
+        first = None
+        for i in range(150):
+            pred = net(x)
+            loss = nn.functional.mse_loss(pred, y)
+            if first is None:
+                first = float(loss.numpy())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        final = float(loss.numpy())
+        assert final < first * 0.05, (first, final)
+
+    def test_optimizer_state_dict_resume(self):
+        p = make_param(np.array([1.0], np.float32))
+        opt = Adam(learning_rate=0.1, parameters=[p])
+        set_grad(p, [1.0]); opt.step()
+        sd = opt.state_dict()
+        pv = p.numpy().copy()
+        set_grad(p, [1.0]); opt.step()
+        after2 = p.numpy().copy()
+        # resume from sd on a fresh optimizer + param copy
+        p2 = make_param(pv)
+        opt2 = Adam(learning_rate=0.1, parameters=[p2])
+        opt2.set_state_dict(sd)
+        set_grad(p2, [1.0]); opt2.step()
+        np.testing.assert_allclose(p2.numpy(), after2, rtol=1e-6)
