@@ -23,6 +23,7 @@ from .ops import linalg
 from . import jit
 from . import nn
 from . import optimizer
+from . import distributed
 from .nn.layer import ParamAttr
 from .optimizer import L1Decay, L2Decay
 

@@ -1,0 +1,40 @@
+"""paddle_tpu.distributed — SPMD distribution over a TPU device mesh.
+
+Upstream: python/paddle/distributed/ (NCCL process groups, fleet
+HybridParallel, auto_parallel). Here: one jax.sharding.Mesh, XLA
+collectives over ICI, GSPMD propagation. See env.py / collective.py /
+fleet.py module docstrings for the design mapping.
+"""
+from __future__ import annotations
+
+from . import auto, collective, env
+from . import fleet as _fleet_mod
+from . import moe, pipeline, ring_attention
+from .auto import (Partial, Placement, ProcessMesh, Replicate, Shard,
+                   dtensor_from_fn, reshard, shard_tensor)
+from .collective import (P2POp, ReduceOp, all_gather, all_reduce, alltoall,
+                         alltoall_single, barrier, batch_isend_irecv,
+                         broadcast, irecv, isend, recv, reduce,
+                         reduce_scatter, scatter, send, wait)
+from .data_parallel import DataParallel
+from .env import (get_group, get_mesh, get_rank, get_world_size,
+                  init_parallel_env, is_initialized, new_group, set_mesh)
+from .fleet import (DistTrainStep, DistributedStrategy, fleet,
+                    shard_optimizer_state)
+from .launch import init_on_pod
+from .moe import MoELayer
+from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                              RowParallelLinear, VocabParallelEmbedding,
+                              shard_batch, split)
+from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc, gpipe
+from .ring_attention import ring_attention, ulysses_attention
+
+# upstream spelling: paddle.distributed.fleet is a module-like object
+import sys as _sys
+fleet = _fleet_mod  # the module itself exposes init/DistributedStrategy/...
+
+ParallelEnv = env.ProcessGroup  # legacy alias surface
+
+
+def get_backend():
+    return 'xla'  # upstream returns 'nccl'/'gloo'

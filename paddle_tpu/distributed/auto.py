@@ -1,0 +1,107 @@
+"""Auto-parallel lite (upstream: python/paddle/distributed/auto_parallel/
+— shard_tensor + ProcessMesh + Placement types).
+
+TPU-native: a ProcessMesh IS a jax.sharding.Mesh; shard_tensor IS a
+device_put with a NamedSharding; propagation is XLA GSPMD (the upstream
+cost-model planner is replaced by the compiler's own SPMD partitioner).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tensor import Tensor
+from . import env
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return 'Replicate()'
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f'Shard(dim={self.dim})'
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type='sum'):
+        self.reduce_type = reduce_type
+
+
+class ProcessMesh:
+    """Upstream: dist.ProcessMesh(mesh=[[0,1],[2,3]], dim_names=['dp','mp'])."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            shape = arr.shape
+        if dim_names is None:
+            dim_names = env.HYBRID_AXES[-len(shape):]
+        devs = np.asarray(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+        self.jax_mesh = Mesh(devs, tuple(dim_names))
+        self.dim_names = tuple(dim_names)
+        self.shape = tuple(shape)
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self.shape))))
+
+
+def _to_spec(placements: Sequence[Placement], ndim: int,
+             dim_names) -> P:
+    spec = [None] * ndim
+    for axis_name, pl in zip(dim_names, placements):
+        if isinstance(pl, Shard):
+            if spec[pl.dim] is not None:
+                spec[pl.dim] = (spec[pl.dim], axis_name) \
+                    if isinstance(spec[pl.dim], str) else \
+                    spec[pl.dim] + (axis_name,)
+            else:
+                spec[pl.dim] = axis_name
+    return P(*spec)
+
+
+def shard_tensor(x, mesh=None, placements: Optional[List[Placement]] = None,
+                 process_mesh=None, shard_spec=None):
+    """Place a tensor on the mesh per placements (Shard/Replicate)."""
+    pm = mesh or process_mesh
+    if isinstance(pm, ProcessMesh):
+        jmesh, dim_names = pm.jax_mesh, pm.dim_names
+    elif isinstance(pm, Mesh):
+        jmesh, dim_names = pm, pm.axis_names
+    else:
+        jmesh = env.get_mesh()
+        dim_names = jmesh.axis_names
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    if shard_spec is not None:          # legacy dims-mapping form
+        spec = P(*[s if s in jmesh.axis_names else None
+                   for s in shard_spec])
+    else:
+        spec = _to_spec(placements or [], v.ndim, dim_names)
+    out = jax.device_put(v, NamedSharding(jmesh, spec))
+    if isinstance(x, Tensor):
+        x._data = out
+        x._node = None
+        return x
+    return Tensor(out)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh=mesh,
+                        placements=placements)
+
+
+def reshard(x, mesh, placements):
+    return shard_tensor(x, mesh=mesh, placements=placements)

@@ -1,0 +1,59 @@
+"""DataParallel wrapper (upstream: python/paddle/nn/parallel/
+DataParallel — NCCL allreduce of grads in backward hooks).
+
+TPU-native: gradient synchronization is not a hook — when the batch is
+sharded over 'dp' and parameters are replicated, XLA GSPMD emits the
+grad all-reduce inside the jitted step automatically. This wrapper
+therefore only (1) places params replicated on the mesh, (2) provides
+the upstream API surface (`no_sync`, `scale_loss`), and (3) supports
+eager gradient accumulation.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layer import Layer
+from . import env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        if env.has_mesh():
+            mesh = env.get_mesh()
+            for _, p in layers.named_parameters():
+                from .parallel_layers import get_sharding
+                spec = get_sharding(p) or P()
+                p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+        self._grad_sync = True
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Upstream skips the allreduce during accumulation; with GSPMD
+        sync happens per jitted step, so accumulation is expressed by
+        summing microbatch grads *inside* the step (see
+        jit.TrainStep/gradient merge) — this context is a no-op kept for
+        API parity."""
+        self._grad_sync = False
+        try:
+            yield
+        finally:
+            self._grad_sync = True
+
+    def scale_loss(self, loss):
+        return loss  # pmean in the jitted step already averages over dp
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,156 @@
+"""Distributed environment: the device mesh and process groups.
+
+Upstream: paddle/fluid/distributed/collective/ (ProcessGroupNCCL) and
+python/paddle/distributed/parallel.py (init_parallel_env).
+
+TPU-native design: there is no NCCL communicator. A single
+`jax.sharding.Mesh` over all chips is the universe; a paddle "process
+group" maps to one mesh *axis* (dp/mp/pp/sp). Collectives are XLA ops
+(`psum`, `all_gather`, `ppermute`, ...) emitted over an axis, riding ICI.
+Single-controller JAX means `get_rank()` is the host process index (0 on
+one host) while per-chip "ranks" only exist *inside* `shard_map` bodies
+via `jax.lax.axis_index(axis)`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# canonical hybrid-parallel axis order: pp outermost (cross-slice / slowest),
+# mp innermost (fastest ICI neighbours), matching fleet HybridParallel's
+# topology assignment (upstream python/paddle/distributed/fleet/base/topology.py)
+HYBRID_AXES = ('pp', 'dp', 'sp', 'mp')
+
+
+class _EnvState:
+    def __init__(self):
+        self.mesh: Optional[Mesh] = None
+        self.strategy = None
+        self.groups: Dict[str, 'ProcessGroup'] = {}
+        self.initialized = False
+
+
+_state = _EnvState()
+
+
+class ProcessGroup:
+    """A communication group = one mesh axis (or tuple of axes)."""
+
+    def __init__(self, axis, mesh: Mesh):
+        self.axis = axis if isinstance(axis, tuple) else (axis,)
+        self.mesh = mesh
+
+    @property
+    def nranks(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.axis]))
+
+    @property
+    def axis_name(self):
+        return self.axis[0] if len(self.axis) == 1 else self.axis
+
+    def __repr__(self):
+        return f'ProcessGroup(axis={self.axis}, nranks={self.nranks})'
+
+
+def _devices() -> List:
+    return list(jax.devices())
+
+
+def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
+                      axis_names: Optional[Sequence[str]] = None) -> Mesh:
+    """Create (or return) the global mesh.
+
+    Default: all devices on a single 'dp' axis — the moral equivalent of
+    upstream init_parallel_env's pure data-parallel NCCL world.
+    """
+    if _state.initialized and mesh_shape is None:
+        return _state.mesh
+    devs = _devices()
+    if mesh_shape is None:
+        mesh_shape = (len(devs),)
+        axis_names = axis_names or ('dp',)
+    axis_names = tuple(axis_names or HYBRID_AXES[-len(mesh_shape):])
+    arr = np.asarray(devs).reshape(tuple(mesh_shape))
+    mesh = Mesh(arr, axis_names)
+    set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh: Mesh):
+    _state.mesh = mesh
+    _state.initialized = True
+    _state.groups = {a: ProcessGroup(a, mesh) for a in mesh.axis_names}
+
+
+def get_mesh(auto_init: bool = True) -> Mesh:
+    if _state.mesh is None:
+        if not auto_init:
+            raise RuntimeError('call paddle_tpu.distributed.init_parallel_env'
+                               ' (or fleet.init) first')
+        init_parallel_env()
+    return _state.mesh
+
+
+def has_mesh() -> bool:
+    return _state.mesh is not None
+
+
+def get_group(axis=None) -> ProcessGroup:
+    """The group for a mesh axis; default = the whole mesh (all axes)."""
+    mesh = get_mesh()
+    if axis is None:
+        return ProcessGroup(tuple(mesh.axis_names), mesh)
+    if isinstance(axis, ProcessGroup):
+        return axis
+    if axis not in _state.groups:
+        _state.groups[axis] = ProcessGroup(axis, mesh)
+    return _state.groups[axis]
+
+
+def new_group(ranks=None, backend=None, axis=None) -> ProcessGroup:
+    """Upstream-compatible signature; on TPU a group is a mesh axis, so
+    `ranks` lists are accepted only when they span a whole axis."""
+    return get_group(axis)
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return get_group(group if not isinstance(group, ProcessGroup)
+                         else group).nranks
+    if not _state.initialized:
+        return int(os.environ.get('PADDLE_TRAINERS_NUM',
+                                  jax.device_count()))
+    return get_mesh().size
+
+
+def get_rank(group=None) -> int:
+    """Host process index (0 on single-controller). Per-chip rank exists
+    only inside shard_map via lax.axis_index."""
+    return jax.process_index()
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def parallel_device_count() -> int:
+    return jax.device_count()
+
+
+def replicated(x, mesh: Optional[Mesh] = None):
+    """Place an array replicated over the mesh."""
+    mesh = mesh or get_mesh()
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def shard_on_axis(x, axis_name: str, dim: int = 0,
+                  mesh: Optional[Mesh] = None):
+    """Place an array sharded over one mesh axis along `dim`."""
+    mesh = mesh or get_mesh()
+    spec = [None] * x.ndim
+    spec[dim] = axis_name
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))

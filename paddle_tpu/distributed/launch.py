@@ -1,0 +1,54 @@
+"""Multi-host launch shim (upstream: python/paddle/distributed/launch —
+the paddle.distributed.launch process spawner over MPI/ssh).
+
+TPU-native: pods are SPMD multi-process JAX — one process per host, all
+launched by the scheduler (GKE/xmanager). This shim just wires
+`jax.distributed.initialize` from the standard env vars and then runs
+the training module, replacing the NCCL rendezvous entirely:
+
+    python -m paddle_tpu.distributed.launch train.py [args...]
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def init_on_pod(coordinator_address=None, num_processes=None,
+                process_id=None):
+    """Initialize the JAX distributed runtime for a multi-host pod.
+    No-ops on single-host (jax.devices() already sees local chips)."""
+    import jax
+    n = num_processes or int(os.environ.get('PADDLE_TRAINERS_NUM',
+                             os.environ.get('JAX_NUM_PROCESSES', '1')))
+    if n <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address
+        or os.environ.get('PADDLE_MASTER',
+                          os.environ.get('COORDINATOR_ADDRESS')),
+        num_processes=n,
+        process_id=process_id if process_id is not None
+        else int(os.environ.get('PADDLE_TRAINER_ID',
+                 os.environ.get('JAX_PROCESS_ID', '0'))))
+
+
+def launch(script=None, argv=()):
+    init_on_pod()
+    if script:
+        sys.argv = [script, *argv]
+        runpy.run_path(script, run_name='__main__')
+
+
+def main():
+    args = sys.argv[1:]
+    if not args:
+        print('usage: python -m paddle_tpu.distributed.launch SCRIPT [ARGS]')
+        return 1
+    launch(args[0], args[1:])
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Tensor-parallel layers (upstream:
+python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+ColumnParallelLinear / RowParallelLinear / VocabParallelEmbedding /
+ParallelCrossEntropy).
+
+TPU-native design: unlike the NCCL version — where every rank constructs
+its 1/mp-th weight slice and hand-codes identity/allreduce ops — each
+layer here holds the FULL logical weight annotated with a
+`PartitionSpec`, and `fleet.distributed_model` (or the jitted train step's
+in_shardings) places it sharded over the 'mp' mesh axis. XLA GSPMD then
+inserts the same all-gather / reduce-scatter / all-reduce the upstream
+layers emit, but scheduled and fused by the compiler and riding ICI.
+Forward code is the plain dense computation plus sharding *constraints*
+(`lax.with_sharding_constraint`) steering GSPMD where propagation alone is
+ambiguous.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, ParamAttr
+from ..ops._helpers import defop
+from ..tensor import Tensor
+from . import env
+
+
+def _constraint(spec: P):
+    """A differentiable op pinning an intermediate's sharding (no-op when
+    no mesh is initialized, e.g. pure single-device eager tests)."""
+    def fn(x):
+        if not env.has_mesh():
+            return x
+        mesh = env.get_mesh(auto_init=False)
+        if all(a is None or a in mesh.axis_names or
+               (isinstance(a, tuple) and all(s in mesh.axis_names for s in a))
+               for a in spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+        return x
+    return defop(fn, name='sharding_constraint')
+
+
+def mark_sharding(param, spec: P):
+    """Attach the dist spec consumed by fleet.distributed_model."""
+    param._dist_spec = spec
+    return param
+
+
+def get_sharding(param) -> Optional[P]:
+    return getattr(param, '_dist_spec', None)
+
+
+class ColumnParallelLinear(Layer):
+    """y = x @ W[:, shard] (+ b[shard]); W sharded on the output (column)
+    dim over 'mp'. gather_output=True constrains y back to replicated
+    (upstream: an explicit all-gather)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None, axis='mp'):
+        super().__init__()
+        self._axis = axis
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, P(None, axis))
+        if has_bias:
+            self.bias = self.create_parameter(
+                (out_features,), is_bias=True)
+            mark_sharding(self.bias, P(axis))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        spec = (P(*([None] * (len(y.shape) - 1)), None) if self.gather_output
+                else P(*([None] * (len(y.shape) - 1)), self._axis))
+        return _constraint(spec)(y)
+
+
+class RowParallelLinear(Layer):
+    """y = x[shard] @ W[shard, :] (+ b); W sharded on the input (row) dim.
+    The partial products are all-reduced by GSPMD (upstream: explicit
+    c_allreduce_sum after the local matmul)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None, axis='mp'):
+        super().__init__()
+        self._axis = axis
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, P(axis, None))
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            mark_sharding(self.bias, P())
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constraint(
+                P(*([None] * (len(x.shape) - 1)), self._axis))(x)
+        y = F.linear(x, self.weight, self.bias)
+        return _constraint(P(*([None] * len(y.shape))))(y)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp'. GSPMD turns the
+    gather into a masked local lookup + all-reduce, matching upstream's
+    c_embedding + allreduce."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None, axis='mp'):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim),
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Normal(std=0.02))
+        mark_sharding(self.weight, P(axis, None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax-CE over vocab-sharded logits. The dense formulation lets
+    GSPMD compute the partial max/sum-exp locally and combine with one
+    small all-reduce (upstream: c_softmax_with_cross_entropy)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction='none',
+                               ignore_index=self.ignore_index)
+
+
+# convenience: pure-dp data batch sharding
+def shard_batch(batch, axis='dp', mesh=None):
+    """device_put a host batch sharded over the dp axis on dim 0."""
+    mesh = mesh or env.get_mesh()
+    import jax.tree_util as tu
+
+    def place(v):
+        v = v.value if isinstance(v, Tensor) else jnp.asarray(v)
+        spec = P(axis, *([None] * (v.ndim - 1)))
+        return jax.device_put(v, NamedSharding(mesh, spec))
+    out = tu.tree_map(place, batch,
+                      is_leaf=lambda v: isinstance(v, Tensor))
+    return out
+
+
+def split(x, group=None, axis=0):
+    """mp_group scatter helper (upstream mp_ops._c_split)."""
+    ax = 'mp'
+    return _constraint(
+        P(*([ax if i == axis else None for i in range(len(x.shape))])))(x)
+
+
+def gather(x, group=None, axis=0):
+    """mp_group gather helper (upstream mp_ops._c_concat)."""
+    return _constraint(P(*([None] * len(x.shape))))(x)

@@ -1,0 +1,179 @@
+"""Pipeline parallelism (upstream:
+python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py —
+PipelineLayer + PipelineParallel with NCCL send/recv microbatch handoff).
+
+TPU-native design: the pipeline is a *collective* program, not a set of
+processes. Stage parameters are STACKED on a leading [pp] dim and sharded
+over the 'pp' mesh axis; the schedule is one `lax.scan` inside
+`shard_map` whose step body runs every stage's block on its current
+microbatch and hands activations to the next stage with a single
+`lax.ppermute` (one ICI hop). GPipe's fill/drain bubble appears as the
+first/last (pp-1) scan steps computing on garbage that is masked out.
+Because the whole schedule is a pure differentiable function,
+`jax.grad` *is* the backward pipeline — the reverse scan replays the
+ppermute in the opposite direction, which is exactly 1F1B's comm
+pattern; `remat='full'` rematerializes each stage block during the
+backward sweep, bounding activation memory at one microbatch per stage
+(the 1F1B memory guarantee).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.layer import Layer
+from . import env
+
+_tree = jax.tree_util
+
+
+def stack_stage_params(param_trees: List[Any]):
+    """Stack per-stage parameter pytrees on a new leading [pp] dim."""
+    return _tree.tree_map(lambda *xs: jnp.stack(xs), *param_trees)
+
+
+def pipeline_spec(tree, axis='pp'):
+    """PartitionSpecs sharding the stacked stage dim over the pp axis."""
+    return _tree.tree_map(
+        lambda x: P(axis, *([None] * (jnp.ndim(x) - 1))), tree)
+
+
+def gpipe(stage_fn: Callable, stacked_params, microbatches,
+          axis: str = 'pp', mesh: Optional[Mesh] = None,
+          schedule: str = '1F1B', remat: bool = True):
+    """Run `y_mb = stage_pp-1 ∘ ... ∘ stage_0 (x_mb)` for every microbatch.
+
+    stage_fn(stage_params, x) -> y with y.shape == x.shape (uniform
+    blocks; embed/head run outside the pipelined region, as upstream's
+    shape-static send/recv also requires).
+
+    microbatches: [n_micro, mb, ...] (replicated or dp-sharded on mb).
+    Returns [n_micro, mb, ...] outputs of the final stage.
+
+    `schedule` is accepted for upstream parity ('F-then-B'/'1F1B') but both
+    compile to the SAME program here: the forward sweep is this scan, and
+    jax.grad's reverse scan + remat IS the 1F1B backward (see module
+    docstring) — there is no separate schedule to pick.
+    """
+    if schedule not in ('1F1B', 'F-then-B', 'FThenB'):
+        raise ValueError(f'unknown pipeline schedule {schedule!r}')
+    mesh = mesh or env.get_mesh()
+    n_pp = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    if n_pp == 1:
+        sp = _tree.tree_map(lambda x: x[0], stacked_params)
+        return jax.vmap(lambda mb: stage_fn(sp, mb))(microbatches)
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    p_specs = pipeline_spec(stacked_params, axis)
+    x_spec = _tree.tree_map(lambda x: P(*([None] * jnp.ndim(x))),
+                            microbatches)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(p_specs, x_spec), out_specs=P(axis), check_vma=False)
+    def run(local_params, x):
+        sp = _tree.tree_map(lambda v: v[0], local_params)  # [1,...] -> [...]
+        s = lax.axis_index(axis)
+        steps = n_micro + n_pp - 1
+        mb_shape = x.shape[1:]
+        perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
+
+        def step(carry, t):
+            buf, out = carry
+            feed_idx = jnp.clip(t, 0, n_micro - 1)
+            x0 = lax.dynamic_index_in_dim(x, feed_idx, 0, keepdims=False)
+            xin = jnp.where(s == 0, x0.astype(buf.dtype), buf)
+            y = body(sp, xin)
+            oidx = t - (n_pp - 1)
+            write = jnp.logical_and(s == n_pp - 1, oidx >= 0)
+            widx = jnp.clip(oidx, 0, n_micro - 1)
+            cur = lax.dynamic_index_in_dim(out, widx, 0, keepdims=False)
+            out = lax.dynamic_update_index_in_dim(
+                out, jnp.where(write, y, cur), widx, 0)
+            buf = lax.ppermute(y, axis, perm)
+            return (buf, out), None
+
+        buf0 = jnp.zeros(mb_shape, x.dtype)
+        out0 = jnp.zeros((n_micro,) + mb_shape, x.dtype)
+        (_, out), _ = lax.scan(step, (buf0, out0), jnp.arange(steps))
+        return out[None]  # [1, n_micro, mb, ...] -> stacked over pp
+
+    stacked_out = run(stacked_params, microbatches)
+    return stacked_out[-1]  # only the final stage's buffer is the output
+
+
+one_f_one_b = functools.partial(gpipe, schedule='1F1B')
+
+
+class LayerDesc:
+    """Deferred layer construction (upstream: fleet.meta_parallel.LayerDesc)
+    so PipelineLayer can build each stage's sublayers lazily."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+
+
+class PipelineLayer(Layer):
+    """Stage-partitioned container (upstream: PipelineLayer).
+
+    `layers` is a list of Layer/LayerDesc; they are segmented into
+    `num_stages` contiguous groups. On TPU the stages are not separate
+    processes: forward runs all segments in order, annotating the
+    boundary activations; the *scheduled* pipeline path is
+    `distributed.pipeline.gpipe` over the uniform middle blocks, which
+    models use directly in their jitted train step (see
+    nlp.transformers.gpt's pp path).
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method='uniform', recompute_interval=0,
+                 **kwargs):
+        super().__init__()
+        built = [l.build() if isinstance(l, LayerDesc) else l
+                 for l in layers]
+        self.runs = Layer()
+        from ..nn.common_layers import LayerList
+        self.run_list = LayerList(built)
+        if num_stages is None:
+            num_stages = env.get_mesh().shape.get('pp', 1) \
+                if env.has_mesh() else 1
+        self.num_stages = num_stages
+        n = len(built)
+        per = max(1, n // num_stages)
+        self._segments = [list(range(i * per, min(n, (i + 1) * per)))
+                          for i in range(num_stages)]
+        if self._segments and self._segments[-1] and \
+                self._segments[-1][-1] < n - 1:
+            self._segments[-1].extend(range(self._segments[-1][-1] + 1, n))
+        self.loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+
+    def get_stage_layers(self, stage: int):
+        return [self.run_list[i] for i in self._segments[stage]]
+
+    def forward(self, x):
+        for i, layer in enumerate(self.run_list):
+            x = layer(x)
+        return x

@@ -104,45 +104,84 @@ def _null_ctx():
 
 
 class StaticLayer:
-    """A Layer (or function) compiled to one XLA program per input shape
-    (the product of @to_static)."""
+    """A Layer (or function) compiled to one XLA program per (input shape,
+    static-kwargs) combination (the product of @to_static). Tensor/array
+    kwargs are traced; python-value kwargs are compile-time constants
+    keyed into the jit cache."""
 
     def __init__(self, fn_or_layer, input_spec=None):
         self._target = fn_or_layer
         self._input_spec = input_spec
         self._is_layer = isinstance(fn_or_layer, Layer)
+        self._jit_cache: Dict[Any, Any] = {}
+
+    def _check_spec(self, args):
+        if not self._input_spec:
+            return
+        for i, (spec, a) in enumerate(zip(self._input_spec, args)):
+            shape = tuple(np.shape(a))
+            if len(shape) != len(spec.shape) or any(
+                    s is not None and s != d
+                    for s, d in zip(spec.shape, shape)):
+                raise ValueError(
+                    f'input {i} shape {shape} does not match InputSpec '
+                    f'{spec.shape} (None dims are dynamic)')
+
+    def _get_jitted(self, static_kwargs):
+        try:
+            key = tuple(sorted(
+                (k, type(v).__name__, v) for k, v in static_kwargs.items()))
+            hash(key)
+        except TypeError:
+            raise TypeError(
+                f'to_static kwargs must be Tensors/arrays (traced) or '
+                f'hashable python values (compile-time constants); got '
+                f'{ {k: type(v).__name__ for k, v in static_kwargs.items()} }')
+        f = self._jit_cache.get(key)
+        if f is not None:
+            return f
         if self._is_layer:
-            self._jitted = jax.jit(self._layer_pure)
+            def fn(params, frozen, buffers, rkey, args, tkwargs):
+                kw = {k: Tensor(v) for k, v in tkwargs.items()}
+                kw.update(static_kwargs)
+                return functional_call(self._target, params, frozen,
+                                       buffers, args, kw, rng_key=rkey)
         else:
-            self._jitted = jax.jit(self._fn_pure)
-
-    def _layer_pure(self, params, frozen, buffers, key, args, kwargs):
-        return functional_call(self._target, params, frozen, buffers,
-                               args, kwargs, rng_key=key)
-
-    def _fn_pure(self, key, args, kwargs):
-        with framework.default_generator.trace_scope(key), \
-                autograd.functional_scope():
-            wrapped = _tree.tree_map(lambda v: Tensor(v), args)
-            out = self._target(*wrapped, **kwargs)
-        return _tree.tree_map(
-            lambda t: t.value if isinstance(t, Tensor) else t, out,
-            is_leaf=lambda t: isinstance(t, Tensor))
+            def fn(rkey, args, tkwargs):
+                with framework.default_generator.trace_scope(rkey), \
+                        autograd.functional_scope():
+                    wrapped = _tree.tree_map(lambda v: Tensor(v), args)
+                    kw = {k: Tensor(v) for k, v in tkwargs.items()}
+                    kw.update(static_kwargs)
+                    out = self._target(*wrapped, **kw)
+                return _tree.tree_map(
+                    lambda t: t.value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+        f = jax.jit(fn)
+        self._jit_cache[key] = f
+        return f
 
     def __call__(self, *args, **kwargs):
+        self._check_spec(args)
         arg_vals = _tree.tree_map(
             lambda v: v.value if isinstance(v, Tensor) else jnp.asarray(v),
             args, is_leaf=lambda v: isinstance(v, Tensor))
+        traced_kw = {k: (v.value if isinstance(v, Tensor)
+                         else jnp.asarray(v))
+                     for k, v in kwargs.items()
+                     if isinstance(v, (Tensor, jax.Array, np.ndarray))}
+        static_kw = {k: v for k, v in kwargs.items() if k not in traced_kw}
+        jitted = self._get_jitted(static_kw)
         key = framework.next_rng_key()
         if self._is_layer:
             params, frozen, buffers = functional_state(self._target)
-            out_vals, new_bufs = self._jitted(params, frozen, buffers, key,
-                                              arg_vals, kwargs)
+            out_vals, new_bufs = jitted(params, frozen, buffers, key,
+                                        arg_vals, traced_kw)
             bmap = dict(self._target.named_buffers())
             for n, v in new_bufs.items():
                 bmap[n]._data = v
         else:
-            out_vals = self._jitted(key, arg_vals, kwargs)
+            out_vals = jitted(key, arg_vals, traced_kw)
         return _tree.tree_map(Tensor, out_vals)
 
     # passthroughs so a converted Layer still looks like one
@@ -177,12 +216,11 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self._opt_state = None
-        self._frozen = None
         self._step_key_root = framework.default_generator.root_key
         self._n_calls = 0
         self.compile_count = 0
 
-        def step_fn(params, opt_state, buffers, key, lr, batch):
+        def step_fn(params, opt_state, buffers, frozen, key, lr, batch):
             self.compile_count += 1  # python-level: counts traces, not runs
 
             def loss_of(pv):
@@ -190,7 +228,7 @@ class TrainStep:
 
                 def fwd(args):
                     out, new_bufs = functional_call(
-                        self.layer, pv, self._frozen, buffers,
+                        self.layer, pv, frozen, buffers,
                         args if isinstance(args, tuple) else (args,), {},
                         rng_key=key)
                     return out, new_bufs
@@ -213,7 +251,6 @@ class TrainStep:
 
     def __call__(self, inputs, labels):
         params, frozen, buffers = functional_state(self.layer)
-        self._frozen = frozen
         if self._opt_state is None:
             self._opt_state = self.optimizer.init_state(params)
         key = jax.random.fold_in(self._step_key_root, self._n_calls)
@@ -227,7 +264,7 @@ class TrainStep:
                            else jnp.asarray(v), labels,
                            is_leaf=lambda v: isinstance(v, Tensor)))
         loss, new_params, self._opt_state, new_bufs = self._jitted(
-            params, self._opt_state, buffers, key, lr, batch)
+            params, self._opt_state, buffers, frozen, key, lr, batch)
         # write back into the live Layer
         pmap = dict(self.layer.named_parameters())
         for n, v in new_params.items():

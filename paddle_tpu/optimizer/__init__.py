@@ -41,13 +41,16 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
+        self._decay_mode = 'l2'
         if weight_decay is None:
             self._coeff = 0.0
         elif isinstance(weight_decay, (int, float)):
             self._coeff = float(weight_decay)
-        else:  # L2Decay-like object with a coeff
+        else:  # L1Decay/L2Decay regularizer object
             self._coeff = float(getattr(weight_decay, '_coeff',
                                         getattr(weight_decay, 'coeff', 0.0)))
+            if type(weight_decay).__name__ == 'L1Decay':
+                self._decay_mode = 'l1'
         self._step_count = 0
         self._slots: Dict[int, dict] = {}  # id(param) -> slot dict
 
@@ -69,15 +72,21 @@ class Optimizer:
             slots['master'] = p_value.astype(jnp.float32)
         return slots
 
-    def _leaf_apply(self, g, p_value, slots, lr_value, step):
+    def _coeff_for(self, name):
+        """Per-parameter decay coefficient (AdamW/Lamb exclusions)."""
+        return self._coeff
+
+    def _leaf_apply(self, g, p_value, slots, lr_value, step, name=None):
         low = 'master' in slots
         p32 = slots['master'] if low else p_value.astype(jnp.float32)
         g32 = g.astype(jnp.float32)
-        if self._coeff and not self._decoupled_decay():
-            g32 = g32 + self._coeff * p32
+        coeff = self._coeff_for(name)
+        if coeff and not self._decoupled_decay():
+            reg = jnp.sign(p32) if self._decay_mode == 'l1' else p32
+            g32 = g32 + coeff * reg
         new_p32, new_slots = self._rule(g32, p32, dict(slots), lr_value, step)
-        if self._coeff and self._decoupled_decay():
-            new_p32 = new_p32 - lr_value * self._coeff * p32
+        if coeff and self._decoupled_decay():
+            new_p32 = new_p32 - lr_value * coeff * p32
         if low:
             new_slots['master'] = new_p32
             return new_p32.astype(p_value.dtype), new_slots
@@ -95,16 +104,19 @@ class Optimizer:
         if self._grad_clip is not None:
             grads = self._grad_clip.apply_pytree(grads)
         step = state['step'] + 1
-        flat_p, treedef = _tree.tree_flatten(params)
+        paths_p, treedef = _tree.tree_flatten_with_path(params)
+        names = ['.'.join(str(getattr(e, 'key', e)) for e in path)
+                 for path, _ in paths_p]
+        flat_p = [p for _, p in paths_p]
         flat_g = treedef.flatten_up_to(grads)
         flat_s = treedef.flatten_up_to(state['slots'])
         new_p, new_s = [], []
-        for g, p, s in zip(flat_g, flat_p, flat_s):
+        for g, p, s, nm in zip(flat_g, flat_p, flat_s, names):
             if g is None:
                 new_p.append(p)
                 new_s.append(s)
                 continue
-            np_, ns_ = self._leaf_apply(g, p, s, lr_value, step)
+            np_, ns_ = self._leaf_apply(g, p, s, lr_value, step, name=nm)
             new_p.append(np_)
             new_s.append(ns_)
         return (_tree.tree_unflatten(treedef, new_p),
@@ -143,7 +155,8 @@ class Optimizer:
             if isinstance(p, Parameter):
                 mult = p.optimize_attr.get('learning_rate', 1.0)
             new_val, new_slots = self._leaf_apply(
-                g.value, p.value, slots, lr_v * mult, self._step_count)
+                g.value, p.value, slots, lr_v * mult, self._step_count,
+                name=getattr(p, 'name', None))
             p._data = new_val
             p._node = None
             self._slots[id(p)] = new_slots
@@ -309,25 +322,12 @@ class AdamW(Adam):
     def _decoupled_decay(self):
         return True
 
-    def step(self):
-        if self._apply_decay_fn is None:
-            return super().step()
-        # selectively disable decay (e.g. biases / norm scales): run the two
-        # groups as separate sub-steps sharing one step count
-        all_params = self._parameter_list
-        coeff = self._coeff
-        try:
-            self._parameter_list = [
-                p for p in all_params if self._apply_decay_fn(p.name)]
-            super().step()
-            self._step_count -= 1
-            self._parameter_list = [
-                p for p in all_params if not self._apply_decay_fn(p.name)]
-            self._coeff = 0.0
-            super().step()
-        finally:
-            self._parameter_list = all_params
-            self._coeff = coeff
+    def _coeff_for(self, name):
+        # exclusion is per-leaf, so grad clipping stays one global pass
+        if self._apply_decay_fn is not None and name is not None \
+                and not self._apply_decay_fn(name):
+            return 0.0
+        return self._coeff
 
 
 class Lamb(Optimizer):
@@ -340,10 +340,19 @@ class Lamb(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lamb_decay = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
+        self._lamb_now = lamb_weight_decay
 
     def _init_slots(self, p):
         return {'moment1': jnp.zeros(p.shape, jnp.float32),
                 'moment2': jnp.zeros(p.shape, jnp.float32)}
+
+    def _coeff_for(self, name):
+        # called once per leaf right before _rule (trace-time python), so
+        # stashing the active decay here routes the exclusion into _rule
+        self._lamb_now = 0.0 if (
+            self._exclude_fn is not None and name is not None
+            and self._exclude_fn(name)) else self._lamb_decay
+        return 0.0
 
     def _rule(self, g, p, slots, lr, step):
         b1, b2 = self._beta1, self._beta2
@@ -353,7 +362,7 @@ class Lamb(Optimizer):
         t = jnp.asarray(step, jnp.float32)
         m_hat = m / (1 - jnp.power(b1, t))
         v_hat = v / (1 - jnp.power(b2, t))
-        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + self._lamb_decay * p
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + self._lamb_now * p
         w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)

@@ -27,7 +27,7 @@ def _is_tensor(x):
 
 class Tensor:
     __slots__ = ('_data', 'stop_gradient', 'grad', '_node', '_leaf_index',
-                 'name', 'persistable', '__weakref__')
+                 'name', 'persistable', '_dist_spec', '__weakref__')
 
     def __init__(self, data, stop_gradient: bool = True, name: str = '',
                  _node=None, _leaf_index: int = 0):

@@ -1,0 +1,119 @@
+"""Collectives on the 8-device virtual CPU mesh (SURVEY.md §4)."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import env
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    env.init_parallel_env((8,), ('dp',))
+    yield
+
+
+N = 8
+
+
+def _stacked(shape=(N, 4)):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+def test_world():
+    assert dist.get_world_size() == 8
+    assert dist.get_rank() == 0
+    assert dist.is_initialized()
+
+
+def test_all_reduce_sum():
+    x = _stacked()
+    t = paddle.to_tensor(x)
+    dist.all_reduce(t, group='dp')
+    want = np.broadcast_to(x.sum(0), x.shape)
+    np.testing.assert_allclose(t.numpy(), want, rtol=1e-5)
+
+
+def test_all_reduce_max_avg():
+    x = _stacked()
+    t = dist.all_reduce(paddle.to_tensor(x), op=dist.ReduceOp.MAX,
+                        group='dp')
+    np.testing.assert_allclose(t.numpy(),
+                               np.broadcast_to(x.max(0), x.shape), rtol=1e-6)
+    t = dist.all_reduce(paddle.to_tensor(x), op=dist.ReduceOp.AVG,
+                        group='dp')
+    np.testing.assert_allclose(t.numpy(),
+                               np.broadcast_to(x.mean(0), x.shape),
+                               rtol=1e-5)
+
+
+def test_all_gather():
+    x = _stacked()
+    lst = []
+    out = dist.all_gather(lst, paddle.to_tensor(x), group='dp')
+    assert len(lst) == N
+    for i in range(N):
+        np.testing.assert_allclose(lst[i].numpy(), x[i], rtol=1e-6)
+    np.testing.assert_allclose(out.numpy(), x, rtol=1e-6)
+
+
+def test_reduce_scatter():
+    x = np.random.randn(N, N * 3).astype(np.float32)
+    out = dist.reduce_scatter(input=paddle.to_tensor(x), group='dp')
+    total = x.sum(0)  # [N*3]
+    want = total.reshape(N, 3)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4)
+
+
+def test_broadcast():
+    x = _stacked()
+    t = dist.broadcast(paddle.to_tensor(x), src=3, group='dp')
+    np.testing.assert_allclose(t.numpy(),
+                               np.broadcast_to(x[3], x.shape), rtol=1e-6)
+
+
+def test_reduce():
+    x = _stacked()
+    t = dist.reduce(paddle.to_tensor(x), dst=2, group='dp')
+    got = t.numpy()
+    np.testing.assert_allclose(got[2], x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(got[0], x[0], rtol=1e-6)
+
+
+def test_alltoall():
+    x = np.random.randn(N, N, 5).astype(np.float32)
+    out = dist.alltoall(paddle.to_tensor(x), group='dp')
+    np.testing.assert_allclose(out.numpy(), x.swapaxes(0, 1), rtol=1e-6)
+
+
+def test_scatter():
+    x = _stacked()
+    out = dist.scatter(paddle.to_tensor(x), src=0, group='dp')
+    np.testing.assert_allclose(out.numpy(), x, rtol=1e-6)
+
+
+def test_send_recv_pair():
+    x = _stacked()
+    t = paddle.to_tensor(x)
+    dist.send(t, dst=5, group='dp')
+    out = dist.recv(t, src=1, group='dp')
+    np.testing.assert_allclose(out.numpy()[5], x[1], rtol=1e-6)
+
+
+def test_barrier_and_wait():
+    dist.barrier()
+    t = paddle.to_tensor(_stacked())
+    dist.wait(t)
+
+
+def test_shard_tensor_placements():
+    from paddle_tpu.distributed import ProcessMesh, Replicate, Shard
+    pm = ProcessMesh(shape=(2, 4), dim_names=('dp', 'mp'))
+    x = paddle.rand([8, 16])
+    t = dist.shard_tensor(x, mesh=pm, placements=[Shard(0), Shard(1)])
+    sh = t.value.sharding
+    assert sh.spec == jax.sharding.PartitionSpec('dp', 'mp')
+    t2 = dist.shard_tensor(paddle.rand([4, 4]), mesh=pm,
+                           placements=[Replicate(), Replicate()])
+    assert all(a is None for a in t2.value.sharding.spec)

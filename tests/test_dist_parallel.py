@@ -1,0 +1,239 @@
+"""Hybrid-parallel correctness on the 8-device CPU mesh (SURVEY.md §4):
+TP == dense, ZeRO step == unsharded step, ring attention == full
+attention, pipeline == sequential."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import env, fleet
+from paddle_tpu.distributed.pipeline import gpipe, stack_stage_params
+from paddle_tpu.distributed.ring_attention import (ring_attention,
+                                                   ulysses_attention)
+from paddle_tpu.ops.pallas import _attention_xla
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _mlp_weights(rng, din, dh, dout):
+    w1 = rng.standard_normal((din, dh)).astype(np.float32) * 0.1
+    b1 = np.zeros(dh, np.float32)
+    w2 = rng.standard_normal((dh, dout)).astype(np.float32) * 0.1
+    b2 = np.zeros(dout, np.float32)
+    return w1, b1, w2, b2
+
+
+class TPMlp(nn.Layer):
+    def __init__(self, din, dh, dout):
+        super().__init__()
+        self.fc1 = dist.ColumnParallelLinear(din, dh, gather_output=False)
+        self.fc2 = dist.RowParallelLinear(dh, dout, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_tp_linear_equals_dense():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {'dp_degree': 2, 'mp_degree': 4,
+                               'pp_degree': 1, 'sep_degree': 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    rng = np.random.default_rng(0)
+    w1, b1, w2, b2 = _mlp_weights(rng, 16, 32, 16)
+    m = TPMlp(16, 32, 16)
+    m.set_state_dict({'fc1.weight': w1, 'fc1.bias': b1,
+                      'fc2.weight': w2, 'fc2.bias': b2})
+    fleet.distributed_model(m)
+    # mp-sharded placement really happened
+    assert 'mp' in str(dict(m.named_parameters())['fc1.weight']
+                       .value.sharding.spec)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy()
+    want = np.maximum(x @ w1 + b1, 0) @ w2 + b2
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_vocab_parallel_embedding_and_ce():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {'dp_degree': 1, 'mp_degree': 8,
+                               'pp_degree': 1, 'sep_degree': 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    emb = dist.VocabParallelEmbedding(64, 16)
+    fleet.distributed_model(emb)
+    ids = np.array([[1, 5, 63], [0, 2, 7]])
+    out = emb(paddle.to_tensor(ids))
+    w = emb.weight.numpy()
+    np.testing.assert_allclose(out.numpy(), w[ids], rtol=1e-6)
+    ce = dist.ParallelCrossEntropy()
+    logits = paddle.to_tensor(
+        np.random.randn(4, 64).astype(np.float32))
+    labels = paddle.to_tensor(np.array([1, 2, 3, 4]))
+    loss = ce(logits, labels)
+    assert loss.shape == [4]
+
+
+class _Mlp(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_zero_sharded_step_equals_unsharded():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((16, 16)).astype(np.float32)
+    y = rng.integers(0, 4, 16)
+
+    def run(sharded):
+        paddle.seed(7)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {'dp_degree': 8, 'mp_degree': 1,
+                                   'pp_degree': 1, 'sep_degree': 1}
+        strategy.sharding = sharded
+        fleet.init(is_collective=True, strategy=strategy)
+        m = _Mlp()
+        fleet.distributed_model(m)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        step = fleet.DistTrainStep(
+            m, lambda out, lab: F.cross_entropy(out, lab), opt,
+            strategy=strategy)
+        losses = [float(step(paddle.to_tensor(x),
+                             paddle.to_tensor(y)).numpy())
+                  for _ in range(3)]
+        return losses
+
+    base = run(False)
+    zero = run(True)
+    np.testing.assert_allclose(base, zero, rtol=1e-4)
+    assert base[2] < base[0]  # actually learning
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_ring_attention_matches_full(causal):
+    env.init_parallel_env((1, 1, 8, 1), ('pp', 'dp', 'sp', 'mp'))
+    rng = np.random.default_rng(2)
+    B, S, H, D = 2, 64, 4, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    full = _attention_xla(jnp.array(q), jnp.array(k), jnp.array(v),
+                          causal=causal)
+    ring = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_gqa():
+    env.init_parallel_env((1, 1, 8, 1), ('pp', 'dp', 'sp', 'mp'))
+    rng = np.random.default_rng(3)
+    B, S, H, HKV, D = 1, 32, 8, 2, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, HKV, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, HKV, D)).astype(np.float32)
+    full = _attention_xla(jnp.array(q), jnp.array(k), jnp.array(v),
+                          causal=True)
+    ring = jax.jit(lambda a, b, c: ring_attention(a, b, c,
+                                                  causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_attention_matches_full():
+    env.init_parallel_env((1, 1, 8, 1), ('pp', 'dp', 'sp', 'mp'))
+    rng = np.random.default_rng(4)
+    B, S, H, D = 2, 64, 8, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    full = _attention_xla(jnp.array(q), jnp.array(k), jnp.array(v),
+                          causal=True)
+    uly = jax.jit(lambda a, b, c: ulysses_attention(
+        a, b, c, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(full),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_gpipe_matches_sequential():
+    env.init_parallel_env((4, 1, 1, 2), ('pp', 'dp', 'sp', 'mp'))
+    rng = np.random.default_rng(5)
+    n_pp, d = 4, 16
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p['w'] + p['b'])
+
+    stages = [{'w': rng.standard_normal((d, d)).astype(np.float32) * 0.3,
+               'b': rng.standard_normal((d,)).astype(np.float32) * 0.1}
+              for _ in range(n_pp)]
+    stacked = stack_stage_params(stages)
+    n_micro, mb = 6, 4
+    x = rng.standard_normal((n_micro, mb, d)).astype(np.float32)
+
+    out = jax.jit(lambda sp, xx: gpipe(stage_fn, sp, xx))(stacked, x)
+    want = x
+    for p in stages:
+        want = np.tanh(want @ p['w'] + p['b'])
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_gpipe_differentiable():
+    env.init_parallel_env((4, 1, 1, 2), ('pp', 'dp', 'sp', 'mp'))
+    rng = np.random.default_rng(6)
+    n_pp, d = 4, 8
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p['w'])
+
+    stages = [{'w': rng.standard_normal((d, d)).astype(np.float32) * 0.3}
+              for _ in range(n_pp)]
+    stacked = stack_stage_params(stages)
+    x = rng.standard_normal((4, 2, d)).astype(np.float32)
+
+    def loss(sp):
+        return jnp.sum(gpipe(stage_fn, sp, jnp.array(x)) ** 2)
+
+    g = jax.jit(jax.grad(loss))(stacked)
+    # reference grad from the sequential program
+    def loss_seq(sp):
+        y = jnp.array(x)
+        for i in range(n_pp):
+            y = jnp.tanh(y @ sp['w'][i])
+        return jnp.sum(y ** 2)
+    g_seq = jax.grad(loss_seq)(stacked)
+    np.testing.assert_allclose(np.asarray(g['w']),
+                               np.asarray(g_seq['w']), rtol=1e-3, atol=1e-4)
+
+
+def test_moe_identical_experts_equals_dense():
+    env.init_parallel_env((1, 8, 1, 1), ('pp', 'dp', 'sp', 'mp'))
+    paddle.seed(0)
+    m = dist.MoELayer(16, 32, num_experts=4, top_k=2, capacity_factor=8.0)
+    # make all experts identical -> MoE == single FFN, routing-independent
+    w_in = m.w_in.numpy().copy()
+    w_in[:] = w_in[0]
+    w_out = m.w_out.numpy().copy()
+    w_out[:] = w_out[0]
+    m.set_state_dict({'gate': m.gate.numpy(), 'w_in': w_in, 'w_out': w_out})
+    x = np.random.default_rng(7).standard_normal((2, 6, 16)) \
+        .astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy()
+    want = np.asarray(jax.nn.gelu(x @ w_in[0])) @ w_out[0]
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-4)
+    assert m.aux_loss is not None
+
+
+def test_moe_grad_flows():
+    env.init_parallel_env((1, 8, 1, 1), ('pp', 'dp', 'sp', 'mp'))
+    m = dist.MoELayer(8, 16, num_experts=4, top_k=1)
+    x = paddle.rand([2, 4, 8])
+    out = m(x)
+    loss = out.sum() + m.aux_loss
+    loss.backward()
+    assert m.w_in.grad is not None
+    assert m.gate.grad is not None
