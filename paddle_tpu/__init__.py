@@ -24,6 +24,7 @@ from . import jit
 from . import nn
 from . import optimizer
 from . import distributed
+from . import nlp
 from .nn.layer import ParamAttr
 from .optimizer import L1Decay, L2Decay
 

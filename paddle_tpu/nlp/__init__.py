@@ -1,0 +1,29 @@
+"""paddle_tpu.nlp — transformer model zoo + generation + tokenizers.
+
+Upstream analogue: PaddleNLP `paddlenlp.transformers`. The `transformers`
+submodule alias mirrors the reference's import path
+(`from paddlenlp.transformers import LlamaForCausalLM` →
+`from paddle_tpu.nlp.transformers import LlamaForCausalLM`).
+"""
+from __future__ import annotations
+
+from .bert import (BertConfig, BertForMaskedLM,
+                   BertForSequenceClassification, BertModel)
+from .ernie import (ErnieConfig, ErnieForMaskedLM,
+                    ErnieForSequenceClassification, ErnieModel)
+from .generation import GenerationMixin
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel)
+from .tokenizer import (BPETokenizer, PretrainedTokenizer,
+                        WhitespaceTokenizer)
+
+from . import transformers  # noqa: E402  (API-parity alias module)
+
+__all__ = [
+    'BertConfig', 'BertForMaskedLM', 'BertForSequenceClassification',
+    'BertModel', 'ErnieConfig', 'ErnieForMaskedLM',
+    'ErnieForSequenceClassification', 'ErnieModel', 'GenerationMixin',
+    'GPTConfig', 'GPTForCausalLM', 'GPTModel', 'LlamaConfig',
+    'LlamaForCausalLM', 'LlamaModel', 'BPETokenizer',
+    'PretrainedTokenizer', 'WhitespaceTokenizer', 'transformers',
+]

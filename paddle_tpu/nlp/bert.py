@@ -1,0 +1,198 @@
+"""BERT model family (upstream analogue: PaddleNLP
+`paddlenlp/transformers/bert/modeling.py` — BertModel, BertForMaskedLM,
+BertForSequenceClassification).
+
+TPU-native: the encoder stack reuses `nn.TransformerEncoder`-style
+pre/post-LN blocks built on the shared fused-attention choke-point; all
+shapes static so one jit covers the whole classification fine-tune step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.common_layers import Dropout, Embedding, Linear
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..tensor import Tensor, apply_op, to_jax
+
+
+class BertConfig:
+    model_type = 'bert'
+
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act='gelu',
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, layer_norm_eps=1e-12,
+                 pad_token_id=0, pool_act='tanh', num_labels=2, **kwargs):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.pad_token_id = pad_token_id
+        self.pool_act = pool_act
+        self.num_labels = num_labels
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    @classmethod
+    def bert_base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def bert_large(cls, **kw):
+        return cls(hidden_size=1024, num_hidden_layers=24,
+                   num_attention_heads=16, intermediate_size=4096, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault('vocab_size', 128)
+        kw.setdefault('hidden_size', 64)
+        kw.setdefault('num_hidden_layers', 2)
+        kw.setdefault('num_attention_heads', 4)
+        kw.setdefault('intermediate_size', 128)
+        kw.setdefault('max_position_embeddings', 128)
+        kw.setdefault('hidden_dropout_prob', 0.0)
+        kw.setdefault('attention_probs_dropout_prob', 0.0)
+        return cls(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                extra_embeds=None):
+        if position_ids is None:
+            position_ids = apply_op(
+                lambda iv: jnp.arange(iv.shape[1], dtype=jnp.int32),
+                input_ids, _name='positions')
+        if token_type_ids is None:
+            token_type_ids = apply_op(
+                lambda iv: jnp.zeros(iv.shape, jnp.int32), input_ids,
+                _name='zeros_like')
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if extra_embeds is not None:
+            h = h + extra_embeds
+        return self.dropout(self.layer_norm(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+        self.activation = F.tanh if config.pool_act == 'tanh' else F.relu
+
+    def forward(self, hidden):
+        first = apply_op(lambda h: h[:, 0], hidden, _name='cls_token')
+        return self.activation(self.dense(first))
+
+
+class BertModel(Layer):
+    config_class = BertConfig
+    base_model_prefix = 'bert'
+
+    def __init__(self, config: BertConfig, add_pooling_layer=True):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            normalize_before=False)
+        self.encoder = TransformerEncoder(enc_layer,
+                                          config.num_hidden_layers)
+        self.pooler = BertPooler(config) if add_pooling_layer else None
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, extra_embeds=None):
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(to_jax(input_ids))
+        mask = attention_mask
+        if mask is not None and not isinstance(mask, Tensor):
+            mask = Tensor(to_jax(mask))
+        if mask is not None and len(mask.shape) == 2:
+            mask = apply_op(lambda m: (m > 0)[:, None, None, :], mask,
+                            _name='pad_mask')
+        h = self.embeddings(ids, token_type_ids, position_ids,
+                            extra_embeds=extra_embeds)
+        h = self.encoder(h, src_mask=mask)
+        pooled = self.pooler(h) if self.pooler is not None else None
+        return (h, pooled) if pooled is not None else h
+
+
+class BertForMaskedLM(Layer):
+    config_class = BertConfig
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config, add_pooling_layer=False)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        epsilon=config.layer_norm_eps)
+        self.decoder = Linear(config.hidden_size, config.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        h = self.bert(input_ids, token_type_ids=token_type_ids,
+                      attention_mask=attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(h)))
+        logits = self.decoder(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                (labels if isinstance(labels, Tensor)
+                 else Tensor(to_jax(labels))).reshape([-1]),
+                ignore_index=-100)
+            return loss, logits
+        return logits
+
+
+class BertForSequenceClassification(Layer):
+    config_class = BertConfig
+
+    def __init__(self, config: BertConfig, num_classes=None):
+        super().__init__()
+        self.config = config
+        self.num_classes = num_classes or config.num_labels
+        self.bert = BertModel(config, add_pooling_layer=True)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, self.num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids=token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits,
+                labels if isinstance(labels, Tensor)
+                else Tensor(to_jax(labels)))
+            return loss, logits
+        return logits

@@ -1,0 +1,110 @@
+"""ERNIE model family (upstream analogue: PaddleNLP
+`paddlenlp/transformers/ernie/modeling.py`).
+
+Architecturally a BERT-style encoder plus task-type embeddings; shares
+the TPU-native encoder stack with bert.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.common_layers import Dropout, Embedding, Linear
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from ..tensor import Tensor, apply_op, to_jax
+from .bert import BertConfig, BertModel
+
+
+class ErnieConfig(BertConfig):
+    model_type = 'ernie'
+
+    def __init__(self, task_type_vocab_size=3, use_task_id=True, **kwargs):
+        super().__init__(**kwargs)
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+
+
+class ErnieModel(Layer):
+    config_class = ErnieConfig
+    base_model_prefix = 'ernie'
+
+    def __init__(self, config: ErnieConfig, add_pooling_layer=True):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config, add_pooling_layer=add_pooling_layer)
+        if config.use_task_id:
+            self.task_type_embeddings = Embedding(
+                config.task_type_vocab_size, config.hidden_size)
+        else:
+            self.task_type_embeddings = None
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        task_emb = None
+        if self.task_type_embeddings is not None:
+            ids = input_ids if isinstance(input_ids, Tensor) \
+                else Tensor(to_jax(input_ids))
+            if task_type_ids is None:
+                task_type_ids = apply_op(
+                    lambda iv: jnp.zeros(iv.shape, jnp.int32), ids,
+                    _name='zeros_like')
+            task_emb = self.task_type_embeddings(task_type_ids)
+        return self.bert(input_ids, token_type_ids=token_type_ids,
+                         position_ids=position_ids,
+                         attention_mask=attention_mask,
+                         extra_embeds=task_emb)
+
+
+class ErnieForMaskedLM(Layer):
+    config_class = ErnieConfig
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieModel(config, add_pooling_layer=False)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        epsilon=config.layer_norm_eps)
+        self.decoder = Linear(config.hidden_size, config.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None, labels=None):
+        h = self.ernie(input_ids, token_type_ids=token_type_ids,
+                       attention_mask=attention_mask,
+                       task_type_ids=task_type_ids)
+        h = self.transform_norm(F.gelu(self.transform(h)))
+        logits = self.decoder(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                (labels if isinstance(labels, Tensor)
+                 else Tensor(to_jax(labels))).reshape([-1]),
+                ignore_index=-100)
+            return loss, logits
+        return logits
+
+
+class ErnieForSequenceClassification(Layer):
+    config_class = ErnieConfig
+
+    def __init__(self, config: ErnieConfig, num_classes=None):
+        super().__init__()
+        self.config = config
+        self.num_classes = num_classes or config.num_labels
+        self.ernie = ErnieModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, self.num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids=token_type_ids,
+                               attention_mask=attention_mask,
+                               task_type_ids=task_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits, labels if isinstance(labels, Tensor)
+                else Tensor(to_jax(labels)))
+            return loss, logits
+        return logits

@@ -1,0 +1,179 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+Upstream analogue: PaddleNLP `paddlenlp/transformers/generation_utils.py`
+(GenerationMixin.generate: greedy / sampling / top-k / top-p with
+incremental decode). TPU-native design: instead of growing KV tensors
+(which would recompile every step), the cache is allocated once at
+`prompt_len + max_new_tokens` and updated in place with
+`lax.dynamic_update_slice`; the whole decode is ONE XLA program — a
+prefill call followed by a `lax.while_loop` over single-token steps with
+early exit when every sequence has emitted EOS.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from ..jit import functional_call, functional_state
+from ..tensor import Tensor, to_jax
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _process_logits(logits, temperature, top_k, top_p):
+    """Filter a [B, V] logits slab for sampling. Static config → traced fine."""
+    logits = logits.astype(jnp.float32)
+    if temperature != 1.0:
+        logits = logits / jnp.maximum(temperature, 1e-6)
+    v = logits.shape[-1]
+    if top_k and 0 < top_k < v:
+        kth = jnp.sort(logits, axis=-1)[:, v - top_k][:, None]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    if top_p and top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always keep top-1)
+        cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1) - 1
+        cutoff = jnp.take_along_axis(srt, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, _NEG_INF, logits)
+    return logits
+
+
+def _next_token(logits, key, strategy, temperature, top_k, top_p):
+    """Sample the next token; returns (token, its log-prob under the raw
+    model distribution)."""
+    if strategy == 'greedy_search':
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        filtered = _process_logits(logits, temperature, top_k, top_p)
+        tok = jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tok_logp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+    return tok, tok_logp
+
+
+class GenerationMixin:
+    """Mixed into *ForCausalLM models. Requires the host class to provide:
+
+    - ``init_cache(batch_size, max_length, dtype) -> pytree of jnp arrays``
+    - ``forward(input_ids, position_offset=..., cache=..., use_cache=True)``
+      returning ``(logits, new_cache)`` when ``use_cache``.
+    """
+
+    generation_config: Dict[str, Any] = {}
+
+    def _decode_jit(self, max_new_tokens: int, strategy: str,
+                    temperature: float, top_k: int, top_p: float,
+                    eos_token_id: int, pad_token_id: int):
+        # per-instance cache (a class-level lru_cache would pin every model
+        # instance and its compiled executables for the process lifetime)
+        cache_key = (max_new_tokens, strategy, temperature, top_k, top_p,
+                     eos_token_id, pad_token_id)
+        store = self.__dict__.setdefault('_generate_jit_cache', {})
+        if cache_key in store:
+            return store[cache_key]
+        def decode(params, frozen, buffers, ids, cache, key):
+            b, s = ids.shape
+
+            def fwd(tok, cache, offset):
+                (logits, new_cache), _ = functional_call(
+                    self, params, frozen, buffers, (tok,),
+                    dict(cache=cache, position_offset=offset,
+                         use_cache=True))
+                return logits, new_cache
+
+            # prefill over the whole prompt
+            logits, cache = fwd(ids, cache, jnp.int32(0))
+            key, sub = jax.random.split(key)
+            nxt, nxt_logp = _next_token(logits[:, -1], sub, strategy,
+                                        temperature, top_k, top_p)
+            out = jnp.full((b, max_new_tokens), pad_token_id, jnp.int32)
+            scores = jnp.zeros((b,), jnp.float32)
+            finished = jnp.zeros((b,), jnp.bool_)
+
+            def cond(state):
+                i, _, _, _, _, finished, _, _ = state
+                return jnp.logical_and(i < max_new_tokens,
+                                       jnp.logical_not(jnp.all(finished)))
+
+            def body(state):
+                i, tok, tok_logp, out, cache, finished, scores, key = state
+                # emit `tok` (sampled last round) and count ITS log-prob
+                tok = jnp.where(finished, pad_token_id, tok)
+                out = jax.lax.dynamic_update_slice(
+                    out, tok[:, None], (0, i))
+                scores = scores + jnp.where(finished, 0.0, tok_logp)
+                newly_done = jnp.logical_or(finished, tok == eos_token_id)
+                logits, cache = fwd(tok[:, None].astype(ids.dtype), cache,
+                                    jnp.int32(s) + i)
+                key, sub = jax.random.split(key)
+                nxt, nxt_logp = _next_token(logits[:, -1], sub, strategy,
+                                            temperature, top_k, top_p)
+                return (i + 1, nxt, nxt_logp, out, cache, newly_done,
+                        scores, key)
+
+            state = (jnp.int32(0), nxt, nxt_logp, out, cache, finished,
+                     scores, key)
+            _, _, _, out, _, _, scores, _ = jax.lax.while_loop(
+                cond, body, state)
+            return out, scores
+
+        jitted = jax.jit(decode)
+        store[cache_key] = jitted
+        return jitted
+
+    def generate(self, input_ids, max_new_tokens: int = 20,
+                 max_length: Optional[int] = None,
+                 decode_strategy: str = 'greedy_search',
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: Optional[int] = None, use_cache: bool = True,
+                 seed: Optional[int] = None,
+                 attention_mask=None, **kwargs) -> Tuple[Tensor, Tensor]:
+        """Returns (generated ids [B, max_new_tokens], per-sequence score)."""
+        if decode_strategy not in ('greedy_search', 'sampling'):
+            raise ValueError(f'unknown decode_strategy {decode_strategy!r}')
+        if attention_mask is not None:
+            raise NotImplementedError(
+                'generate() does not support padded prompts yet; batch '
+                'equal-length prompts (an attention_mask would be silently '
+                'mis-handled by the static decode cache, so this fails loud)')
+        if kwargs:
+            raise TypeError(f'generate() got unexpected kwargs '
+                            f'{sorted(kwargs)}')
+        ids = to_jax(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        b, s = ids.shape
+        if max_length is not None:
+            max_new_tokens = max(int(max_length) - s, 1)
+        cfg = getattr(self, 'config', None)
+        max_pos = getattr(cfg, 'max_position_embeddings', None)
+        if max_pos is not None and s + max_new_tokens > max_pos:
+            raise ValueError(
+                f'prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds '
+                f'max_position_embeddings ({max_pos})')
+        if eos_token_id is None:
+            eos_token_id = getattr(cfg, 'eos_token_id', -1)
+        if pad_token_id is None:
+            pad_token_id = getattr(cfg, 'pad_token_id', 0)
+        was_training = self.training
+        self.eval()
+        try:
+            params, frozen, buffers = functional_state(self)
+            cache = self.init_cache(b, s + max_new_tokens)
+            key = (jax.random.PRNGKey(seed) if seed is not None
+                   else framework.next_rng_key())
+            fn = self._decode_jit(int(max_new_tokens), decode_strategy,
+                                  float(temperature), int(top_k),
+                                  float(top_p), int(eos_token_id),
+                                  int(pad_token_id))
+            out, scores = fn(params, frozen, buffers, ids, cache, key)
+        finally:
+            if was_training:
+                self.train()
+        return Tensor(out), Tensor(scores)

@@ -1,0 +1,10 @@
+"""Import-path parity with the reference's `paddlenlp.transformers`."""
+from .bert import (BertConfig, BertForMaskedLM,  # noqa: F401
+                   BertForSequenceClassification, BertModel)
+from .ernie import (ErnieConfig, ErnieForMaskedLM,  # noqa: F401
+                    ErnieForSequenceClassification, ErnieModel)
+from .generation import GenerationMixin  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .tokenizer import (BPETokenizer, PretrainedTokenizer,  # noqa: F401
+                        WhitespaceTokenizer)

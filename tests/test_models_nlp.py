@@ -1,0 +1,237 @@
+"""NLP model zoo tests (SURVEY.md §4: tiny-config smoke + overfit +
+KV-cache/no-cache decode parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import (BertConfig, BertForMaskedLM,
+                            BertForSequenceClassification, BertModel,
+                            BPETokenizer, ErnieConfig, ErnieForMaskedLM,
+                            GPTConfig, GPTForCausalLM, LlamaConfig,
+                            LlamaForCausalLM, WhitespaceTokenizer)
+
+
+def _ids(cfg, b=2, s=12, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, cfg.vocab_size, (b, s))
+
+
+class TestLlama:
+    def test_forward_shape_and_gqa(self):
+        cfg = LlamaConfig.tiny()  # 4 heads, 2 kv heads -> GQA path
+        m = LlamaForCausalLM(cfg)
+        logits = m(_ids(cfg))
+        assert logits.shape == [2, 12, cfg.vocab_size]
+
+    def test_backward_populates_grads(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        ids = _ids(cfg)
+        loss, _ = m(ids, labels=ids)
+        loss.backward()
+        for n, p in m.named_parameters():
+            assert p.grad is not None, n
+
+    def test_overfit_loss_decreases(self):
+        cfg = LlamaConfig.tiny(num_hidden_layers=1)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        ids = _ids(cfg, b=2, s=8)
+        losses = []
+        for _ in range(15):
+            loss, _ = m(ids, labels=ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.6
+
+    def test_generate_cache_matches_full_forward(self):
+        """Greedy decode with KV cache must equal re-running the full
+        (cache-free) forward each step."""
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        ids = _ids(cfg, b=2, s=6)
+        out, _ = m.generate(ids, max_new_tokens=6,
+                            decode_strategy='greedy_search',
+                            eos_token_id=-1)
+        cur = ids
+        ref = []
+        with paddle.no_grad():
+            for _ in range(6):
+                logits = m(cur).numpy()
+                nxt = logits[:, -1].argmax(-1)
+                ref.append(nxt)
+                cur = np.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out.numpy(), np.stack(ref, axis=1))
+
+    def test_generate_eos_stops_and_pads(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        ids = _ids(cfg, b=1, s=4)
+        with paddle.no_grad():
+            first = int(m(ids).numpy()[0, -1].argmax())
+        out, _ = m.generate(ids, max_new_tokens=5, eos_token_id=first,
+                            pad_token_id=99)
+        o = out.numpy()[0]
+        assert o[0] == first and all(t == 99 for t in o[1:])
+
+    def test_generate_scores_are_emitted_token_logps(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        ids = _ids(cfg, b=2, s=6)
+        out, scores = m.generate(ids, max_new_tokens=1, eos_token_id=-1)
+        with paddle.no_grad():
+            logits = m(ids).numpy()[:, -1].astype(np.float64)
+        logp = logits - np.log(np.exp(
+            logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+            - logits.max(-1, keepdims=True)
+        want = np.take_along_axis(logp, out.numpy().astype(int), 1)[:, 0]
+        np.testing.assert_allclose(scores.numpy(), want, atol=1e-4)
+
+    def test_generate_rejects_padded_prompts_and_overflow(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        ids = _ids(cfg, b=1, s=4)
+        with pytest.raises(NotImplementedError):
+            m.generate(ids, attention_mask=np.ones_like(ids))
+        with pytest.raises(ValueError):
+            m.generate(ids, max_new_tokens=cfg.max_position_embeddings)
+
+    def test_tied_embeddings(self):
+        cfg = LlamaConfig.tiny(tie_word_embeddings=True)
+        m = LlamaForCausalLM(cfg)
+        assert m.lm_head is None
+        assert m(_ids(cfg)).shape == [2, 12, cfg.vocab_size]
+
+
+class TestGPT:
+    def test_forward_and_generate(self):
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg).eval()
+        ids = _ids(cfg, s=8)
+        assert m(ids).shape == [2, 8, cfg.vocab_size]
+        out, _ = m.generate(ids, max_new_tokens=4, eos_token_id=-1)
+        cur = ids
+        with paddle.no_grad():
+            for step in range(4):
+                nxt = m(cur).numpy()[:, -1].argmax(-1)
+                np.testing.assert_array_equal(out.numpy()[:, step], nxt)
+                cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+    def test_sampling_reproducible_with_seed(self):
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg).eval()
+        ids = _ids(cfg, s=8)
+        a, _ = m.generate(ids, max_new_tokens=4, decode_strategy='sampling',
+                          top_k=10, temperature=0.7, seed=3,
+                          eos_token_id=-1)
+        b, _ = m.generate(ids, max_new_tokens=4, decode_strategy='sampling',
+                          top_k=10, temperature=0.7, seed=3,
+                          eos_token_id=-1)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_overfit(self):
+        cfg = GPTConfig.tiny(num_hidden_layers=1)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        ids = _ids(cfg, b=2, s=8)
+        first = last = None
+        for i in range(15):
+            loss, _ = m(ids, labels=ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = v if first is None else first
+            last = v
+        assert last < first * 0.6
+
+
+class TestBertErnie:
+    def test_bert_model_outputs(self):
+        cfg = BertConfig.tiny()
+        m = BertModel(cfg)
+        seq, pooled = m(_ids(cfg))
+        assert seq.shape == [2, 12, cfg.hidden_size]
+        assert pooled.shape == [2, cfg.hidden_size]
+
+    def test_bert_mlm_ignore_index(self):
+        cfg = BertConfig.tiny()
+        m = BertForMaskedLM(cfg)
+        ids = _ids(cfg)
+        labels = np.full_like(ids, -100)
+        labels[:, 3] = ids[:, 3]
+        loss, logits = m(ids, labels=labels)
+        assert np.isfinite(float(loss.numpy()))
+        assert logits.shape == [2, 12, cfg.vocab_size]
+
+    def test_bert_cls_with_padding_mask(self):
+        cfg = BertConfig.tiny()
+        m = BertForSequenceClassification(cfg, num_classes=3)
+        ids = _ids(cfg)
+        mask = np.ones_like(ids)
+        mask[:, 8:] = 0
+        loss, logits = m(ids, attention_mask=mask, labels=np.array([0, 2]))
+        assert logits.shape == [2, 3]
+        loss.backward()
+
+    def test_padding_mask_actually_masks(self):
+        cfg = BertConfig.tiny()
+        m = BertModel(cfg).eval()
+        ids = _ids(cfg)
+        mask = np.ones_like(ids)
+        mask[:, 8:] = 0
+        seq1, _ = m(ids, attention_mask=mask)
+        ids2 = ids.copy()
+        ids2[:, 8:] = (ids2[:, 8:] + 1) % cfg.vocab_size  # perturb masked slots
+        seq2, _ = m(ids2, attention_mask=mask)
+        np.testing.assert_allclose(seq1.numpy()[:, :8], seq2.numpy()[:, :8],
+                                   atol=1e-5)
+
+    def test_ernie_task_types_change_output(self):
+        cfg = ErnieConfig.tiny()
+        m = ErnieForMaskedLM(cfg)
+        ids = _ids(cfg)
+        a = m(ids, task_type_ids=np.zeros_like(ids)).numpy()
+        b = m(ids, task_type_ids=np.ones_like(ids)).numpy()
+        assert not np.allclose(a, b)
+
+
+class TestTokenizers:
+    corpus = ['the quick brown fox jumps over the lazy dog',
+              'pack my box with five dozen liquor jugs'] * 3
+
+    def test_whitespace_roundtrip(self):
+        tok = WhitespaceTokenizer().train_from_iterator(self.corpus)
+        ids = tok.encode('the quick dog')
+        assert tok.decode(ids) == 'the quick dog'
+        assert tok.unk_token_id == tok.encode('zzzunseen')[0]
+
+    def test_bpe_roundtrip_and_fallback(self):
+        tok = BPETokenizer().train_from_iterator(self.corpus, vocab_size=320)
+        for text in ('the quick fox', 'unseen wörds überhaupt'):
+            assert tok.decode(tok.encode(text)) == text
+
+    def test_bpe_save_load(self, tmp_path):
+        tok = BPETokenizer().train_from_iterator(self.corpus, vocab_size=320)
+        tok.save_pretrained(str(tmp_path))
+        tok2 = BPETokenizer.from_pretrained(str(tmp_path))
+        text = 'the quick brown fox'
+        assert tok.encode(text) == tok2.encode(text)
+
+    def test_call_batched_padding(self):
+        tok = WhitespaceTokenizer().train_from_iterator(self.corpus)
+        out = tok(['the quick', 'the quick brown fox'], padding=True)
+        lens = {len(e) for e in out['input_ids']}
+        assert len(lens) == 1
+        assert out['attention_mask'][0][-1] == 0
+
+    def test_from_pretrained_offline_gate(self):
+        with pytest.raises(OSError):
+            PretrainedTokenizer = __import__(
+                'paddle_tpu.nlp.tokenizer', fromlist=['PretrainedTokenizer']
+            ).PretrainedTokenizer
+            PretrainedTokenizer.from_pretrained('bert-base-uncased')
