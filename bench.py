@@ -1,0 +1,118 @@
+"""Single-chip headline benchmark: Llama-flavored decoder pretraining
+step — tokens/sec + MFU on the available chip (SURVEY.md §6).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": tokens/sec, "unit": "tokens/s",
+   "vs_baseline": MFU / 0.40, ...}
+vs_baseline normalizes against the reference's A100-class MFU bar
+(BASELINE.json: ">= A100 MFU (~40%)" on matmul-dominant decoders).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _peak_flops(device) -> float:
+    """Peak bf16 FLOP/s by device kind (public TPU spec sheet numbers)."""
+    kind = getattr(device, 'device_kind', '').lower()
+    table = {
+        'v5 lite': 197e12, 'v5e': 197e12,
+        'v5p': 459e12, 'v5': 459e12,
+        'v6 lite': 918e12, 'v6e': 918e12,
+        'v4': 275e12,
+        'v3': 123e12,
+        'v2': 45e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12  # assume v5e-class if unrecognized
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() not in ('cpu',)
+    # ~740M-param decoder in bf16 on a real chip; thumbnail on CPU CI.
+    # h=2048 / head_dim=128 keeps every matmul MXU-shaped; batch chosen to
+    # fill HBM with the fused-CE loss (no fp32 logits copy) and the pallas
+    # flash-attention path (no [B,H,S,S] materialization).
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=4096)
+        batch, seq, steps, warmup = 4, 2048, 10, 2
+        dtype = 'bfloat16'
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps, warmup = 2, 64, 3, 1
+        dtype = 'float32'
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if dtype == 'bfloat16':
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=3e-4, parameters=model.parameters(),
+        multi_precision=(dtype == 'bfloat16'))
+
+    def loss_fn(logits, labels):
+        # fused CE path: fp32 math without materializing fp32 logits
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1]))
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq))
+
+    for _ in range(warmup):
+        loss = step(ids, ids)
+    float(loss.numpy())  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, ids)
+    final_loss = float(loss.numpy())  # sync on the last step
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+
+    # model FLOPs: 3x forward (fwd + 2x bwd); fwd = 2*N_matmul*B*S weight
+    # matmuls + 4*B*S^2*H attention matmuls per layer
+    h, L = cfg.hidden_size, cfg.num_hidden_layers
+    qkvo = h * (cfg.num_attention_heads * cfg.head_dim) * 2 \
+        + h * (cfg.num_key_value_heads * cfg.head_dim) * 2
+    n_matmul = L * (qkvo + 3 * h * cfg.intermediate_size) \
+        + h * cfg.vocab_size  # lm head included, embed gather excluded
+    fwd_flops = (2 * n_matmul * batch * seq
+                 + L * 4 * batch * seq * seq * h)
+    step_flops = 3 * fwd_flops
+    mfu = step_flops / dt / _peak_flops(jax.devices()[0])
+
+    print(json.dumps({
+        'metric': 'llama_740m_pretrain_tokens_per_sec_per_chip',
+        'value': round(tokens_per_sec, 1),
+        'unit': 'tokens/s',
+        'vs_baseline': round(mfu / 0.40, 4),
+        'mfu': round(mfu, 4),
+        'step_time_s': round(dt, 4),
+        'loss': round(final_loss, 4),
+        'device': str(jax.devices()[0].device_kind),
+        'config': {'params_m': round(sum(
+            int(np.prod(p.shape)) for p in model.parameters()) / 1e6, 1),
+            'batch': batch, 'seq': seq, 'dtype': dtype},
+    }))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

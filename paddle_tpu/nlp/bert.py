@@ -123,7 +123,7 @@ class BertModel(Layer):
             config.intermediate_size, dropout=config.hidden_dropout_prob,
             activation=config.hidden_act,
             attn_dropout=config.attention_probs_dropout_prob,
-            normalize_before=False)
+            normalize_before=False, layer_norm_eps=config.layer_norm_eps)
         self.encoder = TransformerEncoder(enc_layer,
                                           config.num_hidden_layers)
         self.pooler = BertPooler(config) if add_pooling_layer else None

@@ -23,6 +23,42 @@ from ..tensor import Tensor, to_jax
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def as_offset(position_offset):
+    """Normalize a position offset (None / int / Tensor) to a traced i32."""
+    if position_offset is None:
+        return jnp.int32(0)
+    if isinstance(position_offset, Tensor):
+        return position_offset.value
+    return jnp.asarray(position_offset, jnp.int32)
+
+
+def update_kv_cache(k_cache, v_cache, k, v, offset):
+    """Write new K/V blocks into the static decode cache at `offset`.
+    All args are Tensors; [B, L, H_kv, D] caches, [B, S, H_kv, D] updates.
+    Returns (k_cache, v_cache) Tensors. Shared by every causal-LM family
+    so decode-cache semantics can never diverge between models."""
+    from ..tensor import apply_op as _apply
+
+    def upd(c, new):
+        return jax.lax.dynamic_update_slice(c, new.astype(c.dtype),
+                                            (0, offset, 0, 0))
+    return (_apply(upd, k_cache, k, _name='cache_update'),
+            _apply(upd, v_cache, v, _name='cache_update'))
+
+
+def decode_mask(q, k_cache, offset):
+    """[1, 1, Sq, L] boolean causal mask for attention over a static cache:
+    query at absolute position offset+i sees key positions <= offset+i."""
+    from ..tensor import apply_op as _apply
+
+    def fn(qv, kc):
+        s, l = qv.shape[1], kc.shape[1]
+        q_pos = offset + jnp.arange(s, dtype=jnp.int32)
+        k_pos = jnp.arange(l, dtype=jnp.int32)
+        return (k_pos[None, :] <= q_pos[:, None])[None, None]
+    return _apply(fn, q, k_cache, _name='decode_mask')
+
+
 def _process_logits(logits, temperature, top_k, top_p):
     """Filter a [B, V] logits slab for sampling. Static config → traced fine."""
     logits = logits.astype(jnp.float32)

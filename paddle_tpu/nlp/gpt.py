@@ -17,8 +17,9 @@ from ..nn.common_layers import Dropout, Embedding, Linear
 from ..nn.layer import Layer
 from ..nn.norm import LayerNorm
 from ..tensor import Tensor, apply_op, to_jax
-from .generation import GenerationMixin
-from .llama import _as_offset
+from .generation import (GenerationMixin, as_offset as _as_offset,
+                         decode_mask as _decode_mask,
+                         update_kv_cache as _update_kv_cache)
 
 
 class GPTConfig:
@@ -100,20 +101,9 @@ class GPTAttention(Layer):
                 q, k, v, attn_mask=attn_mask, is_causal=True,
                 dropout_p=self.dropout_p, training=self.training)
         else:
-            k_cache, v_cache = cache
-
-            def upd(c, new):
-                return jax.lax.dynamic_update_slice(c, new.astype(c.dtype),
-                                                    (0, offset, 0, 0))
-            k_cache = apply_op(upd, k_cache, k, _name='cache_update')
-            v_cache = apply_op(upd, v_cache, v, _name='cache_update')
-
-            def dec_mask(qv, kc):
-                s, l = qv.shape[1], kc.shape[1]
-                q_pos = offset + jnp.arange(s, dtype=jnp.int32)
-                k_pos = jnp.arange(l, dtype=jnp.int32)
-                return (k_pos[None, :] <= q_pos[:, None])[None, None]
-            mask = apply_op(dec_mask, q, k_cache, _name='decode_mask')
+            k_cache, v_cache = _update_kv_cache(cache[0], cache[1], k, v,
+                                                offset)
+            mask = _decode_mask(q, k_cache, offset)
             out = F.scaled_dot_product_attention(q, k_cache, v_cache,
                                                  attn_mask=mask)
         out = apply_op(lambda t: t.reshape(t.shape[0], t.shape[1], nh * hd),

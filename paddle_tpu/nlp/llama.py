@@ -25,7 +25,9 @@ from ..nn.layer import Layer
 from ..nn.norm import RMSNorm
 from ..nn.common_layers import Embedding
 from ..tensor import Tensor, apply_op, to_jax
-from .generation import GenerationMixin
+from .generation import (GenerationMixin, as_offset as _as_offset,
+                         decode_mask as _decode_mask,
+                         update_kv_cache as _update_kv_cache)
 
 
 class LlamaConfig:
@@ -37,7 +39,8 @@ class LlamaConfig:
                  max_position_embeddings=4096, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
                  pad_token_id=0, bos_token_id=1, eos_token_id=2,
-                 use_recompute=False, **kwargs):
+                 use_recompute=False, tensor_parallel=False,
+                 sequence_parallel=False, **kwargs):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -52,6 +55,8 @@ class LlamaConfig:
         self.bos_token_id = bos_token_id
         self.eos_token_id = eos_token_id
         self.use_recompute = use_recompute
+        self.tensor_parallel = tensor_parallel
+        self.sequence_parallel = sequence_parallel
         for k, v in kwargs.items():
             setattr(self, k, v)
 
@@ -109,12 +114,22 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
-def _as_offset(position_offset):
-    if position_offset is None:
-        return jnp.int32(0)
-    if isinstance(position_offset, Tensor):
-        return position_offset.value
-    return jnp.asarray(position_offset, jnp.int32)
+def _col_linear(config, in_f, out_f):
+    """Plain Linear, or mp-column-sharded when config.tensor_parallel
+    (upstream: tensor_parallel_degree>1 swaps in fleet's parallel layers)."""
+    if config.tensor_parallel:
+        from ..distributed.parallel_layers import ColumnParallelLinear
+        return ColumnParallelLinear(in_f, out_f, has_bias=False,
+                                    gather_output=False)
+    return Linear(in_f, out_f, bias_attr=False)
+
+
+def _row_linear(config, in_f, out_f):
+    if config.tensor_parallel:
+        from ..distributed.parallel_layers import RowParallelLinear
+        return RowParallelLinear(in_f, out_f, has_bias=False,
+                                 input_is_parallel=True)
+    return Linear(in_f, out_f, bias_attr=False)
 
 
 class LlamaAttention(Layer):
@@ -125,12 +140,12 @@ class LlamaAttention(Layer):
         self.num_heads = config.num_attention_heads
         self.num_key_value_heads = config.num_key_value_heads
         self.head_dim = hd
-        self.q_proj = Linear(h, self.num_heads * hd, bias_attr=False)
-        self.k_proj = Linear(h, self.num_key_value_heads * hd,
-                             bias_attr=False)
-        self.v_proj = Linear(h, self.num_key_value_heads * hd,
-                             bias_attr=False)
-        self.o_proj = Linear(self.num_heads * hd, h, bias_attr=False)
+        self.q_proj = _col_linear(config, h, self.num_heads * hd)
+        self.k_proj = _col_linear(config, h,
+                                  self.num_key_value_heads * hd)
+        self.v_proj = _col_linear(config, h,
+                                  self.num_key_value_heads * hd)
+        self.o_proj = _row_linear(config, self.num_heads * hd, h)
 
     def forward(self, hidden, position_offset=None, attn_mask=None,
                 cache=None):
@@ -160,20 +175,9 @@ class LlamaAttention(Layer):
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=True)
         else:
-            k_cache, v_cache = cache
-
-            def upd(c, new):
-                return jax.lax.dynamic_update_slice(c, new.astype(c.dtype),
-                                                    (0, offset, 0, 0))
-            k_cache = apply_op(upd, k_cache, k, _name='cache_update')
-            v_cache = apply_op(upd, v_cache, v, _name='cache_update')
-
-            def dec_mask(qv, kc):
-                s, l = qv.shape[1], kc.shape[1]
-                q_pos = offset + jnp.arange(s, dtype=jnp.int32)
-                k_pos = jnp.arange(l, dtype=jnp.int32)
-                return (k_pos[None, :] <= q_pos[:, None])[None, None]
-            mask = apply_op(dec_mask, q, k_cache, _name='decode_mask')
+            k_cache, v_cache = _update_kv_cache(cache[0], cache[1], k, v,
+                                                offset)
+            mask = _decode_mask(q, k_cache, offset)
             out = F.scaled_dot_product_attention(q, k_cache, v_cache,
                                                  attn_mask=mask)
         out = apply_op(
@@ -189,9 +193,9 @@ class LlamaMLP(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         h, i = config.hidden_size, config.intermediate_size
-        self.gate_proj = Linear(h, i, bias_attr=False)
-        self.up_proj = Linear(h, i, bias_attr=False)
-        self.down_proj = Linear(i, h, bias_attr=False)
+        self.gate_proj = _col_linear(config, h, i)
+        self.up_proj = _col_linear(config, h, i)
+        self.down_proj = _row_linear(config, i, h)
 
     def forward(self, x):
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
@@ -235,7 +239,13 @@ class LlamaModel(LlamaPretrainedModel):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
-        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        if config.tensor_parallel:
+            from ..distributed.parallel_layers import VocabParallelEmbedding
+            self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                       config.hidden_size)
+        else:
+            self.embed_tokens = Embedding(config.vocab_size,
+                                          config.hidden_size)
         self.layers = [LlamaDecoderLayer(config)
                        for _ in range(config.num_hidden_layers)]
         for i, l in enumerate(self.layers):
@@ -247,6 +257,14 @@ class LlamaModel(LlamaPretrainedModel):
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(to_jax(input_ids))
         h = self.embed_tokens(ids)
+        sp_pin = None
+        if self.config.sequence_parallel:
+            # keep activations sequence-sharded over 'sp' between blocks;
+            # GSPMD gathers seq only where attention truly needs it
+            from ..distributed.parallel_layers import _constraint
+            from jax.sharding import PartitionSpec as P
+            sp_pin = _constraint(P('dp', 'sp', None))
+            h = sp_pin(h)
         mask = attention_mask
         if mask is not None and not isinstance(mask, Tensor):
             mask = Tensor(to_jax(mask))
@@ -254,6 +272,9 @@ class LlamaModel(LlamaPretrainedModel):
             # [B, S] padding mask -> [B, 1, 1, S] boolean
             mask = apply_op(
                 lambda m: (m > 0)[:, None, None, :], mask, _name='pad_mask')
+        from .. import autograd as _ag
+        remat = (self.config.use_recompute and cache is None
+                 and _ag._state.functional)
         new_caches = []
         for i, layer in enumerate(self.layers):
             layer_cache = None
@@ -262,13 +283,28 @@ class LlamaModel(LlamaPretrainedModel):
                 layer_cache = (
                     kc if isinstance(kc, Tensor) else Tensor(kc),
                     vc if isinstance(vc, Tensor) else Tensor(vc))
-            out = layer(h, position_offset=position_offset, attn_mask=mask,
-                        cache=layer_cache)
+            if remat:
+                # trade FLOPs for HBM: rematerialize the block in backward
+                # (upstream: recompute_configs; here jax.checkpoint —
+                # closed-over traced params are lifted and differentiated).
+                # use_recompute='dots' keeps matmul outputs and recomputes
+                # only elementwise chains — usually the better trade.
+                policy = (jax.checkpoint_policies.dots_saveable
+                          if self.config.use_recompute == 'dots' else None)
+                out = Tensor(jax.checkpoint(
+                    lambda hv, l=layer: l(
+                        Tensor(hv), position_offset=position_offset,
+                        attn_mask=mask).value, policy=policy)(h.value))
+            else:
+                out = layer(h, position_offset=position_offset,
+                            attn_mask=mask, cache=layer_cache)
             if layer_cache is not None:
                 h, c = out
                 new_caches.append(c)
             else:
                 h = out
+            if sp_pin is not None:
+                h = sp_pin(h)
         h = self.norm(h)
         if use_cache:
             return h, tuple(new_caches)

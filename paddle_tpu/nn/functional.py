@@ -795,10 +795,55 @@ def _reduce(v, reduction):
     return v
 
 
+def _fused_softmax_ce(logits2d, safe_labels, valid):
+    """Per-row softmax CE that never materializes fp32 logits or log-probs:
+    forward saves only (low-precision logits, fp32 lse); backward is a
+    single fused elementwise pass (softmax minus iota-one-hot). This is
+    what makes large-vocab LM training fit in HBM (a [B*S, V] fp32 copy
+    at GPT vocab sizes is ~2GB per buffer)."""
+
+    @jax.custom_vjp
+    def ce(x):
+        return _ce_fwd(x)[0]
+
+    def _ce_fwd(x):
+        xf = x.astype(jnp.float32)
+        m = jnp.max(xf, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(xf - m[:, None]), axis=-1))
+        tgt = jnp.take_along_axis(xf, safe_labels[:, None], 1)[:, 0]
+        return jnp.where(valid, lse - tgt, 0.0), (x, lse)
+
+    def _ce_bwd(res, g):
+        x, lse = res
+        xf = x.astype(jnp.float32)
+        cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+        p = jnp.exp(xf - lse[:, None])
+        onehot = (cols == safe_labels[:, None]).astype(jnp.float32)
+        dx = (p - onehot) * jnp.where(valid, g, 0.0)[:, None]
+        return (dx.astype(x.dtype),)
+
+    ce.defvjp(_ce_fwd, _ce_bwd)
+    return ce(logits2d)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction='mean', soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
     def f(logits, lab, *w):
+        # fused memory-light path for the common LM-loss shape
+        if (use_softmax and not soft_label and not w and not label_smoothing
+                and axis in (-1, logits.ndim - 1) and logits.ndim == 2
+                and not jnp.issubdtype(jnp.asarray(lab).dtype, jnp.floating)):
+            if lab.ndim == logits.ndim:   # trailing [N, 1] label layout
+                lab = jnp.squeeze(lab, axis=-1)
+            lab_i = lab.astype(jnp.int32)
+            valid = lab_i != ignore_index
+            per = _fused_softmax_ce(logits, jnp.where(valid, lab_i, 0),
+                                    valid)
+            if reduction == 'mean':
+                denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+                return jnp.sum(per) / denom
+            return _reduce(per, reduction)
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
             else jnp.log(jnp.maximum(logits, 1e-30))
         nclass = logits.shape[axis]

@@ -86,7 +86,8 @@ def _activation(name):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation='relu', attn_dropout=None, act_dropout=None,
-                 normalize_before=False, weight_attr=None, bias_attr=None):
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5):
         super().__init__()
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(
@@ -94,8 +95,8 @@ class TransformerEncoderLayer(Layer):
             else dropout)
         self.linear1 = Linear(d_model, dim_feedforward)
         self.linear2 = Linear(dim_feedforward, d_model)
-        self.norm1 = LayerNorm(d_model)
-        self.norm2 = LayerNorm(d_model)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
         self.dropout1 = Dropout(dropout)
         self.dropout2 = Dropout(dropout)
         self.dropout_act = Dropout(

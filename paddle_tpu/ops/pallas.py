@@ -73,10 +73,17 @@ def _attention_xla(q, k, v, mask=None, causal=False, dropout_p=0.0,
 def flash_attention(q, k, v, mask=None, causal=False, dropout_p=0.0,
                     dropout_key=None):
     """Dispatch: pallas flash kernel on TPU (no mask/dropout path), XLA
-    softmax-attention otherwise."""
+    softmax-attention otherwise. The pallas path never materializes the
+    [B, H, Sq, Sk] logits — the difference between fitting seq 2048
+    training on one chip and OOMing."""
+    h, kvh = q.shape[2], k.shape[2]
+    # causal requires sq == sk: the pallas kernel's causal mask is
+    # top-left aligned while _attention_xla's is bottom-right aligned —
+    # they only agree on square attention
     if (_pallas_enabled() and mask is None and dropout_p == 0.0
-            and q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0
-            and q.shape[-1] in (64, 128, 256)):
+            and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+            and (not causal or q.shape[1] == k.shape[1])
+            and h % kvh == 0 and q.shape[-1] >= 64):
         try:
             from . import pallas_kernels
             return pallas_kernels.flash_attention(q, k, v, causal=causal)

@@ -1,0 +1,209 @@
+"""Hand-written pallas TPU kernels (upstream analogue: the reference's
+fused CUDA kernels under paddle/phi/kernels/fusion/gpu/ and its
+flash-attn integration).
+
+Contents:
+- `flash_attention(q, k, v, causal=...)` — differentiable flash attention
+  used by the SDPA dispatch on TPU. Forward+backward are the jax pallas
+  TPU library kernels (public `jax.experimental.pallas.ops.tpu
+  .flash_attention`), layout-adapted from paddle's [B, S, H, D].
+- `flash_attention_fwd(...)` — this repo's own blockwise online-softmax
+  pallas kernel (forward only; used on no-grad paths, parity-tested in
+  interpret mode on CPU against the XLA reference).
+- `rms_norm(x, weight, eps)` — fused RMSNorm pallas kernel with an
+  analytic custom VJP.
+
+All kernels keep stats/accumulators in fp32 VMEM scratch and feed the
+MXU with `preferred_element_type=float32` per the TPU tiling rules.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+# ---------------------------------------------------------------------------
+# library-kernel dispatch (differentiable train path)
+# ---------------------------------------------------------------------------
+
+def flash_attention(q, k, v, causal=False):
+    """[B, S, H, D] flash attention via the jax pallas TPU kernel.
+
+    GQA is handled by repeating KV heads (the kernel wants equal heads);
+    the repeat is free at trace level — XLA broadcasts, it does not copy.
+    """
+    b, sq, h, d = q.shape
+    kv_heads = k.shape[2]
+    if kv_heads != h:
+        rep = h // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _fa)
+    # library layout is [B, H, S, D]
+    out = _fa(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+              v.transpose(0, 2, 1, 3), causal=causal,
+              sm_scale=1.0 / math.sqrt(d))
+    return out.transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# our own forward kernel: blockwise online softmax
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                      scale, causal, block_q, block_k, n_k):
+    ik = pl.program_id(3)
+    iq = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [Bq, D]
+        kk = k_ref[0, 0].astype(jnp.float32)         # [Bk, D]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_prev = m_ref[:]                             # [Bq, 128]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)    # [Bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])     # [Bq, 1]
+        p = jnp.exp(s - m_new[:, :1])                     # [Bq, Bk]
+        l_ref[:] = l_ref[:] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    if causal:
+        # whole KV block above the diagonal contributes nothing — skip
+        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == n_k - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def flash_attention_fwd(q, k, v, causal=False, block_q=128, block_k=128,
+                        interpret=False):
+    """Forward-only flash attention, [B, S, H, D] (this repo's kernel)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kv_heads = k.shape[2]
+    if kv_heads != h:
+        rep = h // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qt = q.transpose(0, 2, 1, 3)      # [B, H, S, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q, n_k = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+    scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_k=n_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# fused RMSNorm with analytic custom VJP
+# ---------------------------------------------------------------------------
+
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rms_pallas(x2d, w, eps, block_rows, interpret):
+    rows, width = x2d.shape
+    return pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=(pl.cdiv(rows, block_rows),),
+        in_specs=[
+            pl.BlockSpec((block_rows, width), lambda i: (i, 0)),
+            pl.BlockSpec((width,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, width), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        interpret=interpret,
+    )(x2d, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rms_norm(x, weight, eps=1e-6, interpret=False):
+    """Fused y = x * rsqrt(mean(x^2) + eps) * weight over the last dim."""
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    rows = x2d.shape[0]
+    block = rows if rows <= 256 else 256
+    out = _rms_pallas(x2d, weight, eps, block, interpret)
+    return out.reshape(shape)
+
+
+def _rms_fwd(x, weight, eps, interpret):
+    return rms_norm(x, weight, eps, interpret), (x, weight)
+
+
+def _rms_bwd(eps, interpret, res, g):
+    x, w = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    h = x.shape[-1]
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    gw = gf * wf
+    dx = inv * gw - xf * (inv ** 3 / h) * jnp.sum(gw * xf, axis=-1,
+                                                  keepdims=True)
+    dw = jnp.sum((xf * inv) * gf, axis=tuple(range(x.ndim - 1)))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+rms_norm.defvjp(_rms_fwd, _rms_bwd)

@@ -200,6 +200,45 @@ class TestBertErnie:
         assert not np.allclose(a, b)
 
 
+class TestFusedCE:
+    def test_trailing_label_dim_and_value_parity(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        logits = paddle.to_tensor(
+            rng.standard_normal((6, 11)).astype(np.float32))
+        labels = rng.randint(0, 11, (6,))
+        a = F.cross_entropy(logits, paddle.to_tensor(labels))
+        b = F.cross_entropy(logits, paddle.to_tensor(labels[:, None]))
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
+        # parity with the non-fused (3-D logits) path
+        c = F.cross_entropy(logits.reshape([2, 3, 11]),
+                            paddle.to_tensor(labels.reshape(2, 3)))
+        np.testing.assert_allclose(a.numpy(), c.numpy(), rtol=1e-5)
+
+    def test_fused_ce_grad_matches_reference(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        lg = rng.standard_normal((5, 7)).astype(np.float32)
+        labels = rng.randint(0, 7, (5,))
+        labels[2] = -100  # ignore_index row
+        x = paddle.to_tensor(lg)
+        x.stop_gradient = False
+        loss = F.cross_entropy(x, paddle.to_tensor(labels))
+        loss.backward()
+        got = x.grad.numpy()
+        # reference: softmax minus one-hot over valid rows / n_valid
+        e = np.exp(lg - lg.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = p.copy()
+        for i, l in enumerate(labels):
+            if l == -100:
+                want[i] = 0
+            else:
+                want[i, l] -= 1
+        want /= 4  # 4 valid rows
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
 class TestTokenizers:
     corpus = ['the quick brown fox jumps over the lazy dog',
               'pack my box with five dozen liquor jugs'] * 3
