@@ -25,6 +25,14 @@ from . import nn
 from . import optimizer
 from . import distributed
 from . import nlp
+from . import amp
+from . import utils
+from . import io
+from . import metric
+from . import hapi
+from .hapi import Model
+from .hapi import callbacks_mod as callbacks
+from .serialization import load, save
 from .nn.layer import ParamAttr
 from .optimizer import L1Decay, L2Decay
 

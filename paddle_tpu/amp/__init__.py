@@ -1,0 +1,213 @@
+"""Automatic mixed precision (upstream: python/paddle/amp/ —
+auto_cast, GradScaler, decorate).
+
+TPU-native design: bf16 is the MXU's native input dtype, so the default
+AMP dtype is bfloat16 (fp16 is supported for parity but has no TPU
+advantage). `auto_cast` installs a per-thread policy consulted by the
+single op choke-point (`tensor.apply_op`): white-list ops (matmul-class,
+MXU-bound) compute in the low dtype, black-list ops (softmax/norm/loss,
+numerically sensitive reductions) are pinned to fp32, everything else
+follows its inputs. O2 ("pure bf16") casts the whole model once and
+keeps fp32 master weights inside the optimizer (multi_precision).
+GradScaler does dynamic loss scaling for fp16 and is a correct no-op
+for bf16 (whose exponent range equals fp32's).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterable, Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import tensor as _tensor_mod
+from ..dtype import convert_dtype
+from ..tensor import Tensor
+
+# matmul-class ops: compute-bound on the MXU, safe and fast in bf16/fp16
+WHITE_LIST = {
+    'matmul', 'mm', 'bmm', 'linear', 'dot', 'einsum', 'addmm', 'mv',
+    'conv1d', 'conv2d', 'conv3d', 'conv1d_transpose', 'conv2d_transpose',
+    'conv3d_transpose', 'scaled_dot_product_attention', 'bilinear',
+}
+# numerically-sensitive ops: keep fp32 accumulate/range
+BLACK_LIST = {
+    'softmax', 'log_softmax', 'cross_entropy', 'nll_loss', 'kl_div',
+    'binary_cross_entropy', 'binary_cross_entropy_with_logits',
+    'softmax_with_cross_entropy', 'layer_norm', 'batch_norm', 'rms_norm',
+    'group_norm', 'instance_norm', 'local_response_norm', 'norm',
+    'logsumexp', 'log', 'log2', 'log10', 'log1p', 'exp', 'expm1', 'pow',
+    'cumsum', 'cumprod', 'sum', 'mean', 'std', 'var', 'sigmoid_focal_loss',
+    'mse_loss', 'l1_loss', 'smooth_l1_loss', 'cosine_similarity',
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = 'O1'
+        self.white = WHITE_LIST
+        self.black = BLACK_LIST
+
+
+_state = _AmpState()
+
+
+def _is_float(v):
+    return hasattr(v, 'dtype') and jnp.issubdtype(v.dtype, jnp.floating)
+
+
+def _cast_inputs(vals, op_name):
+    """The apply_op hook: cast raw jax values per the active policy."""
+    if not _state.enabled:
+        return vals
+    if op_name in _state.black:
+        return [v.astype(jnp.float32)
+                if _is_float(v) and v.dtype != jnp.float32 else v
+                for v in vals]
+    low = _state.dtype
+    if op_name in _state.white or _state.level == 'O2':
+        return [v.astype(low)
+                if _is_float(v) and v.dtype == jnp.float32 else v
+                for v in vals]
+    return vals
+
+
+# install the hook at import time (tensor.apply_op checks for None)
+_tensor_mod._amp_cast_hook = _cast_inputs
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list: Optional[Iterable[str]] = None,
+              custom_black_list: Optional[Iterable[str]] = None,
+              level='O1', dtype='bfloat16', use_promote=True):
+    """Context manager enabling mixed-precision op dispatch."""
+    if level not in ('O0', 'O1', 'O2'):
+        raise ValueError(f'amp level must be O0/O1/O2, got {level!r}')
+    old = (_state.enabled, _state.dtype, _state.level, _state.white,
+           _state.black)
+    _state.enabled = bool(enable) and level != 'O0'
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    if custom_white_list:
+        _state.white = WHITE_LIST | set(custom_white_list)
+    if custom_black_list:
+        _state.black = BLACK_LIST | set(custom_black_list)
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.white,
+         _state.black) = old
+
+
+amp_guard = auto_cast  # legacy alias (upstream paddle.fluid.dygraph.amp)
+
+
+def decorate(models, optimizers=None, level='O2', dtype='bfloat16',
+             master_weight=True, save_dtype=None):
+    """O2 decoration: cast model params to the low dtype; keep fp32
+    master weights in the optimizer (upstream: paddle.amp.decorate)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == 'O2':
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            if master_weight:
+                opt._multi_precision = True
+        if single_model and single_opt:
+            return model_list[0], opt_list[0]
+        return model_list, opt_list
+    return model_list[0] if single_model else model_list
+
+
+class GradScaler:
+    """Dynamic loss scaling (upstream: paddle.amp.GradScaler).
+
+    Needed for fp16 (narrow exponent); for bf16 training this is a
+    correct pass-through when `enable=False` (paddle convention) or
+    simply never sees inf grads.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5,
+                 incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def _params_of(self, optimizer):
+        params = optimizer._parameter_list or []
+        return [p for p in params if p.grad is not None]
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        flags = []
+        for p in self._params_of(optimizer):
+            g = p.grad.value * inv
+            flags.append(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+            p.grad._data = g
+        # one device->host sync for the whole parameter set, not one per
+        # tensor (keeps the dispatch pipeline full)
+        self._found_inf = bool(flags) and not bool(
+            jnp.all(jnp.stack(flags)))
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        # scaled_loss.backward() must already have run
+        self.step(optimizer)
+        self.update()
+        optimizer.clear_grad()

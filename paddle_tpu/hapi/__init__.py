@@ -1,0 +1,308 @@
+"""hapi — the high-level `paddle.Model` train/eval/predict API
+(upstream: python/paddle/hapi/model.py).
+
+TPU-native: `fit` drives the jitted donated TrainStep (one XLA program
+per batch shape) rather than an eager op-by-op loop; eval/predict run a
+jitted forward. The DataLoader overlaps host batch assembly with device
+execution, so the step dispatch pipeline stays full.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import optimizer as _opt_mod
+from .. import serialization
+from ..io import DataLoader, Dataset
+from ..jit import TrainStep, functional_call, functional_state
+from ..metric import Metric
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from . import callbacks as callbacks_mod
+from .callbacks import (Callback, CallbackList, EarlyStopping,
+                        LRSchedulerCallback, ModelCheckpoint, ProgBarLogger,
+                        VisualDL)
+
+__all__ = ['Model', 'Callback', 'EarlyStopping', 'LRSchedulerCallback',
+           'ModelCheckpoint', 'ProgBarLogger', 'VisualDL', 'callbacks_mod']
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_loader(data, batch_size, shuffle, num_workers, drop_last):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+    raise TypeError(f'expected Dataset/DataLoader, got {type(data)}')
+
+
+def _feed_metric(m: Metric, out, lab):
+    """compute() may return one value or a tuple destined for update()."""
+    res = m.compute(out, lab)
+    if isinstance(res, tuple):
+        m.update(*res)
+    else:
+        m.update(res)
+
+
+def _split_batch(batch):
+    """(inputs..., label) convention: last element is the label."""
+    if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+        *ins, lab = batch
+        return tuple(ins), lab
+    return (batch,), None
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self.stop_training = False
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        if loss is not None and not callable(loss):
+            raise TypeError('loss must be callable (a loss Layer or fn)')
+        if amp_configs:
+            from .. import amp as _amp
+            cfg = ({'level': amp_configs} if isinstance(amp_configs, str)
+                   else dict(amp_configs))
+            level = cfg.get('level', 'O1')
+            if level == 'O2':
+                out = _amp.decorate(self.network, optimizer, level='O2',
+                                    dtype=cfg.get('dtype', 'bfloat16'))
+                if optimizer is not None:
+                    self.network, optimizer = out
+                else:
+                    self.network = out
+            elif level not in ('O0', 'O1'):
+                raise ValueError(f'bad amp level {level!r}')
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f'metric {m!r} is not a paddle.metric.Metric')
+        self._train_step = None
+        return self
+
+    def _ensure_step(self):
+        if self._train_step is None:
+            if self._optimizer is None or self._loss is None:
+                raise RuntimeError('call prepare(optimizer, loss) first')
+
+            def loss_fn(outputs, labels):
+                out = outputs[0] if isinstance(outputs, (list, tuple)) \
+                    else outputs
+                return self._loss(out, labels)
+            self._train_step = TrainStep(self.network, loss_fn,
+                                         self._optimizer)
+            restored = self.__dict__.pop('_restored_opt_state', None)
+            if restored is not None:
+                self._train_step._opt_state = restored
+        return self._train_step
+
+    # -- batch-level API ----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        step = self._ensure_step()
+        ins = tuple(_to_list(inputs)) if isinstance(inputs, (list, tuple)) \
+            else (inputs,)
+        loss = step(ins if len(ins) > 1 else ins[0], labels)
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = _to_list(inputs)
+        outputs = self.network(*ins)
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        res = {}
+        if self._loss is not None and labels is not None:
+            res['loss'] = [float(self._loss(out, labels).numpy())]
+        for m in self._metrics:
+            _feed_metric(m, out, labels)
+        return res
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = _to_list(inputs)
+        from .. import autograd
+        with autograd.no_grad():
+            out = self.network(*ins)
+        return out
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        if accumulate_grad_batches != 1:
+            raise NotImplementedError(
+                'accumulate_grad_batches > 1 is not implemented yet; '
+                'raise the batch size or use fleet gradient_merge')
+        loader = _as_loader(train_data, batch_size, shuffle, num_workers,
+                            drop_last)
+        eval_loader = _as_loader(eval_data, batch_size, False, num_workers,
+                                 False)
+        cbs = _to_list(callbacks)
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs = [ProgBarLogger(log_freq, verbose=verbose)] + cbs
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cblist = CallbackList(cbs)
+        cblist.set_model(self)
+        cblist.set_params({'epochs': epochs, 'verbose': verbose,
+                           'metrics': ['loss'] + [m.name()
+                                                  for m in self._metrics]})
+        self.stop_training = False
+        cblist.on_train_begin()
+        history = {'loss': []}
+        it_count = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cblist.on_epoch_begin(epoch)
+            self.network.train()
+            epoch_logs: Dict[str, Any] = {}
+            for step, batch in enumerate(loader):
+                cblist.on_train_batch_begin(step)
+                ins, lab = _split_batch(batch)
+                loss = self.train_batch(list(ins), lab)
+                logs = {'loss': loss[0]}
+                epoch_logs.update(logs)
+                cblist.on_train_batch_end(step, logs)
+                history['loss'].append(loss[0])
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cblist)
+                epoch_logs.update({f'eval_{k}': v
+                                   for k, v in eval_logs.items()})
+            cblist.on_epoch_end(epoch, epoch_logs)
+        cblist.on_train_end(epoch_logs if epochs else {})
+        return history
+
+    def _run_eval(self, loader, cblist=None):
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        if cblist:
+            cblist.on_eval_begin()
+        losses = []
+        from .. import autograd
+        with autograd.no_grad():
+            for step, batch in enumerate(loader):
+                if cblist:
+                    cblist.on_eval_batch_begin(step)
+                ins, lab = _split_batch(batch)
+                out = self.network(*ins)
+                out = out[0] if isinstance(out, (list, tuple)) else out
+                if self._loss is not None and lab is not None:
+                    losses.append(float(self._loss(out, lab).numpy()))
+                for m in self._metrics:
+                    _feed_metric(m, out, lab)
+                if cblist:
+                    cblist.on_eval_batch_end(step)
+        logs: Dict[str, Any] = {}
+        if losses:
+            logs['loss'] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, list):
+                for n, a in zip(name, acc):
+                    logs[n] = a
+            else:
+                logs[name] = acc
+        if cblist:
+            cblist.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = _as_loader(eval_data, batch_size, False, num_workers, False)
+        cbs = _to_list(callbacks)
+        cblist = CallbackList(cbs) if cbs else None
+        if cblist:
+            cblist.set_model(self)
+        return self._run_eval(loader, cblist)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = _as_loader(test_data, batch_size, False, num_workers, False)
+        outs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch) if isinstance(batch, (list, tuple)) \
+                else ((batch,), None)
+            out = self.predict_batch(list(ins))
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            outs.append(out.numpy())
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        serialization.save(self.network.state_dict(), path + '.pdparams')
+        if training and self._optimizer is not None:
+            # the live optimizer state lives inside the jitted TrainStep
+            # (functional pytree), not in the eager slot dicts
+            if self._train_step is not None and \
+                    self._train_step._opt_state is not None:
+                serialization.save(
+                    {'jit_opt_state': self._train_step._opt_state},
+                    path + '.pdopt')
+            else:
+                serialization.save(self._optimizer.state_dict(),
+                                   path + '.pdopt')
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = serialization.load(path + '.pdparams')
+        missing, unexpected = self.network.set_state_dict(sd)
+        if not skip_mismatch and (missing or unexpected):
+            raise RuntimeError(
+                f'state mismatch loading model: missing={missing}, '
+                f'unexpected={unexpected} (pass skip_mismatch=True to '
+                f'ignore)')
+        self._train_step = None
+        self.__dict__.pop('_restored_opt_state', None)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + '.pdopt'):
+            opt_sd = serialization.load(path + '.pdopt')
+            if isinstance(opt_sd, dict) and 'jit_opt_state' in opt_sd:
+                self._restored_opt_state = opt_sd['jit_opt_state']
+            else:
+                self._optimizer.set_state_dict(opt_sd)
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = int(sum(np.prod(p.shape) for p in self.network.parameters()))
+        trainable = int(sum(np.prod(p.shape)
+                            for p in self.network.parameters()
+                            if not p.stop_gradient))
+        lines = [repr(self.network),
+                 f'Total params: {total:,}',
+                 f'Trainable params: {trainable:,}']
+        s = '\n'.join(lines)
+        print(s)
+        return {'total_params': total, 'trainable_params': trainable}

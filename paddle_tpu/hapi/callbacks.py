@@ -1,0 +1,216 @@
+"""hapi callbacks (upstream: python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params: Dict = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback]):
+        self.callbacks = callbacks
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def __getattr__(self, name):
+        if not name.startswith('on_'):
+            raise AttributeError(name)
+
+        def dispatch(*args, **kwargs):
+            for c in self.callbacks:
+                getattr(c, name)(*args, **kwargs)
+        return dispatch
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        if self.verbose:
+            total = self.params.get('epochs')
+            print(f'Epoch {epoch + 1}/{total}', file=sys.stderr)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose >= 2 and step % self.log_freq == 0:
+            kv = ' - '.join(f'{k}: {v:.4f}' if isinstance(v, float)
+                            else f'{k}: {v}'
+                            for k, v in (logs or {}).items())
+            print(f'  step {step}: {kv}', file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            kv = ' - '.join(f'{k}: {v:.4f}' if isinstance(v, float)
+                            else f'{k}: {v}'
+                            for k, v in (logs or {}).items())
+            print(f'  epoch done in {dt:.1f}s - {kv}', file=sys.stderr)
+
+
+class LRSchedulerCallback(Callback):
+    """Steps an LRScheduler attached to the optimizer (upstream name:
+    paddle.callbacks.LRScheduler)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, '_optimizer', None)
+        lr = getattr(opt, '_learning_rate', None)
+        return lr if hasattr(lr, 'step') else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir='checkpoint'):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model:
+            self.model.save(os.path.join(self.save_dir, 'final'))
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor='loss', mode='auto', patience=0,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == 'auto':
+            mode = 'max' if 'acc' in monitor else 'min'
+        self.mode = mode
+        self.stopped = False
+        self.wait = 0
+        self.best = None
+
+    def _better(self, cur, best):
+        if best is None:
+            return True
+        delta = self.min_delta if self.mode == 'max' else -self.min_delta
+        return cur > best + delta if self.mode == 'max' \
+            else cur < best - delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped = True
+                if self.model is not None:
+                    self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """Metric logging via the JSONL summary writer
+    (paddle.callbacks.VisualDL parity)."""
+
+    def __init__(self, log_dir='vdl_log'):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        from ..utils.logging import SummaryWriter
+        self._writer = SummaryWriter(self.log_dir)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            try:
+                self._writer.add_scalar(f'train/{k}', float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                v = v[0] if isinstance(v, (list, tuple)) else v
+                self._writer.add_scalar(f'eval/{k}', float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_end(self, logs=None):
+        if self._writer:
+            self._writer.close()

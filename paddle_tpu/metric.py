@@ -1,0 +1,124 @@
+"""paddle.metric — streaming metrics (upstream: python/paddle/metric/).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _np(v):
+    return v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+
+
+class Metric:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__.lower()
+
+    def name(self):
+        return self._name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label, *args):
+        """Per-batch preprocessing; result is fed to update()."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (upstream: paddle.metric.Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        super().__init__(name or 'acc')
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred_np = _np(pred)
+        label_np = _np(label)
+        if label_np.ndim == pred_np.ndim:
+            label_np = label_np.squeeze(-1)
+        maxk = max(self.topk)
+        top = np.argsort(-pred_np, axis=-1)[..., :maxk]
+        return (top == label_np[..., None]).astype(np.float32)
+
+    def update(self, correct):
+        correct = _np(correct)
+        n = correct[..., 0].size
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[..., :k].any(-1).sum()
+            self.count[i] += n
+        acc = self.total / np.maximum(self.count, 1)
+        return acc[0] if len(self.topk) == 1 else acc
+
+    def accumulate(self):
+        acc = self.total / np.maximum(self.count, 1)
+        return float(acc[0]) if len(self.topk) == 1 else acc.tolist()
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f'{self._name}_top{k}' for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision over probability/score predictions."""
+
+    def __init__(self, name=None, threshold=0.5):
+        super().__init__(name or 'precision')
+        self.threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds) > self.threshold).astype(np.int64).reshape(-1)
+        l = _np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None, threshold=0.5):
+        super().__init__(name or 'recall')
+        self.threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds) > self.threshold).astype(np.int64).reshape(-1)
+        l = _np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+def accuracy(input, label, k=1):
+    """Functional top-k accuracy for a single batch."""
+    m = Accuracy(topk=(k,))
+    return float(np.asarray(m.update(m.compute(input, label))))

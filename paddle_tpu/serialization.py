@@ -1,0 +1,104 @@
+"""paddle.save / paddle.load (upstream: python/paddle/framework/io.py).
+
+TPU-native container: instead of the reference's pickle `.pdparams`, the
+object tree is flattened to arrays in one `.npz` plus a JSON manifest of
+the structure — portable, mmap-friendly, and loadable with zero
+arbitrary-code execution. Supports nested dict/list/tuple of Tensor,
+ndarray, scalars, strings, None (e.g. layer state_dicts and optimizer
+state_dicts).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+_ARRAY_KEY = '__arr__'
+
+
+def _encode_array(a: np.ndarray):
+    """npz can't store ml_dtypes (bfloat16/fp8 have numpy kind 'V'); view
+    them as the same-width uint and record the true dtype name."""
+    if a.dtype.kind == 'V':
+        name = a.dtype.name
+        return a.view(np.dtype(f'u{a.dtype.itemsize}')), name
+    return a, None
+
+
+def _decode_array(a: np.ndarray, np_dtype):
+    if np_dtype:
+        return a.view(np.dtype(jnp.dtype(np_dtype)))
+    return a
+
+
+def _flatten(obj: Any, arrays: list, path: str):
+    if isinstance(obj, Tensor):
+        arr, np_dtype = _encode_array(np.asarray(obj.value))
+        arrays.append(arr)
+        return {_ARRAY_KEY: len(arrays) - 1, 'kind': 'tensor',
+                'np_dtype': np_dtype}
+    if isinstance(obj, (np.ndarray, jnp.ndarray)):
+        arr, np_dtype = _encode_array(np.asarray(obj))
+        arrays.append(arr)
+        return {_ARRAY_KEY: len(arrays) - 1, 'kind': 'ndarray',
+                'np_dtype': np_dtype}
+    if isinstance(obj, dict):
+        return {'kind': 'dict',
+                'items': [[str(k), _flatten(v, arrays, f'{path}.{k}')]
+                          for k, v in obj.items()]}
+    if isinstance(obj, (list, tuple)):
+        return {'kind': 'list' if isinstance(obj, list) else 'tuple',
+                'items': [_flatten(v, arrays, f'{path}[{i}]')
+                          for i, v in enumerate(obj)]}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {'kind': 'scalar', 'value': obj}
+    if isinstance(obj, (np.integer, np.floating, np.bool_)):
+        return {'kind': 'scalar', 'value': obj.item()}
+    raise TypeError(
+        f'paddle.save cannot serialize {type(obj).__name__} at {path!r}')
+
+
+def _unflatten(spec, arrays, return_numpy):
+    kind = spec['kind']
+    if kind in ('tensor', 'ndarray'):
+        arr = _decode_array(arrays[f'a{spec[_ARRAY_KEY]}'],
+                            spec.get('np_dtype'))
+        if kind == 'tensor' and not return_numpy:
+            return Tensor(jnp.asarray(arr))
+        return arr
+    if kind == 'dict':
+        return {k: _unflatten(v, arrays, return_numpy)
+                for k, v in spec['items']}
+    if kind == 'list':
+        return [_unflatten(v, arrays, return_numpy) for v in spec['items']]
+    if kind == 'tuple':
+        return tuple(_unflatten(v, arrays, return_numpy)
+                     for v in spec['items'])
+    return spec['value']
+
+
+def save(obj: Any, path: str, protocol=None, **config):
+    """Serialize a nested object tree to `path` (npz + manifest)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays: list = []
+    manifest = _flatten(obj, arrays, '<root>')
+    tmp = path + '.tmp'
+    np.savez(tmp, manifest=json.dumps(manifest),
+             **{f'a{i}': a for i, a in enumerate(arrays)})
+    os.replace(tmp + '.npz' if os.path.exists(tmp + '.npz') else tmp, path)
+
+
+def load(path: str, return_numpy=False, **config) -> Any:
+    """Restore an object tree saved by paddle.save."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    data = np.load(path, allow_pickle=False)
+    manifest = json.loads(str(data['manifest']))
+    return _unflatten(manifest, data, return_numpy)

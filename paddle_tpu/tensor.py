@@ -18,6 +18,10 @@ import numpy as np
 from . import autograd, framework
 from .dtype import convert_dtype, dtype_name
 
+# set by paddle_tpu.amp at import: (raw_vals, op_name) -> raw_vals,
+# implementing the auto_cast white/black-list policy at the op choke-point
+_amp_cast_hook = None
+
 _tree = jax.tree_util
 
 
@@ -299,6 +303,8 @@ def apply_op(fn: Callable, *args, _name: str = '', **kwargs):
     t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     tensors = [leaves[i] for i in t_idx]
     vals = [t._data for t in tensors]
+    if _amp_cast_hook is not None:
+        vals = _amp_cast_hook(vals, _name)
 
     def pure(*vs):
         # Rebuild args with raw jax values in Tensor slots; fn receives raw

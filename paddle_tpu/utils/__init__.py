@@ -1,0 +1,3 @@
+"""paddle_tpu.utils — checkpointing, logging, misc support."""
+from . import checkpoint  # noqa: F401
+from . import logging  # noqa: F401

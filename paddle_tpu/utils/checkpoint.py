@@ -1,0 +1,156 @@
+"""Step-indexed training checkpoints (upstream: fleet checkpointing +
+paddle.distributed.fleet.utils / hapi Checkpoint callback).
+
+TPU-native design: one CheckpointManager with two interchangeable
+backends — orbax (sharded jax arrays, multi-host aware, async) when
+available, and the npz serialization container as fallback. A checkpoint
+is a pytree: {'params', 'opt_state', 'rng_key', 'step', 'meta', ...};
+restore is bit-exact (tested: resumed run reproduces the uninterrupted
+loss trajectory).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .. import serialization
+
+_STEP_RE = re.compile(r'^step_(\d+)$')
+
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp
+        return ocp
+    except Exception:
+        return None
+
+
+class CheckpointManager:
+    """Save/restore step-indexed checkpoints with retention.
+
+    Args mirror orbax's manager (max_to_keep, save_interval_steps); the
+    backend is chosen automatically ('orbax' | 'npz').
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 5,
+                 save_interval_steps: int = 1, async_save: bool = False,
+                 backend: Optional[str] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self.save_interval_steps = save_interval_steps
+        self.async_save = async_save
+        self._ocp = _try_orbax() if backend in (None, 'orbax') else None
+        if backend == 'orbax' and self._ocp is None:
+            raise RuntimeError('orbax backend requested but not importable')
+        self.backend = 'orbax' if self._ocp is not None else 'npz'
+        self._pending: Optional[threading.Thread] = None
+
+    # -- bookkeeping --------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f'step_{step}')
+
+    def all_steps(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and os.path.exists(
+                    os.path.join(self.directory, name, '_COMMITTED')):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def should_save(self, step: int) -> bool:
+        return step % self.save_interval_steps == 0
+
+    # -- save/restore -------------------------------------------------------
+    @staticmethod
+    def _to_host(tree: Any):
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x.value) if hasattr(x, 'value')
+            else (np.asarray(x) if hasattr(x, 'shape') or isinstance(
+                x, (int, float)) else x), tree)
+
+    def _write(self, step: int, host_tree: Any):
+        d = self._step_dir(step)
+        tmp = d + '.tmp'
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        if self.backend == 'orbax':
+            ckptr = self._ocp.StandardCheckpointer()
+            ckptr.save(os.path.join(tmp, 'tree'), host_tree)
+            ckptr.wait_until_finished()
+        else:
+            serialization.save(host_tree, os.path.join(tmp, 'tree.npz'))
+        with open(os.path.join(tmp, '_COMMITTED'), 'w') as f:
+            json.dump({'step': step, 'backend': self.backend}, f)
+        if os.path.exists(d):
+            shutil.rmtree(d)
+        os.replace(tmp, d)
+        self._gc()
+
+    def save(self, step: int, tree: Any, force: bool = False):
+        """Snapshot `tree` at `step`. Respects save_interval unless forced."""
+        if not force and not self.should_save(step):
+            return False
+        self.wait_until_finished()
+        # snapshot to host SYNCHRONOUSLY: the train loop mutates live
+        # Tensors in place, so deferring materialization to the writer
+        # thread would tear the checkpoint across steps
+        host_tree = self._to_host(tree)
+        if self.async_save:
+            self._pending = threading.Thread(
+                target=self._write, args=(step, host_tree), daemon=True)
+            self._pending.start()
+        else:
+            self._write(step, host_tree)
+        return True
+
+    def restore(self, step: Optional[int] = None,
+                template: Any = None) -> Any:
+        self.wait_until_finished()
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f'no committed checkpoints under {self.directory}')
+        d = self._step_dir(step)
+        with open(os.path.join(d, '_COMMITTED')) as f:
+            meta = json.load(f)
+        if meta['backend'] == 'orbax':
+            if self._ocp is None:
+                raise RuntimeError('checkpoint written by orbax but orbax '
+                                   'is unavailable')
+            ckptr = self._ocp.StandardCheckpointer()
+            if template is not None:
+                host_template = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x.value) if hasattr(x, 'value')
+                    else x, template)
+                return ckptr.restore(os.path.join(d, 'tree'),
+                                     target=host_template)
+            return ckptr.restore(os.path.join(d, 'tree'))
+        return serialization.load(os.path.join(d, 'tree.npz'),
+                                  return_numpy=True)
+
+    def wait_until_finished(self):
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+
+    def _gc(self):
+        steps = self.all_steps()
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            shutil.rmtree(self._step_dir(victim), ignore_errors=True)

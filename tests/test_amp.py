@@ -1,0 +1,107 @@
+"""AMP tests: auto_cast policy, GradScaler fp16 dynamics, O2 decorate
+(SURVEY.md §2 'AMP' row)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.amp import GradScaler, auto_cast, decorate
+
+
+def test_autocast_whitelist_casts_matmul_to_bf16():
+    a = paddle.randn([8, 8])
+    b = paddle.randn([8, 8])
+    with auto_cast(dtype='bfloat16'):
+        out = paddle.matmul(a, b)
+    assert str(out.dtype) in ('bfloat16',) or 'bfloat16' in str(out.dtype)
+    out2 = paddle.matmul(a, b)
+    assert 'float32' in str(out2.dtype)
+
+
+def test_autocast_blacklist_stays_fp32():
+    x = paddle.randn([4, 8]).astype('bfloat16')
+    with auto_cast(dtype='bfloat16'):
+        out = F.softmax(x)
+    assert 'float32' in str(out.dtype)
+
+
+def test_autocast_o2_casts_everything_but_blacklist():
+    a = paddle.randn([4, 4])
+    with auto_cast(level='O2'):
+        s = paddle.add(a, a)
+    assert 'bfloat16' in str(s.dtype)
+
+
+def test_autocast_nesting_restores_state():
+    a = paddle.randn([4, 4])
+    with auto_cast():
+        with auto_cast(enable=False):
+            out = paddle.matmul(a, a)
+            assert 'float32' in str(out.dtype)
+        out2 = paddle.matmul(a, a)
+        assert 'bfloat16' in str(out2.dtype)
+    assert 'float32' in str(paddle.matmul(a, a).dtype)
+
+
+def test_autocast_gradients_flow():
+    m = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    with auto_cast():
+        y = m(x)
+        loss = y.astype('float32').sum()
+    loss.backward()
+    assert m.weight.grad is not None
+    assert 'float32' in str(m.weight.grad.dtype)  # grads land in param dtype
+
+
+def test_grad_scaler_scales_and_unscales():
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = GradScaler(init_loss_scaling=128.0)
+    x = paddle.randn([3, 4])
+    loss = m(x).sum()
+    ref = float(loss.numpy())
+    scaled = scaler.scale(loss)
+    assert abs(float(scaled.numpy()) - 128.0 * ref) < 1e-2 * abs(ref) + 1e-3
+    scaled.backward()
+    w_before = m.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    opt.clear_grad()
+    assert not np.allclose(m.weight.numpy(), w_before)
+
+
+def test_grad_scaler_skips_on_inf_and_decays():
+    m = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = GradScaler(init_loss_scaling=64.0, decr_every_n_nan_or_inf=1)
+    x = paddle.to_tensor(np.array([[1e38, 1e38]], np.float32))
+    loss = (m(x) * 1e10).sum()  # overflow -> inf grads
+    scaler.scale(loss).backward()
+    w_before = m.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_array_equal(m.weight.numpy(), w_before)  # skipped
+    assert scaler.get_loss_scaling() == 32.0  # decayed
+
+
+def test_decorate_o2_bf16_master_weights_training():
+    m = nn.Linear(8, 8)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    m, opt = decorate(m, opt, level='O2', dtype='bfloat16')
+    assert 'bfloat16' in str(m.weight.dtype)
+    assert opt._multi_precision
+    x = paddle.randn([4, 8]).astype('bfloat16')
+    losses = []
+    tgt = paddle.randn([4, 8]).astype('bfloat16')
+    for _ in range(10):
+        out = m(x)
+        loss = ((out - tgt).astype('float32') ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert 'bfloat16' in str(m.weight.dtype)  # params stayed bf16
+    assert losses[-1] < losses[0]

@@ -1,0 +1,127 @@
+"""Serialization + checkpoint/resume tests (SURVEY.md §4 E2E row:
+'checkpoint save→resume bit-exact continuation')."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.utils.checkpoint import CheckpointManager
+
+
+class TestSaveLoad:
+    def test_nested_roundtrip(self, tmp_path):
+        obj = {
+            'params': {'w': paddle.randn([3, 4]), 'b': paddle.zeros([4])},
+            'meta': {'epoch': 3, 'lr': 0.1, 'name': 'run1', 'flag': True,
+                     'none': None},
+            'hist': [1, 2.5, 'x', (np.arange(3), [4, 5])],
+        }
+        p = str(tmp_path / 'ckpt.pdparams')
+        paddle.save(obj, p)
+        back = paddle.load(p)
+        np.testing.assert_array_equal(back['params']['w'].numpy(),
+                                      obj['params']['w'].numpy())
+        assert back['meta'] == obj['meta']
+        assert back['hist'][0] == 1 and back['hist'][2] == 'x'
+        assert isinstance(back['hist'][3], tuple)
+        np.testing.assert_array_equal(back['hist'][3][0], np.arange(3))
+
+    def test_layer_state_dict_roundtrip(self, tmp_path):
+        m = nn.Linear(4, 3)
+        p = str(tmp_path / 'linear.pdparams')
+        paddle.save(m.state_dict(), p)
+        m2 = nn.Linear(4, 3)
+        m2.set_state_dict(paddle.load(p))
+        np.testing.assert_array_equal(m.weight.numpy(), m2.weight.numpy())
+
+    def test_optimizer_state_roundtrip(self, tmp_path):
+        m = nn.Linear(4, 3)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        loss = m(paddle.randn([2, 4])).sum()
+        loss.backward()
+        opt.step()
+        p = str(tmp_path / 'opt.pdopt')
+        paddle.save(opt.state_dict(), p)
+        sd = paddle.load(p)
+        opt2 = paddle.optimizer.Adam(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        opt2.set_state_dict(sd)
+        assert opt2.state_dict().keys() == opt.state_dict().keys()
+
+    def test_rejects_unserializable(self, tmp_path):
+        with pytest.raises(TypeError):
+            paddle.save({'fn': lambda: 1}, str(tmp_path / 'bad'))
+
+    def test_load_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            paddle.load(str(tmp_path / 'nope.pdparams'))
+
+
+def _train(m, opt, data, steps, ckpt=None, start=0):
+    losses = []
+    for i in range(start, start + steps):
+        x, y = data
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        if ckpt is not None:
+            ckpt.save(i + 1, {
+                'model': {k: v for k, v in m.state_dict().items()},
+                'opt': opt.state_dict(),
+            })
+    return losses
+
+
+@pytest.mark.parametrize('backend', ['npz', None])
+class TestCheckpointManager:
+    def test_resume_bit_exact(self, tmp_path, backend):
+        paddle.seed(0)
+        x = paddle.randn([8, 4])
+        y = paddle.randn([8, 2])
+
+        # uninterrupted 6-step run
+        paddle.seed(1)
+        m_full = nn.Linear(4, 2)
+        opt_full = paddle.optimizer.Adam(learning_rate=1e-2,
+                                         parameters=m_full.parameters())
+        full = _train(m_full, opt_full, (x, y), 6)
+
+        # 3 steps + checkpoint, then resume into fresh objects
+        paddle.seed(1)
+        m1 = nn.Linear(4, 2)
+        opt1 = paddle.optimizer.Adam(learning_rate=1e-2,
+                                     parameters=m1.parameters())
+        ck = CheckpointManager(str(tmp_path / 'ck'), backend=backend)
+        first = _train(m1, opt1, (x, y), 3, ckpt=ck)
+
+        m2 = nn.Linear(4, 2)
+        opt2 = paddle.optimizer.Adam(learning_rate=1e-2,
+                                     parameters=m2.parameters())
+        assert ck.latest_step() == 3
+        state = ck.restore()
+        m2.set_state_dict(state['model'])
+        opt2.set_state_dict(state['opt'])
+        rest = _train(m2, opt2, (x, y), 3)
+        np.testing.assert_allclose(first + rest, full, rtol=1e-6)
+
+    def test_retention_and_interval(self, tmp_path, backend):
+        ck = CheckpointManager(str(tmp_path / 'ck'), max_to_keep=2,
+                               save_interval_steps=2, backend=backend)
+        for step in range(1, 8):
+            ck.save(step, {'x': np.array([step])})
+        assert ck.all_steps() == [4, 6]
+        got = ck.restore()
+        assert got['x'][0] == 6
+
+    def test_async_save(self, tmp_path, backend):
+        ck = CheckpointManager(str(tmp_path / 'ck'), async_save=True,
+                               backend=backend)
+        ck.save(1, {'w': np.ones((128, 128))})
+        ck.wait_until_finished()
+        assert ck.all_steps() == [1]
+        np.testing.assert_array_equal(ck.restore()['w'],
+                                      np.ones((128, 128)))

@@ -1,0 +1,178 @@
+"""hapi Model tests (SURVEY.md §4 E2E: Model.fit on synthetic data)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import EarlyStopping, ModelCheckpoint
+from paddle_tpu.io import Dataset, TensorDataset
+from paddle_tpu.metric import Accuracy, Precision, Recall
+
+
+class Blobs(Dataset):
+    """Two linearly separable gaussian blobs."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        half = n // 2
+        x0 = rng.randn(half, 4).astype(np.float32) - 2
+        x1 = rng.randn(n - half, 4).astype(np.float32) + 2
+        self.x = np.concatenate([x0, x1])
+        self.y = np.concatenate([np.zeros(half, np.int64),
+                                 np.ones(n - half, np.int64)])
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+
+
+def _model():
+    net = _mlp()
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    return m
+
+
+class TestFit:
+    def test_fit_learns_and_evaluates(self):
+        m = _model()
+        hist = m.fit(Blobs(64), epochs=5, batch_size=16, verbose=0,
+                     shuffle=True)
+        assert hist['loss'][-1] < hist['loss'][0]
+        res = m.evaluate(Blobs(32, seed=1), batch_size=16)
+        assert res['acc'] > 0.9
+        assert 'loss' in res
+
+    def test_fit_with_eval_data_and_early_stopping(self):
+        m = _model()
+        es = EarlyStopping(monitor='acc', patience=0, mode='max')
+        m.fit(Blobs(32), eval_data=Blobs(16, seed=2), epochs=30,
+              batch_size=16, verbose=0, callbacks=[es])
+        assert es.best is not None
+
+    def test_predict(self):
+        m = _model()
+        m.fit(Blobs(32), epochs=2, batch_size=16, verbose=0)
+        out = m.predict(Blobs(8, seed=3), batch_size=4, stack_outputs=True)
+        assert out[0].shape == (8, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = _model()
+        m.fit(Blobs(32), epochs=2, batch_size=16, verbose=0)
+        path = str(tmp_path / 'ck' / 'model')
+        m.save(path)
+        assert os.path.exists(path + '.pdparams')
+        m2 = _model()
+        m2.load(path)
+        a = m.predict_batch([paddle.to_tensor(Blobs(4).x)]).numpy()
+        b = m2.predict_batch([paddle.to_tensor(Blobs(4).x)]).numpy()
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_save_load_resumes_optimizer_state(self, tmp_path):
+        """Resumed training must continue the Adam moments, not restart:
+        a fresh-optimizer run diverges from the uninterrupted one."""
+        def make(seed=7):
+            paddle.seed(seed)
+            net = _mlp()
+            m = paddle.Model(net)
+            m.prepare(optimizer=paddle.optimizer.Adam(
+                learning_rate=1e-2, parameters=net.parameters()),
+                loss=nn.CrossEntropyLoss())
+            return m
+        data = Blobs(32)
+        full = make()
+        h_full = full.fit(data, epochs=4, batch_size=32, verbose=0,
+                          shuffle=False)
+
+        part = make()
+        part.fit(data, epochs=2, batch_size=32, verbose=0, shuffle=False)
+        path = str(tmp_path / 'resume' / 'model')
+        part.save(path)
+        resumed = make()
+        resumed.load(path)
+        h_resumed = resumed.fit(data, epochs=2, batch_size=32, verbose=0,
+                                shuffle=False)
+        np.testing.assert_allclose(h_resumed['loss'],
+                                   h_full['loss'][2:], rtol=1e-4)
+
+    def test_evaluate_with_precision_recall_metrics(self):
+        # binary head: Precision/Recall take update(preds, labels)
+        import paddle_tpu.nn.functional as F
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        m = paddle.Model(net)
+        m.prepare(loss=lambda o, l: F.binary_cross_entropy_with_logits(
+            o.reshape([-1]), l.astype('float32')),
+            metrics=[Precision(), Recall()])
+        res = m.evaluate(Blobs(16), batch_size=8)
+        assert 'precision' in res and 'recall' in res
+
+    def test_load_raises_on_unexpected_keys(self, tmp_path):
+        m = _model()
+        path = str(tmp_path / 'big' / 'model')
+        big = nn.Sequential(nn.Linear(4, 16), nn.ReLU(),
+                            nn.Linear(16, 2), nn.Linear(2, 2))
+        paddle.Model(big).save(path)
+        with pytest.raises(RuntimeError, match='unexpected'):
+            m.load(path)
+        m.load(path, skip_mismatch=True)
+
+    def test_bf16_model_save_load(self, tmp_path):
+        net = _mlp().bfloat16()
+        m = paddle.Model(net)
+        path = str(tmp_path / 'bf16' / 'model')
+        m.save(path, training=False)
+        net2 = _mlp().bfloat16()
+        paddle.Model(net2).load(path)
+        w = dict(net2.named_parameters())['0.weight']
+        assert 'bfloat16' in str(w.dtype)
+
+    def test_checkpoint_callback(self, tmp_path):
+        m = _model()
+        m.fit(Blobs(16), epochs=2, batch_size=8, verbose=0,
+              save_dir=str(tmp_path / 'ckpts'))
+        assert os.path.exists(str(tmp_path / 'ckpts' / 'final.pdparams'))
+
+    def test_num_iters_stops_early(self):
+        m = _model()
+        hist = m.fit(Blobs(64), epochs=100, batch_size=8, verbose=0,
+                     num_iters=3)
+        assert len(hist['loss']) == 3
+
+    def test_prepare_validation(self):
+        net = _mlp()
+        m = paddle.Model(net)
+        with pytest.raises(TypeError):
+            m.prepare(loss='not callable')
+        m.prepare()
+        with pytest.raises(RuntimeError):
+            m.train_batch([paddle.randn([2, 4])], paddle.zeros([2]))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        acc = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+        label = np.array([1, 2])
+        acc.update(acc.compute(pred, label))
+        top1, top2 = acc.accumulate()
+        assert top1 == 0.5 and top2 == 0.5
+        assert acc.name() == ['acc_top1', 'acc_top2']
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.6])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-9
+        assert abs(r.accumulate() - 2 / 3) < 1e-9

@@ -1,0 +1,175 @@
+"""io tests: datasets, samplers, DataLoader (sync, threaded, native
+staging path) — SURVEY.md §2 DataLoader row."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, SequenceSampler, Subset,
+                           TensorDataset, WeightedRandomSampler,
+                           default_collate_fn, random_split)
+from paddle_tpu.io import native
+
+
+class SquaresDataset(Dataset):
+    def __init__(self, n=32, shape=(3, 4)):
+        self.n = n
+        self.shape = shape
+
+    def __getitem__(self, i):
+        x = np.full(self.shape, float(i), np.float32)
+        return x, np.int64(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+class Counter(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        xs = paddle.randn([10, 3])
+        ys = np.arange(10)
+        ds = TensorDataset([xs, ys])
+        a, b = ds[4]
+        np.testing.assert_array_equal(a, xs.numpy()[4])
+        assert b == 4 and len(ds) == 10
+
+    def test_subset_and_split(self):
+        ds = SquaresDataset(10)
+        sub = Subset(ds, [2, 5])
+        assert sub[1][1] == 25 and len(sub) == 2
+        a, b = random_split(ds, [7, 3], generator=0)
+        assert len(a) == 7 and len(b) == 3
+        seen = {int(s[1]) for s in list(a) + list(b)}
+        assert seen == {i * i for i in range(10)}
+
+
+class TestSamplers:
+    def test_sequence_and_random(self):
+        ds = SquaresDataset(8)
+        assert list(SequenceSampler(ds)) == list(range(8))
+        r = list(RandomSampler(ds, generator=0))
+        assert sorted(r) == list(range(8)) and r != list(range(8))
+
+    def test_weighted(self):
+        w = [0.0, 0.0, 1.0]
+        idx = list(WeightedRandomSampler(w, 20))
+        assert all(i == 2 for i in idx)
+
+    def test_batch_sampler(self):
+        ds = SquaresDataset(10)
+        bs = list(BatchSampler(ds, batch_size=4))
+        assert [len(b) for b in bs] == [4, 4, 2]
+        bs = list(BatchSampler(ds, batch_size=4, drop_last=True))
+        assert [len(b) for b in bs] == [4, 4]
+
+    def test_distributed_batch_sampler_disjoint_covering(self):
+        ds = SquaresDataset(10)
+        all_idx = []
+        for rank in range(4):
+            s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                        rank=rank)
+            got = [i for b in s for i in b]
+            assert len(got) == 3  # ceil(10/4) with wrap padding
+            all_idx.extend(got)
+        assert set(all_idx) == set(range(10))
+
+
+class TestDataLoader:
+    @pytest.mark.parametrize('workers', [0, 2])
+    def test_order_and_shapes(self, workers):
+        ds = SquaresDataset(20)
+        dl = DataLoader(ds, batch_size=4, num_workers=workers)
+        batches = list(dl)
+        assert len(batches) == 5
+        x, y = batches[0]
+        assert x.shape == [4, 3, 4] and y.shape == [4]
+        # deterministic order preserved even with threads
+        np.testing.assert_array_equal(y.numpy(), [0, 1, 4, 9])
+        np.testing.assert_array_equal(batches[3][1].numpy(),
+                                      [144, 169, 196, 225])
+
+    def test_iterable_dataset(self):
+        dl = DataLoader(Counter(7), batch_size=3)
+        got = [b.numpy().tolist() for b in dl]
+        assert got == [[0, 1, 2], [3, 4, 5], [6]]
+
+    def test_custom_collate(self):
+        ds = SquaresDataset(4)
+        dl = DataLoader(ds, batch_size=2,
+                        collate_fn=lambda b: len(b))
+        assert list(dl) == [2, 2]
+
+    def test_worker_exception_propagates(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                if i == 2:
+                    raise ValueError('boom')
+                return np.zeros(2, np.float32)
+
+        dl = DataLoader(Bad(), batch_size=2, num_workers=2)
+        with pytest.raises(ValueError, match='boom'):
+            list(dl)
+
+    def test_shuffle_epoch_coverage(self):
+        ds = SquaresDataset(16)
+        dl = DataLoader(ds, batch_size=4, shuffle=True)
+        ys = sorted(int(v) for _, y in dl for v in y.numpy())
+        assert ys == sorted(i * i for i in range(16))
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason='no C++ toolchain for staging runtime')
+class TestNativeRuntime:
+    def test_staging_ring_roundtrip(self):
+        st = native.StagingBuffer(1024, n_slots=2)
+        slot = st.acquire()
+        view = st.view(slot, nbytes=16, dtype=np.float32)
+        view[:] = np.arange(4, dtype=np.float32)
+        st.commit(slot, 16)
+        got, nbytes = st.pop()
+        assert got == slot and nbytes == 16
+        np.testing.assert_array_equal(
+            st.view(got, nbytes=16, dtype=np.float32),
+            np.arange(4, dtype=np.float32))
+        st.release(got)
+
+    def test_decoder_pool_memcpy_and_u8(self):
+        pool = native.DecoderPool(2)
+        src = np.arange(256, dtype=np.uint8)
+        dst = np.empty(256, np.uint8)
+        t = pool.ticket()
+        pool.submit_memcpy(src.ctypes.data, dst.ctypes.data, 256, t)
+        pool.wait(t, 1)
+        pool.ticket_free(t)
+        np.testing.assert_array_equal(src, dst)
+        f = np.empty(256, np.float32)
+        t = pool.ticket()
+        pool.submit_u8_to_f32(src.ctypes.data, f.ctypes.data, 256,
+                              1.0 / 255, 127.5, t)
+        pool.wait(t, 1)
+        pool.ticket_free(t)
+        np.testing.assert_allclose(
+            f, (src.astype(np.float32) - 127.5) / 255, rtol=1e-6)
+
+    def test_native_collate_used_and_correct(self):
+        ds = SquaresDataset(12, shape=(5, 7))
+        dl = DataLoader(ds, batch_size=4, num_workers=2)
+        assert dl._native is not None
+        x, y = next(iter(dl))
+        assert x.shape == [4, 5, 7]
+        np.testing.assert_array_equal(x.numpy()[3],
+                                      np.full((5, 7), 3.0, np.float32))
+        np.testing.assert_array_equal(y.numpy(), [0, 1, 4, 9])
