@@ -25,9 +25,12 @@ from . import nn
 from . import optimizer
 from . import distributed
 from . import nlp
+from . import vision
 from . import amp
 from . import utils
 from . import io
+from . import profiler
+from . import debug
 from . import metric
 from . import hapi
 from .hapi import Model

@@ -85,15 +85,19 @@ def auto_cast(enable=True, custom_white_list: Optional[Iterable[str]] = None,
     """Context manager enabling mixed-precision op dispatch."""
     if level not in ('O0', 'O1', 'O2'):
         raise ValueError(f'amp level must be O0/O1/O2, got {level!r}')
+    cw = set(custom_white_list or ())
+    cb = set(custom_black_list or ())
+    if cw & cb:  # validate BEFORE touching state (no partial mutation)
+        raise ValueError(f'ops in both custom lists: {sorted(cw & cb)}')
+    new_dtype = convert_dtype(dtype)
     old = (_state.enabled, _state.dtype, _state.level, _state.white,
            _state.black)
     _state.enabled = bool(enable) and level != 'O0'
-    _state.dtype = convert_dtype(dtype)
+    _state.dtype = new_dtype
     _state.level = level
-    if custom_white_list:
-        _state.white = WHITE_LIST | set(custom_white_list)
-    if custom_black_list:
-        _state.black = BLACK_LIST | set(custom_black_list)
+    # custom entries override the built-in opposite list
+    _state.white = (WHITE_LIST | cw) - cb
+    _state.black = (BLACK_LIST | cb) - cw
     try:
         yield
     finally:

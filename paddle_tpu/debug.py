@@ -1,0 +1,122 @@
+"""Failure detection (upstream: paddle.amp.debugging / check_nan_inf,
+python/paddle/amp/debugging.py + the fleet loss-spike monitor).
+
+- `check_numerics(x, name)` — raises on NaN/Inf in eager mode; under
+  jit it routes through `jax.debug` safe-guarding via checkify-style
+  host callback only when enabled (zero overhead when off).
+- `enable_check_numerics()` — installs a tape-level hook: every op
+  recorded by apply_op is scanned for non-finite outputs (eager only,
+  the DyGraph debugging workflow).
+- `LossSpikeDetector` — windowed z-score monitor used by hapi/fleet to
+  flag divergence (upstream: loss scaling skip-counters + spike logs).
+"""
+from __future__ import annotations
+
+import collections
+import math
+from typing import Deque, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import flags as _flags
+from .tensor import Tensor
+
+
+class NumericsError(RuntimeError):
+    pass
+
+
+def check_numerics(x, name: str = 'tensor', raise_on_error: bool = True):
+    """Assert a tensor is finite. Eager: host check with a precise count.
+    Traced: uses jax.debug.callback so the check travels into the XLA
+    program (no effect on the computed value)."""
+    val = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    if not jnp.issubdtype(val.dtype, jnp.floating):
+        return x
+
+    if isinstance(val, jax.core.Tracer):
+        def cb(n_nan, n_inf):
+            if int(n_nan) or int(n_inf):
+                msg = (f'check_numerics({name}): {int(n_nan)} NaN, '
+                       f'{int(n_inf)} Inf values')
+                if raise_on_error:
+                    raise NumericsError(msg)
+                print(msg)
+        f32 = val.astype(jnp.float32)
+        jax.debug.callback(cb, jnp.isnan(f32).sum(),
+                           jnp.isinf(f32).sum())
+        return x
+
+    f32 = np.asarray(val, np.float32)
+    n_nan = int(np.isnan(f32).sum())
+    n_inf = int(np.isinf(f32).sum())
+    if n_nan or n_inf:
+        msg = (f'check_numerics({name}): {n_nan} NaN, {n_inf} Inf of '
+               f'{f32.size} values, shape {tuple(f32.shape)}')
+        if raise_on_error:
+            raise NumericsError(msg)
+        print(msg)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# tape-level monitor (FLAGS_check_nan_inf)
+# ---------------------------------------------------------------------------
+
+def _scan_outputs(out, op_name):
+    def scan(t):
+        if isinstance(t, Tensor) and not isinstance(
+                t.value, jax.core.Tracer):
+            check_numerics(t, name=op_name or 'op')
+        return t
+    jax.tree_util.tree_map(scan, out,
+                           is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def enable_check_numerics(level: int = 0):
+    """Scan every eager op output for NaN/Inf via the apply_op hook
+    (upstream: FLAGS_check_nan_inf=1). Heavy — debugging only."""
+    from . import tensor as tmod
+    _flags.set_flags({'FLAGS_check_nan_inf': True,
+                      'FLAGS_check_nan_inf_level': level})
+    tmod._numerics_hook = _scan_outputs
+
+
+def disable_check_numerics():
+    from . import tensor as tmod
+    _flags.set_flags({'FLAGS_check_nan_inf': False})
+    tmod._numerics_hook = None
+
+
+class LossSpikeDetector:
+    """Windowed spike detector: flags a step whose loss exceeds
+    mean + k*std of the trailing window, or is non-finite."""
+
+    def __init__(self, window: int = 20, threshold_sigma: float = 6.0,
+                 min_steps: int = 5):
+        self.window: Deque[float] = collections.deque(maxlen=window)
+        self.k = threshold_sigma
+        self.min_steps = min_steps
+        self.spikes: List[int] = []
+        self._step = 0
+
+    def update(self, loss: float) -> bool:
+        """Returns True if this step is a spike."""
+        v = float(loss)
+        self._step += 1
+        if not math.isfinite(v):
+            self.spikes.append(self._step)
+            return True
+        spiked = False
+        if len(self.window) >= self.min_steps:
+            mean = sum(self.window) / len(self.window)
+            var = sum((x - mean) ** 2 for x in self.window) \
+                / len(self.window)
+            std = math.sqrt(var)
+            if v > mean + self.k * max(std, 1e-12):
+                spiked = True
+                self.spikes.append(self._step)
+        self.window.append(v)
+        return spiked

@@ -55,9 +55,11 @@ def _feed_metric(m: Metric, out, lab):
 
 def _split_batch(batch):
     """(inputs..., label) convention: last element is the label."""
-    if isinstance(batch, (list, tuple)) and len(batch) >= 2:
-        *ins, lab = batch
-        return tuple(ins), lab
+    if isinstance(batch, (list, tuple)):
+        if len(batch) >= 2:
+            *ins, lab = batch
+            return tuple(ins), lab
+        return tuple(batch), None  # 1-tuple: sole element IS the input
     return (batch,), None
 
 
@@ -75,20 +77,24 @@ class Model:
                 amp_configs=None):
         if loss is not None and not callable(loss):
             raise TypeError('loss must be callable (a loss Layer or fn)')
+        self._amp_level = 'O0'
+        self._amp_dtype = 'bfloat16'
         if amp_configs:
             from .. import amp as _amp
             cfg = ({'level': amp_configs} if isinstance(amp_configs, str)
                    else dict(amp_configs))
             level = cfg.get('level', 'O1')
+            self._amp_dtype = cfg.get('dtype', 'bfloat16')
             if level == 'O2':
                 out = _amp.decorate(self.network, optimizer, level='O2',
-                                    dtype=cfg.get('dtype', 'bfloat16'))
+                                    dtype=self._amp_dtype)
                 if optimizer is not None:
                     self.network, optimizer = out
                 else:
                     self.network = out
             elif level not in ('O0', 'O1'):
                 raise ValueError(f'bad amp level {level!r}')
+            self._amp_level = level
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
@@ -115,18 +121,27 @@ class Model:
         return self._train_step
 
     # -- batch-level API ----------------------------------------------------
+    def _amp_ctx(self):
+        import contextlib
+        if getattr(self, '_amp_level', 'O0') == 'O1':
+            from .. import amp as _amp
+            return _amp.auto_cast(level='O1', dtype=self._amp_dtype)
+        return contextlib.nullcontext()
+
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         step = self._ensure_step()
         ins = tuple(_to_list(inputs)) if isinstance(inputs, (list, tuple)) \
             else (inputs,)
-        loss = step(ins if len(ins) > 1 else ins[0], labels)
+        with self._amp_ctx():
+            loss = step(ins if len(ins) > 1 else ins[0], labels)
         return [float(loss.numpy())]
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         ins = _to_list(inputs)
-        outputs = self.network(*ins)
+        with self._amp_ctx():
+            outputs = self.network(*ins)
         out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
         res = {}
         if self._loss is not None and labels is not None:

@@ -191,23 +191,30 @@ class VisualDL(Callback):
         self._writer = None
         self._step = 0
 
+    def _ensure_writer(self):
+        if self._writer is None:  # standalone evaluate() skips train_begin
+            from ..utils.logging import SummaryWriter
+            self._writer = SummaryWriter(self.log_dir)
+        return self._writer
+
     def on_train_begin(self, logs=None):
-        from ..utils.logging import SummaryWriter
-        self._writer = SummaryWriter(self.log_dir)
+        self._ensure_writer()
 
     def on_train_batch_end(self, step, logs=None):
         self._step += 1
+        w = self._ensure_writer()
         for k, v in (logs or {}).items():
             try:
-                self._writer.add_scalar(f'train/{k}', float(v), self._step)
+                w.add_scalar(f'train/{k}', float(v), self._step)
             except (TypeError, ValueError):
                 pass
 
     def on_eval_end(self, logs=None):
+        w = self._ensure_writer()
         for k, v in (logs or {}).items():
             try:
                 v = v[0] if isinstance(v, (list, tuple)) else v
-                self._writer.add_scalar(f'eval/{k}', float(v), self._step)
+                w.add_scalar(f'eval/{k}', float(v), self._step)
             except (TypeError, ValueError):
                 pass
 

@@ -422,13 +422,21 @@ class DataLoader:
         stop = threading.Event()
         results: dict = {}
         results_cv = threading.Condition()
+        # bound in-flight batches with a semaphore acquired BEFORE taking
+        # an index (never block the insert — blocking the worker that
+        # holds the batch the consumer is waiting on would deadlock)
+        inflight = threading.Semaphore(cap)
 
         def worker():
             while not stop.is_set():
+                inflight.acquire()
+                if stop.is_set():
+                    return
                 with lock:
                     try:
                         seq, idx = next(index_it)
                     except StopIteration:
+                        inflight.release()
                         return
                 try:
                     batch = self._collate(self._fetch(idx))
@@ -436,8 +444,6 @@ class DataLoader:
                 except Exception as e:  # surface in consumer
                     batch, err = None, e
                 with results_cv:
-                    while len(results) >= cap and not stop.is_set():
-                        results_cv.wait(timeout=0.1)
                     results[seq] = (batch, err)
                     results_cv.notify_all()
 
@@ -455,12 +461,14 @@ class DataLoader:
                                 and want not in results:
                             raise RuntimeError('DataLoader workers died')
                     batch, err = results.pop(want)
-                    results_cv.notify_all()  # wake producers (backpressure)
+                inflight.release()
                 if err is not None:
                     raise err
                 yield batch
         finally:
             stop.set()
+            for _ in threads:  # unblock workers parked on the semaphore
+                inflight.release()
 
     def __iter__(self):
         if self.num_workers > 0 and not self._iterable:

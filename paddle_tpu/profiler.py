@@ -1,0 +1,148 @@
+"""paddle.profiler (upstream: python/paddle/profiler/profiler.py).
+
+TPU-native: device-side tracing delegates to the XLA/jax profiler
+(perfetto .trace.pb consumable by Perfetto UI / xprof); host-side op
+timing is a lightweight in-process aggregator around `RecordEvent`
+regions. `profile(dir)` is the one-liner; `Profiler` mirrors the
+reference's start/stop/step object API.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+class _HostTimer(threading.local):
+    def __init__(self):
+        self.stack: List = []
+        self.totals: Dict[str, float] = collections.defaultdict(float)
+        self.counts: Dict[str, int] = collections.defaultdict(int)
+        self.active = False
+
+
+_host = _HostTimer()
+
+
+class RecordEvent:
+    """Named host region, nestable; shows up in summary() and, when a jax
+    trace is active, as a TraceAnnotation on the device timeline."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._jax_ctx = None
+        self._t0 = 0.0
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        try:
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+        return self
+
+    def end(self):
+        dt = time.perf_counter() - self._t0
+        if _host.active:
+            _host.totals[self.name] += dt
+            _host.counts[self.name] += 1
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+            self._jax_ctx = None
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def annotate(name: str) -> RecordEvent:
+    return RecordEvent(name)
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, trace_dir: Optional[str] = None):
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir
+        self._tracing = False
+        self._step_count = 0
+        self._step_times: List[float] = []
+        self._last_step_t: Optional[float] = None
+
+    def start(self):
+        _host.active = True
+        _host.totals.clear()
+        _host.counts.clear()
+        self._last_step_t = time.perf_counter()
+        if self.trace_dir and not self.timer_only:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self.trace_dir)
+                self._tracing = True
+            except Exception:
+                self._tracing = False
+        return self
+
+    def step(self):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step_count += 1
+
+    def stop(self):
+        _host.active = False
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._tracing = False
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def summary(self, sorted_by='total', max_rows=30) -> str:
+        rows = sorted(_host.totals.items(), key=lambda kv: -kv[1])
+        lines = [f'{"region":<40}{"calls":>8}{"total_s":>12}{"avg_ms":>10}']
+        for name, total in rows[:max_rows]:
+            n = _host.counts[name]
+            lines.append(
+                f'{name:<40}{n:>8}{total:>12.4f}{total / n * 1e3:>10.2f}')
+        if self._step_times:
+            avg = sum(self._step_times) / len(self._step_times)
+            lines.append(f'steps: {self._step_count}, avg step '
+                         f'{avg * 1e3:.2f} ms')
+        s = '\n'.join(lines)
+        return s
+
+    def export(self, path: str):
+        with open(path, 'w') as f:
+            json.dump({'regions': {k: {'total_s': v,
+                                       'calls': _host.counts[k]}
+                                   for k, v in _host.totals.items()},
+                       'step_times': self._step_times}, f)
+
+
+@contextlib.contextmanager
+def profile(trace_dir: Optional[str] = None, timer_only=False):
+    """`with paddle_tpu.profiler.profile('/tmp/trace'):` — wraps
+    jax.profiler.trace + host region timing."""
+    p = Profiler(trace_dir=trace_dir, timer_only=timer_only)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()

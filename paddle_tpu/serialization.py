@@ -21,6 +21,27 @@ from .tensor import Tensor
 _ARRAY_KEY = '__arr__'
 
 
+def _tag_key(k):
+    if isinstance(k, bool):   # before int: bool is an int subclass
+        return f'b:{k}'
+    if isinstance(k, int):
+        return f'i:{k}'
+    if isinstance(k, float):
+        return f'f:{k!r}'
+    return f's:{k}'
+
+
+def _untag_key(tagged: str):
+    tag, _, v = tagged.partition(':')
+    if tag == 'i':
+        return int(v)
+    if tag == 'b':
+        return v == 'True'
+    if tag == 'f':
+        return float(v)
+    return v
+
+
 def _encode_array(a: np.ndarray):
     """npz can't store ml_dtypes (bfloat16/fp8 have numpy kind 'V'); view
     them as the same-width uint and record the true dtype name."""
@@ -48,8 +69,14 @@ def _flatten(obj: Any, arrays: list, path: str):
         return {_ARRAY_KEY: len(arrays) - 1, 'kind': 'ndarray',
                 'np_dtype': np_dtype}
     if isinstance(obj, dict):
+        for k in obj:
+            if not isinstance(k, (str, int, bool, float)):
+                raise TypeError(
+                    f'paddle.save dict keys must be str/int/bool/float, '
+                    f'got {type(k).__name__} at {path!r}')
+        # keys keep their python type ('s:'/'i:'/'b:'/'f:' tagged)
         return {'kind': 'dict',
-                'items': [[str(k), _flatten(v, arrays, f'{path}.{k}')]
+                'items': [[_tag_key(k), _flatten(v, arrays, f'{path}.{k}')]
                           for k, v in obj.items()]}
     if isinstance(obj, (list, tuple)):
         return {'kind': 'list' if isinstance(obj, list) else 'tuple',
@@ -72,7 +99,7 @@ def _unflatten(spec, arrays, return_numpy):
             return Tensor(jnp.asarray(arr))
         return arr
     if kind == 'dict':
-        return {k: _unflatten(v, arrays, return_numpy)
+        return {_untag_key(k): _unflatten(v, arrays, return_numpy)
                 for k, v in spec['items']}
     if kind == 'list':
         return [_unflatten(v, arrays, return_numpy) for v in spec['items']]

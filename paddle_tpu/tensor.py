@@ -22,6 +22,9 @@ from .dtype import convert_dtype, dtype_name
 # implementing the auto_cast white/black-list policy at the op choke-point
 _amp_cast_hook = None
 
+# set by paddle_tpu.debug.enable_check_numerics: (out_pytree, op_name) -> None
+_numerics_hook = None
+
 _tree = jax.tree_util
 
 
@@ -341,7 +344,10 @@ def apply_op(fn: Callable, *args, _name: str = '', **kwargs):
         if not isinstance(l, Tensor) else l
         for i, l in enumerate(out_leaves)
     ]
-    return _tree.tree_unflatten(out_td, wrapped)
+    result = _tree.tree_unflatten(out_td, wrapped)
+    if _numerics_hook is not None:
+        _numerics_hook(result, _name)
+    return result
 
 
 def to_jax(x):

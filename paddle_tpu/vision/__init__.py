@@ -1,0 +1,5 @@
+"""paddle.vision — model zoo, transforms, datasets."""
+from . import datasets, models, transforms  # noqa: F401
+from .models import (LeNet, MobileNetV2, ResNet, VGG,  # noqa: F401
+                     mobilenet_v2, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, vgg16)

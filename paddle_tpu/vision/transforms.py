@@ -1,0 +1,183 @@
+"""paddle.vision.transforms (upstream: python/paddle/vision/transforms/).
+
+Numpy-based: transforms run in DataLoader workers on the host (where the
+C++ decoder pool does the heavy copies); only the final batch hits the
+device. Images are HWC uint8/float arrays; ToTensor converts to CHW
+float32 in [0, 1].
+"""
+from __future__ import annotations
+
+import numbers
+from typing import List, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..tensor import Tensor
+
+
+def _as_hwc(img):
+    img = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format='CHW'):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        else:
+            img = img.astype(np.float32)
+        if self.data_format == 'CHW':
+            img = np.transpose(img, (2, 0, 1))
+        return img
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format='CHW', to_rgb=False):
+        self.mean = np.asarray(
+            [mean] if isinstance(mean, numbers.Number) else mean,
+            np.float32)
+        self.std = np.asarray(
+            [std] if isinstance(std, numbers.Number) else std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == 'CHW':
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Resize(BaseTransform):
+    """Nearest/bilinear resize on HWC arrays (pure numpy)."""
+
+    def __init__(self, size, interpolation='bilinear'):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if (h, w) == (th, tw):
+            return img
+        if self.interpolation == 'nearest':
+            ri = (np.arange(th) * h / th).astype(int).clip(0, h - 1)
+            ci = (np.arange(tw) * w / tw).astype(int).clip(0, w - 1)
+            return img[ri][:, ci]
+        # bilinear
+        ys = (np.arange(th) + 0.5) * h / th - 0.5
+        xs = (np.arange(tw) + 0.5) * w / tw - 0.5
+        y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        wy = np.clip(ys - y0, 0, 1)[:, None, None]
+        wx = np.clip(xs - x0, 0, 1)[None, :, None]
+        f = img.astype(np.float32)
+        out = ((f[y0][:, x0] * (1 - wy) + f[y1][:, x0] * wy) * (1 - wx)
+               + (f[y0][:, x1] * (1 - wy) + f[y1][:, x1] * wy) * wx)
+        return out.astype(img.dtype) if img.dtype == np.uint8 \
+            else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, ((p, p), (p, p), (0, 0)))
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            ph, pw = max(th - h, 0), max(tw - w, 0)
+            img = np.pad(img, ((0, ph), (0, pw), (0, 0)))
+            h, w = img.shape[:2]
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if np.random.rand() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if np.random.rand() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+def to_tensor(img, data_format='CHW'):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format='CHW'):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation='bilinear'):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)

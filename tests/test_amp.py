@@ -44,6 +44,16 @@ def test_autocast_nesting_restores_state():
     assert 'float32' in str(paddle.matmul(a, a).dtype)
 
 
+def test_custom_white_list_overrides_black_list():
+    x = paddle.randn([4, 4])
+    with auto_cast(custom_white_list={'sum'}):
+        out = paddle.sum(x)
+    assert 'bfloat16' in str(out.dtype)
+    with pytest.raises(ValueError):
+        auto_cast(custom_white_list={'sum'},
+                  custom_black_list={'sum'}).__enter__()
+
+
 def test_autocast_gradients_flow():
     m = nn.Linear(8, 4)
     x = paddle.randn([2, 8])

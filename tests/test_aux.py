@@ -1,0 +1,106 @@
+"""Aux subsystem tests: profiler, debug/check_numerics, flags, logging
+(SURVEY.md §5)."""
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import debug, profiler
+from paddle_tpu.debug import (LossSpikeDetector, NumericsError,
+                              check_numerics, disable_check_numerics,
+                              enable_check_numerics)
+from paddle_tpu.utils.logging import SummaryWriter, read_jsonl, scalars
+
+
+class TestProfiler:
+    def test_regions_aggregate(self):
+        with profiler.profile(timer_only=True) as p:
+            for _ in range(3):
+                with profiler.annotate('matmul_region'):
+                    paddle.matmul(paddle.randn([16, 16]),
+                                  paddle.randn([16, 16])).numpy()
+                p.step()
+        s = p.summary()
+        assert 'matmul_region' in s and 'steps: 3' in s
+
+    def test_export(self, tmp_path):
+        with profiler.profile(timer_only=True) as p:
+            with profiler.annotate('r'):
+                pass
+        out = str(tmp_path / 'prof.json')
+        p.export(out)
+        data = json.load(open(out))
+        assert 'r' in data['regions']
+
+
+class TestCheckNumerics:
+    def test_eager_pass_and_fail(self):
+        check_numerics(paddle.ones([3]), 'ok')
+        bad = paddle.to_tensor(np.array([1.0, np.nan, np.inf], np.float32))
+        with pytest.raises(NumericsError, match='1 NaN, 1 Inf'):
+            check_numerics(bad, 'bad')
+
+    def test_traced_callback(self):
+        @jax.jit
+        def f(x):
+            check_numerics(x, 'traced')
+            return x * 2
+        np.testing.assert_array_equal(
+            np.asarray(f(np.ones(3, np.float32))), [2, 2, 2])
+        with pytest.raises(Exception):
+            f(np.array([np.nan], np.float32))
+            jax.block_until_ready(f(np.array([np.nan], np.float32)))
+
+    def test_tape_hook(self):
+        enable_check_numerics()
+        try:
+            assert paddle.get_flags('FLAGS_check_nan_inf')[
+                'FLAGS_check_nan_inf']
+            with pytest.raises(NumericsError):
+                paddle.log(paddle.to_tensor(
+                    np.array([-1.0], np.float32))).sqrt()
+        finally:
+            disable_check_numerics()
+        # after disable: silent again
+        paddle.log(paddle.to_tensor(np.array([-1.0], np.float32)))
+
+    def test_int_tensors_skipped(self):
+        check_numerics(paddle.to_tensor(np.array([1, 2])), 'ints')
+
+
+class TestLossSpike:
+    def test_detects_spike_and_nonfinite(self):
+        d = LossSpikeDetector(window=10, threshold_sigma=3.0, min_steps=3)
+        for v in [1.0, 1.01, 0.99, 1.0, 1.02]:
+            assert not d.update(v)
+        assert d.update(50.0)
+        assert d.update(float('nan'))
+        assert len(d.spikes) == 2
+
+    def test_gradual_drift_ok(self):
+        d = LossSpikeDetector(window=5, threshold_sigma=6.0)
+        assert not any(d.update(10.0 - 0.1 * i) for i in range(30))
+
+
+class TestFlagsAndLogging:
+    def test_flags_roundtrip_and_validation(self):
+        paddle.set_flags({'FLAGS_check_nan_inf_level': 2})
+        assert paddle.get_flags(['check_nan_inf_level'])[
+            'FLAGS_check_nan_inf_level'] == 2
+        with pytest.raises(ValueError):
+            paddle.set_flags({'FLAGS_not_a_flag': 1})
+        paddle.set_flags({'FLAGS_check_nan_inf_level': 0})
+
+    def test_summary_writer(self, tmp_path):
+        d = str(tmp_path / 'log')
+        with SummaryWriter(d) as w:
+            for i in range(3):
+                w.add_scalar('train/loss', 1.0 / (i + 1), step=i)
+            w.add_text('note', 'hello')
+        recs = read_jsonl(os.path.join(d, 'metrics.jsonl'))
+        assert len(recs) == 4
+        vals = [r['value'] for r in scalars(d, 'train/loss')]
+        assert vals == [1.0, 0.5, 1.0 / 3]

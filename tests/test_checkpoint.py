@@ -50,6 +50,17 @@ class TestSaveLoad:
         opt2.set_state_dict(sd)
         assert opt2.state_dict().keys() == opt.state_dict().keys()
 
+    def test_int_and_mixed_dict_keys_roundtrip(self, tmp_path):
+        obj = {0: 'a', 1: np.arange(2), 'x': {2: 3.5, True: 'yes'}}
+        p = str(tmp_path / 'keys.pd')
+        paddle.save(obj, p)
+        back = paddle.load(p)
+        assert back[0] == 'a' and back['x'][2] == 3.5
+        assert back['x'][True] == 'yes'
+        np.testing.assert_array_equal(back[1], np.arange(2))
+        with pytest.raises(TypeError, match='keys'):
+            paddle.save({(1, 2): 'tuple-key'}, p)
+
     def test_rejects_unserializable(self, tmp_path):
         with pytest.raises(TypeError):
             paddle.save({'fn': lambda: 1}, str(tmp_path / 'bad'))

@@ -136,6 +136,42 @@ class TestFit:
         w = dict(net2.named_parameters())['0.weight']
         assert 'bfloat16' in str(w.dtype)
 
+    def test_predict_single_field_dataset(self):
+        from paddle_tpu.io import TensorDataset
+        m = _model()
+        ds = TensorDataset([Blobs(8).x])
+        out = m.predict(ds, batch_size=4, stack_outputs=True)
+        assert out[0].shape == (8, 2)
+
+    def test_fit_amp_o1_actually_casts(self):
+        from paddle_tpu import amp as amp_mod
+        seen = []
+        orig = amp_mod._cast_inputs
+
+        def spy(vals, name):
+            out = orig(vals, name)
+            if name == 'linear' and amp_mod._state.enabled:
+                seen.extend(str(v.dtype) for v in out
+                            if hasattr(v, 'dtype'))
+            return out
+        amp_mod._tensor_mod._amp_cast_hook = spy
+        try:
+            net = _mlp()
+            m = paddle.Model(net)
+            m.prepare(paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=net.parameters()),
+                nn.CrossEntropyLoss(), amp_configs={'level': 'O1'})
+            m.fit(Blobs(16), epochs=1, batch_size=8, verbose=0)
+        finally:
+            amp_mod._tensor_mod._amp_cast_hook = orig
+        assert any('bfloat16' in s for s in seen)
+
+    def test_visualdl_standalone_evaluate(self, tmp_path):
+        from paddle_tpu.hapi import VisualDL
+        m = _model()
+        m.evaluate(Blobs(8), batch_size=4,
+                   callbacks=[VisualDL(log_dir=str(tmp_path / 'vdl'))])
+
     def test_checkpoint_callback(self, tmp_path):
         m = _model()
         m.fit(Blobs(16), epochs=2, batch_size=8, verbose=0,

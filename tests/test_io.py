@@ -123,6 +123,27 @@ class TestDataLoader:
         with pytest.raises(ValueError, match='boom'):
             list(dl)
 
+    def test_slow_first_batch_no_deadlock(self):
+        """One slow worker holding batch 0 while others fill the prefetch
+        window must not deadlock (regression: insert-side backpressure)."""
+        import time
+
+        class SlowFirst(Dataset):
+            def __len__(self):
+                return 24
+
+            def __getitem__(self, i):
+                if i == 0:
+                    time.sleep(0.3)
+                return np.full(4, i, np.float32)
+
+        dl = DataLoader(SlowFirst(), batch_size=2, num_workers=4,
+                        prefetch_factor=2)
+        batches = list(dl)
+        assert len(batches) == 12
+        np.testing.assert_array_equal(batches[0].numpy()[0],
+                                      np.zeros(4, np.float32))
+
     def test_shuffle_epoch_coverage(self):
         ds = SquaresDataset(16)
         dl = DataLoader(ds, batch_size=4, shuffle=True)

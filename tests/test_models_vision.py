@@ -1,0 +1,126 @@
+"""Vision zoo tests (SURVEY.md §4: tiny forward smoke + overfit +
+transform correctness)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision import (LeNet, MobileNetV2, mobilenet_v2, resnet18,
+                               resnet50, vgg16)
+from paddle_tpu.vision.datasets import Cifar10, MNIST
+from paddle_tpu.vision import transforms as T
+
+
+class TestModels:
+    def test_lenet_forward_and_overfit(self):
+        m = LeNet(num_classes=10)
+        x = paddle.rand([4, 1, 28, 28])
+        y = np.array([0, 1, 2, 3])
+        assert m(x).shape == [4, 10]
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        first = last = None
+        for _ in range(12):
+            loss = loss_fn(m(x), paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = v if first is None else first
+            last = v
+        assert last < first
+
+    def test_resnet18_forward_shapes(self):
+        m = resnet18(num_classes=7).eval()
+        out = m(paddle.rand([2, 3, 64, 64]))
+        assert out.shape == [2, 7]
+
+    def test_resnet50_bottleneck_forward(self):
+        m = resnet50(num_classes=5).eval()
+        out = m(paddle.rand([1, 3, 64, 64]))
+        assert out.shape == [1, 5]
+
+    def test_resnet_batchnorm_updates_stats_in_train(self):
+        m = resnet18(num_classes=4)
+        before = m.bn1._buffers['_mean'].numpy().copy()
+        m.train()
+        m(paddle.rand([2, 3, 32, 32]) + 3.0)
+        after = m.bn1._buffers['_mean'].numpy()
+        assert not np.allclose(before, after)
+
+    def test_vgg16_forward(self):
+        m = vgg16(num_classes=3).eval()
+        assert m(paddle.rand([1, 3, 32, 32])).shape == [1, 3]
+
+    def test_mobilenet_v2_forward_and_depthwise(self):
+        m = mobilenet_v2(num_classes=6).eval()
+        assert m(paddle.rand([1, 3, 32, 32])).shape == [1, 6]
+
+    def test_pretrained_rejected_offline(self):
+        with pytest.raises(ValueError):
+            resnet18(pretrained=True)
+
+
+class TestTransforms:
+    def test_to_tensor_and_normalize(self):
+        img = (np.arange(2 * 3 * 3) % 255).astype(np.uint8).reshape(3, 3, 2)
+        t = T.Compose([T.ToTensor(),
+                       T.Normalize(mean=[0.5, 0.5], std=[0.5, 0.5])])
+        out = t(img)
+        assert out.shape == (2, 3, 3)
+        np.testing.assert_allclose(
+            out, (img.transpose(2, 0, 1) / 255.0 - 0.5) / 0.5, rtol=1e-6)
+
+    def test_resize_nearest_and_bilinear(self):
+        img = np.arange(16, dtype=np.uint8).reshape(4, 4, 1)
+        near = T.Resize(2, interpolation='nearest')(img)
+        assert near.shape == (2, 2, 1)
+        bil = T.Resize((8, 8))(img)
+        assert bil.shape == (8, 8, 1)
+        # torch parity for bilinear values
+        import torch
+        want = torch.nn.functional.interpolate(
+            torch.tensor(img.astype(np.float32)).permute(2, 0, 1)[None],
+            size=(8, 8), mode='bilinear', align_corners=False)[0, 0]
+        np.testing.assert_allclose(
+            T.Resize((8, 8))(img.astype(np.float32))[:, :, 0],
+            want.numpy(), atol=1e-4)
+
+    def test_crops_and_flip(self):
+        img = np.arange(25, dtype=np.uint8).reshape(5, 5, 1)
+        assert T.CenterCrop(3)(img).shape == (3, 3, 1)
+        assert T.RandomCrop(3)(img).shape == (3, 3, 1)
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        flipped = T.RandomHorizontalFlip(prob=1.0)(img)
+        np.testing.assert_array_equal(flipped, img[:, ::-1])
+
+
+class TestDatasets:
+    def test_synthetic_mnist_trains_with_model_fit(self):
+        ds = MNIST(backend='synthetic', transform=T.ToTensor())
+        img, label = ds[0]
+        assert img.shape == (1, 28, 28) and 0 <= label < 10
+        net = LeNet(num_classes=10)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(
+            learning_rate=1e-3, parameters=net.parameters()),
+            nn.CrossEntropyLoss(),
+            paddle.metric.Accuracy())
+        hist = model.fit(ds, epochs=2, batch_size=64, verbose=0)
+        assert hist['loss'][-1] < hist['loss'][0]
+
+    def test_synthetic_cifar10(self):
+        ds = Cifar10(backend='synthetic', mode='test')
+        img, label = ds[3]
+        assert img.shape == (32, 32, 3) and img.dtype == np.uint8
+
+    def test_synthetic_cifar100_has_100_classes(self):
+        from paddle_tpu.vision.datasets import Cifar100
+        ds = Cifar100(backend='synthetic')
+        labels = {int(ds[i][1]) for i in range(len(ds))}
+        assert max(labels) >= 10  # not capped at CIFAR-10's range
+
+    def test_download_rejected(self):
+        with pytest.raises(RuntimeError, match='offline'):
+            MNIST(download=True)

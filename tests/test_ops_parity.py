@@ -1,0 +1,266 @@
+"""Per-op parity tests vs numpy/torch (SURVEY.md §4 'Op parity' row):
+search, linalg, indexing, dtype promotion, in-place/view semantics."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+RNG = np.random.RandomState(0)
+
+
+class TestSearchOps:
+    x = RNG.standard_normal((4, 6)).astype(np.float32)
+
+    def test_argmax_argmin_axes(self):
+        for ax in (None, 0, 1, -1):
+            np.testing.assert_array_equal(
+                paddle.argmax(_t(self.x), axis=ax).numpy(),
+                np.argmax(self.x, axis=ax))
+            np.testing.assert_array_equal(
+                paddle.argmin(_t(self.x), axis=ax).numpy(),
+                np.argmin(self.x, axis=ax))
+
+    def test_sort_argsort_descending_stable(self):
+        v = np.array([3.0, 1.0, 3.0, 2.0, 1.0], np.float32)
+        np.testing.assert_array_equal(paddle.sort(_t(v)).numpy(),
+                                      np.sort(v))
+        got = paddle.argsort(_t(v), descending=True).numpy()
+        want = torch.argsort(torch.tensor(v), descending=True,
+                             stable=True).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_topk_largest_and_smallest(self):
+        vals, idx = paddle.topk(_t(self.x), k=3, axis=1)
+        tv, ti = torch.topk(torch.tensor(self.x), 3, dim=1)
+        np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), ti.numpy())
+        vals, idx = paddle.topk(_t(self.x), k=2, largest=False)
+        tv, ti = torch.topk(torch.tensor(self.x), 2, largest=False)
+        np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+
+    def test_topk_smallest_unsigned_ints(self):
+        v = np.array([5, 250, 1, 128], np.uint8)
+        vals, _ = paddle.topk(_t(v), k=2, largest=False)
+        np.testing.assert_array_equal(np.sort(vals.numpy()), [1, 5])
+
+    def test_where_nonzero_masked(self):
+        m = self.x > 0
+        np.testing.assert_array_equal(
+            paddle.where(_t(m), _t(self.x), _t(-self.x)).numpy(),
+            np.where(m, self.x, -self.x))
+        np.testing.assert_array_equal(
+            paddle.masked_select(_t(self.x), _t(m)).numpy(), self.x[m])
+        nz = paddle.nonzero(_t(m)).numpy()
+        np.testing.assert_array_equal(nz, np.argwhere(m))
+
+    def test_unique_and_counts(self):
+        v = np.array([3, 1, 2, 3, 1, 3])
+        out = paddle.unique(_t(v))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+    def test_searchsorted_kthvalue_mode(self):
+        s = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+        q = np.array([0.0, 3.0, 8.0], np.float32)
+        np.testing.assert_array_equal(
+            paddle.searchsorted(_t(s), _t(q)).numpy(),
+            np.searchsorted(s, q))
+        v = np.array([[4.0, 2.0, 9.0, 1.0]], np.float32)
+        val, idx = paddle.kthvalue(_t(v), k=2, axis=1)
+        tv, ti = torch.kthvalue(torch.tensor(v), 2, dim=1)
+        assert val.numpy()[0] == tv.numpy()[0]
+        m = np.array([[1, 2, 2, 3, 3, 3]])
+        mv, _ = paddle.mode(_t(m))
+        assert mv.numpy()[0] == 3
+
+    def test_isin(self):
+        a = np.array([1, 2, 3, 4])
+        test = np.array([2, 4])
+        np.testing.assert_array_equal(
+            paddle.isin(_t(a), _t(test)).numpy(), np.isin(a, test))
+
+
+class TestLinalgOps:
+    a = RNG.standard_normal((3, 3)).astype(np.float32)
+    spd = (a @ a.T + 3 * np.eye(3)).astype(np.float32)
+    b = RNG.standard_normal((3, 2)).astype(np.float32)
+
+    def test_cholesky_solve_inv(self):
+        np.testing.assert_allclose(
+            paddle.linalg.cholesky(_t(self.spd)).numpy(),
+            np.linalg.cholesky(self.spd), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(_t(self.spd), _t(self.b)).numpy(),
+            np.linalg.solve(self.spd, self.b), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(_t(self.spd)).numpy(),
+            np.linalg.inv(self.spd), rtol=1e-3, atol=1e-4)
+
+    def test_svd_qr_reconstruct(self):
+        u, s, vh = paddle.linalg.svd(_t(self.a), full_matrices=False)
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ vh.numpy(), self.a,
+            rtol=1e-3, atol=1e-4)
+        q, r = paddle.linalg.qr(_t(self.a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), self.a,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_norms(self):
+        # paddle semantics: p-norms with axis=None flatten the input
+        np.testing.assert_allclose(
+            paddle.linalg.norm(_t(self.a), p='fro').numpy(),
+            np.linalg.norm(self.a, ord='fro'), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.norm(_t(self.a), p=1).numpy(),
+            np.abs(self.a).sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.norm(_t(self.a), p=np.inf).numpy(),
+            np.abs(self.a).max(), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.norm(_t(self.a), p=2, axis=1).numpy(),
+            np.linalg.norm(self.a, axis=1), rtol=1e-5)
+
+    def test_matrix_power_einsum_kron(self):
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_power(_t(self.a), 3).numpy(),
+            np.linalg.matrix_power(self.a, 3), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.einsum('ij,jk->ik', _t(self.a), _t(self.b)).numpy(),
+            self.a @ self.b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.kron(_t(np.eye(2, dtype=np.float32)), _t(self.a)).numpy(),
+            np.kron(np.eye(2, dtype=np.float32), self.a), rtol=1e-6)
+
+    def test_cross_dist_mv(self):
+        u = np.array([1.0, 0, 0], np.float32)
+        v = np.array([0, 1.0, 0], np.float32)
+        np.testing.assert_array_equal(
+            paddle.cross(_t(u), _t(v)).numpy(), np.cross(u, v))
+        np.testing.assert_allclose(
+            paddle.dist(_t(u), _t(v), p=2).numpy(), np.sqrt(2),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.mv(_t(self.a), _t(u)).numpy(), self.a @ u, rtol=1e-6)
+
+
+class TestIndexing:
+    x = RNG.standard_normal((5, 7)).astype(np.float32)
+
+    def test_basic_slicing_parity(self):
+        t = _t(self.x)
+        for sl in (np.s_[1:4], np.s_[:, 2:5], np.s_[::2, ::-1],
+                   np.s_[-1], np.s_[..., 0]):
+            np.testing.assert_array_equal(t[sl].numpy(), self.x[sl])
+
+    def test_integer_array_and_bool_indexing(self):
+        t = _t(self.x)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_array_equal(t[_t(idx)].numpy(), self.x[idx])
+        m = self.x > 0.5
+        np.testing.assert_array_equal(t[_t(m)].numpy(), self.x[m])
+
+    def test_gather_take_put_along_axis(self):
+        idx = np.array([[0, 1], [2, 0], [1, 1], [0, 0], [2, 2]])
+        np.testing.assert_array_equal(
+            paddle.take_along_axis(_t(self.x), _t(idx), axis=1).numpy(),
+            np.take_along_axis(self.x, idx, axis=1))
+        vals = np.zeros_like(idx, dtype=np.float32)
+        got = paddle.put_along_axis(_t(self.x), _t(idx), _t(vals),
+                                    axis=1).numpy()
+        want = self.x.copy()
+        np.put_along_axis(want, idx, vals, axis=1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_setitem_grad_and_value(self):
+        t = _t(self.x.copy())
+        t[1:3] = 0.0
+        want = self.x.copy()
+        want[1:3] = 0
+        np.testing.assert_array_equal(t.numpy(), want)
+
+    def test_index_select_index_add(self):
+        idx = np.array([2, 0])
+        np.testing.assert_array_equal(
+            paddle.index_select(_t(self.x), _t(idx), axis=0).numpy(),
+            self.x[idx])
+
+
+class TestDtypePromotion:
+    def test_int_float_promote(self):
+        a = _t(np.array([1, 2], np.int32))
+        b = _t(np.array([0.5, 0.5], np.float32))
+        out = a + b
+        assert 'float32' in str(out.dtype)
+        np.testing.assert_allclose(out.numpy(), [1.5, 2.5])
+
+    def test_python_scalar_keeps_dtype(self):
+        a = _t(np.array([1.0], np.float32))
+        assert 'float32' in str((a + 1).dtype)
+        assert 'float32' in str((a * 2.5).dtype)
+        i = _t(np.array([1], np.int64))
+        # jax without x64 stores int64 as int32; either is integer-stable
+        assert 'int' in str((i + 1).dtype)
+
+    def test_bool_arithmetic(self):
+        m = _t(np.array([True, False]))
+        s = m.astype('int32').sum()
+        assert int(s.numpy()) == 1
+
+    def test_comparison_returns_bool(self):
+        a = _t(np.array([1.0, 2.0], np.float32))
+        assert 'bool' in str((a > 1.5).dtype)
+
+
+class TestInplaceAndViews:
+    def test_inplace_updates_visible_through_refs(self):
+        x = _t(np.zeros(3, np.float32))
+        y = x  # same Tensor object
+        x.add_(_t(np.ones(3, np.float32)))
+        np.testing.assert_array_equal(y.numpy(), [1, 1, 1])
+
+    def test_views_are_functional_copies(self):
+        """Pinned semantics: reshape produces an independent functional
+        array — later in-place writes to the base do NOT propagate
+        (diverges from the reference's aliasing views; documented)."""
+        x = _t(np.zeros(4, np.float32))
+        v = x.reshape([2, 2])
+        x.add_(_t(np.ones(4, np.float32)))
+        np.testing.assert_array_equal(v.numpy(), np.zeros((2, 2)))
+
+    def test_inplace_on_leaf_under_no_grad_then_train(self):
+        w = _t(np.ones(3, np.float32))
+        w.stop_gradient = False
+        loss = (w * w).sum()
+        loss.backward()
+        g1 = w.grad.numpy().copy()
+        with paddle.no_grad():
+            w -= 0.1 * w.grad
+        w.clear_grad()
+        loss = (w * w).sum()
+        loss.backward()
+        np.testing.assert_allclose(w.grad.numpy(), 2 * w.numpy(),
+                                   rtol=1e-6)
+        assert not np.allclose(g1, w.grad.numpy())
+
+    def test_fill_and_zero_(self):
+        x = _t(np.ones((2, 2), np.float32))
+        x.fill_(5.0)
+        np.testing.assert_array_equal(x.numpy(), np.full((2, 2), 5.0))
+
+
+class TestMethodResolution:
+    def test_all_listed_methods_attached(self):
+        from paddle_tpu.ops import _METHOD_NAMES
+        t = paddle.ones([2, 2])
+        for name in _METHOD_NAMES:
+            assert hasattr(t, name), name
+
+    def test_one_hot(self):
+        out = paddle.one_hot(_t(np.array([0, 2])), num_classes=3)
+        np.testing.assert_array_equal(out.numpy(),
+                                      [[1, 0, 0], [0, 0, 1]])
