@@ -33,6 +33,22 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 # library-kernel dispatch (differentiable train path)
 # ---------------------------------------------------------------------------
 
+def _fa_block_sizes(sq, sk):
+    """Tuned block sizes: 512 everywhere measured 2.3x faster than the
+    library defaults for fwd+bwd on v5e (25.9ms -> 11.1ms at
+    [4,16,2048,128]); fall back to defaults when seq doesn't divide."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+    bq = min(512, sq)
+    bk = min(512, sk)
+    if sq % bq or sk % bk:
+        return None
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk,
+        block_q_dq=bq)
+
+
 def flash_attention(q, k, v, causal=False):
     """[B, S, H, D] flash attention via the jax pallas TPU kernel.
 
@@ -50,7 +66,8 @@ def flash_attention(q, k, v, causal=False):
     # library layout is [B, H, S, D]
     out = _fa(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
               v.transpose(0, 2, 1, 3), causal=causal,
-              sm_scale=1.0 / math.sqrt(d))
+              sm_scale=1.0 / math.sqrt(d),
+              block_sizes=_fa_block_sizes(sq, k.shape[1]))
     return out.transpose(0, 2, 1, 3)
 
 
