@@ -1,11 +1,19 @@
-"""Single-chip headline benchmark: Llama-flavored decoder pretraining
-step — tokens/sec + MFU on the available chip (SURVEY.md §6).
+"""Single-chip headline benchmark: GPT-3-1.3B-class decoder pretraining
+step — tokens/sec + MFU on the available chip (SURVEY.md §6,
+BASELINE.json configs[2]).
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": tokens/sec, "unit": "tokens/s",
    "vs_baseline": MFU / 0.40, ...}
 vs_baseline normalizes against the reference's A100-class MFU bar
 (BASELINE.json: ">= A100 MFU (~40%)" on matmul-dominant decoders).
+
+The headline model is the GPT-3 XL shape (h=2048, L=24, 16 heads x 128,
+seq 2048, ~1.3B params) built on the Llama block (RMSNorm/SwiGLU/RoPE —
+the TPU-native decoder this framework optimizes); `use_recompute='dots'`
+plus bf16 Adam moments are what fit params+optimizer+activations into a
+single v5e's 16 GB HBM. Falls back to the round-2 740M config (and
+reports so) if the 1.3B step OOMs on smaller chips.
 """
 from __future__ import annotations
 
@@ -33,62 +41,72 @@ def _peak_flops(device) -> float:
     return 197e12  # assume v5e-class if unrecognized
 
 
-def main():
+def _configs(on_tpu):
+    from paddle_tpu.nlp import LlamaConfig
+    if not on_tpu:
+        return [('llama_tiny', LlamaConfig.tiny(), 2, 64, 3, 1, 'float32')]
+    # full-block recompute, not 'dots': at 24 layers x batch 8 x seq 2048
+    # the dots policy's saved matmul outputs alone (~10 GB) blow the 16 GB
+    # HBM; full remat keeps only block inputs (~1.6 GB) and re-runs each
+    # block's forward inside backward — the classic memory/FLOPs trade
+    gpt3_xl = LlamaConfig(
+        vocab_size=50304, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=24, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=4096,
+        use_recompute=True)
+    m740 = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=12, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=4096)
+    return [
+        ('gpt3_1p3b', gpt3_xl, 8, 2048, 10, 2, 'bfloat16'),
+        ('gpt3_1p3b', gpt3_xl, 4, 2048, 10, 2, 'bfloat16'),
+        ('llama_740m', m740, 4, 2048, 10, 2, 'bfloat16'),
+    ]
+
+
+def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
     import jax
-    import jax.numpy as jnp
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu.jit import TrainStep
-    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
-
-    on_tpu = jax.default_backend() not in ('cpu',)
-    # ~740M-param decoder in bf16 on a real chip; thumbnail on CPU CI.
-    # h=2048 / head_dim=128 keeps every matmul MXU-shaped; batch chosen to
-    # fill HBM with the fused-CE loss (no fp32 logits copy) and the pallas
-    # flash-attention path (no [B,H,S,S] materialization).
-    if on_tpu:
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
-            num_hidden_layers=12, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=4096)
-        batch, seq, steps, warmup = 4, 2048, 10, 2
-        dtype = 'bfloat16'
-    else:
-        cfg = LlamaConfig.tiny()
-        batch, seq, steps, warmup = 2, 64, 3, 1
-        dtype = 'float32'
+    from paddle_tpu.nlp import LlamaForCausalLM
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     if dtype == 'bfloat16':
         model.bfloat16()
+    big = sum(int(np.prod(p.shape)) for p in model.parameters()) > 1e9
     opt = paddle.optimizer.AdamW(
         learning_rate=3e-4, parameters=model.parameters(),
-        multi_precision=(dtype == 'bfloat16'))
+        multi_precision=(dtype == 'bfloat16' and not big),
+        # >1B params: bf16 moments are the difference between fitting a
+        # single 16GB chip and OOM (fp32 m+v alone would be 10.7 GB)
+        moment_dtype=('bfloat16' if big else None))
 
     def loss_fn(logits, labels):
-        # fused CE path: fp32 math without materializing fp32 logits
         return F.cross_entropy(
             logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1]))
 
     step = TrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq))
+    batches = [rng.randint(0, cfg.vocab_size, (batch, seq))
+               for _ in range(4)]  # rotate data: no single-batch cache luck
 
-    for _ in range(warmup):
-        loss = step(ids, ids)
+    for i in range(warmup):
+        loss = step(batches[i % 4], batches[i % 4])
     float(loss.numpy())  # sync
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(ids, ids)
+    for i in range(steps):
+        loss = step(batches[i % 4], batches[i % 4])
     final_loss = float(loss.numpy())  # sync on the last step
     dt = (time.perf_counter() - t0) / steps
 
-    tokens_per_sec = batch * seq / dt
-
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     # model FLOPs: 3x forward (fwd + 2x bwd); fwd = 2*N_matmul*B*S weight
-    # matmuls + 4*B*S^2*H attention matmuls per layer
+    # matmuls + 4*B*S^2*H attention matmuls per layer (remat recompute
+    # FLOPs deliberately NOT counted — MFU measures model math only)
     h, L = cfg.hidden_size, cfg.num_hidden_layers
     qkvo = h * (cfg.num_attention_heads * cfg.head_dim) * 2 \
         + h * (cfg.num_key_value_heads * cfg.head_dim) * 2
@@ -98,20 +116,90 @@ def main():
                  + L * 4 * batch * seq * seq * h)
     step_flops = 3 * fwd_flops
     mfu = step_flops / dt / _peak_flops(jax.devices()[0])
+    return {
+        'tokens_per_sec': batch * seq / dt,
+        'mfu': mfu,
+        'step_time_s': dt,
+        'loss': final_loss,
+        'params_m': round(n_params / 1e6, 1),
+        'batch': batch, 'seq': seq, 'dtype': dtype,
+    }
 
-    print(json.dumps({
-        'metric': 'llama_740m_pretrain_tokens_per_sec_per_chip',
-        'value': round(tokens_per_sec, 1),
+
+def _bench_flash_kernels():
+    """Own pallas flash (fwd+bwd) vs jax library kernel, one fwd+bwd each
+    (VERDICT r2 #8: measured justification for the kernel choice)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+    rng = np.random.RandomState(0)
+    shape = (4, 2048, 16, 128)
+    q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+
+    def time_fn(f):
+        g = jax.jit(jax.grad(lambda a, b, c: jnp.sum(
+            f(a, b, c).astype(jnp.float32)), argnums=(0, 1, 2)))
+        r = g(q, k, v)  # compile + warm
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            r = g(q, k, v)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / 5 * 1e3
+
+    try:
+        own_ms = time_fn(lambda a, b, c: pk.flash_attention_own(
+            a, b, c, True, 512, 512, False))
+        lib_ms = time_fn(lambda a, b, c: pk.flash_attention(a, b, c,
+                                                            causal=True))
+        return {'flash_own_ms': round(own_ms, 2),
+                'flash_lib_ms': round(lib_ms, 2)}
+    except Exception as e:  # never let the micro-bench kill the headline
+        return {'flash_bench_error': type(e).__name__}
+
+
+def main():
+    import jax
+    on_tpu = jax.default_backend() not in ('cpu',)
+    result = None
+    for name, cfg, batch, seq, steps, warmup, dtype in _configs(on_tpu):
+        try:
+            result = _run_config(name, cfg, batch, seq, steps, warmup, dtype)
+            metric_name = name
+            break
+        except Exception as e:
+            msg = str(e).lower()
+            if 'resource' in msg or 'memory' in msg or 'oom' in msg \
+                    or 'allocat' in msg or 'compile' in msg:
+                # OOM (or a compiler blow-up on the big config): try the
+                # next, smaller config and say so in the output
+                continue
+            raise
+    if result is None:
+        raise RuntimeError('all bench configs failed')
+    # only a different MODEL counts as a fallback (batch shrink within the
+    # 1.3B config still benches the 1.3B headline)
+    fell_back = on_tpu and metric_name != 'gpt3_1p3b'
+
+    out = {
+        'metric': f'{metric_name}_pretrain_tokens_per_sec_per_chip',
+        'value': round(result['tokens_per_sec'], 1),
         'unit': 'tokens/s',
-        'vs_baseline': round(mfu / 0.40, 4),
-        'mfu': round(mfu, 4),
-        'step_time_s': round(dt, 4),
-        'loss': round(final_loss, 4),
+        'vs_baseline': round(result['mfu'] / 0.40, 4),
+        'mfu': round(result['mfu'], 4),
+        'step_time_s': round(result['step_time_s'], 4),
+        'loss': round(result['loss'], 4),
         'device': str(jax.devices()[0].device_kind),
-        'config': {'params_m': round(sum(
-            int(np.prod(p.shape)) for p in model.parameters()) / 1e6, 1),
-            'batch': batch, 'seq': seq, 'dtype': dtype},
-    }))
+        'fell_back_from_1p3b': fell_back,
+        'config': {'params_m': result['params_m'],
+                   'batch': result['batch'], 'seq': result['seq'],
+                   'dtype': result['dtype']},
+    }
+    if on_tpu:
+        out.update(_bench_flash_kernels())
+    print(json.dumps(out))
 
 
 if __name__ == '__main__':

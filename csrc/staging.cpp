@@ -139,13 +139,22 @@ enum JobKind : int {
   JOB_F32_SCALE = 2,    // dst_f32[i] = (src_f32[i] - shift) * scale
 };
 
+// a ticket is a counter + condvar the submitter blocks on (a bare atomic
+// would force pool_ticket_wait to busy-spin, pinning a host core for the
+// whole batch assembly and competing with the decoder threads)
+struct Ticket {
+  std::mutex mu;
+  std::condition_variable cv;
+  int count = 0;
+};
+
 struct Job {
   int kind;
   const uint8_t* src;
   uint8_t* dst;
   size_t n;            // element count
   float scale, shift;
-  std::atomic<int>* done_flag;
+  Ticket* done_flag;
 };
 
 struct Pool {
@@ -175,7 +184,14 @@ static void run_job(const Job& j) {
       break;
     }
   }
-  if (j.done_flag) j.done_flag->fetch_add(1, std::memory_order_release);
+  if (j.done_flag) {
+    // notify while still holding the mutex: the waiter may destroy the
+    // ticket the moment its predicate is satisfied, so an unlocked
+    // notify_all could touch freed memory
+    std::lock_guard<std::mutex> lk(j.done_flag->mu);
+    ++j.done_flag->count;
+    j.done_flag->cv.notify_all();
+  }
 }
 
 void* pool_create(int n_threads) {
@@ -198,20 +214,21 @@ void* pool_create(int n_threads) {
   return p;
 }
 
-// a ticket is a heap-allocated atomic counter the caller polls/waits on
-void* pool_ticket_create() { return new std::atomic<int>(0); }
+void* pool_ticket_create() { return new Ticket(); }
 int pool_ticket_count(void* t) {
-  return static_cast<std::atomic<int>*>(t)->load(std::memory_order_acquire);
+  auto* tk = static_cast<Ticket*>(t);
+  std::lock_guard<std::mutex> lk(tk->mu);
+  return tk->count;
 }
 void pool_ticket_destroy(void* t) {
-  delete static_cast<std::atomic<int>*>(t);
+  delete static_cast<Ticket*>(t);
 }
 
 void pool_submit(void* h, int kind, const void* src, void* dst, size_t n,
                  float scale, float shift, void* ticket) {
   auto* p = static_cast<Pool*>(h);
   Job j{kind, static_cast<const uint8_t*>(src), static_cast<uint8_t*>(dst),
-        n, scale, shift, static_cast<std::atomic<int>*>(ticket)};
+        n, scale, shift, static_cast<Ticket*>(ticket)};
   {
     std::lock_guard<std::mutex> lk(p->mu);
     p->q.push_back(j);
@@ -221,9 +238,9 @@ void pool_submit(void* h, int kind, const void* src, void* dst, size_t n,
 
 // block (in C++, GIL released by ctypes) until `count` jobs completed
 void pool_ticket_wait(void* t, int count) {
-  auto* a = static_cast<std::atomic<int>*>(t);
-  while (a->load(std::memory_order_acquire) < count)
-    std::this_thread::yield();
+  auto* tk = static_cast<Ticket*>(t);
+  std::unique_lock<std::mutex> lk(tk->mu);
+  tk->cv.wait(lk, [&] { return tk->count >= count; });
 }
 
 void pool_destroy(void* h) {

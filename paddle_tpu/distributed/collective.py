@@ -154,9 +154,11 @@ def _coll_fn(kind, axis_name, ndim, mesh, extra=None):
         src = extra
 
         def body(x):
-            # one-to-all is not a permutation; gather then take src's slice
-            g = lax.all_gather(x, axis_name, tiled=True)
-            return lax.dynamic_slice_in_dim(g, src, 1, 0)
+            # one-to-all as a masked all-reduce: O(1) per-device memory
+            # (an all_gather+slice would be O(world) — wrong at pod scale)
+            r = lax.axis_index(axis_name)
+            return lax.psum(jnp.where(r == src, x, jnp.zeros_like(x)),
+                            axis_name)
     elif kind == 'alltoall':
         def body(x):
             # received chunks line up on the same dim => grid transpose
@@ -313,16 +315,22 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def _match_send(tensor):
-    """Find the pending send for this recv: same Tensor object first
-    (the rank-stacked array is shared), then same shape."""
+    """Find the pending send for this recv: same Tensor object first (the
+    rank-stacked array is shared). A shape-based fallback is accepted ONLY
+    when it is unambiguous — two in-flight sends of the same shape raise
+    rather than silently mispair."""
     for i, (t, dst, g) in enumerate(_pending_sends):
         if t is tensor:
             return i
     shape = tuple(np.shape(_val(tensor)))
-    for i, (t, dst, g) in enumerate(_pending_sends):
-        if tuple(np.shape(_val(t))) == shape:
-            return i
-    return None
+    hits = [i for i, (t, dst, g) in enumerate(_pending_sends)
+            if tuple(np.shape(_val(t))) == shape]
+    if len(hits) > 1:
+        raise RuntimeError(
+            f'recv() matches {len(hits)} pending send()s of shape {shape}; '
+            'pairing by shape would be ambiguous — recv on the same stacked '
+            'Tensor object that was sent, or drain sends in order')
+    return hits[0] if hits else None
 
 
 def recv(tensor, src=0, group=None, sync_op=True):

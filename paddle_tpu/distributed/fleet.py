@@ -8,8 +8,14 @@ TPU-native design: `fleet.init(strategy)` builds ONE
 mesh's named axes. `distributed_model` places parameters per their
 PartitionSpec (TP layers pre-mark theirs; everything else replicates).
 `distributed_optimizer` + `DistTrainStep` shard optimizer state over 'dp'
-(ZeRO-1) and jit the whole step so GSPMD emits grad all-reduces (dp),
-weight all-gathers (mp), and pipeline permutes (pp) over ICI.
+(ZeRO-1/2/3 per `strategy.sharding_configs['stage']`) and jit the whole
+step so GSPMD emits grad all-reduces / reduce-scatters (dp) and weight
+all-gathers (mp) over ICI. When `pp_degree > 1` the step routes the
+model's uniform decoder blocks (the `pp_blocks()` protocol) through the
+`pipeline.gpipe` collective schedule — microbatched ppermute handoff on
+the 'pp' axis — with embed/head outside the pipelined region.
+`strategy.recompute / amp / gradient_merge` are honored inside the step
+(jax.checkpoint, auto_cast policy, microbatch grad accumulation).
 """
 from __future__ import annotations
 
@@ -29,6 +35,7 @@ from . import env
 from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                               RowParallelLinear, VocabParallelEmbedding,
                               get_sharding, shard_batch)
+from .pipeline import gpipe
 
 _tree = jax.tree_util
 
@@ -189,8 +196,13 @@ def distributed_model(layer: Layer):
 
 
 def _zero_spec(shape, base: P, dp_size: int, axis='dp') -> P:
-    """ZeRO-1: extend a param's spec by sharding one more dim over dp."""
+    """ZeRO: extend a param's spec by sharding one more dim over dp."""
     if dp_size <= 1 or not shape:
+        return base
+    used = set()
+    for a in base:
+        used.update(a if isinstance(a, tuple) else (a,))
+    if axis in used:  # already dp-sharded (e.g. a stage-3 param spec)
         return base
     spec = list(base) + [None] * (len(shape) - len(base))
     for i, s in enumerate(shape):
@@ -246,13 +258,30 @@ def distributed_optimizer(optimizer, strategy=None):
                                 or DistributedStrategy())
 
 
+def _split_block_params(d: Dict[str, Any], prefix: str, n_blocks: int):
+    """Split a flat {name: leaf} dict into (outer, per-block list of
+    {suffix: leaf}) around `prefix.<i>.suffix` names."""
+    pre = prefix + '.'
+    outer: Dict[str, Any] = {}
+    blocks = [dict() for _ in range(n_blocks)]
+    for name, v in d.items():
+        if name.startswith(pre):
+            idx, suffix = name[len(pre):].split('.', 1)
+            blocks[int(idx)][suffix] = v
+        else:
+            outer[name] = v
+    return outer, blocks
+
+
 class DistTrainStep:
     """The hybrid-parallel jitted train step (upstream analogue: the
-    HybridParallelOptimizer step inside a to_static program).
+    HybridParallelOptimizer step inside a to_static program; for
+    pp_degree>1 it subsumes meta_parallel/pipeline_parallel.py's
+    micro-batched 1F1B schedule via `pipeline.gpipe`).
 
-    params live sharded per TP specs; opt state per ZeRO specs; the batch
-    arrives dp-sharded on dim 0. One jax.jit with donation — GSPMD
-    inserts all collectives.
+    params live sharded per TP specs (dp-extended under ZeRO-3); opt
+    state per ZeRO specs; the batch arrives dp-sharded on dim 0. One
+    jax.jit with donation — GSPMD inserts all collectives.
     """
 
     def __init__(self, layer: Layer, loss_fn, optimizer,
@@ -265,32 +294,164 @@ class DistTrainStep:
         self.mesh = env.get_mesh()
         self._opt_state = None
         self._n_calls = 0
-        self._param_specs = {
-            n: param_spec(p) for n, p in layer.named_parameters()
-            if not p.stop_gradient}
+        st = self.strategy
+        dp = self.mesh.shape.get('dp', 1)
+        self._dp = dp
 
-        def step_fn(params, opt_state, buffers, frozen, key, lr, batch):
-            def loss_of(pv):
-                inputs, labels = batch
-                from .. import autograd
-                out, new_bufs = functional_call(
-                    self.layer, pv, frozen, buffers,
-                    inputs if isinstance(inputs, tuple) else (inputs,), {},
-                    rng_key=key)
+        # ---- ZeRO stage (sharding knob) --------------------------------
+        self._zero_stage = 0
+        if st.sharding or st.hybrid_configs.get('sharding_degree', 1) > 1:
+            self._zero_stage = int(st.sharding_configs.get('stage', 1))
+            if self._zero_stage not in (1, 2, 3):
+                raise ValueError(
+                    f'sharding_configs["stage"] must be 1/2/3, got '
+                    f'{self._zero_stage}')
+
+        pmap = dict(layer.named_parameters())
+        self._param_specs = {}
+        for n, p in pmap.items():
+            if p.stop_gradient:
+                continue
+            spec = param_spec(p)
+            if self._zero_stage >= 3:
+                # ZeRO-3: params stored dp-sharded; GSPMD all-gathers on
+                # use and reduce-scatters the grads back.
+                spec = _zero_spec(p._data.shape, spec, dp)
+                p._data = jax.device_put(
+                    p._data, NamedSharding(self.mesh, spec))
+            self._param_specs[n] = spec
+        self._grad_specs = {
+            n: _zero_spec(pmap[n]._data.shape, s, dp)
+            for n, s in self._param_specs.items()} \
+            if self._zero_stage >= 2 else {}
+
+        # ---- pipeline parallel (pp knob) -------------------------------
+        pp_degree = int(st.hybrid_configs.get('pp_degree', 1))
+        self._use_pp = pp_degree > 1 or st.pipeline
+        if self._use_pp:
+            if not hasattr(layer, 'pp_blocks'):
+                raise ValueError(
+                    'pipeline parallelism needs the model to expose '
+                    'pp_blocks() (uniform decoder blocks); '
+                    f'{type(layer).__name__} does not')
+            self._pp_prefix, blocks = layer.pp_blocks()
+            self._pp_template = blocks[0]
+            self._pp_L = len(blocks)
+            n_stage = max(pp_degree, 1)
+            if self._pp_L % n_stage:
+                raise ValueError(
+                    f'{self._pp_L} blocks not divisible by pp_degree '
+                    f'{n_stage}')
+            self._pp_nstage = n_stage
+            self._pp_per = self._pp_L // n_stage
+            self._pp_nmicro = max(
+                int(st.pipeline_configs.get('accumulate_steps', 1)), 1)
+            pre = self._pp_prefix + '.'
+            if any(n.startswith(pre) for n, _ in layer.named_buffers()):
+                raise ValueError('pipelined blocks must be buffer-free '
+                                 '(stateful layers like BatchNorm cannot '
+                                 'ride the pp scan)')
+
+        # ---- recompute knob --------------------------------------------
+        self._recompute_whole = False
+        if st.recompute:
+            gran = st.recompute_configs.get('granularity', 'full')
+            cfg = getattr(layer, 'config', None)
+            if cfg is not None and hasattr(cfg, 'use_recompute'):
+                cfg.use_recompute = 'dots' if gran == 'dots' else True
+            else:
+                self._recompute_whole = True  # jax.checkpoint whole fwd
+
+        # ---- amp knob ---------------------------------------------------
+        self._amp_cfg = None
+        if st.amp:
+            self._amp_cfg = (st.amp_configs.get('level', 'O1'),
+                             st.amp_configs.get('dtype', 'bfloat16'))
+
+        # ---- gradient merge knob ----------------------------------------
+        self._gm_k = int(st.gradient_merge_configs.get('k_steps', 1)) \
+            if st.gradient_merge else 1
+
+        def loss_of(pv, batch, frozen, buffers, key):
+            import contextlib
+            from .. import autograd
+            inputs, labels = batch
+            args = inputs if isinstance(inputs, tuple) else (inputs,)
+            amp_ctx = contextlib.nullcontext()
+            if self._amp_cfg is not None:
+                from .. import amp as amp_mod
+                amp_ctx = amp_mod.auto_cast(True, level=self._amp_cfg[0],
+                                            dtype=self._amp_cfg[1])
+            with amp_ctx:
+                if self._use_pp:
+                    out, new_bufs = self._pp_forward(
+                        pv, frozen, buffers, args, key)
+                else:
+                    call = functools.partial(
+                        functional_call, self.layer, frozen=frozen,
+                        buffers=buffers, args=args, kwargs={}, rng_key=key)
+                    if self._recompute_whole:
+                        out, new_bufs = jax.checkpoint(
+                            lambda p: call(p))(pv)
+                    else:
+                        out, new_bufs = call(pv)
                 with autograd.functional_scope():
                     wrapped_out = _tree.tree_map(Tensor, out)
                     wrapped_lab = _tree.tree_map(
                         lambda v: Tensor(v) if not isinstance(v, Tensor)
                         else v, labels)
                     loss_t = self.loss_fn(wrapped_out, wrapped_lab)
-                loss_v = loss_t.value if isinstance(loss_t, Tensor) \
-                    else loss_t
-                return loss_v, new_bufs
-            (loss, new_bufs), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
+            loss_v = loss_t.value if isinstance(loss_t, Tensor) else loss_t
+            return loss_v.astype(jnp.float32), new_bufs
+
+        def step_fn(params, opt_state, buffers, frozen, key, lr, batch):
+            k = self._gm_k
+            if k > 1:
+                # gradient merge: scan k microbatches, average the grads,
+                # apply ONE optimizer update (== a k-times-larger batch
+                # for mean losses; upstream: GradientMergeOptimizer).
+                def resh(v):
+                    if v.shape[0] % k:
+                        raise ValueError(
+                            f'batch dim {v.shape[0]} not divisible by '
+                            f'gradient_merge k_steps={k}')
+                    return v.reshape((k, v.shape[0] // k) + v.shape[1:])
+                mb_batch = _tree.tree_map(resh, batch)
+
+                def body(carry, mb):
+                    loss_acc, grad_acc, i, bufs_c = carry
+                    mb_key = jax.random.fold_in(key, i)
+                    # thread buffers through the carry so running stats
+                    # (e.g. BatchNorm) advance per microbatch, matching
+                    # the sequential accumulation this knob emulates
+                    (l, bufs_c), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(
+                            params, mb, frozen, bufs_c, mb_key)
+                    grad_acc = _tree.tree_map(jnp.add, grad_acc, g)
+                    return (loss_acc + l, grad_acc, i + 1, bufs_c), None
+
+                zero_g = _tree.tree_map(jnp.zeros_like, params)
+                (loss_sum, grads, _, new_bufs), _ = jax.lax.scan(
+                    body, (jnp.float32(0.0), zero_g, jnp.int32(0), buffers),
+                    mb_batch)
+                loss = loss_sum / k
+                grads = _tree.tree_map(lambda g: g / k, grads)
+            else:
+                (loss, new_bufs), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params, batch, frozen, buffers,
+                                           key)
+            if self._zero_stage >= 2:
+                # ZeRO-2: reduce-scatter grads into their dp shard before
+                # the optimizer touches them (moments are already dp-
+                # sharded by stage 1's placement).
+                grads = {
+                    n: jax.lax.with_sharding_constraint(
+                        g, NamedSharding(self.mesh, self._grad_specs[n]))
+                    for n, g in grads.items()}
             new_params, new_opt = self.optimizer.apply_gradients(
                 grads, params, opt_state, lr)
-            # pin updated params back to their TP placement
+            # pin updated params back to their TP (stage-3: dp-extended)
+            # placement
             new_params = {
                 n: jax.lax.with_sharding_constraint(
                     v, NamedSharding(self.mesh, self._param_specs[n]))
@@ -299,12 +460,73 @@ class DistTrainStep:
 
         self._jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
+    def _pp_forward(self, pv, frozen, buffers, args, key):
+        """Forward with the decoder stack routed through the gpipe
+        collective schedule (upstream: PipelineParallel._forward_step
+        micro-batch loop + P2P send/recv; here ONE differentiable scan
+        whose reverse-mode replay is the 1F1B backward)."""
+        from jax import lax
+        prefix, L = self._pp_prefix, self._pp_L
+        n_stage, per = self._pp_nstage, self._pp_per
+        n_micro = self._pp_nmicro
+
+        outer_p, blocks_p = _split_block_params(pv, prefix, L)
+        f_outer, f_blocks = _split_block_params(frozen, prefix, L)
+
+        def stack(blocks):
+            if not blocks or not blocks[0]:
+                return {}
+            return _tree.tree_map(
+                lambda *xs: jnp.stack(xs).reshape(
+                    (n_stage, per) + xs[0].shape), *blocks)
+
+        stacked = stack(blocks_p)
+        f_stacked = stack(f_blocks)
+        keys = jax.random.split(key, L).reshape((n_stage, per) + key.shape)
+        template = self._pp_template
+
+        def blocks_fn(h):
+            B = h.shape[0]
+            if B % n_micro:
+                raise ValueError(
+                    f'batch {B} not divisible by pipeline '
+                    f'accumulate_steps={n_micro}')
+            if (B // n_micro) % self._dp:
+                raise ValueError(
+                    f'microbatch {B // n_micro} (batch {B} / '
+                    f'accumulate_steps {n_micro}) not divisible by '
+                    f'dp_degree {self._dp}')
+            mbs = h.reshape((n_micro, B // n_micro) + h.shape[1:])
+
+            def stage_fn(sp_tree, x):
+                ks, ps, fps = sp_tree
+
+                def body(hh, xs):
+                    kj, lp, flp = xs
+                    out, _ = functional_call(
+                        template, lp, flp, {}, (hh,), {}, rng_key=kj)
+                    return out, None
+
+                hh, _ = lax.scan(body, x, (ks, ps, fps))
+                return hh
+
+            y = gpipe(stage_fn, (keys, stacked, f_stacked), mbs,
+                      mesh=self.mesh,
+                      batch_axis='dp' if self._dp > 1 else None,
+                      schedule=self.strategy.pipeline_configs.get(
+                          'schedule_mode', '1F1B'),
+                      remat=True)
+            return y.reshape((B,) + y.shape[2:])
+
+        return functional_call(self.layer, outer_p, f_outer, buffers,
+                               args, {'blocks_fn': blocks_fn}, rng_key=key)
+
     def _init_opt_state(self, params):
         state = self.optimizer.init_state(params)
-        if self.strategy.sharding or \
-                self.strategy.hybrid_configs.get('sharding_degree', 1) > 1:
+        if self._zero_stage >= 1:
             state = shard_optimizer_state(state, self._param_specs,
-                                          self.mesh)
+                                          self.mesh,
+                                          stage=self._zero_stage)
         return state
 
     def __call__(self, inputs, labels):

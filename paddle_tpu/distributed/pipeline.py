@@ -46,14 +46,18 @@ def pipeline_spec(tree, axis='pp'):
 
 def gpipe(stage_fn: Callable, stacked_params, microbatches,
           axis: str = 'pp', mesh: Optional[Mesh] = None,
-          schedule: str = '1F1B', remat: bool = True):
+          schedule: str = '1F1B', remat: bool = True,
+          batch_axis: Optional[str] = None):
     """Run `y_mb = stage_pp-1 ∘ ... ∘ stage_0 (x_mb)` for every microbatch.
 
     stage_fn(stage_params, x) -> y with y.shape == x.shape (uniform
     blocks; embed/head run outside the pipelined region, as upstream's
     shape-static send/recv also requires).
 
-    microbatches: [n_micro, mb, ...] (replicated or dp-sharded on mb).
+    microbatches: [n_micro, mb, ...]. When `batch_axis` is given (e.g.
+    'dp'), the mb dim is sharded over that mesh axis inside the
+    shard_map, so pipeline (pp) and data (dp) parallelism compose: each
+    dp group runs the full pp ring on its 1/dp slice of every microbatch.
     Returns [n_micro, mb, ...] outputs of the final stage.
 
     `schedule` is accepted for upstream parity ('F-then-B'/'1F1B') but both
@@ -68,19 +72,22 @@ def gpipe(stage_fn: Callable, stacked_params, microbatches,
     n_micro = microbatches.shape[0]
     if n_pp == 1:
         sp = _tree.tree_map(lambda x: x[0], stacked_params)
-        return jax.vmap(lambda mb: stage_fn(sp, mb))(microbatches)
+        body1 = jax.checkpoint(stage_fn) if remat else stage_fn
+        return jax.vmap(lambda mb: body1(sp, mb))(microbatches)
 
     body = stage_fn
     if remat:
         body = jax.checkpoint(stage_fn)
 
     p_specs = pipeline_spec(stacked_params, axis)
-    x_spec = _tree.tree_map(lambda x: P(*([None] * jnp.ndim(x))),
-                            microbatches)
+    x_spec = _tree.tree_map(
+        lambda x: P(None, batch_axis, *([None] * (jnp.ndim(x) - 2))),
+        microbatches)
+    out_spec = P(axis, None, batch_axis)
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(p_specs, x_spec), out_specs=P(axis), check_vma=False)
+        in_specs=(p_specs, x_spec), out_specs=out_spec, check_vma=False)
     def run(local_params, x):
         sp = _tree.tree_map(lambda v: v[0], local_params)  # [1,...] -> [...]
         s = lax.axis_index(axis)
@@ -139,12 +146,16 @@ class PipelineLayer(Layer):
     """Stage-partitioned container (upstream: PipelineLayer).
 
     `layers` is a list of Layer/LayerDesc; they are segmented into
-    `num_stages` contiguous groups. On TPU the stages are not separate
-    processes: forward runs all segments in order, annotating the
-    boundary activations; the *scheduled* pipeline path is
-    `distributed.pipeline.gpipe` over the uniform middle blocks, which
-    models use directly in their jitted train step (see
-    nlp.transformers.gpt's pp path).
+    `num_stages` groups per `seg_method`. On TPU the stages are not
+    separate processes: forward runs all segments in order (optionally
+    rematerializing per `recompute_interval`); the *scheduled* pipeline
+    path is `fleet.DistTrainStep` with `pp_degree>1`, which routes a
+    model's uniform blocks (the `pp_blocks()` protocol) through
+    `distributed.pipeline.gpipe`.
+
+    seg_method: 'uniform' (equal contiguous groups) or 'layer:<Name>'
+    (stage boundaries at layers whose class name contains <Name>,
+    upstream's regex convention).
     """
 
     def __init__(self, layers, num_stages=None, topology=None,
@@ -161,19 +172,59 @@ class PipelineLayer(Layer):
                 if env.has_mesh() else 1
         self.num_stages = num_stages
         n = len(built)
-        per = max(1, n // num_stages)
-        self._segments = [list(range(i * per, min(n, (i + 1) * per)))
-                          for i in range(num_stages)]
-        if self._segments and self._segments[-1] and \
-                self._segments[-1][-1] < n - 1:
-            self._segments[-1].extend(range(self._segments[-1][-1] + 1, n))
+        if seg_method.startswith('layer:'):
+            name = seg_method[len('layer:'):]
+            marks = [i for i, l in enumerate(built)
+                     if name in type(l).__name__]
+            if len(marks) < num_stages:
+                raise ValueError(
+                    f'seg_method {seg_method!r} found {len(marks)} '
+                    f'boundary layers for {num_stages} stages')
+            # distribute the marked layers evenly; each stage starts at a
+            # marked layer (upstream: segment_layers with method "layer:")
+            per = len(marks) / num_stages
+            starts = [marks[int(i * per)] for i in range(num_stages)]
+            starts[0] = 0
+            self._segments = [
+                list(range(starts[i],
+                           starts[i + 1] if i + 1 < num_stages else n))
+                for i in range(num_stages)]
+        elif seg_method == 'uniform':
+            per = max(1, n // num_stages)
+            self._segments = [list(range(i * per, min(n, (i + 1) * per)))
+                              for i in range(num_stages)]
+            if self._segments and self._segments[-1] and \
+                    self._segments[-1][-1] < n - 1:
+                self._segments[-1].extend(
+                    range(self._segments[-1][-1] + 1, n))
+        else:
+            raise ValueError(f'unknown seg_method {seg_method!r}')
         self.loss_fn = loss_fn
-        self._recompute_interval = recompute_interval
+        self._recompute_interval = int(recompute_interval)
 
     def get_stage_layers(self, stage: int):
         return [self.run_list[i] for i in self._segments[stage]]
 
     def forward(self, x):
+        interval = self._recompute_interval
+        from .. import autograd as _ag
+        if interval > 0 and _ag._state.functional:
+            # under jit, rematerialize every `interval` layers (closed-over
+            # traced params are lifted and differentiated by jax.checkpoint;
+            # in eager-tape mode remat is a no-op, so plain loop below)
+            from ..tensor import Tensor
+            layers = list(self.run_list)
+            xv = x.value
+            for i in range(0, len(layers), interval):
+                chunk = layers[i:i + interval]
+
+                def run_chunk(hv, chunk=chunk):
+                    h = Tensor(hv)
+                    for l in chunk:
+                        h = l(h)
+                    return h.value
+                xv = jax.checkpoint(run_chunk)(xv)
+            return Tensor(xv)
         for i, layer in enumerate(self.run_list):
             x = layer(x)
         return x

@@ -313,28 +313,41 @@ class _NativeCollator:
         slot = self.staging.acquire()
         if slot < 0:
             return None
-        ticket = self.pool.ticket()
+        # try/finally: an exception between acquire() and release() would
+        # otherwise leak the slot permanently — after n_slots leaks every
+        # worker blocks forever inside staging_acquire with no watchdog.
+        try:
+            ticket = self.pool.ticket()
+        except Exception:
+            self.staging.release(slot)
+            raise
         njobs = 0
-        keepalive = []
-        for f in range(nfields):
-            base = self.staging.addr(slot, offsets[f])
-            for b, s in enumerate(samples):
-                arr = s[f]
-                keepalive.append(arr)
-                self.pool.submit_memcpy(
-                    arr.ctypes.data, base + b * sizes[f], arr.nbytes,
-                    ticket)
-                njobs += 1
-        self.pool.wait(ticket, njobs)
-        self.pool.ticket_free(ticket)
-        out = []
-        for f in range(nfields):
-            shape, dtype = metas[f]
-            view = self.staging.view(
-                slot, nbytes=int(np.prod(shape)) * dtype.itemsize,
-                dtype=dtype, shape=shape, offset=offsets[f])
-            out.append(Tensor(np.array(view)))  # device put copies; then free
-        self.staging.release(slot)
+        try:
+            keepalive = []
+            for f in range(nfields):
+                base = self.staging.addr(slot, offsets[f])
+                for b, s in enumerate(samples):
+                    arr = s[f]
+                    keepalive.append(arr)
+                    self.pool.submit_memcpy(
+                        arr.ctypes.data, base + b * sizes[f], arr.nbytes,
+                        ticket)
+                    njobs += 1
+            self.pool.wait(ticket, njobs)
+            out = []
+            for f in range(nfields):
+                shape, dtype = metas[f]
+                view = self.staging.view(
+                    slot, nbytes=int(np.prod(shape)) * dtype.itemsize,
+                    dtype=dtype, shape=shape, offset=offsets[f])
+                out.append(Tensor(np.array(view)))  # device put copies
+        finally:
+            # drain jobs already submitted BEFORE freeing the ticket or
+            # releasing the slot — C++ workers still hold pointers to both
+            # (freeing early would be a heap use-after-free / slot race)
+            self.pool.wait(ticket, njobs)
+            self.pool.ticket_free(ticket)
+            self.staging.release(slot)
         if structure == 'single':
             return out[0]
         return tuple(out)

@@ -70,6 +70,18 @@ def _bind(lib):
     return lib
 
 
+def _stale() -> bool:
+    """The .so must be rebuilt when staging.cpp is newer (a stale binary
+    loaded over a changed ABI via ctypes corrupts memory silently)."""
+    src = os.path.join(_CSRC, 'staging.cpp')
+    if not os.path.exists(_LIB_PATH):
+        return True
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+
+
 def get_lib():
     global _lib, _tried
     with _lock:
@@ -77,7 +89,7 @@ def get_lib():
             return _lib
         _tried = True
         try:
-            if not os.path.exists(_LIB_PATH):
+            if _stale():
                 _build()
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
         except Exception:

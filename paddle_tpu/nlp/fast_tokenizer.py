@@ -1,0 +1,156 @@
+"""C++-accelerated BPE tokenizer (upstream analogue: PaddleNLP
+faster_tokenizer / paddlenlp_ops fast tokenizers).
+
+`FastBPETokenizer` is a drop-in `BPETokenizer` whose `tokenize`/`encode`
+hot path (greedy merge loop) runs in csrc/fast_tokenizer.cpp via ctypes
+— no Python interpreter cost per merge. Falls back to the pure-python
+path transparently when no compiler is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from .tokenizer import BPETokenizer, _WORD_END
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), 'csrc')
+_BUILD = os.path.join(_CSRC, 'build')
+_LIB_PATH = os.path.join(_BUILD, 'libpaddle_tpu_fast_tokenizer.so')
+_SRC = os.path.join(_CSRC, 'fast_tokenizer.cpp')
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    os.makedirs(_BUILD, exist_ok=True)
+    tmp = _LIB_PATH + '.tmp.so'
+    subprocess.run(
+        ['g++', '-O3', '-fPIC', '-shared', '-std=c++17', _SRC, '-o', tmp],
+        check=True, capture_output=True)
+    os.replace(tmp, _LIB_PATH)
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    try:
+        return os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+
+
+def _bind(lib):
+    lib.bpe_create.restype = ctypes.c_void_p
+    lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+    lib.bpe_set_unk.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.bpe_add_token.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+    lib.bpe_add_merge.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_int]
+    lib.bpe_encode.restype = ctypes.c_int
+    lib.bpe_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    return lib
+
+
+def get_lib():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if _stale():
+                _build()
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class FastBPETokenizer(BPETokenizer):
+    """BPETokenizer with the merge loop in C++. The python data model
+    (vocab dict, merges list, save/load) is unchanged; only encode's hot
+    path moves to native code."""
+
+    def __init__(self, vocab=None, merges=None):
+        super().__init__(vocab, merges)
+        self._native = None
+        self._native_dirty = True
+
+    # any mutation of vocab/merges (training, load) re-syncs the C++ side
+    def _load_extra_state(self, state):
+        super()._load_extra_state(state)
+        # from_pretrained builds via __new__ (no __init__): create the
+        # native-handle slots here as well
+        self._native = getattr(self, '_native', None)
+        self._native_dirty = True
+
+    def train_from_iterator(self, it, vocab_size=1000, min_frequency=2):
+        out = super().train_from_iterator(it, vocab_size, min_frequency)
+        self._native_dirty = True
+        return out
+
+    def _sync_native(self):
+        lib = get_lib()
+        if lib is None:
+            return None
+        if self._native is not None and not self._native_dirty:
+            return self._native
+        if self._native is not None:
+            lib.bpe_destroy(self._native)
+        h = lib.bpe_create()
+        lib.bpe_set_unk(h, self.unk_token_id)
+        for tok, i in self.vocab.items():
+            lib.bpe_add_token(h, tok.encode('utf-8'), i)
+        for rank, (a, b) in enumerate(self.merges):
+            lib.bpe_add_merge(h, a.encode('utf-8'), b.encode('utf-8'), rank)
+        self._native = h
+        self._native_dirty = False
+        return h
+
+    def encode(self, text: str, add_special_tokens: bool = False,
+               max_length: Optional[int] = None) -> List[int]:
+        h = self._sync_native()
+        if h is None:  # no compiler: python fallback
+            return super().encode(text, add_special_tokens, max_length)
+        lib = get_lib()
+        data = text.encode('utf-8')
+        cap = max(256, len(data) * 2)
+        buf = (ctypes.c_int32 * cap)()
+        n = lib.bpe_encode(h, data, buf, cap)
+        if n > cap:  # pathological byte-fallback blowup: retry exact
+            buf = (ctypes.c_int32 * n)()
+            n = lib.bpe_encode(h, data, buf, n)
+        ids = list(buf[:n])
+        if add_special_tokens:
+            ids = [self.bos_token_id] + ids + [self.eos_token_id]
+        if max_length is not None:
+            ids = ids[:max_length]
+        return ids
+
+    def tokenize(self, text: str) -> List[str]:
+        h = self._sync_native()
+        if h is None:
+            return super().tokenize(text)
+        return self.convert_ids_to_tokens(self.encode(text))
+
+    def __del__(self):
+        try:
+            if self._native is not None and _lib is not None:
+                _lib.bpe_destroy(self._native)
+                self._native = None
+        except Exception:
+            pass

@@ -24,12 +24,23 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
 def as_offset(position_offset):
-    """Normalize a position offset (None / int / Tensor) to a traced i32."""
+    """Normalize a position offset (None / int / [B] array / Tensor) to a
+    traced i32 (scalar, or [B] for per-sequence offsets — left-padded
+    prompts give each sequence its own logical position origin)."""
     if position_offset is None:
         return jnp.int32(0)
     if isinstance(position_offset, Tensor):
-        return position_offset.value
+        return position_offset.value.astype(jnp.int32)
     return jnp.asarray(position_offset, jnp.int32)
+
+
+def offset_grid(offset, s):
+    """Logical positions of `s` consecutive tokens starting at `offset`:
+    scalar offset -> [S]; per-sequence [B] offset -> [B, S]."""
+    ar = jnp.arange(s, dtype=jnp.int32)
+    if jnp.ndim(offset) >= 1:
+        return offset[:, None] + ar[None, :]
+    return offset + ar
 
 
 def update_kv_cache(k_cache, v_cache, k, v, offset):
@@ -48,7 +59,9 @@ def update_kv_cache(k_cache, v_cache, k, v, offset):
 
 def decode_mask(q, k_cache, offset):
     """[1, 1, Sq, L] boolean causal mask for attention over a static cache:
-    query at absolute position offset+i sees key positions <= offset+i."""
+    query at cache slot offset+i sees key slots <= offset+i. (`offset`
+    here is the SLOT offset; for unpadded prompts slot == logical
+    position.)"""
     from ..tensor import apply_op as _apply
 
     def fn(qv, kc):
@@ -57,6 +70,24 @@ def decode_mask(q, k_cache, offset):
         k_pos = jnp.arange(l, dtype=jnp.int32)
         return (k_pos[None, :] <= q_pos[:, None])[None, None]
     return _apply(fn, q, k_cache, _name='decode_mask')
+
+
+def padded_decode_mask(keep, cache_len, cache_offset, sq):
+    """[B, 1, Sq, L] boolean mask for decode over a static cache holding a
+    left/right-PADDED prompt: slot-causal AND key slot not a pad slot.
+    `keep`: [B, S_prompt] bool (1 = real token); generated slots are
+    always kept. Self-attention is always allowed so a fully-padded row
+    can never produce an all-masked softmax (NaN)."""
+    b, s_prompt = keep.shape
+    k_slot = jnp.arange(cache_len, dtype=jnp.int32)
+    q_slot = cache_offset + jnp.arange(sq, dtype=jnp.int32)
+    causal = k_slot[None, :] <= q_slot[:, None]              # [Sq, L]
+    keep_full = jnp.concatenate(
+        [keep.astype(bool),
+         jnp.ones((b, cache_len - s_prompt), bool)], axis=1)  # [B, L]
+    self_ok = k_slot[None, :] == q_slot[:, None]             # [Sq, L]
+    m = causal[None] & (keep_full[:, None, :] | self_ok[None])
+    return m[:, None]                                        # [B,1,Sq,L]
 
 
 def _process_logits(logits, temperature, top_k, top_p):
@@ -104,26 +135,44 @@ class GenerationMixin:
 
     def _decode_jit(self, max_new_tokens: int, strategy: str,
                     temperature: float, top_k: int, top_p: float,
-                    eos_token_id: int, pad_token_id: int):
+                    eos_token_id: int, pad_token_id: int,
+                    padded: bool = False):
         # per-instance cache (a class-level lru_cache would pin every model
         # instance and its compiled executables for the process lifetime)
         cache_key = (max_new_tokens, strategy, temperature, top_k, top_p,
-                     eos_token_id, pad_token_id)
+                     eos_token_id, pad_token_id, padded)
         store = self.__dict__.setdefault('_generate_jit_cache', {})
         if cache_key in store:
             return store[cache_key]
-        def decode(params, frozen, buffers, ids, cache, key):
-            b, s = ids.shape
 
-            def fwd(tok, cache, offset):
+        def decode(params, frozen, buffers, ids, keep, cache, key):
+            b, s = ids.shape
+            total = s + max_new_tokens
+
+            def fwd(tok, cache, pos_offset, slot, mask):
                 (logits, new_cache), _ = functional_call(
                     self, params, frozen, buffers, (tok,),
-                    dict(cache=cache, position_offset=offset,
+                    dict(cache=cache, position_offset=pos_offset,
+                         cache_offset=slot, attention_mask=mask,
                          use_cache=True))
                 return logits, new_cache
 
+            if padded:
+                # left-padded prompts: per-sequence logical origin
+                offsets = jnp.sum(keep, axis=1).astype(jnp.int32) - s  # [B]
+                prefill_mask = padded_decode_mask(keep, s, jnp.int32(0), s)
+            else:
+                offsets = jnp.int32(0)
+                prefill_mask = None
+
+            def step_mask(i):
+                if not padded:
+                    return None
+                return padded_decode_mask(keep, total, jnp.int32(s) + i, 1)
+
             # prefill over the whole prompt
-            logits, cache = fwd(ids, cache, jnp.int32(0))
+            logits, cache = fwd(ids, cache, offsets, jnp.int32(0),
+                                prefill_mask)
             key, sub = jax.random.split(key)
             nxt, nxt_logp = _next_token(logits[:, -1], sub, strategy,
                                         temperature, top_k, top_p)
@@ -145,7 +194,8 @@ class GenerationMixin:
                 scores = scores + jnp.where(finished, 0.0, tok_logp)
                 newly_done = jnp.logical_or(finished, tok == eos_token_id)
                 logits, cache = fwd(tok[:, None].astype(ids.dtype), cache,
-                                    jnp.int32(s) + i)
+                                    offsets + s + i, jnp.int32(s) + i,
+                                    step_mask(i))
                 key, sub = jax.random.split(key)
                 nxt, nxt_logp = _next_token(logits[:, -1], sub, strategy,
                                             temperature, top_k, top_p)
@@ -157,6 +207,118 @@ class GenerationMixin:
             _, _, _, out, _, _, scores, _ = jax.lax.while_loop(
                 cond, body, state)
             return out, scores
+
+        jitted = jax.jit(decode)
+        store[cache_key] = jitted
+        return jitted
+
+    def _beam_decode_jit(self, max_new_tokens: int, num_beams: int,
+                         eos_token_id: int, pad_token_id: int,
+                         length_penalty: float, padded: bool = False):
+        """Beam search over the static cache (upstream: paddlenlp
+        generation_utils BeamSearchScorer path). All K beams of all B
+        prompts decode as ONE [B*K] batch; beam reordering is a gather on
+        the cache's batch dim inside the loop."""
+        cache_key = ('beam', max_new_tokens, num_beams, eos_token_id,
+                     pad_token_id, length_penalty, padded)
+        store = self.__dict__.setdefault('_generate_jit_cache', {})
+        if cache_key in store:
+            return store[cache_key]
+        K = num_beams
+        NEG = jnp.float32(-1e9)
+
+        def decode(params, frozen, buffers, ids, keep, cache):
+            b, s = ids.shape
+            total = s + max_new_tokens
+
+            def fwd(tok, cache, pos_offset, slot, mask):
+                (logits, new_cache), _ = functional_call(
+                    self, params, frozen, buffers, (tok,),
+                    dict(cache=cache, position_offset=pos_offset,
+                         cache_offset=slot, attention_mask=mask,
+                         use_cache=True))
+                return logits, new_cache
+
+            if padded:
+                offsets = jnp.sum(keep, axis=1).astype(jnp.int32) - s  # [B]
+                prefill_mask = padded_decode_mask(keep, s, jnp.int32(0), s)
+            else:
+                offsets = jnp.zeros((b,), jnp.int32)
+                prefill_mask = None
+
+            logits, cache = fwd(ids, cache, offsets if padded else
+                                jnp.int32(0), jnp.int32(0), prefill_mask)
+            logp0 = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1)      # [B, V]
+            v = logp0.shape[-1]
+            scores, tok = jax.lax.top_k(logp0, K)                # [B, K]
+            # expand everything beam-wise to a [B*K] batch
+            cache = jax.tree_util.tree_map(
+                lambda c: jnp.repeat(c, K, axis=0), cache)
+            offsets_bk = jnp.repeat(offsets, K)                  # [B*K]
+            keep_bk = jnp.repeat(keep, K, axis=0)
+            out = jnp.full((b, K, max_new_tokens), pad_token_id, jnp.int32)
+            finished = jnp.zeros((b, K), jnp.bool_)
+            lengths = jnp.zeros((b, K), jnp.int32)
+
+            def step_mask(i):
+                if not padded:
+                    return None
+                return padded_decode_mask(keep_bk, total, jnp.int32(s) + i,
+                                          1)
+
+            def cond(state):
+                i = state[0]
+                finished = state[5]
+                return jnp.logical_and(i < max_new_tokens,
+                                       jnp.logical_not(jnp.all(finished)))
+
+            def body(state):
+                (i, tok, out, cache, scores, finished, lengths) = state
+                tok = jnp.where(finished, pad_token_id, tok)     # [B, K]
+                out = jax.lax.dynamic_update_slice(
+                    out, tok[:, :, None], (0, 0, i))
+                lengths = lengths + jnp.where(finished, 0, 1)
+                finished = jnp.logical_or(finished, tok == eos_token_id)
+                logits, cache = fwd(
+                    tok.reshape(b * K, 1).astype(ids.dtype), cache,
+                    offsets_bk + s + i, jnp.int32(s) + i, step_mask(i))
+                logp = jax.nn.log_softmax(
+                    logits[:, -1].astype(jnp.float32), -1)       # [B*K, V]
+                logp = logp.reshape(b, K, v)
+                # finished beams contribute exactly one candidate: their
+                # frozen score continuing with pad
+                pad_only = jnp.full((v,), NEG).at[pad_token_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], pad_only[None, None],
+                                 logp)
+                cand = scores[:, :, None] + logp                 # [B, K, V]
+                scores, flat_idx = jax.lax.top_k(
+                    cand.reshape(b, K * v), K)                   # [B, K]
+                beam_src = flat_idx // v                         # [B, K]
+                nxt = (flat_idx % v).astype(jnp.int32)
+                # reorder per-beam state along the beam dim
+                out = jnp.take_along_axis(out, beam_src[:, :, None], axis=1)
+                finished = jnp.take_along_axis(finished, beam_src, axis=1)
+                lengths = jnp.take_along_axis(lengths, beam_src, axis=1)
+                flat_src = (jnp.arange(b)[:, None] * K
+                            + beam_src).reshape(-1)              # [B*K]
+                cache = jax.tree_util.tree_map(
+                    lambda c: jnp.take(c, flat_src, axis=0), cache)
+                return (i + 1, nxt, out, cache, scores, finished, lengths)
+
+            state = (jnp.int32(0), tok, out, cache, scores, finished,
+                     lengths)
+            _, _, out, _, scores, _, lengths = jax.lax.while_loop(
+                cond, body, state)
+            # length-normalized selection (length_penalty=0 -> raw scores)
+            norm = jnp.maximum(lengths, 1).astype(jnp.float32) \
+                ** jnp.float32(length_penalty)
+            best = jnp.argmax(scores / norm, axis=1)             # [B]
+            best_out = jnp.take_along_axis(
+                out, best[:, None, None], axis=1)[:, 0]          # [B, T]
+            best_score = jnp.take_along_axis(
+                scores / norm, best[:, None], axis=1)[:, 0]
+            return best_out, best_score
 
         jitted = jax.jit(decode)
         store[cache_key] = jitted

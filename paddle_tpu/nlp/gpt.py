@@ -19,6 +19,7 @@ from ..nn.norm import LayerNorm
 from ..tensor import Tensor, apply_op, to_jax
 from .generation import (GenerationMixin, as_offset as _as_offset,
                          decode_mask as _decode_mask,
+                         offset_grid as _offset_grid,
                          update_kv_cache as _update_kv_cache)
 
 
@@ -88,9 +89,11 @@ class GPTAttention(Layer):
         self.dropout_p = config.attention_probs_dropout_prob
 
     def forward(self, hidden, position_offset=None, attn_mask=None,
-                cache=None):
+                cache=None, cache_offset=None):
         nh, hd = self.num_heads, self.head_dim
         offset = _as_offset(position_offset)
+        slot = _as_offset(cache_offset) if cache_offset is not None \
+            else offset
         qkv = self.qkv_proj(hidden)
         q, k, v = (apply_op(
             lambda t, i=i: t[..., i * nh * hd:(i + 1) * nh * hd].reshape(
@@ -102,8 +105,9 @@ class GPTAttention(Layer):
                 dropout_p=self.dropout_p, training=self.training)
         else:
             k_cache, v_cache = _update_kv_cache(cache[0], cache[1], k, v,
-                                                offset)
-            mask = _decode_mask(q, k_cache, offset)
+                                                slot)
+            mask = attn_mask if attn_mask is not None \
+                else _decode_mask(q, k_cache, slot)
             out = F.scaled_dot_product_attention(q, k_cache, v_cache,
                                                  attn_mask=mask)
         out = apply_op(lambda t: t.reshape(t.shape[0], t.shape[1], nh * hd),
@@ -128,10 +132,11 @@ class GPTDecoderLayer(Layer):
         self.act = {'gelu': F.gelu, 'relu': F.relu}[config.hidden_act]
 
     def forward(self, hidden, position_offset=None, attn_mask=None,
-                cache=None):
+                cache=None, cache_offset=None):
         residual = hidden
         out = self.attn(self.norm1(hidden), position_offset=position_offset,
-                        attn_mask=attn_mask, cache=cache)
+                        attn_mask=attn_mask, cache=cache,
+                        cache_offset=cache_offset)
         new_cache = None
         if cache is not None:
             out, new_cache = out
@@ -163,15 +168,23 @@ class GPTModel(Layer):
                                     epsilon=config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_offset=None, attention_mask=None,
-                cache=None, use_cache=False):
+                cache=None, use_cache=False, blocks_fn=None,
+                cache_offset=None):
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(to_jax(input_ids))
         offset = _as_offset(position_offset)
         pos = apply_op(
-            lambda iv: offset + jnp.arange(iv.shape[1], dtype=jnp.int32),
+            lambda iv: jnp.clip(_offset_grid(offset, iv.shape[1]), 0, None),
             ids, _name='positions')
         h = self.word_embeddings(ids) + self.position_embeddings(pos)
         h = self.embed_dropout(h)
+        if blocks_fn is not None:
+            # pipeline-parallel path — see LlamaModel.forward
+            if attention_mask is not None or cache is not None:
+                raise ValueError('blocks_fn (pipeline) path supports only '
+                                 'full-length causal batches')
+            h = apply_op(blocks_fn, h, _name='pp_blocks')
+            return self.final_norm(h)
         mask = attention_mask
         if mask is not None and not isinstance(mask, Tensor):
             mask = Tensor(to_jax(mask))
@@ -187,7 +200,7 @@ class GPTModel(Layer):
                     kc if isinstance(kc, Tensor) else Tensor(kc),
                     vc if isinstance(vc, Tensor) else Tensor(vc))
             out = layer(h, position_offset=position_offset, attn_mask=mask,
-                        cache=layer_cache)
+                        cache=layer_cache, cache_offset=cache_offset)
             if layer_cache is not None:
                 h, c = out
                 new_caches.append(c)
@@ -227,11 +240,17 @@ class GPTForCausalLM(Layer, GenerationMixin):
         w = self.gpt.word_embeddings.weight
         return apply_op(lambda hv, wv: hv @ wv.T, h, w, _name='tied_lm_head')
 
+    def pp_blocks(self):
+        """Pipeline-parallel protocol — see LlamaForCausalLM.pp_blocks."""
+        return 'gpt.layers', self.gpt.layers
+
     def forward(self, input_ids, position_offset=None, attention_mask=None,
-                cache=None, use_cache=False, labels=None):
+                cache=None, use_cache=False, labels=None, blocks_fn=None,
+                cache_offset=None):
         out = self.gpt(input_ids, position_offset=position_offset,
                        attention_mask=attention_mask, cache=cache,
-                       use_cache=use_cache)
+                       use_cache=use_cache, blocks_fn=blocks_fn,
+                       cache_offset=cache_offset)
         if use_cache:
             h, new_cache = out
         else:

@@ -27,6 +27,7 @@ from ..nn.common_layers import Embedding
 from ..tensor import Tensor, apply_op, to_jax
 from .generation import (GenerationMixin, as_offset as _as_offset,
                          decode_mask as _decode_mask,
+                         offset_grid as _offset_grid,
                          update_kv_cache as _update_kv_cache)
 
 
@@ -148,9 +149,14 @@ class LlamaAttention(Layer):
         self.o_proj = _row_linear(config, self.num_heads * hd, h)
 
     def forward(self, hidden, position_offset=None, attn_mask=None,
-                cache=None):
+                cache=None, cache_offset=None):
         cfg = self.config
         offset = _as_offset(position_offset)
+        # cache_offset = SLOT index in the static cache (always scalar);
+        # position_offset = LOGICAL position for RoPE (scalar or [B] for
+        # left-padded prompts). They coincide for unpadded prompts.
+        slot = _as_offset(cache_offset) if cache_offset is not None \
+            else offset
         nh, nkv, hd = self.num_heads, self.num_key_value_heads, self.head_dim
         theta = cfg.rope_theta
 
@@ -165,8 +171,7 @@ class LlamaAttention(Layer):
             self.v_proj(hidden), _name='split_heads')
 
         def rope_q(qv):
-            s = qv.shape[1]
-            pos = offset + jnp.arange(s, dtype=jnp.int32)
+            pos = _offset_grid(offset, qv.shape[1])
             return _rope(qv, pos, theta)
         q = apply_op(rope_q, q, _name='rope')
         k = apply_op(rope_q, k, _name='rope')
@@ -176,8 +181,11 @@ class LlamaAttention(Layer):
                                                  is_causal=True)
         else:
             k_cache, v_cache = _update_kv_cache(cache[0], cache[1], k, v,
-                                                offset)
-            mask = _decode_mask(q, k_cache, offset)
+                                                slot)
+            # a caller-built mask (padded-prompt decode) wins over the
+            # default slot-causal one
+            mask = attn_mask if attn_mask is not None \
+                else _decode_mask(q, k_cache, slot)
             out = F.scaled_dot_product_attention(q, k_cache, v_cache,
                                                  attn_mask=mask)
         out = apply_op(
@@ -212,11 +220,12 @@ class LlamaDecoderLayer(Layer):
                                                 epsilon=config.rms_norm_eps)
 
     def forward(self, hidden, position_offset=None, attn_mask=None,
-                cache=None):
+                cache=None, cache_offset=None):
         residual = hidden
         h = self.input_layernorm(hidden)
         attn_out = self.self_attn(h, position_offset=position_offset,
-                                  attn_mask=attn_mask, cache=cache)
+                                  attn_mask=attn_mask, cache=cache,
+                                  cache_offset=cache_offset)
         new_cache = None
         if cache is not None:
             attn_out, new_cache = attn_out
@@ -253,10 +262,22 @@ class LlamaModel(LlamaPretrainedModel):
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, position_offset=None, attention_mask=None,
-                cache=None, use_cache=False):
+                cache=None, use_cache=False, blocks_fn=None,
+                cache_offset=None):
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(to_jax(input_ids))
         h = self.embed_tokens(ids)
+        if blocks_fn is not None:
+            # pipeline-parallel path (fleet.DistTrainStep pp): the decoder
+            # stack is replaced by a scheduled collective program; embed
+            # and final norm stay outside the pipelined region.
+            if attention_mask is not None or cache is not None \
+                    or position_offset is not None:
+                raise ValueError('blocks_fn (pipeline) path supports only '
+                                 'full-length causal batches from position '
+                                 '0 (mask/cache/offset unsupported)')
+            h = apply_op(blocks_fn, h, _name='pp_blocks')
+            return self.norm(h)
         sp_pin = None
         if self.config.sequence_parallel:
             # keep activations sequence-sharded over 'sp' between blocks;
@@ -297,7 +318,8 @@ class LlamaModel(LlamaPretrainedModel):
                         attn_mask=mask).value, policy=policy)(h.value))
             else:
                 out = layer(h, position_offset=position_offset,
-                            attn_mask=mask, cache=layer_cache)
+                            attn_mask=mask, cache=layer_cache,
+                            cache_offset=cache_offset)
             if layer_cache is not None:
                 h, c = out
                 new_caches.append(c)
@@ -337,11 +359,19 @@ class LlamaForCausalLM(LlamaPretrainedModel, GenerationMixin):
         w = self.llama.embed_tokens.weight
         return apply_op(lambda hv, wv: hv @ wv.T, h, w, _name='tied_lm_head')
 
+    def pp_blocks(self):
+        """Pipeline-parallel protocol (consumed by fleet.DistTrainStep):
+        (param-name prefix of the uniform decoder blocks, the block list).
+        """
+        return 'llama.layers', self.llama.layers
+
     def forward(self, input_ids, position_offset=None, attention_mask=None,
-                cache=None, use_cache=False, labels=None):
+                cache=None, use_cache=False, labels=None, blocks_fn=None,
+                cache_offset=None):
         out = self.llama(input_ids, position_offset=position_offset,
                          attention_mask=attention_mask, cache=cache,
-                         use_cache=use_cache)
+                         use_cache=use_cache, blocks_fn=blocks_fn,
+                         cache_offset=cache_offset)
         if use_cache:
             h, new_cache = out
         else:

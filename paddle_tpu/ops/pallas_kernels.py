@@ -54,7 +54,12 @@ def flash_attention(q, k, v, causal=False):
 
     GQA is handled by repeating KV heads (the kernel wants equal heads);
     the repeat is free at trace level — XLA broadcasts, it does not copy.
+    PADDLE_TPU_OWN_FLASH=1 switches to this repo's own fwd+bwd kernels
+    (flash_attention_own) instead of the jax library's.
     """
+    import os
+    if os.environ.get('PADDLE_TPU_OWN_FLASH', '').lower() in ('1', 'true'):
+        return flash_attention_own(q, k, v, causal)
     b, sq, h, d = q.shape
     kv_heads = k.shape[2]
     if kv_heads != h:
@@ -75,8 +80,13 @@ def flash_attention(q, k, v, causal=False):
 # our own forward kernel: blockwise online softmax
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                      scale, causal, block_q, block_k, n_k):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
+                      block_q, block_k, n_k, with_lse=False):
+    if with_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        lse_ref = None
+        m_ref, l_ref, acc_ref = rest
     ik = pl.program_id(3)
     iq = pl.program_id(2)
 
@@ -121,11 +131,34 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(ik == n_k - 1)
     def _finalize():
         o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # m/l scratch keep identical copies across all 128 lanes, so
+            # the [block_q, 128] lse tile is their elementwise combination
+            # (TPU tiling wants the last dim 128-wide; layout matches the
+            # jax library kernel's (B, H, Sq, MIN_BLOCK_SIZE) residuals)
+            lse_ref[0, 0] = m_ref[:] + jnp.log(l_ref[:])
+
+
+def _check_blocks(sq, sk, block_q, block_k):
+    """The grid pads the last block with pl.cdiv, and padded key rows
+    would contribute exp-mass to the online-softmax denominator — fail
+    loud instead of returning silently wrong results."""
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f'flash kernel needs seq lengths divisible by block sizes: '
+            f'sq={sq} %% block_q={block_q} or sk={sk} %% block_k={block_k} '
+            f'!= 0; pad the sequence or pick smaller blocks')
 
 
 def flash_attention_fwd(q, k, v, causal=False, block_q=128, block_k=128,
-                        interpret=False):
-    """Forward-only flash attention, [B, S, H, D] (this repo's kernel)."""
+                        interpret=False, return_lse=False):
+    """Forward flash attention, [B, S, H, D] (this repo's kernel).
+
+    With return_lse=True also returns the per-row logsumexp as a
+    [B, H, Sq, 128] fp32 array (value replicated over the 128-lane dim —
+    the TPU tiling layout the backward kernels consume; take [..., 0]
+    for the logical [B, H, Sq] values).
+    """
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kv_heads = k.shape[2]
@@ -138,12 +171,23 @@ def flash_attention_fwd(q, k, v, causal=False, block_q=128, block_k=128,
     vt = v.transpose(0, 2, 1, 3)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    _check_blocks(sq, sk, block_q, block_k)
     n_q, n_k = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
     scale = 1.0 / math.sqrt(d)
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, n_k=n_k)
-    out = pl.pallas_call(
+        block_q=block_q, block_k=block_k, n_k=n_k, with_lse=return_lse)
+    out_specs = [pl.BlockSpec((1, 1, block_q, d),
+                              lambda b_, h_, iq, ik: (b_, h_, iq, 0))]
+    out_shape = [jax.ShapeDtypeStruct(qt.shape, q.dtype)]
+    if return_lse:
+        # the lse residual is only materialized when the caller (the
+        # backward pass) actually needs it — forward-only calls skip the
+        # [B, H, Sq, 128] fp32 write entirely
+        out_specs.append(pl.BlockSpec((1, 1, block_q, 128),
+                                      lambda b_, h_, iq, ik: (b_, h_, iq, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(b, h, n_q, n_k),
         in_specs=[
@@ -154,9 +198,8 @@ def flash_attention_fwd(q, k, v, causal=False, block_q=128, block_k=128,
             pl.BlockSpec((1, 1, block_k, d),
                          lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
@@ -164,7 +207,215 @@ def flash_attention_fwd(q, k, v, causal=False, block_q=128, block_k=128,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    if return_lse:
+        out, lse = res
+        return out.transpose(0, 2, 1, 3), lse
+    return res[0].transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# our own backward kernels: dq and dk/dv sweeps (FlashAttention-2 scheme)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                         n_k):
+    ik = pl.program_id(3)
+    iq = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [Bq, D]
+        kk = k_ref[0, 0].astype(jnp.float32)           # [Bk, D]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        lse = lse_ref[0, 0][:, :1]                     # [Bq, 1]
+        p = jnp.exp(s - lse)                           # [Bq, Bk]
+        do = do_ref[0, 0].astype(jnp.float32)          # [Bq, D]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bq, Bk]
+        delta = delta_ref[0, 0][:, :1]                 # [Bq, 1]
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == n_k - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                          block_q, block_k, n_q):
+    iq = pl.program_id(3)
+    ik = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [Bq, D]
+        kk = k_ref[0, 0].astype(jnp.float32)           # [Bk, D]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        lse = lse_ref[0, 0][:, :1]                     # [Bq, 1]
+        p = jnp.exp(s - lse)                           # [Bq, Bk]
+        do = do_ref[0, 0].astype(jnp.float32)          # [Bq, D]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bk, D]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bq, Bk]
+        delta = delta_ref[0, 0][:, :1]                 # [Bq, 1]
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bk, D]
+
+    if causal:
+        # q blocks strictly above the diagonal see none of this k block
+        @pl.when(iq * block_q + block_q - 1 >= ik * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(iq == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def flash_attention_bwd(q, k, v, out, lse, g, causal=False, block_q=128,
+                        block_k=128, interpret=False):
+    """dq/dk/dv via two pallas sweeps. All arrays [B, H, S, D] (already
+    transposed); lse [B, H, Sq, 128] fp32 (lane-replicated, from
+    flash_attention_fwd); returns grads in the same layout."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    _check_blocks(sq, sk, block_q, block_k)
+    n_q, n_k = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+    scale = 1.0 / math.sqrt(d)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term,
+    # lane-replicated to the same [B, H, Sq, 128] tiling as lse
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True), (b, h, sq, 128))
+
+    qspec = pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_, j, 0))
+    rowq = pl.BlockSpec((1, 1, block_q, 128),
+                        lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        grid=(b, h, n_q, n_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dkv sweep: grid iterates k blocks in dim 2, q blocks in dim 3
+    qspec2 = pl.BlockSpec((1, 1, block_q, d),
+                          lambda b_, h_, j, i: (b_, h_, i, 0))
+    kspec2 = pl.BlockSpec((1, 1, block_k, d),
+                          lambda b_, h_, j, i: (b_, h_, j, 0))
+    rowq2 = pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b_, h_, j, i: (b_, h_, i, 0))
+    kout = pl.BlockSpec((1, 1, block_k, d),
+                        lambda b_, h_, j, i: (b_, h_, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid=(b, h, n_k, n_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+        out_specs=[kout, kout],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_own(q, k, v, causal=False, block_q=128, block_k=128,
+                        interpret=False):
+    """This repo's fully-owned differentiable flash attention,
+    [B, S, H, D] layout (fwd online-softmax + FA-2 style bwd sweeps).
+    Selected over the jax library kernel by PADDLE_TPU_OWN_FLASH=1."""
+    out, _ = _flash_own_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_own_fwd(q, k, v, causal, block_q, block_k, interpret):
+    h, kvh = q.shape[2], k.shape[2]
+    out, lse = flash_attention_fwd(q, k, v, causal=causal, block_q=block_q,
+                                   block_k=block_k, interpret=interpret,
+                                   return_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_own_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    h, kvh = q.shape[2], k.shape[2]
+    kf, vf = k, v
+    if kvh != h:
+        rep = h // kvh
+        kf = jnp.repeat(k, rep, axis=2)
+        vf = jnp.repeat(v, rep, axis=2)
+    tr = lambda x: x.transpose(0, 2, 1, 3)
+    dq, dk, dv = flash_attention_bwd(
+        tr(q), tr(kf), tr(vf), tr(out), lse, tr(g), causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    dq, dk, dv = tr(dq), tr(dk), tr(dv)
+    if kvh != h:
+        rep = h // kvh
+        b, sk_, _, d = dk.shape
+        # repeat interleaves groups per kv head: fold [H] -> [HKV, rep]
+        dk = dk.reshape(b, sk_, kvh, rep, d).sum(3).astype(k.dtype)
+        dv = dv.reshape(b, sk_, kvh, rep, d).sum(3).astype(v.dtype)
+    return dq, dk, dv
+
+
+flash_attention_own.defvjp(_flash_own_fwd, _flash_own_bwd)
 
 
 # ---------------------------------------------------------------------------

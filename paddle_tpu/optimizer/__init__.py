@@ -278,30 +278,37 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 amsgrad=False, name=None):
+                 amsgrad=False, moment_dtype=None, name=None):
+        """moment_dtype: storage dtype for m/v (default fp32). 'bfloat16'
+        halves optimizer HBM — how billion-param models fit one chip; the
+        moment *update* still computes in fp32 either way."""
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._amsgrad = amsgrad
+        self._moment_dtype = jnp.dtype(moment_dtype) if moment_dtype \
+            else jnp.float32
 
     def _init_slots(self, p):
-        s = {'moment1': jnp.zeros(p.shape, jnp.float32),
-             'moment2': jnp.zeros(p.shape, jnp.float32)}
+        s = {'moment1': jnp.zeros(p.shape, self._moment_dtype),
+             'moment2': jnp.zeros(p.shape, self._moment_dtype)}
         if self._amsgrad:
-            s['moment2_max'] = jnp.zeros(p.shape, jnp.float32)
+            s['moment2_max'] = jnp.zeros(p.shape, self._moment_dtype)
         return s
 
     def _rule(self, g, p, slots, lr, step):
         b1, b2 = self._beta1, self._beta2
-        m = b1 * slots['moment1'] + (1 - b1) * g
-        v = b2 * slots['moment2'] + (1 - b2) * jnp.square(g)
-        slots['moment1'], slots['moment2'] = m, v
+        m = b1 * slots['moment1'].astype(jnp.float32) + (1 - b1) * g
+        v = b2 * slots['moment2'].astype(jnp.float32) \
+            + (1 - b2) * jnp.square(g)
+        slots['moment1'] = m.astype(self._moment_dtype)
+        slots['moment2'] = v.astype(self._moment_dtype)
         t = step.astype(jnp.float32) if hasattr(step, 'astype') \
             else jnp.asarray(step, jnp.float32)
         lr_t = lr * jnp.sqrt(1 - jnp.power(b2, t)) / (1 - jnp.power(b1, t))
         if self._amsgrad:
-            vm = jnp.maximum(slots['moment2_max'], v)
-            slots['moment2_max'] = vm
+            vm = jnp.maximum(slots['moment2_max'].astype(jnp.float32), v)
+            slots['moment2_max'] = vm.astype(self._moment_dtype)
             v = vm
         return p - lr_t * m / (jnp.sqrt(v) + self._epsilon), slots
 
@@ -313,10 +320,10 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, amsgrad=False,
-                 name=None):
+                 moment_dtype=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         amsgrad)
+                         amsgrad, moment_dtype)
         self._apply_decay_fn = apply_decay_param_fun
 
     def _decoupled_decay(self):

@@ -237,3 +237,164 @@ def test_moe_grad_flows():
     loss.backward()
     assert m.w_in.grad is not None
     assert m.gate.grad is not None
+
+
+# ---------------------------------------------------------------------------
+# round 3: pipeline parallel end-to-end, strategy knobs, ZeRO-2/3, full TP
+# ---------------------------------------------------------------------------
+
+def _lm_batch(vocab=128, b=8, s=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, vocab, (b, s)), rng.randint(0, vocab, (b, s))
+
+
+def _make_strategy(pp=1, dp=1, mp=1, **kw):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {'pp_degree': pp, 'dp_degree': dp,
+                               'sep_degree': 1, 'mp_degree': mp}
+    for k, v in kw.items():
+        setattr(strategy, k, v)
+    return strategy
+
+
+def _run_lm(strategy, model_cls, cfg_cls, steps=3, seed=7):
+    ids, lab = _lm_batch()
+    paddle.seed(seed)
+    fleet.init(is_collective=True, strategy=strategy)
+    cfg = cfg_cls.tiny()
+    m = model_cls(cfg)
+    fleet.distributed_model(m)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits.reshape([-1, cfg.vocab_size]),
+                               labels.reshape([-1]))
+
+    step = fleet.DistTrainStep(m, loss_fn, opt, strategy)
+    losses = [float(step(ids, lab).numpy()) for _ in range(steps)]
+    return losses, step
+
+
+def test_pp_llama_matches_single_device():
+    """VERDICT r2 #1: Llama-tiny at pp2 x dp4, per-step losses == dense."""
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    base, _ = _run_lm(_make_strategy(), LlamaForCausalLM, LlamaConfig)
+    s = _make_strategy(pp=2, dp=4, pipeline=True)
+    s.pipeline_configs = {'accumulate_steps': 2, 'schedule_mode': '1F1B'}
+    pp, _ = _run_lm(s, LlamaForCausalLM, LlamaConfig)
+    np.testing.assert_allclose(base, pp, rtol=1e-3)
+    assert base[-1] < base[0]
+
+
+def test_pp_gpt_matches_single_device():
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    base, _ = _run_lm(_make_strategy(), GPTForCausalLM, GPTConfig)
+    s = _make_strategy(pp=2, dp=2, mp=2, pipeline=True)
+    s.pipeline_configs = {'accumulate_steps': 4, 'schedule_mode': 'F-then-B'}
+    pp, _ = _run_lm(s, GPTForCausalLM, GPTConfig)
+    np.testing.assert_allclose(base, pp, rtol=1e-3)
+
+
+def test_strategy_gradient_merge():
+    """k_steps=4 microbatch accumulation == the full-batch step."""
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    base, _ = _run_lm(_make_strategy(), GPTForCausalLM, GPTConfig)
+    gm = _make_strategy(gradient_merge=True)
+    gm.gradient_merge_configs = {'k_steps': 4}
+    merged, _ = _run_lm(gm, GPTForCausalLM, GPTConfig)
+    np.testing.assert_allclose(base, merged, rtol=1e-4)
+    # indivisible batch fails loud, proving the scan path is really taken
+    bad = _make_strategy(gradient_merge=True)
+    bad.gradient_merge_configs = {'k_steps': 3}
+    with pytest.raises(Exception):
+        _run_lm(bad, GPTForCausalLM, GPTConfig, steps=1)
+
+
+def test_strategy_amp_has_effect():
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    base, _ = _run_lm(_make_strategy(), GPTForCausalLM, GPTConfig)
+    a = _make_strategy(amp=True)
+    a.amp_configs = {'level': 'O1', 'dtype': 'bfloat16'}
+    amp_l, _ = _run_lm(a, GPTForCausalLM, GPTConfig)
+    assert all(np.isfinite(amp_l)) and amp_l[-1] < amp_l[0]
+    # bf16 matmuls perturb the trajectory: close to fp32 but not identical
+    np.testing.assert_allclose(base, amp_l, rtol=5e-2)
+    assert not np.allclose(base, amp_l, rtol=1e-7), 'amp knob had no effect'
+
+
+def test_strategy_recompute_wires_model_config():
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    base, _ = _run_lm(_make_strategy(), LlamaForCausalLM, LlamaConfig)
+    r = _make_strategy(recompute=True)
+    r.recompute_configs = {'granularity': 'dots'}
+    rec, step = _run_lm(r, LlamaForCausalLM, LlamaConfig)
+    assert step.layer.config.use_recompute == 'dots'
+    np.testing.assert_allclose(base, rec, rtol=1e-4)
+
+
+@pytest.mark.parametrize('stage', [2, 3])
+def test_zero_stage_2_3_match_unsharded(stage):
+    """VERDICT r2 #3: stage2/3 == unsharded trajectories + memory shrinks."""
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    base, _ = _run_lm(_make_strategy(), GPTForCausalLM, GPTConfig)
+    z = _make_strategy(dp=8, sharding=True)
+    z.sharding_configs = {'stage': stage}
+    zl, zstep = _run_lm(z, GPTForCausalLM, GPTConfig)
+    np.testing.assert_allclose(base, zl, rtol=1e-4)
+    # per-device optimizer-moment bytes shrink ~dp for shardable leaves
+    leaves = [v for v in jax.tree_util.tree_leaves(zstep._opt_state)
+              if hasattr(v, 'sharding') and v.ndim >= 2]
+    assert leaves, 'no shardable moment leaves found'
+    shrunk = [v for v in leaves
+              if np.prod(v.sharding.shard_shape(v.shape)) < v.size]
+    assert shrunk, 'ZeRO placement did not shard any moment leaf'
+    if stage >= 3:
+        pmap = dict(zstep.layer.named_parameters())
+        p_shrunk = [p for p in pmap.values()
+                    if np.prod(p.value.sharding.shard_shape(
+                        p.value.shape)) < p.value.size]
+        assert p_shrunk, 'stage 3 did not shard any parameter'
+
+
+def test_tp_llama_full_model_matches_dense():
+    """VERDICT r2 #6: Llama-tiny tensor_parallel=True on mp4 — logits and
+    one DistTrainStep loss match the dense model bit-for-tolerance."""
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    ids, lab = _lm_batch(b=8, s=8)
+
+    fleet.init(is_collective=True, strategy=_make_strategy())
+    paddle.seed(11)
+    dense = LlamaForCausalLM(LlamaConfig.tiny())
+    sd = {k: v.numpy() for k, v in dense.state_dict().items()}
+    dense_logits = dense(paddle.to_tensor(ids)).numpy()
+
+    strategy = _make_strategy(dp=2, mp=4)
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(11)
+    tp = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=True))
+    tp.set_state_dict(sd)
+    fleet.distributed_model(tp)
+    # TP placement really happened on at least one projection weight
+    qw = dict(tp.named_parameters())[
+        'llama.layers.0.self_attn.q_proj.weight']
+    assert 'mp' in str(qw.value.sharding.spec)
+    tp_logits = tp(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(dense_logits, tp_logits, rtol=2e-4, atol=2e-5)
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits.reshape([-1, 128]),
+                               labels.reshape([-1]))
+
+    opt_d = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=dense.parameters())
+    fleet.init(is_collective=True, strategy=_make_strategy())
+    step_d = fleet.DistTrainStep(dense, loss_fn, opt_d)
+    dense_loss = float(step_d(ids, lab).numpy())
+
+    fleet.init(is_collective=True, strategy=strategy)
+    opt_t = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=tp.parameters())
+    step_t = fleet.DistTrainStep(tp, loss_fn, opt_t, strategy)
+    tp_loss = float(step_t(ids, lab).numpy())
+    np.testing.assert_allclose(dense_loss, tp_loss, rtol=1e-4)

@@ -1,0 +1,134 @@
+"""Parity tests for the repo's own pallas kernels, run in interpret mode
+on the CPU mesh (SURVEY.md §4). The XLA reference attention is the
+ground truth for both forward values and dq/dk/dv gradients."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import _attention_xla
+from paddle_tpu.ops.pallas_kernels import (flash_attention_bwd,
+                                           flash_attention_fwd,
+                                           flash_attention_own, rms_norm)
+
+
+def _qkv(b=1, sq=256, sk=256, h=2, hkv=None, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((b, sq, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, sk, hkv or h, d)).astype(np.float32)
+    v = rng.standard_normal((b, sk, hkv or h, d)).astype(np.float32)
+    return jnp.array(q), jnp.array(k), jnp.array(v)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_flash_fwd_matches_xla(causal):
+    q, k, v = _qkv()
+    ours = flash_attention_fwd(q, k, v, causal=causal, interpret=True)
+    ref = _attention_xla(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_fwd_gqa():
+    q, k, v = _qkv(h=4, hkv=2)
+    ours = flash_attention_fwd(q, k, v, causal=True, interpret=True)
+    ref = _attention_xla(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_fwd_lse():
+    q, k, v = _qkv(sq=128, sk=128)
+    _, lse = flash_attention_fwd(q, k, v, causal=False, interpret=True,
+                                 return_lse=True)
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k) / np.sqrt(q.shape[-1])
+    want = jax.scipy.special.logsumexp(logits, axis=-1)
+    assert lse.shape == want.shape + (128,)  # lane-replicated TPU tiling
+    np.testing.assert_allclose(np.asarray(lse[..., 0]), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_fwd_rejects_indivisible():
+    q, k, v = _qkv(sq=130, sk=256)
+    with pytest.raises(ValueError, match='divisible'):
+        flash_attention_fwd(q, k, v, interpret=True)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_flash_own_backward_matches_xla(causal):
+    """VERDICT r2 #8: the repo owns its flash bwd (dq/dk/dv kernels)."""
+    q, k, v = _qkv(sq=128, sk=128)
+
+    def loss_own(q, k, v):
+        return jnp.sum(flash_attention_own(q, k, v, causal, 128, 128,
+                                           True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_attention_xla(q, k, v, causal=causal) ** 2)
+
+    g_own = jax.grad(loss_own, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for ours, ref, name in zip(g_own, g_ref, 'q k v'.split()):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(ref), rtol=5e-3, atol=1e-4,
+            err_msg=f'd{name} mismatch (causal={causal})')
+
+
+def test_flash_own_backward_gqa():
+    q, k, v = _qkv(sq=128, sk=128, h=4, hkv=2)
+
+    def loss_own(q, k, v):
+        return jnp.sum(flash_attention_own(q, k, v, True, 128, 128,
+                                           True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_attention_xla(q, k, v, causal=True) ** 2)
+
+    g_own = jax.grad(loss_own, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for ours, ref, name in zip(g_own, g_ref, 'q k v'.split()):
+        assert ours.shape == ref.shape
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(ref), rtol=5e-3, atol=1e-4,
+            err_msg=f'd{name} mismatch (gqa)')
+
+
+def test_flash_own_multiblock_causal():
+    """Exercise the block-skip paths: 2x2 q/k block grid, causal."""
+    q, k, v = _qkv(sq=256, sk=256, d=64, seed=3)
+
+    def loss_own(q, k, v):
+        return jnp.sum(flash_attention_own(q, k, v, True, 128, 128,
+                                           True) * 0.01)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_attention_xla(q, k, v, causal=True) * 0.01)
+
+    g_own = jax.grad(loss_own, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for ours, ref, name in zip(g_own, g_ref, 'q k v'.split()):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(ref), rtol=5e-3, atol=1e-5,
+            err_msg=f'd{name} mismatch (multiblock)')
+
+
+def test_rms_norm_kernel_and_grad():
+    rng = np.random.default_rng(5)
+    x = jnp.array(rng.standard_normal((8, 64)).astype(np.float32))
+    w = jnp.array(rng.standard_normal((64,)).astype(np.float32))
+
+    def ref(x, w):
+        ms = jnp.mean(x * x, axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(ms + 1e-6) * w
+
+    ours = rms_norm(x, w, 1e-6, True)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref(x, w)),
+                               rtol=1e-5, atol=1e-6)
+    g1 = jax.grad(lambda a, b: jnp.sum(rms_norm(a, b, 1e-6, True) ** 2),
+                  argnums=(0, 1))(x, w)
+    g2 = jax.grad(lambda a, b: jnp.sum(ref(a, b) ** 2),
+                  argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]),
+                               rtol=1e-4, atol=1e-5)
