@@ -111,27 +111,28 @@ void bpe_add_merge(void* h, const char* a, const char* b, int rank) {
   static_cast<BPE*>(h)->ranks.emplace(std::make_pair(a, b), rank);
 }
 
-// Encode whitespace-split `text`; writes up to max_out ids, returns the
-// number of ids the full encoding needs (caller re-calls with a larger
-// buffer when the return value exceeds max_out).
-int bpe_encode(void* h, const char* text, int32_t* out_ids, int max_out) {
+// Encode whitespace-split `text` of `text_len` bytes (explicit length:
+// embedded NUL bytes are word bytes, matching python str semantics — the
+// python wrapper pre-normalizes unicode whitespace to ' ' so only ASCII
+// separators appear here); writes up to max_out ids, returns the number
+// of ids the full encoding needs (caller re-calls with a larger buffer
+// when the return value exceeds max_out).
+int bpe_encode(void* h, const char* text, int32_t text_len, int32_t* out_ids,
+               int max_out) {
   const BPE* t = static_cast<BPE*>(h);
   std::vector<int> ids;
-  const char* p = text;
   std::string word;
-  for (;;) {
-    char c = *p;
-    if (c == '\0' || c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
-        c == '\f' || c == '\v') {
+  for (int32_t i = 0; i <= text_len; ++i) {
+    char c = (i < text_len) ? text[i] : ' ';
+    if (c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+        c == '\v') {
       if (!word.empty()) {
         bpe_word(t, word, &ids);
         word.clear();
       }
-      if (c == '\0') break;
     } else {
       word.push_back(c);
     }
-    ++p;
   }
   int n = static_cast<int>(ids.size());
   for (int i = 0; i < n && i < max_out; ++i) out_ids[i] = ids[i];

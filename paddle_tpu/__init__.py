@@ -20,6 +20,7 @@ from .tensor import Parameter, Tensor
 from .ops import *  # noqa: F401,F403
 from .ops import linalg
 
+from . import device
 from . import jit
 from . import nn
 from . import optimizer

@@ -1,0 +1,22 @@
+"""paddle.device parity surface (upstream: python/paddle/device/) over
+the PjRt backend: device selection, synchronization, and memory
+introspection via per-device ``memory_stats()``."""
+from __future__ import annotations
+
+from ..framework import (device_memory_limit, get_device, max_memory_allocated,
+                         max_memory_reserved, memory_allocated,
+                         memory_reserved, set_device, synchronize)
+from . import cuda  # noqa: F401  (upstream-name alias module)
+
+__all__ = ['get_device', 'set_device', 'synchronize', 'memory_allocated',
+           'max_memory_allocated', 'memory_reserved', 'max_memory_reserved',
+           'device_memory_limit', 'cuda']
+
+
+def device_count() -> int:
+    import jax
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False

@@ -1,0 +1,23 @@
+"""Upstream-name alias: ``paddle.device.cuda.max_memory_allocated`` and
+friends (python/paddle/device/cuda/__init__.py) — here they report the
+accelerator jax exposes (TPU HBM; zeros on backends without stats)."""
+from __future__ import annotations
+
+from ..framework import (device_memory_limit, max_memory_allocated,
+                         max_memory_reserved, memory_allocated,
+                         memory_reserved, synchronize)
+
+__all__ = ['memory_allocated', 'max_memory_allocated', 'memory_reserved',
+           'max_memory_reserved', 'device_memory_limit', 'synchronize',
+           'device_count', 'empty_cache']
+
+
+def device_count() -> int:
+    import jax
+    return len(jax.devices())
+
+
+def empty_cache() -> None:
+    """Upstream releases the CUDA caching-allocator pool; PjRt manages HBM
+    itself, so this is a synchronization point only."""
+    synchronize()

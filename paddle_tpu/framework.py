@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -127,6 +128,51 @@ def is_compiled_with_cuda() -> bool:  # reference-compat shim
 
 def is_compiled_with_xla() -> bool:
     return True
+
+
+# --------------------------------------------------------------------------
+# Device memory introspection (upstream: python/paddle/device/cuda/
+# max_memory_allocated / memory_allocated / memory_reserved family —
+# here backed by PjRt per-device memory_stats()).
+# --------------------------------------------------------------------------
+
+
+def _memory_stats(device_id: Optional[int] = None) -> dict:
+    devs = jax.devices()
+    dev = devs[device_id or 0] if device_id is not None else devs[0]
+    stats = dev.memory_stats()
+    return dict(stats) if stats else {}
+
+
+def memory_allocated(device_id: Optional[int] = None) -> int:
+    """Bytes currently allocated on the device (0 when the backend does
+    not expose stats, e.g. the CPU test mesh)."""
+    return int(_memory_stats(device_id).get('bytes_in_use', 0))
+
+
+def max_memory_allocated(device_id: Optional[int] = None) -> int:
+    """High-water mark of device bytes allocated since process start."""
+    s = _memory_stats(device_id)
+    return int(s.get('peak_bytes_in_use', s.get('bytes_in_use', 0)))
+
+
+def memory_reserved(device_id: Optional[int] = None) -> int:
+    """Bytes reserved by the allocator pool (>= allocated)."""
+    s = _memory_stats(device_id)
+    return int(s.get('bytes_reserved',
+                     s.get('bytes_reservable_limit', 0)) or
+               s.get('bytes_in_use', 0))
+
+
+def max_memory_reserved(device_id: Optional[int] = None) -> int:
+    s = _memory_stats(device_id)
+    return int(s.get('peak_bytes_reserved', 0) or max_memory_allocated(
+        device_id))
+
+
+def device_memory_limit(device_id: Optional[int] = None) -> int:
+    """Total usable device memory (HBM) in bytes, when known."""
+    return int(_memory_stats(device_id).get('bytes_limit', 0))
 
 
 # --------------------------------------------------------------------------

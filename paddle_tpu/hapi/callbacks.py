@@ -221,3 +221,8 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._writer:
             self._writer.close()
+
+
+# upstream name parity: paddle.callbacks.LRScheduler
+# (python/paddle/hapi/callbacks.py exposes the class under this name)
+LRScheduler = LRSchedulerCallback

@@ -276,44 +276,172 @@ class TrainStep:
         return Tensor(loss)
 
 
+class TranslatedLayer:
+    """The product of `jit.load(path)` without the original class
+    (upstream: paddle.jit.TranslatedLayer from python/paddle/jit/api.py):
+    a deserialized StableHLO program closed over restored state. Callable
+    like the original (Static)Layer's inference forward."""
+
+    def __init__(self, exported, params, frozen, buffers, manifest):
+        self._exported = exported
+        self._params = params
+        self._frozen = frozen
+        self._buffers = buffers
+        self._manifest = manifest
+
+    @property
+    def input_spec(self):
+        return [InputSpec(s['shape'], s['dtype'])
+                for s in self._manifest.get('input_spec', [])]
+
+    def named_parameters(self):
+        for n, v in {**self._params, **self._frozen}.items():
+            yield n, Tensor(v)
+
+    def eval(self):
+        return self
+
+    def __call__(self, *args):
+        vals = _tree.tree_map(
+            lambda v: v.value if isinstance(v, Tensor) else jnp.asarray(v),
+            args, is_leaf=lambda v: isinstance(v, Tensor))
+        out = self._exported.call(self._params, self._frozen, self._buffers,
+                                  *vals)
+        return _tree.tree_map(Tensor, out)
+
+
+def _export_platforms():
+    # make the artifact portable across the surfaces this framework runs
+    # on: the real chip and the CPU test mesh
+    plats = {'tpu', 'cpu'}
+    plats.add(jax.default_backend())
+    return tuple(sorted(plats))
+
+
 def save(layer, path, input_spec=None, **config):
-    """Persist a (Static)Layer's state for deployment: parameters + buffers
-    as npz plus a spec manifest. (The compiled XLA executable itself is
-    rebuilt on load-side jit — PjRt compilation caches make this cheap.)"""
+    """Serialize a (Static)Layer as a self-contained inference artifact
+    (upstream: paddle.jit.save, python/paddle/jit/api.py — Program +
+    persistables). TPU-native form: `jax.export` StableHLO bytes
+    (`<path>.pdmodel.stablehlo`) + parameters/buffers npz
+    (`<path>.pdiparams.npz`). `jit.load(path)` rebuilds a callable from
+    the serialized program alone — the original Python class is NOT
+    needed. None dims in input_spec export as symbolic (dynamic) dims."""
     import json
     import os
     target = layer._target if isinstance(layer, StaticLayer) else layer
+    if input_spec is None and isinstance(layer, StaticLayer):
+        input_spec = layer._input_spec
+    if not input_spec:
+        raise ValueError('jit.save needs input_spec (shapes/dtypes of the '
+                         'forward arguments) to trace the program')
     os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
     arrays = {f'param::{n}': np.asarray(p.value)
               for n, p in target.named_parameters()}
     arrays.update({f'buffer::{n}': np.asarray(b.value)
                    for n, b in target.named_buffers()})
     np.savez(path + '.pdiparams.npz', **arrays)
+
+    # the serialized program is the EVAL forward (a deployment artifact:
+    # dropout off, BN in inference mode), matching upstream jit.save
+    was_training = target.training
+    target.eval()
+    try:
+        params, frozen, buffers = functional_state(target)
+
+        def infer_fn(params, frozen, buffers, *args):
+            out, _ = functional_call(target, params, frozen, buffers,
+                                     args, {})
+            return out
+
+        arg_specs = []
+        scope = None
+        n_sym = 0
+        for s in input_spec:
+            dims = []
+            has_sym = False
+            for d in s.shape:
+                if d is None:
+                    dims.append(f'b{n_sym}')
+                    n_sym += 1
+                    has_sym = True
+                else:
+                    dims.append(str(d))
+            if has_sym:
+                # one shared scope so symbols across args can relate
+                if scope is None:
+                    scope = jax.export.SymbolicScope()
+                shape = jax.export.symbolic_shape(', '.join(dims),
+                                                  scope=scope)
+            else:
+                shape = tuple(int(d) for d in dims)
+            arg_specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
+        abstract = lambda tree: _tree.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), tree)
+        exported = jax.export.export(
+            jax.jit(infer_fn), platforms=_export_platforms())(
+            abstract(params), abstract(frozen), abstract(buffers),
+            *arg_specs)
+        with open(path + '.pdmodel.stablehlo', 'wb') as f:
+            f.write(exported.serialize())
+    finally:
+        if was_training:
+            target.train()
     manifest = {
         'class': type(target).__name__,
+        'format': 'stablehlo',
+        # the exported program's calling convention splits state into
+        # (trainable, frozen, buffers) dicts; load must rebuild the same
+        # pytrees, so record the partition
+        'trainable': sorted(params),
+        'frozen': sorted(frozen),
         'input_spec': [
             {'shape': list(s.shape), 'dtype': str(s.dtype)}
-            for s in (input_spec or [])],
+            for s in input_spec],
     }
     with open(path + '.pdmodel.json', 'w') as f:
         json.dump(manifest, f)
 
 
 def load(path, layer=None):
-    """Restore state saved by jit.save into `layer` (the architecture is
-    rebuilt from code, reference `paddle.jit.load`'s TranslatedLayer role)."""
+    """Load a `jit.save` artifact. Without `layer`, deserializes the
+    StableHLO program and returns a `TranslatedLayer` — no Python class
+    required (upstream paddle.jit.load semantics). With `layer`, restores
+    state into it (a state-dict fast path)."""
+    import json
+    import os
     data = np.load(path + '.pdiparams.npz')
-    if layer is None:
+    if layer is not None:
+        target = layer._target if isinstance(layer, StaticLayer) else layer
+        sd = {}
+        for k in data.files:
+            kind, name = k.split('::', 1)
+            sd[name] = data[k]
+        target.set_state_dict(sd)
+        return layer if isinstance(layer, StaticLayer) else StaticLayer(layer)
+    hlo_path = path + '.pdmodel.stablehlo'
+    if not os.path.exists(hlo_path):
         raise ValueError(
-            'paddle_tpu.jit.load needs the layer instance to restore into '
-            '(XLA programs are recompiled from code, not deserialized)')
-    target = layer._target if isinstance(layer, StaticLayer) else layer
-    sd = {}
+            f'{hlo_path} not found: this artifact predates program '
+            f'serialization — pass the layer instance to restore into')
+    with open(hlo_path, 'rb') as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    params, frozen, buffers = {}, {}, {}
+    manifest = {}
+    try:
+        with open(path + '.pdmodel.json') as f:
+            manifest = json.load(f)
+    except OSError:
+        pass
+    frozen_names = set(manifest.get('frozen', []))
     for k in data.files:
         kind, name = k.split('::', 1)
-        sd[name] = data[k]
-    target.set_state_dict(sd)
-    return layer if isinstance(layer, StaticLayer) else StaticLayer(layer)
+        if kind == 'buffer':
+            buffers[name] = jnp.asarray(data[k])
+        elif name in frozen_names:
+            frozen[name] = jnp.asarray(data[k])
+        else:
+            params[name] = jnp.asarray(data[k])
+    return TranslatedLayer(exported, params, frozen, buffers, manifest)
 
 
 def not_to_static(fn):

@@ -57,6 +57,7 @@ def _bind(lib):
                                   ctypes.c_char_p, ctypes.c_int]
     lib.bpe_encode.restype = ctypes.c_int
     lib.bpe_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int32,
                                ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
     return lib
 
@@ -127,13 +128,17 @@ class FastBPETokenizer(BPETokenizer):
         if h is None:  # no compiler: python fallback
             return super().encode(text, add_special_tokens, max_length)
         lib = get_lib()
-        data = text.encode('utf-8')
+        # ' '.join(text.split()) reproduces python str.split() semantics
+        # exactly (unicode whitespace separators) so the C side only ever
+        # sees ASCII-space-separated words; words keep NUL bytes, which
+        # the explicit-length API passes through un-truncated
+        data = ' '.join(text.split()).encode('utf-8')
         cap = max(256, len(data) * 2)
         buf = (ctypes.c_int32 * cap)()
-        n = lib.bpe_encode(h, data, buf, cap)
+        n = lib.bpe_encode(h, data, len(data), buf, cap)
         if n > cap:  # pathological byte-fallback blowup: retry exact
             buf = (ctypes.c_int32 * n)()
-            n = lib.bpe_encode(h, data, buf, n)
+            n = lib.bpe_encode(h, data, len(data), buf, n)
         ids = list(buf[:n])
         if add_special_tokens:
             ids = [self.bos_token_id] + ids + [self.eos_token_id]

@@ -160,7 +160,8 @@ class GenerationMixin:
             if padded:
                 # left-padded prompts: per-sequence logical origin
                 offsets = jnp.sum(keep, axis=1).astype(jnp.int32) - s  # [B]
-                prefill_mask = padded_decode_mask(keep, s, jnp.int32(0), s)
+                prefill_mask = padded_decode_mask(keep, total, jnp.int32(0),
+                                                  s)
             else:
                 offsets = jnp.int32(0)
                 prefill_mask = None
@@ -241,7 +242,8 @@ class GenerationMixin:
 
             if padded:
                 offsets = jnp.sum(keep, axis=1).astype(jnp.int32) - s  # [B]
-                prefill_mask = padded_decode_mask(keep, s, jnp.int32(0), s)
+                prefill_mask = padded_decode_mask(keep, total, jnp.int32(0),
+                                                  s)
             else:
                 offsets = jnp.zeros((b,), jnp.int32)
                 prefill_mask = None
@@ -328,18 +330,16 @@ class GenerationMixin:
                  max_length: Optional[int] = None,
                  decode_strategy: str = 'greedy_search',
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 num_beams: int = 1, length_penalty: float = 0.0,
                  eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None, use_cache: bool = True,
                  seed: Optional[int] = None,
                  attention_mask=None, **kwargs) -> Tuple[Tensor, Tensor]:
         """Returns (generated ids [B, max_new_tokens], per-sequence score)."""
-        if decode_strategy not in ('greedy_search', 'sampling'):
+        if decode_strategy not in ('greedy_search', 'sampling', 'beam_search'):
             raise ValueError(f'unknown decode_strategy {decode_strategy!r}')
-        if attention_mask is not None:
-            raise NotImplementedError(
-                'generate() does not support padded prompts yet; batch '
-                'equal-length prompts (an attention_mask would be silently '
-                'mis-handled by the static decode cache, so this fails loud)')
+        if decode_strategy == 'beam_search' and num_beams < 1:
+            raise ValueError('beam_search requires num_beams >= 1')
         if kwargs:
             raise TypeError(f'generate() got unexpected kwargs '
                             f'{sorted(kwargs)}')
@@ -347,6 +347,17 @@ class GenerationMixin:
         if ids.ndim == 1:
             ids = ids[None, :]
         b, s = ids.shape
+        padded = attention_mask is not None
+        if padded:
+            keep = to_jax(attention_mask).astype(bool)
+            if keep.ndim == 1:
+                keep = keep[None, :]
+            if keep.shape != (b, s):
+                raise ValueError(
+                    f'attention_mask shape {keep.shape} does not match '
+                    f'input_ids shape {(b, s)}')
+        else:
+            keep = jnp.ones((b, s), bool)
         if max_length is not None:
             max_new_tokens = max(int(max_length) - s, 1)
         cfg = getattr(self, 'config', None)
@@ -363,14 +374,26 @@ class GenerationMixin:
         self.eval()
         try:
             params, frozen, buffers = functional_state(self)
-            cache = self.init_cache(b, s + max_new_tokens)
-            key = (jax.random.PRNGKey(seed) if seed is not None
-                   else framework.next_rng_key())
-            fn = self._decode_jit(int(max_new_tokens), decode_strategy,
-                                  float(temperature), int(top_k),
-                                  float(top_p), int(eos_token_id),
-                                  int(pad_token_id))
-            out, scores = fn(params, frozen, buffers, ids, cache, key)
+            total = s + max_new_tokens
+            if decode_strategy == 'beam_search':
+                # cache is beam-expanded to [B*K] inside decode after prefill
+                cache = self.init_cache(b, total)
+                fn = self._beam_decode_jit(int(max_new_tokens),
+                                           int(num_beams), int(eos_token_id),
+                                           int(pad_token_id),
+                                           float(length_penalty),
+                                           padded=padded)
+                out, scores = fn(params, frozen, buffers, ids, keep, cache)
+            else:
+                cache = self.init_cache(b, total)
+                key = (jax.random.PRNGKey(seed) if seed is not None
+                       else framework.next_rng_key())
+                fn = self._decode_jit(int(max_new_tokens), decode_strategy,
+                                      float(temperature), int(top_k),
+                                      float(top_p), int(eos_token_id),
+                                      int(pad_token_id), padded=padded)
+                out, scores = fn(params, frozen, buffers, ids, keep, cache,
+                                 key)
         finally:
             if was_training:
                 self.train()

@@ -381,8 +381,11 @@ def flash_attention_own(q, k, v, causal=False, block_q=128, block_k=128,
     """This repo's fully-owned differentiable flash attention,
     [B, S, H, D] layout (fwd online-softmax + FA-2 style bwd sweeps).
     Selected over the jax library kernel by PADDLE_TPU_OWN_FLASH=1."""
-    out, _ = _flash_own_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out
+    # undifferentiated (inference) path: skip the [B,H,Sq,128] fp32 LSE
+    # write — only the custom_vjp fwd rule below needs it as a residual
+    return flash_attention_fwd(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret,
+                               return_lse=False)
 
 
 def _flash_own_fwd(q, k, v, causal, block_q, block_k, interpret):

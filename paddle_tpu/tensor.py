@@ -71,6 +71,9 @@ class Tensor:
     def numel(self):
         return self.size
 
+    def dim(self):
+        return self._data.ndim
+
     @property
     def place(self):
         try:

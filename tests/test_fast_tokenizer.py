@@ -23,6 +23,12 @@ SAMPLES = [
     '  leading and   multiple   spaces  ',
     '',
     'a',
+    # unicode whitespace separators (str.split() semantics): nbsp, line
+    # separator, em-space, vertical tab
+    'quick\xa0brown fox jumps\x0bover',
+    # embedded NUL is a WORD byte in python, not a separator
+    'quick\x00brown fox',
+    'em\u2003space and\u2028line sep',
 ]
 
 

@@ -113,13 +113,62 @@ def test_jit_save_load_roundtrip(tmp_path):
     net = _mlp()
     x = paddle.randn([2, 4])
     expect = net(x).numpy()
-    jit.save(net, str(tmp_path / 'model'))
+    jit.save(net, str(tmp_path / 'model'),
+             input_spec=[jit.InputSpec([2, 4])])
     net2 = _mlp()
     # perturb then restore
     for p in net2.parameters():
         p._data = p.value + 1.0
     jit.load(str(tmp_path / 'model'), net2)
     np.testing.assert_allclose(net2(x).numpy(), expect, rtol=1e-6)
+
+
+def test_jit_load_without_class_runs_serialized_program(tmp_path):
+    """jit.load(path) alone must rebuild a callable from the serialized
+    StableHLO — upstream paddle.jit.load / TranslatedLayer semantics."""
+    net = _mlp()
+    x = paddle.randn([2, 4])
+    expect = net(x).numpy()
+    jit.save(net, str(tmp_path / 'model'),
+             input_spec=[jit.InputSpec([2, 4])])
+    translated = jit.load(str(tmp_path / 'model'))
+    got = translated(x).numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_requires_input_spec(tmp_path):
+    with pytest.raises(ValueError):
+        jit.save(_mlp(), str(tmp_path / 'model'))
+
+
+def test_jit_load_dynamic_batch(tmp_path):
+    """None dims in input_spec export as symbolic dims: one artifact
+    serves every batch size."""
+    net = _mlp()
+    jit.save(net, str(tmp_path / 'model'),
+             input_spec=[jit.InputSpec([None, 4])])
+    translated = jit.load(str(tmp_path / 'model'))
+    for b in (1, 3, 8):
+        x = paddle.randn([b, 4])
+        np.testing.assert_allclose(translated(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_jit_saved_program_is_eval_mode(tmp_path):
+    """The artifact is an inference program: dropout must be disabled even
+    if the layer was saved while in train mode."""
+    net = nn.Sequential(nn.Linear(4, 8), nn.Dropout(0.9), nn.Linear(8, 2))
+    net.train()
+    jit.save(net, str(tmp_path / 'model'),
+             input_spec=[jit.InputSpec([2, 4])])
+    assert net.training  # save restores the caller's mode
+    translated = jit.load(str(tmp_path / 'model'))
+    x = paddle.randn([2, 4])
+    a = translated(x).numpy()
+    b = translated(x).numpy()
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(a, net.eval()(x).numpy(), rtol=1e-5,
+                               atol=1e-6)
 
 
 def test_dropout_under_jit_is_deterministic_per_step():

@@ -90,20 +90,150 @@ class TestLlama:
         want = np.take_along_axis(logp, out.numpy().astype(int), 1)[:, 0]
         np.testing.assert_allclose(scores.numpy(), want, atol=1e-4)
 
-    def test_generate_rejects_padded_prompts_and_overflow(self):
+    def test_generate_rejects_overflow_and_bad_mask(self):
         cfg = LlamaConfig.tiny()
         m = LlamaForCausalLM(cfg).eval()
         ids = _ids(cfg, b=1, s=4)
-        with pytest.raises(NotImplementedError):
-            m.generate(ids, attention_mask=np.ones_like(ids))
         with pytest.raises(ValueError):
             m.generate(ids, max_new_tokens=cfg.max_position_embeddings)
+        with pytest.raises(ValueError):
+            m.generate(ids, attention_mask=np.ones((1, 3)))
+
+    def test_generate_left_padded_matches_unpadded(self):
+        """A left-padded prompt (attention_mask) must produce exactly the
+        tokens the unpadded prompt produces — pad slots are masked out of
+        attention and RoPE positions start at the first real token."""
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        real = _ids(cfg, b=1, s=4)
+        want, _ = m.generate(real, max_new_tokens=5, eos_token_id=-1)
+        pad = 2
+        padded = np.concatenate(
+            [np.zeros((1, pad), real.dtype), real], axis=1)
+        mask = np.concatenate(
+            [np.zeros((1, pad), np.int32), np.ones((1, 4), np.int32)],
+            axis=1)
+        got, _ = m.generate(padded, attention_mask=mask, max_new_tokens=5,
+                            eos_token_id=-1)
+        np.testing.assert_array_equal(got.numpy(), want.numpy())
+
+    def test_generate_padded_batch_matches_per_sequence(self):
+        """Batched generation of different-length prompts (left-padded to a
+        common length) must match generating each prompt alone."""
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(1, cfg.vocab_size, (n,)) for n in (3, 5)]
+        s = 5
+        padded = np.stack([np.pad(p, (s - len(p), 0)) for p in prompts])
+        mask = np.stack([np.pad(np.ones(len(p), np.int32), (s - len(p), 0))
+                         for p in prompts])
+        got, _ = m.generate(padded, attention_mask=mask, max_new_tokens=4,
+                            eos_token_id=-1)
+        for i, p in enumerate(prompts):
+            want, _ = m.generate(p[None, :], max_new_tokens=4,
+                                 eos_token_id=-1)
+            np.testing.assert_array_equal(got.numpy()[i], want.numpy()[0])
 
     def test_tied_embeddings(self):
         cfg = LlamaConfig.tiny(tie_word_embeddings=True)
         m = LlamaForCausalLM(cfg)
         assert m.lm_head is None
         assert m(_ids(cfg)).shape == [2, 12, cfg.vocab_size]
+
+
+def _ref_beam(m, prompt, K, max_new, eos, pad, length_penalty=0.0):
+    """Pure-python beam search over full (cache-free) forwards, mirroring
+    _beam_decode_jit's state machine: finished beams contribute exactly one
+    pad-continuation candidate with frozen score."""
+    NEG = np.float32(-1e9)
+
+    def logp_last(seq):
+        with paddle.no_grad():
+            lg = m(seq[None, :]).numpy()[0, -1].astype(np.float32)
+        return lg - np.log(np.exp(lg - lg.max()).sum()) - lg.max()
+
+    lp0 = logp_last(prompt)
+    V = lp0.shape[0]
+    order = np.argsort(-lp0, kind='stable')[:K]
+    scores = lp0[order].copy()
+    tok = order.astype(np.int64)
+    out = np.full((K, max_new), pad, np.int64)
+    finished = np.zeros(K, bool)
+    lengths = np.zeros(K, np.int64)
+    for i in range(max_new):
+        if finished.all():
+            break
+        tok = np.where(finished, pad, tok)
+        out[:, i] = tok
+        lengths = lengths + (~finished)
+        finished = finished | (tok == eos)
+        cand = np.full((K, V), NEG, np.float32)
+        for k in range(K):
+            if finished[k]:
+                cand[k, pad] = scores[k]
+            else:
+                seq = np.concatenate([prompt, out[k, :i + 1]])
+                cand[k] = scores[k] + logp_last(seq)
+        flat = np.argsort(-cand.ravel(), kind='stable')[:K]
+        scores = cand.ravel()[flat]
+        src = flat // V
+        tok = (flat % V).astype(np.int64)
+        out, finished, lengths = out[src], finished[src], lengths[src]
+    norm = np.maximum(lengths, 1).astype(np.float32) ** length_penalty
+    best = int(np.argmax(scores / norm))
+    return out[best], float((scores / norm)[best])
+
+
+class TestBeamSearch:
+    def test_beam_1_equals_greedy(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        ids = _ids(cfg, b=2, s=5)
+        greedy, _ = m.generate(ids, max_new_tokens=5, eos_token_id=-1)
+        beam, _ = m.generate(ids, max_new_tokens=5, eos_token_id=-1,
+                             decode_strategy='beam_search', num_beams=1)
+        np.testing.assert_array_equal(beam.numpy(), greedy.numpy())
+
+    def test_beam_k_matches_python_reference(self):
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        prompt = _ids(cfg, b=1, s=4, seed=11)[0]
+        got, got_score = m.generate(prompt[None, :], max_new_tokens=4,
+                                    eos_token_id=-1,
+                                    decode_strategy='beam_search',
+                                    num_beams=3)
+        want, want_score = _ref_beam(m, prompt, K=3, max_new=4, eos=-1,
+                                     pad=0)
+        np.testing.assert_array_equal(got.numpy()[0], want)
+        np.testing.assert_allclose(float(got_score.numpy()[0]), want_score,
+                                   atol=1e-3)
+
+    def test_beam_eos_freezes_and_pads(self):
+        """Force EOS to be the argmax continuation; the winning beam must
+        emit it once then pad, and its score must stop accumulating."""
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg).eval()
+        prompt = _ids(cfg, b=1, s=4, seed=3)[0]
+        with paddle.no_grad():
+            first = int(m(prompt[None, :]).numpy()[0, -1].argmax())
+        got, _ = m.generate(prompt[None, :], max_new_tokens=4,
+                            eos_token_id=first, pad_token_id=97,
+                            decode_strategy='beam_search', num_beams=2)
+        want, _ = _ref_beam(m, prompt, K=2, max_new=4, eos=first, pad=97)
+        np.testing.assert_array_equal(got.numpy()[0], want)
+
+    def test_beam_gpt_matches_python_reference(self):
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg).eval()
+        prompt = _ids(cfg, b=1, s=5, seed=5)[0]
+        got, _ = m.generate(prompt[None, :], max_new_tokens=3,
+                            eos_token_id=-1,
+                            decode_strategy='beam_search', num_beams=4,
+                            length_penalty=1.0)
+        want, _ = _ref_beam(m, prompt, K=4, max_new=3, eos=-1, pad=0,
+                            length_penalty=1.0)
+        np.testing.assert_array_equal(got.numpy()[0], want)
 
 
 class TestGPT:
