@@ -27,7 +27,8 @@ class BertConfig:
                  hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
                  max_position_embeddings=512, type_vocab_size=2,
                  initializer_range=0.02, layer_norm_eps=1e-12,
-                 pad_token_id=0, pool_act='tanh', num_labels=2, **kwargs):
+                 pad_token_id=0, pool_act='tanh', num_labels=2,
+                 use_recompute=False, **kwargs):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -43,6 +44,7 @@ class BertConfig:
         self.pad_token_id = pad_token_id
         self.pool_act = pool_act
         self.num_labels = num_labels
+        self.use_recompute = use_recompute
         for k, v in kwargs.items():
             setattr(self, k, v)
 
@@ -129,18 +131,39 @@ class BertModel(Layer):
         self.pooler = BertPooler(config) if add_pooling_layer else None
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None, extra_embeds=None):
+                attention_mask=None, extra_embeds=None, blocks_fn=None):
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(to_jax(input_ids))
+        h = self.embeddings(ids, token_type_ids, position_ids,
+                            extra_embeds=extra_embeds)
+        if blocks_fn is not None:
+            # pipeline-parallel path (fleet.DistTrainStep pp): the encoder
+            # stack is replaced by a scheduled collective program; the
+            # embeddings and pooler stay outside the pipelined region.
+            if attention_mask is not None:
+                raise ValueError('blocks_fn (pipeline) path supports only '
+                                 'unpadded full-length batches '
+                                 '(attention_mask unsupported)')
+            h = apply_op(blocks_fn, h, _name='pp_blocks')
+            pooled = self.pooler(h) if self.pooler is not None else None
+            return (h, pooled) if pooled is not None else h
         mask = attention_mask
         if mask is not None and not isinstance(mask, Tensor):
             mask = Tensor(to_jax(mask))
         if mask is not None and len(mask.shape) == 2:
             mask = apply_op(lambda m: (m > 0)[:, None, None, :], mask,
                             _name='pad_mask')
-        h = self.embeddings(ids, token_type_ids, position_ids,
-                            extra_embeds=extra_embeds)
-        h = self.encoder(h, src_mask=mask)
+        from .. import autograd as _ag
+        if self.config.use_recompute and _ag._state.functional:
+            # trade FLOPs for HBM exactly like LlamaModel (llama.py remat
+            # branch): rematerialize each encoder block in backward
+            import jax
+            for layer in self.encoder.layers:
+                h = Tensor(jax.checkpoint(
+                    lambda hv, l=layer, m=mask: l(Tensor(hv),
+                                                  src_mask=m).value)(h.value))
+        else:
+            h = self.encoder(h, src_mask=mask)
         pooled = self.pooler(h) if self.pooler is not None else None
         return (h, pooled) if pooled is not None else h
 
@@ -157,10 +180,15 @@ class BertForMaskedLM(Layer):
                                         epsilon=config.layer_norm_eps)
         self.decoder = Linear(config.hidden_size, config.vocab_size)
 
+    def pp_blocks(self):
+        """Pipeline-parallel protocol (consumed by fleet.DistTrainStep) —
+        see LlamaForCausalLM.pp_blocks."""
+        return 'bert.encoder.layers', list(self.bert.encoder.layers)
+
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
-                labels=None):
+                labels=None, blocks_fn=None):
         h = self.bert(input_ids, token_type_ids=token_type_ids,
-                      attention_mask=attention_mask)
+                      attention_mask=attention_mask, blocks_fn=blocks_fn)
         h = self.transform_norm(F.gelu(self.transform(h)))
         logits = self.decoder(h)
         if labels is not None:

@@ -40,7 +40,7 @@ class ErnieModel(Layer):
             self.task_type_embeddings = None
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None, task_type_ids=None):
+                attention_mask=None, task_type_ids=None, blocks_fn=None):
         task_emb = None
         if self.task_type_embeddings is not None:
             ids = input_ids if isinstance(input_ids, Tensor) \
@@ -53,7 +53,7 @@ class ErnieModel(Layer):
         return self.bert(input_ids, token_type_ids=token_type_ids,
                          position_ids=position_ids,
                          attention_mask=attention_mask,
-                         extra_embeds=task_emb)
+                         extra_embeds=task_emb, blocks_fn=blocks_fn)
 
 
 class ErnieForMaskedLM(Layer):
@@ -68,11 +68,19 @@ class ErnieForMaskedLM(Layer):
                                         epsilon=config.layer_norm_eps)
         self.decoder = Linear(config.hidden_size, config.vocab_size)
 
+    def pp_blocks(self):
+        """Pipeline-parallel protocol (consumed by fleet.DistTrainStep) —
+        see LlamaForCausalLM.pp_blocks. Covers BASELINE config #5 (ERNIE
+        with pipeline-parallel + recompute; upstream
+        fleet/meta_parallel/pipeline_parallel.py + recompute/)."""
+        return 'ernie.bert.encoder.layers', \
+            list(self.ernie.bert.encoder.layers)
+
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
-                task_type_ids=None, labels=None):
+                task_type_ids=None, labels=None, blocks_fn=None):
         h = self.ernie(input_ids, token_type_ids=token_type_ids,
                        attention_mask=attention_mask,
-                       task_type_ids=task_type_ids)
+                       task_type_ids=task_type_ids, blocks_fn=blocks_fn)
         h = self.transform_norm(F.gelu(self.transform(h)))
         logits = self.decoder(h)
         if labels is not None:
