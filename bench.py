@@ -65,6 +65,26 @@ def _configs(on_tpu):
     ]
 
 
+def _7b_configs():
+    """Llama-2 7B-shaped ladder (BASELINE headline #2): FULL 7B
+    hidden/FFN/head geometry (h=4096, ffn=11008, 32 heads, seq 4096),
+    depth reduced to what fits one 16 GB chip (bf16 params + bf16 Adam
+    moments are ~6 bytes/param: 32 layers = 40 GB, 8 layers = 11 GB),
+    full-block remat on. Reported per-layer metrics are geometry-honest;
+    the depth reduction is flagged in the output JSON."""
+    from paddle_tpu.nlp import LlamaConfig
+    shape = dict(vocab_size=32000, hidden_size=4096,
+                 intermediate_size=11008, num_attention_heads=32,
+                 num_key_value_heads=32, max_position_embeddings=4096,
+                 use_recompute=True)
+    l8 = LlamaConfig(num_hidden_layers=8, **shape)
+    return [
+        ('llama2_7b_shape_8L', l8, 4, 4096, 6, 2, 'bfloat16'),
+        ('llama2_7b_shape_8L', l8, 2, 4096, 6, 2, 'bfloat16'),
+        ('llama2_7b_shape_8L', l8, 2, 2048, 6, 2, 'bfloat16'),
+    ]
+
+
 def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
     import jax
     import paddle_tpu as paddle
@@ -103,6 +123,15 @@ def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
     final_loss = float(loss.numpy())  # sync on the last step
     dt = (time.perf_counter() - t0) / steps
 
+    peak_hbm = 0
+    try:
+        ma = step.memory_analysis(batches[0], batches[0])
+        peak_hbm = int(getattr(ma, 'peak_memory_in_bytes', 0)) or (
+            int(ma.argument_size_in_bytes) + int(ma.temp_size_in_bytes)
+            + int(ma.output_size_in_bytes) - int(ma.alias_size_in_bytes))
+    except Exception:
+        pass  # AOT introspection is best-effort; never kill the bench
+
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     # model FLOPs: 3x forward (fwd + 2x bwd); fwd = 2*N_matmul*B*S weight
     # matmuls + 4*B*S^2*H attention matmuls per layer (remat recompute
@@ -123,6 +152,7 @@ def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
         'loss': final_loss,
         'params_m': round(n_params / 1e6, 1),
         'batch': batch, 'seq': seq, 'dtype': dtype,
+        'peak_hbm_gb': round(peak_hbm / 2**30, 2),
     }
 
 
@@ -160,23 +190,42 @@ def _bench_flash_kernels():
         return {'flash_bench_error': type(e).__name__}
 
 
-def main():
+def _free_device_memory():
+    """Drop dead device buffers between ladder rungs: the autograd tape
+    creates reference cycles, so the previous rung's params/moments wait
+    on the cyclic GC — collect them NOW or the next rung sees an HBM
+    that is still full (r4: all 7B rungs OOMed behind the 1.3B run's
+    garbage)."""
+    import gc
     import jax
-    on_tpu = jax.default_backend() not in ('cpu',)
-    result = None
-    for name, cfg, batch, seq, steps, warmup, dtype in _configs(on_tpu):
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+
+
+def _run_ladder(configs):
+    """Run the first config of a ladder that fits; (name, result) or
+    (None, None) if every rung OOMs."""
+    for name, cfg, batch, seq, steps, warmup, dtype in configs:
         try:
-            result = _run_config(name, cfg, batch, seq, steps, warmup, dtype)
-            metric_name = name
-            break
+            return name, _run_config(name, cfg, batch, seq, steps, warmup,
+                                     dtype)
         except Exception as e:
             msg = str(e).lower()
             if 'resource' in msg or 'memory' in msg or 'oom' in msg \
                     or 'allocat' in msg or 'compile' in msg:
                 # OOM (or a compiler blow-up on the big config): try the
                 # next, smaller config and say so in the output
+                _free_device_memory()
                 continue
             raise
+    return None, None
+
+
+def main():
+    import jax
+    on_tpu = jax.default_backend() not in ('cpu',)
+    metric_name, result = _run_ladder(_configs(on_tpu))
     if result is None:
         raise RuntimeError('all bench configs failed')
     # only a different MODEL counts as a fallback (batch shrink within the
@@ -197,7 +246,28 @@ def main():
                    'batch': result['batch'], 'seq': result['seq'],
                    'dtype': result['dtype']},
     }
+    if result.get('peak_hbm_gb'):
+        out['peak_hbm_gb'] = result['peak_hbm_gb']
     if on_tpu:
+        # BASELINE headline #2: Llama-2 7B geometry (depth-reduced to fit
+        # one chip; reduction flagged — see _7b_configs)
+        _free_device_memory()
+        name7, res7 = _run_ladder(_7b_configs())
+        if res7 is not None:
+            out['llama2_7b_shape'] = {
+                'tokens_per_sec': round(res7['tokens_per_sec'], 1),
+                'mfu': round(res7['mfu'], 4),
+                'step_time_s': round(res7['step_time_s'], 4),
+                'loss': round(res7['loss'], 4),
+                'params_m': res7['params_m'],
+                'batch': res7['batch'], 'seq': res7['seq'],
+                'peak_hbm_gb': res7.get('peak_hbm_gb'),
+                'layers': 8, 'layers_full_7b': 32,
+                'depth_reduced_to_fit_hbm': True,
+            }
+        else:
+            out['llama2_7b_shape'] = {'error': 'all 7B-shape rungs OOMed'}
+        _free_device_memory()
         out.update(_bench_flash_kernels())
     print(json.dumps(out))
 

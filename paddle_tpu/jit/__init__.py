@@ -249,6 +249,30 @@ class TrainStep:
 
         self._jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
+    @staticmethod
+    def _as_batch(inputs, labels):
+        return (
+            _tree.tree_map(lambda v: v.value if isinstance(v, Tensor)
+                           else jnp.asarray(v), inputs,
+                           is_leaf=lambda v: isinstance(v, Tensor)),
+            _tree.tree_map(lambda v: v.value if isinstance(v, Tensor)
+                           else jnp.asarray(v), labels,
+                           is_leaf=lambda v: isinstance(v, Tensor)))
+
+    def memory_analysis(self, inputs, labels):
+        """XLA's CompiledMemoryStats for the step at these batch shapes
+        (peak_memory_in_bytes, temp/argument/output sizes). The AOT
+        lower().compile() hits the jit cache, so after the step has run
+        once this costs no recompile."""
+        params, frozen, buffers = functional_state(self.layer)
+        if self._opt_state is None:
+            self._opt_state = self.optimizer.init_state(params)
+        key = jax.random.fold_in(self._step_key_root, 0)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        return self._jitted.lower(
+            params, self._opt_state, buffers, frozen, key, lr,
+            self._as_batch(inputs, labels)).compile().memory_analysis()
+
     def __call__(self, inputs, labels):
         params, frozen, buffers = functional_state(self.layer)
         if self._opt_state is None:
@@ -256,13 +280,7 @@ class TrainStep:
         key = jax.random.fold_in(self._step_key_root, self._n_calls)
         self._n_calls += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        batch = (
-            _tree.tree_map(lambda v: v.value if isinstance(v, Tensor)
-                           else jnp.asarray(v), inputs,
-                           is_leaf=lambda v: isinstance(v, Tensor)),
-            _tree.tree_map(lambda v: v.value if isinstance(v, Tensor)
-                           else jnp.asarray(v), labels,
-                           is_leaf=lambda v: isinstance(v, Tensor)))
+        batch = self._as_batch(inputs, labels)
         loss, new_params, self._opt_state, new_bufs = self._jitted(
             params, self._opt_state, buffers, frozen, key, lr, batch)
         # write back into the live Layer

@@ -16,6 +16,37 @@ import os
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 
+def _validate_tokenizer_state(fname: str, state) -> Dict:
+    """Schema check for a saved tokenizer.json; raises ValueError naming
+    the file and the first offending entry."""
+    if not isinstance(state, dict):
+        raise ValueError(
+            f'{fname}: expected a JSON object, got {type(state).__name__}')
+    vocab = state.get('vocab')
+    if not isinstance(vocab, dict) or not vocab:
+        raise ValueError(f"{fname}: 'vocab' must be a non-empty object "
+                         f'mapping token -> id')
+    for tok, idx in vocab.items():
+        if not isinstance(idx, int) or isinstance(idx, bool) or idx < 0:
+            raise ValueError(
+                f'{fname}: vocab entry {tok!r} has invalid id {idx!r} '
+                f'(want a non-negative integer)')
+    ids = list(vocab.values())
+    if len(set(ids)) != len(ids):
+        dup = next(i for i in ids if ids.count(i) > 1)
+        raise ValueError(f'{fname}: duplicate token id {dup} in vocab')
+    merges = state.get('merges', [])
+    if not isinstance(merges, list):
+        raise ValueError(f"{fname}: 'merges' must be a list")
+    for m in merges:
+        if not (isinstance(m, (list, tuple)) and len(m) == 2
+                and all(isinstance(s, str) for s in m)):
+            raise ValueError(
+                f'{fname}: merge entry {m!r} is not a [left, right] '
+                f'string pair')
+    return state
+
+
 class PretrainedTokenizer:
     pad_token = '<pad>'
     unk_token = '<unk>'
@@ -122,15 +153,21 @@ class PretrainedTokenizer:
     @classmethod
     def from_pretrained(cls, path: str):
         """Load from a local directory. Hub names are rejected offline
-        (reference downloads from bos/huggingface; zero-egress here)."""
+        (reference downloads from bos/huggingface; zero-egress here).
+        The file schema is validated up front so a malformed directory
+        fails with a clear message, not a KeyError mid-load."""
         fname = os.path.join(path, 'tokenizer.json')
         if not os.path.isfile(fname):
             raise OSError(
                 f'{path!r} is not a local tokenizer directory (offline '
                 f'build: hub downloads are disabled; call save_pretrained '
                 f'first)')
-        with open(fname) as f:
-            state = json.load(f)
+        try:
+            with open(fname) as f:
+                state = json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise ValueError(f'{fname}: not valid JSON: {e}') from e
+        state = _validate_tokenizer_state(fname, state)
         klass = {c.__name__: c for c in
                  (WhitespaceTokenizer, BPETokenizer)}.get(
                      state.get('class'), cls)

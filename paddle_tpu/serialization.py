@@ -129,3 +129,36 @@ def load(path: str, return_numpy=False, **config) -> Any:
     data = np.load(path, allow_pickle=False)
     manifest = json.loads(str(data['manifest']))
     return _unflatten(manifest, data, return_numpy)
+
+
+def save_sharded(obj: Any, dirname: str, n_shards: int = 8):
+    """Serialize like `save`, but through the parallel C++ shard writer
+    (csrc/ckpt_sharder.cpp): arrays are size-balanced across
+    `shard_<k>.bin` files written by one thread each — no zip/CRC pass,
+    so large checkpoints write several times faster than the npz
+    container. Layout: tree.json (structure) + manifest.json + shards."""
+    from .utils import ckpt_native
+    os.makedirs(dirname, exist_ok=True)
+    arrays: list = []
+    manifest = _flatten(obj, arrays, '<root>')
+    ckpt_native.write_shards(
+        dirname, {f'a{i}': a for i, a in enumerate(arrays)},
+        n_shards=n_shards)
+    tmp = os.path.join(dirname, 'tree.json.tmp')
+    with open(tmp, 'w') as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(dirname, 'tree.json'))
+
+
+def load_sharded(dirname: str, return_numpy=False) -> Any:
+    """Restore an object tree saved by `save_sharded` (parallel C++
+    shard reader)."""
+    tree_file = os.path.join(dirname, 'tree.json')
+    if not os.path.isfile(tree_file):
+        raise FileNotFoundError(
+            f'{dirname!r} is not a sharded checkpoint (no tree.json)')
+    from .utils import ckpt_native
+    with open(tree_file) as f:
+        manifest = json.load(f)
+    return _unflatten(manifest, ckpt_native.read_shards(dirname),
+                      return_numpy)

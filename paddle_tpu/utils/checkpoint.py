@@ -51,7 +51,15 @@ class CheckpointManager:
         self._ocp = _try_orbax() if backend in (None, 'orbax') else None
         if backend == 'orbax' and self._ocp is None:
             raise RuntimeError('orbax backend requested but not importable')
-        self.backend = 'orbax' if self._ocp is not None else 'npz'
+        if backend == 'native':
+            from . import ckpt_native
+            if not ckpt_native.available():
+                raise RuntimeError(
+                    'native backend requested but the C++ checkpoint '
+                    'sharder is unavailable (no compiler?)')
+            self.backend = 'native'
+        else:
+            self.backend = 'orbax' if self._ocp is not None else 'npz'
         self._pending: Optional[threading.Thread] = None
 
     # -- bookkeeping --------------------------------------------------------
@@ -92,6 +100,9 @@ class CheckpointManager:
             ckptr = self._ocp.StandardCheckpointer()
             ckptr.save(os.path.join(tmp, 'tree'), host_tree)
             ckptr.wait_until_finished()
+        elif self.backend == 'native':
+            serialization.save_sharded(host_tree,
+                                       os.path.join(tmp, 'tree_sharded'))
         else:
             serialization.save(host_tree, os.path.join(tmp, 'tree.npz'))
         with open(os.path.join(tmp, '_COMMITTED'), 'w') as f:
@@ -141,6 +152,9 @@ class CheckpointManager:
                 return ckptr.restore(os.path.join(d, 'tree'),
                                      target=host_template)
             return ckptr.restore(os.path.join(d, 'tree'))
+        if meta['backend'] == 'native':
+            return serialization.load_sharded(
+                os.path.join(d, 'tree_sharded'), return_numpy=True)
         return serialization.load(os.path.join(d, 'tree.npz'),
                                   return_numpy=True)
 
