@@ -45,22 +45,26 @@ def _configs(on_tpu):
     from paddle_tpu.nlp import LlamaConfig
     if not on_tpu:
         return [('llama_tiny', LlamaConfig.tiny(), 2, 64, 3, 1, 'float32')]
-    # full-block recompute, not 'dots': at 24 layers x batch 8 x seq 2048
-    # the dots policy's saved matmul outputs alone (~10 GB) blow the 16 GB
-    # HBM; full remat keeps only block inputs (~1.6 GB) and re-runs each
-    # block's forward inside backward — the classic memory/FLOPs trade
-    gpt3_xl = LlamaConfig(
-        vocab_size=50304, hidden_size=2048, intermediate_size=5504,
-        num_hidden_layers=24, num_attention_heads=16,
-        num_key_value_heads=16, max_position_embeddings=4096,
-        use_recompute=True)
+    # remat policy (r4 sweep on v5e, BENCH experiments E1-E4):
+    # 'dots_no_batch' keeps weight-matmul outputs and recomputes only
+    # attention + elementwise in backward — at batch 2 the saved outputs
+    # (~2.5 GB) fit beside params+moments and MFU jumps 0.50 -> 0.64
+    # vs full-block remat at batch 8 (whose extra forward is ~1/4 of
+    # step flops). Full-remat rungs remain as OOM fallbacks.
+    shape = dict(vocab_size=50304, hidden_size=2048,
+                 intermediate_size=5504, num_hidden_layers=24,
+                 num_attention_heads=16, num_key_value_heads=16,
+                 max_position_embeddings=4096)
+    gpt3_dots = LlamaConfig(use_recompute='dots_no_batch', **shape)
+    gpt3_full = LlamaConfig(use_recompute=True, **shape)
     m740 = LlamaConfig(
         vocab_size=32000, hidden_size=2048, intermediate_size=5504,
         num_hidden_layers=12, num_attention_heads=16,
         num_key_value_heads=16, max_position_embeddings=4096)
     return [
-        ('gpt3_1p3b', gpt3_xl, 8, 2048, 10, 2, 'bfloat16'),
-        ('gpt3_1p3b', gpt3_xl, 4, 2048, 10, 2, 'bfloat16'),
+        ('gpt3_1p3b', gpt3_dots, 2, 2048, 10, 2, 'bfloat16'),
+        ('gpt3_1p3b', gpt3_full, 8, 2048, 10, 2, 'bfloat16'),
+        ('gpt3_1p3b', gpt3_full, 4, 2048, 10, 2, 'bfloat16'),
         ('llama_740m', m740, 4, 2048, 10, 2, 'bfloat16'),
     ]
 
@@ -76,12 +80,14 @@ def _7b_configs():
     shape = dict(vocab_size=32000, hidden_size=4096,
                  intermediate_size=11008, num_attention_heads=32,
                  num_key_value_heads=32, max_position_embeddings=4096,
-                 use_recompute=True)
-    l8 = LlamaConfig(num_hidden_layers=8, **shape)
+                 num_hidden_layers=8)
+    l8_dots = LlamaConfig(use_recompute='dots_no_batch', **shape)
+    l8_full = LlamaConfig(use_recompute=True, **shape)
     return [
-        ('llama2_7b_shape_8L', l8, 4, 4096, 6, 2, 'bfloat16'),
-        ('llama2_7b_shape_8L', l8, 2, 4096, 6, 2, 'bfloat16'),
-        ('llama2_7b_shape_8L', l8, 2, 2048, 6, 2, 'bfloat16'),
+        ('llama2_7b_shape_8L', l8_dots, 1, 4096, 6, 2, 'bfloat16'),
+        ('llama2_7b_shape_8L', l8_full, 4, 4096, 6, 2, 'bfloat16'),
+        ('llama2_7b_shape_8L', l8_full, 2, 4096, 6, 2, 'bfloat16'),
+        ('llama2_7b_shape_8L', l8_full, 2, 2048, 6, 2, 'bfloat16'),
     ]
 
 
@@ -157,27 +163,31 @@ def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
 
 
 def _bench_flash_kernels():
-    """Own pallas flash (fwd+bwd) vs jax library kernel, one fwd+bwd each
-    (VERDICT r2 #8: measured justification for the kernel choice)."""
+    """Own pallas flash (fwd+bwd) vs jax library kernel (VERDICT r2 #8:
+    measured justification for the kernel choice). The timing loop runs
+    ON DEVICE (lax.fori_loop chaining q through the gradient) — host
+    loops over a tunneled TPU measure RPC pipelining/caching, not the
+    kernel (r4: host-loop timings swung 11-18 ms run to run)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.ops import pallas_kernels as pk
     rng = np.random.RandomState(0)
     shape = (4, 2048, 16, 128)
-    q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-    k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-    v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    q0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    k0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    v0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    n = 10
 
     def time_fn(f):
-        g = jax.jit(jax.grad(lambda a, b, c: jnp.sum(
-            f(a, b, c).astype(jnp.float32)), argnums=(0, 1, 2)))
-        r = g(q, k, v)  # compile + warm
-        jax.block_until_ready(r)
+        def body(i, q):
+            dq = jax.grad(lambda a: jnp.sum(
+                f(a, k0, v0).astype(jnp.float32)))(q)
+            return (q + dq * jnp.bfloat16(1e-4)).astype(jnp.bfloat16)
+        g = jax.jit(lambda q: jax.lax.fori_loop(0, n, body, q))
+        jax.block_until_ready(g(q0))  # compile + warm
         t0 = time.perf_counter()
-        for _ in range(5):
-            r = g(q, k, v)
-        jax.block_until_ready(r)
-        return (time.perf_counter() - t0) / 5 * 1e3
+        jax.block_until_ready(g(q0))
+        return (time.perf_counter() - t0) / n * 1e3
 
     try:
         own_ms = time_fn(lambda a, b, c: pk.flash_attention_own(
@@ -250,9 +260,15 @@ def main():
         out['peak_hbm_gb'] = result['peak_hbm_gb']
     if on_tpu:
         # BASELINE headline #2: Llama-2 7B geometry (depth-reduced to fit
-        # one chip; reduction flagged — see _7b_configs)
+        # one chip; reduction flagged — see _7b_configs). Never let the
+        # secondary ladder kill the already-measured headline.
         _free_device_memory()
-        name7, res7 = _run_ladder(_7b_configs())
+        try:
+            name7, res7 = _run_ladder(_7b_configs())
+        except Exception as e:
+            name7, res7 = None, None
+            print(f'# 7B ladder failed: {type(e).__name__}: {e}',
+                  file=sys.stderr)
         if res7 is not None:
             out['llama2_7b_shape'] = {
                 'tokens_per_sec': round(res7['tokens_per_sec'], 1),

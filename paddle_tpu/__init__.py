@@ -40,14 +40,20 @@ from .serialization import load, save
 from .nn.layer import ParamAttr
 from .optimizer import L1Decay, L2Decay
 
+from . import static
+from . import sparse
+from . import quantization
+
 bool = bool_  # paddle.bool
 
 __version__ = '0.1.0'
 
-disable_static = lambda *a, **k: None  # DyGraph is the only eager mode here
-enable_static = lambda *a, **k: None
+disable_static = static.disable_static
+enable_static = static.enable_static
 
-in_dynamic_mode = lambda: True
+
+def in_dynamic_mode() -> bool:
+    return not static.in_static_mode()
 
 
 def is_grad_enabled():

@@ -358,7 +358,8 @@ class DistTrainStep:
             gran = st.recompute_configs.get('granularity', 'full')
             cfg = getattr(layer, 'config', None)
             if cfg is not None and hasattr(cfg, 'use_recompute'):
-                cfg.use_recompute = 'dots' if gran == 'dots' else True
+                cfg.use_recompute = gran if gran in (
+                    'dots', 'dots_no_batch') else True
             else:
                 self._recompute_whole = True  # jax.checkpoint whole fwd
 

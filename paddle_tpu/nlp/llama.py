@@ -309,9 +309,17 @@ class LlamaModel(LlamaPretrainedModel):
                 # (upstream: recompute_configs; here jax.checkpoint —
                 # closed-over traced params are lifted and differentiated).
                 # use_recompute='dots' keeps matmul outputs and recomputes
-                # only elementwise chains — usually the better trade.
-                policy = (jax.checkpoint_policies.dots_saveable
-                          if self.config.use_recompute == 'dots' else None)
+                # only elementwise chains; 'dots_no_batch' keeps only
+                # weight-matmul outputs (batched attention dots at
+                # b*h*s^2 would blow HBM) — the middle trade: backward
+                # re-runs just attention + elementwise, so the remat
+                # overhead drops from ~1/3 of model flops to a few %.
+                policy = {
+                    'dots': jax.checkpoint_policies.dots_saveable,
+                    'dots_no_batch':
+                        jax.checkpoint_policies
+                        .dots_with_no_batch_dims_saveable,
+                }.get(self.config.use_recompute)
                 out = Tensor(jax.checkpoint(
                     lambda hv, l=layer: l(
                         Tensor(hv), position_offset=position_offset,

@@ -77,6 +77,9 @@ class Layer:
             for store in (subs, bufs):
                 if store is not None and name in store:
                     del store[name]
+            # a prior plain assignment (e.g. `self.bias = None`) would
+            # shadow the parameter store at lookup time — un-shadow it
+            self.__dict__.pop(name, None)
             params[name] = value
         elif isinstance(value, Layer):
             if subs is None:
@@ -84,8 +87,10 @@ class Layer:
             for store in (params, bufs):
                 if store is not None and name in store:
                     del store[name]
+            self.__dict__.pop(name, None)
             subs[name] = value
         elif bufs is not None and name in bufs:
+            self.__dict__.pop(name, None)
             bufs[name] = value
         elif params is not None and name in params and value is None:
             params[name] = None
@@ -153,6 +158,10 @@ class Layer:
     def register_buffer(self, name, tensor, persistable=True):
         if tensor is not None and not isinstance(tensor, Tensor):
             raise TypeError('register_buffer expects a Tensor')
+        # a prior plain assignment (`self.m = None`) would shadow the
+        # buffer store at lookup time — un-shadow it (same rule as
+        # __setattr__'s Parameter/Layer/buffer branches)
+        self.__dict__.pop(name, None)
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)

@@ -1,0 +1,205 @@
+"""paddle.quantization compatibility layer (upstream:
+python/paddle/quantization/ — QuantConfig, PTQ, QAT, observers/quanters).
+
+TPU-native design, two paths:
+- PTQ (post-training): per-channel absmax int8 weight quantization of
+  Linear layers. The quantized layer stores int8 weights + fp32 scales
+  and dequantizes into the matmul dtype at call time — weights sit in
+  HBM at 1/2 (vs bf16) or 1/4 (vs fp32) the bytes, and the matmul stays
+  on the MXU's native bf16 path.
+- QAT (quant-aware training): FakeQuantAbsMax straight-through-estimator
+  wrapping on Linear forward — quantization error is simulated in fwd,
+  gradients pass through unchanged (lax.stop_gradient residual trick).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.common_layers import Linear
+from ..nn.layer import Layer
+from ..tensor import Tensor, apply_op
+
+__all__ = ['QuantConfig', 'PTQ', 'QAT', 'QuantedLinear',
+           'FakeQuantAbsMax', 'quanted_state_bytes']
+
+
+class QuantConfig:
+    """Which layers to quantize (upstream: paddle.quantization.QuantConfig
+    with activation/weight quanter factories; here weight-only int8)."""
+
+    def __init__(self, activation=None, weight='abs_max_channel_wise'):
+        self.activation = activation
+        self.weight = weight
+        self._types = (Linear,)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._types = tuple(set(self._types) | set(layer_types))
+        return self
+
+
+def _absmax_scales(w: np.ndarray, axis: int = 0) -> np.ndarray:
+    """Per-output-channel absmax scale mapping to int8 [-127, 127]."""
+    amax = np.max(np.abs(w), axis=axis, keepdims=True)
+    return np.where(amax == 0, 1.0, amax / 127.0).astype(np.float32)
+
+
+class QuantedLinear(Layer):
+    """Linear with int8 weights + per-channel scales (upstream analogue:
+    quanted nn.Linear produced by PTQ.convert)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 has_bias: bool = True, compute_dtype='float32'):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.compute_dtype = compute_dtype
+        self.register_buffer('weight_int8', Tensor(
+            jnp.zeros((in_features, out_features), jnp.int8)))
+        self.register_buffer('weight_scale', Tensor(
+            jnp.ones((1, out_features), jnp.float32)))
+        self.bias = None
+
+    @classmethod
+    def from_linear(cls, lin: Linear) -> 'QuantedLinear':
+        w = np.asarray(lin.weight.value, np.float32)
+        q = cls(w.shape[0], w.shape[1], has_bias=lin.bias is not None)
+        scales = _absmax_scales(w)
+        wq = np.clip(np.round(w / scales), -127, 127).astype(np.int8)
+        q.weight_int8 = Tensor(jnp.asarray(wq))
+        q.weight_scale = Tensor(jnp.asarray(scales))
+        if lin.bias is not None:
+            q.bias = lin.bias
+        q.compute_dtype = ('bfloat16'
+                           if lin.weight.value.dtype == jnp.bfloat16
+                           else 'float32')
+        return q
+
+    def forward(self, x):
+        cd = jnp.dtype(self.compute_dtype)
+
+        def run(xv, wq, sc, *maybe_bias):
+            w = wq.astype(cd) * sc.astype(cd)
+            y = xv @ w
+            if maybe_bias:
+                y = y + maybe_bias[0].astype(y.dtype)
+            return y
+        args = (x, self.weight_int8, self.weight_scale)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return apply_op(run, *args, _name='quanted_linear')
+
+
+class FakeQuantAbsMax(Layer):
+    """QAT fake-quantizer: int8-rounds in forward, identity in backward
+    (straight-through estimator via the stop_gradient residual)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def forward(self, x):
+        def fq(v):
+            amax = jnp.max(jnp.abs(v), axis=0, keepdims=True)
+            scale = jnp.where(amax == 0, 1.0, amax / self.qmax)
+            q = jnp.clip(jnp.round(v / scale), -self.qmax, self.qmax) * scale
+            # STE: forward sees q, backward sees identity
+            return v + jax.lax.stop_gradient(q - v)
+        return apply_op(fq, x, _name='fake_quant_absmax')
+
+
+class _QATLinear(Layer):
+    def __init__(self, lin: Linear, quanter: FakeQuantAbsMax):
+        super().__init__()
+        self.inner = lin
+        self.quanter = quanter
+
+    def forward(self, x):
+        w = self.quanter(self.inner.weight)
+        y = x @ w if self.inner.bias is None else x @ w + self.inner.bias
+        return y
+
+
+def _replace_layers(model: Layer, predicate, factory) -> int:
+    n = 0
+    for holder in model.sublayers(include_self=True):
+        for name, child in list(holder.named_children()):
+            if predicate(child):
+                holder.add_sublayer(name, factory(child))
+                n += 1
+    return n
+
+
+class PTQ:
+    """Post-training weight quantization driver (upstream:
+    paddle.quantization.PTQ.quantize/convert)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if type(model) in self.config._types and isinstance(model, Linear):
+            # the model IS the quantizable layer — no parent to rebind
+            if inplace:
+                raise ValueError('cannot quantize a bare Linear inplace; '
+                                 'use the returned layer')
+            return QuantedLinear.from_linear(model)
+        m = model if inplace else copy.deepcopy(model)
+        hits = _replace_layers(
+            m, lambda l: type(l) in self.config._types
+            and isinstance(l, Linear),
+            QuantedLinear.from_linear)
+        if hits == 0:
+            raise ValueError('PTQ.quantize found no quantizable layers '
+                             f'(config types: {self.config._types})')
+        return m
+
+    # upstream calls the de-simulation step `convert`; weight-only PTQ is
+    # already in deployable form, so convert is the identity
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        return model if inplace else copy.deepcopy(model)
+
+
+class QAT:
+    """Quant-aware training driver: wraps Linear weights in fake-quant
+    STE nodes; `convert` turns the trained model into QuantedLinear."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if type(model) in self.config._types and isinstance(model, Linear):
+            if inplace:
+                raise ValueError('cannot quantize a bare Linear inplace; '
+                                 'use the returned layer')
+            return _QATLinear(copy.deepcopy(model), FakeQuantAbsMax())
+        m = model if inplace else copy.deepcopy(model)
+        hits = _replace_layers(
+            m, lambda l: type(l) in self.config._types
+            and isinstance(l, Linear),
+            lambda lin: _QATLinear(lin, FakeQuantAbsMax()))
+        if hits == 0:
+            raise ValueError('QAT.quantize found no quantizable layers')
+        return m
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        m = model if inplace else copy.deepcopy(model)
+        _replace_layers(m, lambda l: isinstance(l, _QATLinear),
+                        lambda q: QuantedLinear.from_linear(q.inner))
+        return m
+
+
+def quanted_state_bytes(model: Layer) -> int:
+    """HBM bytes of quantized weight state (for compression reporting)."""
+    total = 0
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, QuantedLinear):
+            total += layer.weight_int8.value.nbytes
+            total += layer.weight_scale.value.nbytes
+    return total

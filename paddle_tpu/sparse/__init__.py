@@ -1,0 +1,303 @@
+"""paddle.sparse compatibility layer (upstream: python/paddle/sparse/ —
+SparseCooTensor/SparseCsrTensor creation, conversion, unary/binary ops,
+matmul).
+
+TPU-native design: COO tensors wrap `jax.experimental.sparse.BCOO`
+(XLA-lowerable batched-COO — the only sparse format with a real XLA
+lowering path); CSR keeps paddle's (crows, cols, values) surface and
+converts to BCOO for compute. Dense<->sparse conversions and
+`sparse.matmul` against dense operands run on device; elementwise
+binaries require matching sparsity patterns (documented upstream
+behavior for same-shape COO inputs after coalesce)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as _jsparse
+
+from ..tensor import Tensor, to_jax
+
+__all__ = [
+    'sparse_coo_tensor', 'sparse_csr_tensor', 'SparseCooTensor',
+    'SparseCsrTensor', 'is_same_shape', 'add', 'subtract', 'multiply',
+    'matmul', 'masked_matmul', 'relu', 'tanh', 'sqrt', 'sin', 'abs',
+    'neg', 'pow', 'cast', 'transpose', 'nn',
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor over BCOO; `indices` follows paddle's
+    [sparse_ndim, nnz] layout (BCOO stores [nnz, ndim] internally)."""
+
+    is_sparse_coo_val = True
+
+    def __init__(self, bcoo: _jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface -----------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.data.dtype
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self) -> 'SparseCooTensor':
+        return SparseCooTensor(
+            _jsparse.bcoo_sum_duplicates(self._bcoo))
+
+    def to_sparse_csr(self) -> 'SparseCsrTensor':
+        if len(self.shape) != 2:
+            raise ValueError('to_sparse_csr supports 2-D tensors only')
+        coo = _jsparse.bcoo_sum_duplicates(self._bcoo)
+        rows, cols = coo.indices[:, 0], coo.indices[:, 1]
+        order = jnp.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], coo.data[order]
+        n_rows = self.shape[0]
+        crows = jnp.zeros(n_rows + 1, jnp.int64).at[rows + 1].add(1)
+        return SparseCsrTensor(jnp.cumsum(crows), cols, vals, self.shape)
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def astype(self, dtype) -> 'SparseCooTensor':
+        return SparseCooTensor(_jsparse.BCOO(
+            (self._bcoo.data.astype(jnp.dtype(dtype)), self._bcoo.indices),
+            shape=self._bcoo.shape))
+
+    def t(self) -> 'SparseCooTensor':
+        return transpose(self, list(range(len(self.shape)))[::-1])
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return (f'SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, '
+                f'dtype={self.dtype})')
+
+    def _unary(self, fn) -> 'SparseCooTensor':
+        return SparseCooTensor(_jsparse.BCOO(
+            (fn(self._bcoo.data), self._bcoo.indices),
+            shape=self._bcoo.shape))
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR surface (crows/cols/values); compute converts to BCOO."""
+
+    def __init__(self, crows, cols, values, shape: Sequence[int]):
+        self._crows = jnp.asarray(crows)
+        self._cols = jnp.asarray(cols)
+        self._values = jnp.asarray(values)
+        self._shape = list(int(s) for s in shape)
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    def _rows(self):
+        counts = jnp.diff(self._crows)
+        return jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz())
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        idx = jnp.stack([self._rows(), self._cols], axis=1)
+        return SparseCooTensor(_jsparse.BCOO((self._values, idx),
+                                             shape=tuple(self._shape)))
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.to_dense().value)
+
+    def __repr__(self):
+        return (f'SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, '
+                f'dtype={self.dtype})')
+
+
+def _as_bcoo(x) -> _jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()._bcoo
+    raise TypeError(f'expected a sparse tensor, got {type(x).__name__}')
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """Build a COO tensor from paddle-layout [sparse_ndim, nnz] indices."""
+    idx = jnp.asarray(to_jax(indices), jnp.int32).T
+    vals = jnp.asarray(to_jax(values))
+    if dtype is not None:
+        vals = vals.astype(jnp.dtype(dtype))
+    if shape is None:
+        shape = tuple(int(s) for s in (idx.max(axis=0) + 1))
+    return SparseCooTensor(
+        _jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    vals = jnp.asarray(to_jax(values))
+    if dtype is not None:
+        vals = vals.astype(jnp.dtype(dtype))
+    return SparseCsrTensor(jnp.asarray(to_jax(crows), jnp.int64),
+                           jnp.asarray(to_jax(cols), jnp.int64),
+                           vals, shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# -- binary ops -------------------------------------------------------------
+
+def _binary(x, y, fn):
+    a, b = _as_bcoo(x), _as_bcoo(y)
+    if a.shape != b.shape:
+        raise ValueError(f'shape mismatch: {a.shape} vs {b.shape}')
+    a = _jsparse.bcoo_sum_duplicates(a)
+    b = _jsparse.bcoo_sum_duplicates(b)
+    # union of patterns via concat + sum_duplicates on transformed values
+    return SparseCooTensor(_jsparse.bcoo_sum_duplicates(_jsparse.BCOO(
+        (jnp.concatenate([a.data,
+                          -b.data if fn == 'sub' else b.data]),
+         jnp.concatenate([a.indices, b.indices])), shape=a.shape)))
+
+
+def add(x, y) -> SparseCooTensor:
+    return _binary(x, y, 'add')
+
+
+def subtract(x, y) -> SparseCooTensor:
+    return _binary(x, y, 'sub')
+
+
+def multiply(x, y):
+    """Elementwise product. Sparse*scalar scales values; sparse*sparse
+    multiplies via the dense intersection (patterns need not match)."""
+    if isinstance(y, (int, float)):
+        x_ = _as_bcoo(x)
+        return SparseCooTensor(_jsparse.BCOO(
+            (x_.data * y, x_.indices), shape=x_.shape))
+    a = _jsparse.bcoo_sum_duplicates(_as_bcoo(x))
+    b_dense = _as_bcoo(y).todense()
+    gathered = b_dense[tuple(a.indices[:, i]
+                             for i in range(a.indices.shape[1]))]
+    return SparseCooTensor(_jsparse.BCOO(
+        (a.data * gathered, a.indices), shape=a.shape))
+
+
+def matmul(x, y) -> Tensor:
+    """sparse @ dense -> dense (upstream sparse.matmul); rides XLA's
+    BCOO dot_general lowering (gather + segment-sum on TPU)."""
+    yv = y.value if isinstance(y, Tensor) else jnp.asarray(to_jax(y))
+    return Tensor(_as_bcoo(x) @ yv)
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask) -> SparseCooTensor:
+    """dense @ dense sampled at `mask`'s sparsity (SDDMM)."""
+    m = _jsparse.bcoo_sum_duplicates(_as_bcoo(mask))
+    xv = x.value if isinstance(x, Tensor) else jnp.asarray(to_jax(x))
+    yv = y.value if isinstance(y, Tensor) else jnp.asarray(to_jax(y))
+    rows, cols = m.indices[:, 0], m.indices[:, 1]
+    vals = jnp.einsum('nk,nk->n', xv[rows], yv.T[cols])
+    return SparseCooTensor(_jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+# -- unary ops --------------------------------------------------------------
+
+def _make_unary(fn, name):
+    def op(x):
+        if isinstance(x, SparseCsrTensor):
+            coo = op(x.to_sparse_coo())
+            return coo.to_sparse_csr()
+        return x._unary(fn)
+    op.__name__ = name
+    return op
+
+
+relu = _make_unary(lambda v: jnp.maximum(v, 0), 'relu')
+tanh = _make_unary(jnp.tanh, 'tanh')
+sqrt = _make_unary(jnp.sqrt, 'sqrt')
+sin = _make_unary(jnp.sin, 'sin')
+abs = _make_unary(jnp.abs, 'abs')
+neg = _make_unary(jnp.negative, 'neg')
+
+
+def pow(x, factor):
+    return _make_unary(lambda v: jnp.power(v, factor), 'pow')(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    b = _as_bcoo(x)
+    idx = b.indices.astype(jnp.dtype(index_dtype)) if index_dtype else \
+        b.indices
+    vals = b.data.astype(jnp.dtype(value_dtype)) if value_dtype else b.data
+    return SparseCooTensor(_jsparse.BCOO((vals, idx), shape=b.shape))
+
+
+def transpose(x, perm) -> SparseCooTensor:
+    b = _as_bcoo(x)
+    return SparseCooTensor(_jsparse.bcoo_transpose(b, permutation=perm))
+
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+nn = type('nn', (), {'ReLU': _SparseReLU})

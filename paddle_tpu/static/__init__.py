@@ -1,0 +1,172 @@
+"""paddle.static compatibility layer (upstream: python/paddle/static/ —
+Program, program_guard, data, Executor).
+
+TPU-native design: there is no separate graph IR. Ops recorded on the
+DyGraph tape ARE the program — `static.data` creates named placeholder
+Tensors, user code builds the graph eagerly under `program_guard`
+(placeholders carry zero values at build time), and `Executor.run`
+replays the recorded subgraph as one pure jax function of the feeds
+(autograd._build_pure), jitted and cached per feed signature. XLA is
+the program; the tape is the ProgramDesc.
+
+Supported surface: enable_static/disable_static, in_static_mode, data,
+Program, program_guard, default_main_program, default_startup_program,
+Executor(place).run(feed=..., fetch_list=..., return_numpy=...),
+global_scope (no-op shim), InputSpec (re-export). Static-graph TRAINING
+(optimizer.minimize inside a program) is deliberately out: the
+framework's training path is DyGraph + jit.TrainStep (see SCOPE.md).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec parity)
+from ..tensor import Tensor
+
+
+class _Mode(threading.local):
+    def __init__(self):
+        self.static = False
+
+
+_mode = _Mode()
+
+
+def enable_static(place=None):
+    """`place` accepted for upstream signature parity (device selection
+    is global via paddle.set_device here)."""
+    _mode.static = True
+
+
+def disable_static(place=None):
+    _mode.static = False
+
+
+def in_static_mode() -> bool:
+    return _mode.static
+
+
+class Program:
+    """A named collection of placeholders + whatever tape the user built
+    from them (upstream: framework.Program / ProgramDesc)."""
+
+    def __init__(self):
+        self.placeholders: Dict[str, Tensor] = {}
+        self._jit_cache: Dict[Any, Any] = {}
+
+    def clone(self, for_test: bool = False) -> 'Program':
+        return self  # the tape is immutable once recorded
+
+    # upstream parity helpers
+    def all_parameters(self):
+        return []
+
+
+class _ProgramStack(threading.local):
+    def __init__(self):
+        self.main = Program()
+        self.startup = Program()
+        self.stack: List[Program] = []
+
+
+_programs = _ProgramStack()
+
+
+def default_main_program() -> Program:
+    return _programs.stack[-1] if _programs.stack else _programs.main
+
+
+def default_startup_program() -> Program:
+    return _programs.startup
+
+
+class program_guard:
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _programs.stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _programs.stack.pop()
+        return False
+
+
+def data(name: str, shape, dtype='float32', lod_level=0) -> Tensor:
+    """Declare a named feed placeholder (upstream: paddle.static.data).
+
+    Unknown dims (None/-1) are built at extent 1; Executor.run replays
+    the graph at the actual feed shapes, so ops must be
+    batch-polymorphic (true for the op set: jnp broadcasting rules)."""
+    build_shape = tuple(1 if (d is None or int(d) < 0) else int(d)
+                        for d in shape)
+    t = Tensor(jnp.zeros(build_shape, jnp.dtype(dtype)))
+    # placeholders must be tape-recorded downstream (the tape IS the
+    # program), and the tape skips ops whose inputs are all
+    # stop_gradient — so feeds are marked differentiable at build time
+    t.stop_gradient = False
+    t.name = name
+    prog = default_main_program()
+    prog.placeholders[name] = t
+    return t
+
+
+def global_scope():
+    """Scope shim: variables live on Tensors, not in a C++ scope."""
+    return None
+
+
+class Executor:
+    """Runs a recorded program (upstream: paddle/fluid/executor.py; here
+    a jitted replay of the tape — one XLA executable per feed
+    signature)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy: bool = True):
+        prog = program or default_main_program()
+        feed = feed or {}
+        if not fetch_list:
+            raise ValueError('Executor.run needs a non-empty fetch_list')
+        fetches = [f for f in fetch_list]
+        for f in fetches:
+            if not isinstance(f, Tensor):
+                raise TypeError(
+                    f'fetch_list entries must be Tensors built from '
+                    f'static.data placeholders, got {type(f).__name__}')
+        names = sorted(feed)
+        unknown = [n for n in names if n not in prog.placeholders]
+        if unknown:
+            raise KeyError(
+                f'feed names {unknown} were never declared via '
+                f'static.data in this program '
+                f'(declared: {sorted(prog.placeholders)})')
+        inputs = [prog.placeholders[n] for n in names]
+        vals = [jnp.asarray(feed[n]) for n in names]
+        key = (tuple(names),
+               tuple((v.shape, str(v.dtype)) for v in vals),
+               tuple(id(f) for f in fetches))
+        runner = prog._jit_cache.get(key)
+        if runner is None:
+            pure, _ = autograd._build_pure(fetches, inputs)
+
+            def traced(*xvals):
+                with autograd.functional_scope():
+                    return pure(*xvals)
+            runner = jax.jit(traced)
+            prog._jit_cache[key] = runner
+        outs = runner(*vals)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]

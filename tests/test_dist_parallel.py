@@ -348,13 +348,17 @@ def test_strategy_amp_has_effect():
     assert not np.allclose(base, amp_l, rtol=1e-7), 'amp knob had no effect'
 
 
-def test_strategy_recompute_wires_model_config():
+@pytest.mark.parametrize('granularity', ['dots', 'dots_no_batch'])
+def test_strategy_recompute_wires_model_config(granularity):
+    """Remat policies trade memory for flops — never math: losses under
+    each granularity == the no-remat run ('dots_no_batch' is the r4
+    bench headline policy)."""
     from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
     base, _ = _run_lm(_make_strategy(), LlamaForCausalLM, LlamaConfig)
     r = _make_strategy(recompute=True)
-    r.recompute_configs = {'granularity': 'dots'}
+    r.recompute_configs = {'granularity': granularity}
     rec, step = _run_lm(r, LlamaForCausalLM, LlamaConfig)
-    assert step.layer.config.use_recompute == 'dots'
+    assert step.layer.config.use_recompute == granularity
     np.testing.assert_allclose(base, rec, rtol=1e-4)
 
 

@@ -1,0 +1,233 @@
+"""paddle.static (tape-replay Executor), paddle.sparse (BCOO-backed),
+paddle.quantization (int8 PTQ/QAT) — the round-4 coverage wideners
+(VERDICT r3 missing #6 surfaces, upstream python/paddle/{static,sparse,
+quantization})."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+# ---------------------------------------------------------------------------
+# static
+# ---------------------------------------------------------------------------
+
+class TestStatic:
+    def teardown_method(self, method):
+        paddle.disable_static()
+
+    def test_mode_switch(self):
+        assert paddle.in_dynamic_mode()
+        paddle.enable_static()
+        assert not paddle.in_dynamic_mode()
+        paddle.disable_static()
+        assert paddle.in_dynamic_mode()
+
+    def test_executor_runs_program(self):
+        paddle.enable_static()
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data('x', [None, 4], 'float32')
+            w = paddle.to_tensor(np.eye(4, 3, dtype=np.float32) * 2.0)
+            y = F.relu(paddle.matmul(x, w) - 1.0)
+        exe = paddle.static.Executor()
+        feed = np.arange(8, dtype=np.float32).reshape(2, 4)
+        out, = exe.run(main, feed={'x': feed}, fetch_list=[y])
+        want = np.maximum(feed @ (np.eye(4, 3, dtype=np.float32) * 2) - 1, 0)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+        # batch-polymorphic replay: same program, new batch size
+        feed2 = np.ones((5, 4), np.float32)
+        out2, = exe.run(main, feed={'x': feed2}, fetch_list=[y])
+        assert out2.shape == (5, 3)
+
+    def test_executor_with_layer(self):
+        paddle.enable_static()
+        paddle.seed(3)
+        lin = nn.Linear(6, 2)
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data('x', [None, 6])
+            y = F.softmax(lin(x))
+        exe = paddle.static.Executor()
+        feed = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        out, = exe.run(main, feed={'x': feed}, fetch_list=[y])
+        paddle.disable_static()
+        want = F.softmax(lin(paddle.to_tensor(feed))).numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_multiple_fetches_and_default_program(self):
+        paddle.enable_static()
+        x = paddle.static.data('inp', [None, 2])
+        a = x * 2.0
+        b = a.sum()
+        exe = paddle.static.Executor()
+        ra, rb = exe.run(feed={'inp': np.ones((4, 2), np.float32)},
+                         fetch_list=[a, b])
+        np.testing.assert_allclose(ra, np.full((4, 2), 2.0))
+        np.testing.assert_allclose(rb, 16.0)
+
+    def test_errors(self):
+        paddle.enable_static()
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data('x', [None, 2])
+            y = x + 1.0
+        exe = paddle.static.Executor()
+        with pytest.raises(KeyError, match='never declared'):
+            exe.run(main, feed={'wrong': np.ones((1, 2))}, fetch_list=[y])
+        with pytest.raises(ValueError, match='fetch_list'):
+            exe.run(main, feed={'x': np.ones((1, 2))}, fetch_list=[])
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+
+class TestSparse:
+    def _coo(self):
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        return paddle.sparse.sparse_coo_tensor(indices, values, [3, 3])
+
+    def test_coo_create_dense_roundtrip(self):
+        s = self._coo()
+        assert s.shape == [3, 3] and s.nnz() == 3
+        dense = s.to_dense().numpy()
+        want = np.zeros((3, 3), np.float32)
+        want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+        np.testing.assert_array_equal(dense, want)
+        np.testing.assert_array_equal(s.indices().numpy(),
+                                      [[0, 1, 2], [1, 2, 0]])
+        np.testing.assert_array_equal(s.values().numpy(), [1, 2, 3])
+
+    def test_csr_create_and_convert(self):
+        c = paddle.sparse.sparse_csr_tensor(
+            [0, 1, 2, 3], [1, 2, 0], [1.0, 2.0, 3.0], [3, 3])
+        np.testing.assert_array_equal(c.to_dense().numpy(),
+                                      self._coo().to_dense().numpy())
+        back = c.to_sparse_coo()
+        np.testing.assert_array_equal(back.to_dense().numpy(),
+                                      self._coo().to_dense().numpy())
+        csr = self._coo().to_sparse_csr()
+        np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
+        np.testing.assert_array_equal(csr.cols().numpy(), [1, 2, 0])
+
+    def test_add_subtract_multiply(self):
+        a, b = self._coo(), self._coo()
+        np.testing.assert_array_equal(
+            paddle.sparse.add(a, b).to_dense().numpy(),
+            2 * a.to_dense().numpy())
+        np.testing.assert_array_equal(
+            paddle.sparse.subtract(a, b).to_dense().numpy(),
+            np.zeros((3, 3)))
+        np.testing.assert_array_equal(
+            paddle.sparse.multiply(a, b).to_dense().numpy(),
+            a.to_dense().numpy() ** 2)
+        np.testing.assert_array_equal(
+            paddle.sparse.multiply(a, 2.0).to_dense().numpy(),
+            2 * a.to_dense().numpy())
+
+    def test_matmul_and_masked_matmul(self):
+        s = self._coo()
+        d = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out = paddle.sparse.matmul(s, paddle.to_tensor(d))
+        np.testing.assert_allclose(out.numpy(), s.to_dense().numpy() @ d,
+                                   rtol=1e-6)
+        x = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+        y = np.random.RandomState(2).randn(5, 3).astype(np.float32)
+        sdd = paddle.sparse.masked_matmul(
+            paddle.to_tensor(x), paddle.to_tensor(y), s)
+        full = x @ y
+        mask = (s.to_dense().numpy() != 0)
+        np.testing.assert_allclose(sdd.to_dense().numpy(), full * mask,
+                                   rtol=1e-5)
+
+    def test_unary_and_transpose(self):
+        idx = [[0, 1], [0, 1]]
+        s = paddle.sparse.sparse_coo_tensor(idx, [-4.0, 9.0], [2, 2])
+        np.testing.assert_array_equal(
+            paddle.sparse.relu(s).values().numpy(), [0.0, 9.0])
+        np.testing.assert_array_equal(
+            paddle.sparse.abs(s).values().numpy(), [4.0, 9.0])
+        t = paddle.sparse.transpose(self._coo(), [1, 0])
+        np.testing.assert_array_equal(t.to_dense().numpy(),
+                                      self._coo().to_dense().numpy().T)
+
+    def test_coalesce_merges_duplicates(self):
+        s = paddle.sparse.sparse_coo_tensor(
+            [[0, 0], [1, 1]], [1.0, 5.0], [2, 2])
+        c = s.coalesce()
+        assert c.nnz() == 1
+        assert float(c.to_dense().numpy()[0, 1]) == 6.0
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+class _TwoLayer(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+class TestQuantization:
+    def test_ptq_accuracy_and_compression(self):
+        paddle.seed(0)
+        m = _TwoLayer()
+        q = paddle.quantization.PTQ().quantize(m)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 16).astype(np.float32))
+        ref = m(x).numpy()
+        got = q(x).numpy()
+        # int8 weight-only: outputs track fp32 within quant noise
+        scale = np.abs(ref).max() + 1e-6
+        assert np.abs(got - ref).max() / scale < 0.05
+        from paddle_tpu.quantization import QuantedLinear, \
+            quanted_state_bytes
+        assert isinstance(dict(q.named_children())['fc1'], QuantedLinear)
+        fp32_bytes = sum(p.value.nbytes for n, p in m.named_parameters()
+                         if 'weight' in n)
+        assert quanted_state_bytes(q) < fp32_bytes / 3  # ~4x smaller
+        # original model untouched (inplace=False)
+        assert isinstance(dict(m.named_children())['fc1'], nn.Linear)
+
+    def test_ptq_no_quantizable_raises(self):
+        class NoLinear(nn.Layer):
+            def forward(self, x):
+                return x
+        with pytest.raises(ValueError, match='no quantizable'):
+            paddle.quantization.PTQ().quantize(NoLinear())
+
+    def test_qat_trains_through_fake_quant(self):
+        paddle.seed(1)
+        m = _TwoLayer()
+        qat = paddle.quantization.QAT()
+        qm = qat.quantize(m)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(16, 16).astype(np.float32))
+        labels = paddle.to_tensor(np.random.RandomState(2).randint(0, 4, 16))
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=qm.parameters())
+        losses = []
+        for _ in range(12):
+            loss = F.cross_entropy(qm(x), labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], 'QAT model did not learn (STE broken?)'
+        converted = qat.convert(qm)
+        from paddle_tpu.quantization import QuantedLinear
+        assert isinstance(dict(converted.named_children())['fc1'],
+                          QuantedLinear)
+        out = converted(x).numpy()
+        ref = qm(x).numpy()
+        scale = np.abs(ref).max() + 1e-6
+        assert np.abs(out - ref).max() / scale < 0.05
