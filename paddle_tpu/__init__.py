@@ -43,6 +43,9 @@ from .optimizer import L1Decay, L2Decay
 from . import static
 from . import sparse
 from . import quantization
+from . import fft
+from . import signal
+from .utils.flops import flops, summary
 
 bool = bool_  # paddle.bool
 

@@ -1,0 +1,78 @@
+"""paddle.fft (upstream: python/paddle/fft.py) — thin defop wrappers
+over jnp.fft so transforms ride XLA's FFT lowering (and stay
+differentiable through the tape)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._helpers import defop
+
+__all__ = ['fft', 'ifft', 'rfft', 'irfft', 'hfft', 'ihfft',
+           'fft2', 'ifft2', 'rfft2', 'irfft2',
+           'fftn', 'ifftn', 'rfftn', 'irfftn',
+           'fftshift', 'ifftshift', 'fftfreq', 'rfftfreq']
+
+
+def _wrap1(jfn, name):
+    def op(x, n=None, axis=-1, norm='backward', name_=None):
+        return defop(lambda v: jfn(v, n=n, axis=axis, norm=norm),
+                     name=name)(x)
+    op.__name__ = name
+    return op
+
+
+def _wrap2(jfn, name):
+    def op(x, s=None, axes=(-2, -1), norm='backward', name_=None):
+        return defop(lambda v: jfn(v, s=s, axes=tuple(axes), norm=norm),
+                     name=name)(x)
+    op.__name__ = name
+    return op
+
+
+def _wrapn(jfn, name):
+    def op(x, s=None, axes=None, norm='backward', name_=None):
+        ax = tuple(axes) if axes is not None else None
+        return defop(lambda v: jfn(v, s=s, axes=ax, norm=norm),
+                     name=name)(x)
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, 'fft')
+ifft = _wrap1(jnp.fft.ifft, 'ifft')
+rfft = _wrap1(jnp.fft.rfft, 'rfft')
+irfft = _wrap1(jnp.fft.irfft, 'irfft')
+hfft = _wrap1(jnp.fft.hfft, 'hfft')
+ihfft = _wrap1(jnp.fft.ihfft, 'ihfft')
+fft2 = _wrap2(jnp.fft.fft2, 'fft2')
+ifft2 = _wrap2(jnp.fft.ifft2, 'ifft2')
+rfft2 = _wrap2(jnp.fft.rfft2, 'rfft2')
+irfft2 = _wrap2(jnp.fft.irfft2, 'irfft2')
+fftn = _wrapn(jnp.fft.fftn, 'fftn')
+ifftn = _wrapn(jnp.fft.ifftn, 'ifftn')
+rfftn = _wrapn(jnp.fft.rfftn, 'rfftn')
+irfftn = _wrapn(jnp.fft.irfftn, 'irfftn')
+
+
+def fftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return defop(lambda v: jnp.fft.fftshift(v, axes=ax),
+                 name='fftshift')(x)
+
+
+def ifftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return defop(lambda v: jnp.fft.ifftshift(v, axes=ax),
+                 name='ifftshift')(x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return Tensor(out.astype(jnp.dtype(dtype)) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return Tensor(out.astype(jnp.dtype(dtype)) if dtype else out)

@@ -500,3 +500,22 @@ __all__ = [
     'TensorDataset', 'WeightedRandomSampler', 'default_collate_fn',
     'get_worker_info', 'random_split',
 ]
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in random order (upstream:
+    python/paddle/io/sampler.py:SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        super().__init__(None)
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        rng = np.random.RandomState(
+            self.generator if isinstance(self.generator, int) else None)
+        return iter([self.indices[i]
+                     for i in rng.permutation(len(self.indices))])
+
+    def __len__(self):
+        return len(self.indices)

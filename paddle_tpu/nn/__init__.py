@@ -2,6 +2,8 @@
 from __future__ import annotations
 
 from . import functional
+from . import utils
+from .utils import SpectralNorm
 from . import initializer
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                    clip_grad_norm_)
@@ -16,7 +18,8 @@ from .common_layers import (GLU, AlphaDropout, Bilinear, CELU, CosineSimilarity,
                             Swish, Tanh, Tanhshrink, Unfold, Upsample,
                             UpsamplingBilinear2D, UpsamplingNearest2D,
                             ZeroPad2D)
-from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D)
+from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
+                   Conv3D, Conv3DTranspose)
 from .layer import Layer, ParamAttr
 from .loss_layers import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
                           HingeEmbeddingLoss, KLDivLoss, L1Loss,

@@ -119,3 +119,19 @@ class Conv2DTranspose(_ConvNd):
         return F.conv2d_transpose(x, self.weight, self.bias, self.stride,
                                   self.padding, self.output_padding,
                                   self.dilation, self.groups, self.data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format='NCDHW'):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, 'zeros', weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups,
+                                  self.data_format)

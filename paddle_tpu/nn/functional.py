@@ -556,6 +556,14 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                               'conv2d_transpose')
 
 
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format='NCDHW', output_size=None, name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format == 'NDHWC',
+                              'conv3d_transpose')
+
+
 # ---------------------------------------------------------------------------
 # pooling
 # ---------------------------------------------------------------------------
@@ -711,6 +719,166 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         n = v.shape[0]
         return patches.reshape(n, patches.shape[1], -1)
     return defop(f, name='unfold')(x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    """col2im — inverse of unfold: [N, C*kh*kw, L] -> NCHW with
+    overlapping patches summed. TPU-native formulation: one
+    scatter-add over the same patch index map unfold reads from."""
+    oh, ow = _tuplize(output_sizes, 2)
+    kh, kw = _tuplize(kernel_sizes, 2)
+    sh, sw = _tuplize(strides, 2)
+    dh, dw = _tuplize(dilations, 2)
+    p = _tuplize(paddings, 2) if not isinstance(paddings, int) \
+        else (paddings, paddings)
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        hp, wp = oh + 2 * p[0], ow + 2 * p[1]
+        nh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (wp - (dw * (kw - 1) + 1)) // sw + 1
+        cols = v.reshape(n, c, kh, kw, nh, nw)
+        # destination row/col per (kernel tap, patch) pair
+        ys = (jnp.arange(kh) * dh)[:, None, None, None] \
+            + (jnp.arange(nh) * sh)[None, None, :, None]
+        xs = (jnp.arange(kw) * dw)[None, :, None, None] \
+            + (jnp.arange(nw) * sw)[None, None, None, :]
+        flat_idx = (ys * wp + xs).reshape(-1)
+        out = jnp.zeros((n, c, hp * wp), v.dtype)
+        out = out.at[:, :, flat_idx].add(cols.reshape(n, c, -1))
+        out = out.reshape(n, c, hp, wp)
+        return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+    return defop(f, name='fold')(x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """[N,2,3] affine matrices -> [N,H,W,2] sampling grid in [-1, 1]
+    coords (paddle.nn.functional.affine_grid)."""
+    def f(th):
+        n, h, w = th.shape[0], int(out_shape[2]), int(out_shape[3])
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+        return jnp.einsum('hwk,nok->nhwo', base, th.astype(jnp.float32))
+    return defop(f, name='affine_grid')(theta)
+
+
+def grid_sample(x, grid, mode='bilinear', padding_mode='zeros',
+                align_corners=True, name=None):
+    """Sample NCHW `x` at [N,H',W',2] normalized grid locations
+    (paddle.nn.functional.grid_sample) — gather + fused bilinear
+    arithmetic, the XLA-native replacement for the CUDA sampler.
+    padding_mode zeros/border/reflection match upstream: zeros blends
+    per-corner (a partially out-of-bounds bilinear sample still gets
+    mass from its in-bounds corners)."""
+    if padding_mode not in ('zeros', 'border', 'reflection'):
+        raise ValueError(f'unsupported padding_mode {padding_mode!r}')
+
+    def f(xv, gv):
+        n, c, h, w = xv.shape
+        gx, gy = gv[..., 0], gv[..., 1]
+        if align_corners:
+            fx = (gx + 1) * 0.5 * (w - 1)
+            fy = (gy + 1) * 0.5 * (h - 1)
+        else:
+            fx = ((gx + 1) * w - 1) * 0.5
+            fy = ((gy + 1) * h - 1) * 0.5
+
+        def reflect(v, size):
+            # reflect across cell borders onto [0, size-1]
+            span = 2 * (size - 1) if align_corners else 2 * size
+            if span == 0:
+                return jnp.zeros_like(v)
+            v = jnp.abs(v) if align_corners else jnp.abs(v + 0.5) - 0.5
+            v = v % span
+            return jnp.where(v > span / 2, span - v, v) \
+                if align_corners else \
+                jnp.clip(jnp.where(v > span / 2 - 0.5, span - 1 - v, v),
+                         0, size - 1)
+
+        if padding_mode == 'border':
+            fx = jnp.clip(fx, 0, w - 1)
+            fy = jnp.clip(fy, 0, h - 1)
+        elif padding_mode == 'reflection':
+            fx = jnp.clip(reflect(fx, w), 0, w - 1)
+            fy = jnp.clip(reflect(fy, h), 0, h - 1)
+
+        def inb(yy, xx):
+            return ((yy >= 0) & (yy <= h - 1)
+                    & (xx >= 0) & (xx <= w - 1))
+
+        if mode == 'nearest':
+            xi = jnp.round(fx)
+            yi = jnp.round(fy)
+            out = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(
+                xv, jnp.clip(yi, 0, h - 1).astype(jnp.int32),
+                jnp.clip(xi, 0, w - 1).astype(jnp.int32))
+            if padding_mode == 'zeros':
+                out = jnp.where(inb(yi, xi)[:, None], out, 0.0)
+            return out.astype(xv.dtype)
+
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = (fx - x0)[:, None]
+        wy = (fy - y0)[:, None]
+
+        def gather(img, yy, xx):
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            return img[:, yc, xc]
+
+        def corners(img, yy0, xx0):
+            return (gather(img, yy0, xx0), gather(img, yy0, xx0 + 1),
+                    gather(img, yy0 + 1, xx0),
+                    gather(img, yy0 + 1, xx0 + 1))
+
+        v00, v01, v10, v11 = jax.vmap(corners)(xv, y0, x0)
+        if padding_mode == 'zeros':
+            # per-corner zeroing: out-of-bounds corners contribute 0,
+            # in-bounds corners keep their bilinear mass (upstream)
+            v00 = v00 * inb(y0, x0)[:, None]
+            v01 = v01 * inb(y0, x0 + 1)[:, None]
+            v10 = v10 * inb(y0 + 1, x0)[:, None]
+            v11 = v11 * inb(y0 + 1, x0 + 1)[:, None]
+        out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+               + v10 * wy * (1 - wx) + v11 * wy * wx)
+        return out.astype(xv.dtype)
+    return defop(f, name='grid_sample')(x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format='NCHW',
+                   name=None):
+    """TSM temporal shift: shift 1/ratio of channels one step along the
+    segment axis ([N*T, C, H, W] with T=seg_num; NHWC supported via
+    transpose)."""
+    if data_format not in ('NCHW', 'NHWC'):
+        raise ValueError(f'unsupported data_format {data_format!r}')
+
+    def f(v):
+        if data_format == 'NHWC':
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold_c], jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
+             v[:, :-1, fold_c:2 * fold_c]], axis=1)
+        out = jnp.concatenate([left, right, v[:, :, 2 * fold_c:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == 'NHWC':
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return defop(f, name='temporal_shift')(x)
 
 
 def pixel_shuffle(x, upscale_factor, data_format='NCHW', name=None):

@@ -1,0 +1,181 @@
+"""paddle.nn.utils (upstream: python/paddle/nn/utils/weight_norm_hook.py
+and spectral_norm_hook.py).
+
+Reparameterizations are forward-pre-hooks: the underlying `<name>_g` /
+`<name>_v` (or power-iteration buffers) stay the trainable state, and
+the effective weight is recomputed on the tape at every call — so
+gradients flow to the reparameterized leaves through the normal eager
+autograd, and functional capture (jit/fleet) sees the recomputation."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Parameter, Tensor, apply_op
+from .layer import Layer
+
+__all__ = ['weight_norm', 'remove_weight_norm', 'spectral_norm',
+           'parameters_to_vector', 'vector_to_parameters']
+
+
+def _norm_axes(ndim, dim):
+    if dim is None:
+        return None
+    return tuple(i for i in range(ndim) if i != dim)
+
+
+def weight_norm(layer: Layer, name: str = 'weight', dim: int = 0) -> Layer:
+    """w = g * v / ||v||, with g/v trainable (upstream weight_norm)."""
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f'{name!r} is not a Parameter of '
+                         f'{type(layer).__name__}')
+    wv = np.asarray(w.value)
+    axes = _norm_axes(wv.ndim, dim)
+    g0 = np.sqrt((wv.astype(np.float64) ** 2)
+                 .sum(axis=axes, keepdims=True)).astype(wv.dtype)
+    layer.add_parameter(name + '_g', Parameter(g0))
+    layer.add_parameter(name + '_v', Parameter(wv.copy()))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        import jax.numpy as jnp
+        v = getattr(l, name + '_v')
+        g = getattr(l, name + '_g')
+        norm = apply_op(
+            lambda vv: jnp.sqrt((vv.astype(jnp.float32) ** 2).sum(
+                axis=axes, keepdims=True)).astype(vv.dtype),
+            v, _name='wn_norm')
+        l.__dict__[name] = v * (g / norm)
+    helper = layer.register_forward_pre_hook(hook)
+    layer.__dict__['_wn_hook_' + name] = helper
+    hook(layer, ())  # populate immediately so getattr(name) works
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = 'weight') -> Layer:
+    helper = layer.__dict__.pop('_wn_hook_' + name, None)
+    if helper is None:
+        raise ValueError(f'no weight_norm hook on {type(layer).__name__}')
+    helper.remove()
+    g = layer._parameters.pop(name + '_g')
+    v = layer._parameters.pop(name + '_v')
+    gv, vv = np.asarray(g.value, np.float64), np.asarray(v.value,
+                                                         np.float64)
+    axes = tuple(i for i in range(vv.ndim)
+                 if gv.shape[i] == 1) if gv.ndim == vv.ndim else None
+    norm = np.sqrt((vv ** 2).sum(axis=axes, keepdims=True))
+    w = (vv * (gv / norm)).astype(np.asarray(v.value).dtype)
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w))
+    layer.__dict__.pop('_wn_cached_' + name, None)
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = 'weight',
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = 0) -> Layer:
+    """w_sn = w / sigma_max(w), sigma estimated by power iteration
+    (upstream spectral_norm hook; u/v persist as buffers)."""
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f'{name!r} is not a Parameter')
+    wv = np.asarray(w.value, np.float32)
+    mat = np.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(mat.shape[0]).astype(np.float32)
+    v0 = rng.randn(mat.shape[1]).astype(np.float32)
+    layer.register_buffer(name + '_u', Tensor(u0 / np.linalg.norm(u0)))
+    layer.register_buffer(name + '_v', Tensor(v0 / np.linalg.norm(v0)))
+    orig = Parameter(np.asarray(w.value))
+    layer.add_parameter(name + '_orig', orig)
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        w_p = getattr(l, name + '_orig')
+        # power iteration on host values (buffers, no grad)
+        wm = np.asarray(w_p.value, np.float32)
+        m = np.moveaxis(wm, dim, 0).reshape(wm.shape[dim], -1)
+        u = np.asarray(getattr(l, name + '_u').value)
+        v = np.asarray(getattr(l, name + '_v').value)
+        for _ in range(max(n_power_iterations, 1)):
+            v = m.T @ u
+            v = v / (np.linalg.norm(v) + eps)
+            u = m @ v
+            u = u / (np.linalg.norm(u) + eps)
+        l._buffers[name + '_u'] = Tensor(u)
+        l._buffers[name + '_v'] = Tensor(v)
+
+        def sig_fn(ww, uu, vvv):
+            import jax.numpy as jnp
+            mat2 = jnp.moveaxis(ww, dim, 0).reshape(ww.shape[dim], -1)
+            return uu @ mat2.astype(uu.dtype) @ vvv
+
+        sigma = apply_op(sig_fn, w_p, Tensor(u), Tensor(v),
+                         _name='sn_sigma')
+        l.__dict__[name] = w_p / sigma
+    helper = layer.register_forward_pre_hook(hook)
+    layer.__dict__['_sn_hook_' + name] = helper
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    from ..ops.manipulation import concat
+    return concat([p.reshape([-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        chunk = vec[offset:offset + n].reshape(list(p.shape))
+        p._data = chunk.value.astype(p.value.dtype)
+        p._node = None
+        offset += n
+
+
+class SpectralNorm(Layer):
+    """Layer form (paddle.nn.SpectralNorm): forward(weight) returns the
+    spectrally-normalized weight via power iteration."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.RandomState(0)
+        u0 = rng.randn(h).astype(np.float32)
+        v0 = rng.randn(w).astype(np.float32)
+        self.register_buffer('weight_u',
+                             Tensor(u0 / np.linalg.norm(u0)))
+        self.register_buffer('weight_v',
+                             Tensor(v0 / np.linalg.norm(v0)))
+
+    def forward(self, weight):
+        wm = np.asarray(weight.value
+                        if isinstance(weight, Tensor) else weight,
+                        np.float32)
+        m = np.moveaxis(wm, self.dim, 0).reshape(wm.shape[self.dim], -1)
+        u = np.asarray(self.weight_u.value)
+        v = np.asarray(self.weight_v.value)
+        for _ in range(max(self.power_iters, 1)):
+            v = m.T @ u
+            v = v / (np.linalg.norm(v) + self.eps)
+            u = m @ v
+            u = u / (np.linalg.norm(u) + self.eps)
+        self._buffers['weight_u'] = Tensor(u)
+        self._buffers['weight_v'] = Tensor(v)
+        dim = self.dim
+
+        def sig_fn(ww, uu, vvv):
+            import jax.numpy as jnp
+            mat2 = jnp.moveaxis(ww, dim, 0).reshape(ww.shape[dim], -1)
+            return uu @ mat2.astype(uu.dtype) @ vvv
+
+        w_t = weight if isinstance(weight, Tensor) else Tensor(wm)
+        sigma = apply_op(sig_fn, w_t, Tensor(u), Tensor(v),
+                         _name='sn_sigma')
+        return w_t / sigma

@@ -177,3 +177,44 @@ def corrcoef(x, rowvar=True, name=None):
 
 def multi_dot(x, name=None):
     return defop(lambda vs: jnp.linalg.multi_dot(vs), name='multi_dot')(list(x))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """paddle.linalg.lu: packed LU factors + 1-based pivots (and infos
+    when requested), backed by jax.scipy.linalg.lu_factor."""
+    import jax.scipy.linalg as jsl
+
+    def f(v):
+        lu_mat, piv = jsl.lu_factor(v)
+        piv = piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+        if get_infos:
+            return lu_mat, piv, jnp.zeros(v.shape[:-2], jnp.int32)
+        return lu_mat, piv
+    return defop(f, name='lu')(x)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu output into (P, L, U); batched inputs
+    ([..., n, n] with [..., n] pivots) unpack per matrix via vmap."""
+    def one(lu_mat, piv):
+        n = lu_mat.shape[-2]
+        l_mat = jnp.tril(lu_mat, -1) + jnp.eye(n, dtype=lu_mat.dtype)
+        u_mat = jnp.triu(lu_mat)
+        perm = jnp.arange(n)
+
+        def body(i, p):
+            j = piv[i] - 1
+            return p.at[i].set(p[j]).at[j].set(p[i])
+        perm = jax.lax.fori_loop(0, piv.shape[0], body, perm)
+        p_mat = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
+        return p_mat, l_mat, u_mat
+
+    def f(lu_mat, piv):
+        if lu_mat.shape[-2] != lu_mat.shape[-1]:
+            raise NotImplementedError(
+                'lu_unpack supports square matrices only')
+        fn = one
+        for _ in range(lu_mat.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(lu_mat, piv)
+    return defop(f, name='lu_unpack')(x, y)

@@ -155,3 +155,35 @@ isnan = defop(lambda x: jnp.isnan(x), name='isnan')
 isinf = defop(lambda x: jnp.isinf(x), name='isinf')
 isfinite = defop(lambda x: jnp.isfinite(x), name='isfinite')
 isreal = defop(lambda x: jnp.isreal(x), name='isreal')
+
+
+def tensordot(x, y, axes=2, name=None):
+    """paddle.tensordot: contract over `axes` (int, list, or pair of
+    lists — same semantics as np.tensordot)."""
+    if isinstance(axes, (list, tuple)) and len(axes) == 2 \
+            and isinstance(axes[0], (list, tuple)):
+        jaxes = (tuple(axes[0]), tuple(axes[1]))
+    elif isinstance(axes, (list, tuple)):
+        jaxes = (tuple(axes), tuple(axes))
+    else:
+        jaxes = int(axes)
+    return defop(lambda a, b: jnp.tensordot(a, b, axes=jaxes),
+                 name='tensordot')(x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode='use_mm_for_euclid_dist_if_necessary',
+          name=None):
+    """Pairwise p-norm distances between row vectors of the last two
+    dims ([..., M, D] x [..., N, D] -> [..., M, N])."""
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(
+                jnp.sum(diff * diff, axis=-1), 0.0))
+        if p == float('inf'):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1),
+                         1.0 / p)
+    return defop(f, name='cdist')(x, y)

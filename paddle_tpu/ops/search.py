@@ -182,3 +182,9 @@ def is_empty(x):
 
 def isin(x, test_x, assume_unique=False, invert=False, name=None):
     return defop(lambda a, b: jnp.isin(a, b, invert=invert), name='isin')(x, test_x)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """paddle.bucketize — searchsorted with 1-D boundaries."""
+    return searchsorted(sorted_sequence, x, out_int32=out_int32,
+                        right=right, name=name)

@@ -1,0 +1,108 @@
+"""paddle.signal (upstream: python/paddle/signal.py) — stft/istft built
+on frame extraction + the fft module (XLA-lowered, differentiable)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._helpers import defop
+
+__all__ = ['stft', 'istft', 'frame', 'overlap_add']
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames along `axis` (last-dim layout: paddle
+    returns [..., frame_length, num_frames])."""
+    def f(v):
+        if axis not in (-1, v.ndim - 1):
+            raise NotImplementedError('frame supports the last axis only')
+        n = v.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[None, :] + jnp.arange(frame_length)[:, None]
+        return v[..., idx]  # [..., frame_length, num]
+    return defop(f, name='frame')(x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: sum overlapping [..., frame_length, num_frames]
+    back to a signal."""
+    def f(v):
+        fl, num = v.shape[-2], v.shape[-1]
+        out_len = fl + hop_length * (num - 1)
+        starts = jnp.arange(num) * hop_length
+        idx = (starts[None, :] + jnp.arange(fl)[:, None]).reshape(-1)
+        flat = v.reshape(v.shape[:-2] + (-1,))
+        out = jnp.zeros(v.shape[:-2] + (out_len,), v.dtype)
+        return out.at[..., idx].add(flat)
+    return defop(f, name='overlap_add')(x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode='reflect', normalized=False,
+         onesided=True, name=None):
+    """Short-time Fourier transform ([B, T] -> [B, n_fft//2+1, frames]
+    complex, matching paddle.signal.stft semantics)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def f(v, *w):
+        win = w[0] if w else jnp.ones(wl, v.dtype)
+        if wl < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - wl) // 2
+            win = jnp.pad(win, (lp, n_fft - wl - lp))
+        sig = v
+        if center:
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1)
+                          + [(n_fft // 2, n_fft // 2)], mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        starts = jnp.arange(num) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = sig[..., idx] * win  # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+    args = (x,) if window is None else (x, window)
+    return defop(f, name='stft')(*args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (matches
+    paddle.signal.istft for COLA-satisfying windows)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def f(v, *w):
+        win = w[0] if w else jnp.ones(wl, jnp.float32)
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            win = jnp.pad(win, (lp, n_fft - wl - lp))
+        spec = jnp.swapaxes(v, -1, -2)  # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * win
+        num = frames.shape[-2]
+        out_len = n_fft + hop * (num - 1)
+        starts = jnp.arange(num) * hop
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        sig = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        sig = sig.at[..., idx].add(
+            frames.reshape(frames.shape[:-2] + (-1,)))
+        env = jnp.zeros(out_len, frames.dtype).at[idx].add(
+            jnp.tile(win * win, num))
+        sig = sig / jnp.maximum(env, 1e-10)
+        if center:
+            sig = sig[..., n_fft // 2:]
+            if length is None:
+                sig = sig[..., :sig.shape[-1] - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+    args = (x,) if window is None else (x, window)
+    return defop(f, name='istft')(*args)

@@ -1,0 +1,136 @@
+"""paddle.flops (upstream: python/paddle/hapi/dynamic_flops.py) —
+per-layer FLOP counting via forward-post hooks over a dry-run forward.
+
+Counts multiply-accumulate-style FLOPs (2 * MACs) for the compute
+layers and elementwise costs for norms/activations — same conventions
+as the upstream counter."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import nn
+
+
+def _out_shape(out):
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    return tuple(int(s) for s in out.shape)
+
+
+def _count(layer, inputs, out) -> Optional[int]:
+    from ..nn.common_layers import Embedding, Linear
+    from ..nn.conv import _ConvNd
+    in_shape = tuple(int(s) for s in inputs[0].shape) if inputs else ()
+    o = _out_shape(out)
+    if isinstance(layer, Linear):
+        rows = int(np.prod(o[:-1])) if len(o) > 1 else 1
+        return 2 * rows * layer.in_features * layer.out_features
+    if isinstance(layer, _ConvNd):
+        k = int(np.prod(layer.kernel_size))
+        in_c = layer.in_channels // layer.groups
+        return 2 * int(np.prod(o)) * in_c * k
+    if isinstance(layer, Embedding):
+        return 0  # gather, no FLOPs by upstream convention
+    name = type(layer).__name__
+    if 'Norm' in name:
+        return 2 * int(np.prod(in_shape))
+    if 'Pool' in name or name in ('ReLU', 'GELU', 'Sigmoid', 'Tanh',
+                                  'Hardswish', 'Hardsigmoid', 'Swish',
+                                  'ReLU6', 'LeakyReLU', 'Softmax'):
+        return int(np.prod(o))
+    return None  # containers and unknown layers: children count instead
+
+
+def summary(net: nn.Layer, input_size=None, dtypes=None, input=None):
+    """paddle.summary (upstream python/paddle/hapi/model_summary.py):
+    dry-run + per-layer output-shape/param table; returns the totals
+    dict like upstream."""
+    import paddle_tpu as paddle
+
+    rows = []
+    hooks = []
+
+    def make_hook(path):
+        def hook(layer, inputs, out):
+            if layer._sub_layers:
+                return  # leaf layers only, like upstream's table
+            o = out[0] if isinstance(out, (tuple, list)) else out
+            n_params = int(sum(np.prod(p.shape)
+                               for p in layer.parameters(
+                                   include_sublayers=False)))
+            rows.append((path or type(layer).__name__,
+                         type(layer).__name__,
+                         list(getattr(o, 'shape', [])), n_params))
+        return hook
+
+    for path, sub in net.named_sublayers(include_self=True):
+        hooks.append(sub.register_forward_post_hook(make_hook(path)))
+    was_training = net.training
+    net.eval()
+    try:
+        x = input if input is not None else paddle.zeros(list(input_size))
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    total = int(sum(np.prod(p.shape) for p in net.parameters()))
+    trainable = int(sum(np.prod(p.shape) for p in net.parameters()
+                        if not p.stop_gradient))
+    header = f'{"Layer (type)":<40}{"Output Shape":<24}{"Param #":>12}'
+    lines = [header, '-' * len(header)]
+    for path, tname, shape, n in rows:
+        lines.append(f'{path + " (" + tname + ")":<40}'
+                     f'{str(shape):<24}{n:>12,}')
+    lines += ['-' * len(header),
+              f'Total params: {total:,}',
+              f'Trainable params: {trainable:,}',
+              f'Non-trainable params: {total - trainable:,}']
+    print('\n'.join(lines))
+    return {'total_params': total, 'trainable_params': trainable}
+
+
+def flops(net: nn.Layer, input_size, custom_ops=None,
+          print_detail: bool = False) -> int:
+    """Dry-run `net` on zeros of `input_size` and return total FLOPs.
+
+    `custom_ops` maps layer CLASS -> fn(layer, inputs, output) -> flops,
+    overriding the built-in table (upstream-compatible signature).
+    """
+    import paddle_tpu as paddle
+
+    totals = {}
+    hooks = []
+
+    def make_hook(path):
+        def hook(layer, inputs, out):
+            fn = (custom_ops or {}).get(type(layer))
+            n = fn(layer, inputs, out) if fn \
+                else _count(layer, inputs, out)
+            if n:
+                totals[path] = totals.get(path, 0) + int(n)
+        return hook
+
+    for path, sub in net.named_sublayers(include_self=True):
+        hooks.append(sub.register_forward_post_hook(make_hook(path or
+                                                              'net')))
+    was_training = net.training
+    net.eval()
+    try:
+        x = paddle.zeros(list(input_size))
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    total = sum(totals.values())
+    if print_detail:
+        for path, n in sorted(totals.items()):
+            print(f'{path:50s} {n:,}')
+    print(f'Total Flops: {total:,}     Total Params: '
+          f'{int(sum(np.prod(p.shape) for p in net.parameters())):,}')
+    return total

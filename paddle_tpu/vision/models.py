@@ -65,14 +65,16 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
-        self.conv3 = nn.Conv2D(planes, planes * 4, 1, bias_attr=False)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(width)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=1,
+                               groups=groups, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(width)
+        self.conv3 = nn.Conv2D(width, planes * 4, 1, bias_attr=False)
         self.bn3 = nn.BatchNorm2D(planes * 4)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -89,9 +91,11 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth_cfg: List[int], num_classes=1000,
-                 with_pool=True):
+                 with_pool=True, groups=1, width_per_group=64):
         super().__init__()
         self.inplanes = 64
+        self.groups = groups
+        self.base_width = width_per_group
         self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3,
                                bias_attr=False)
         self.bn1 = nn.BatchNorm2D(64)
@@ -115,10 +119,12 @@ class ResNet(nn.Layer):
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
                           stride=stride, bias_attr=False),
                 nn.BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        extra = {} if block is BasicBlock else {
+            'groups': self.groups, 'base_width': self.base_width}
+        layers = [block(self.inplanes, planes, stride, downsample, **extra)]
         self.inplanes = planes * block.expansion
         for _ in range(1, n):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **extra))
         return nn.Sequential(*layers)
 
     def forward(self, x):
@@ -166,6 +172,38 @@ def resnet101(pretrained=False, **kw):
 
 def resnet152(pretrained=False, **kw):
     return _resnet(152, pretrained, **kw)
+
+
+def resnext50_32x4d(pretrained=False, **kw):
+    return _resnet(50, pretrained, groups=32, width_per_group=4, **kw)
+
+
+def resnext50_64x4d(pretrained=False, **kw):
+    return _resnet(50, pretrained, groups=64, width_per_group=4, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    return _resnet(101, pretrained, groups=32, width_per_group=4, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    return _resnet(101, pretrained, groups=64, width_per_group=4, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    return _resnet(152, pretrained, groups=32, width_per_group=4, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    return _resnet(152, pretrained, groups=64, width_per_group=4, **kw)
+
+
+def wide_resnet50_2(pretrained=False, **kw):
+    return _resnet(50, pretrained, width_per_group=128, **kw)
+
+
+def wide_resnet101_2(pretrained=False, **kw):
+    return _resnet(101, pretrained, width_per_group=128, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -279,3 +317,14 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kw):
     if pretrained:
         raise ValueError('pretrained weights are unavailable offline')
     return MobileNetV2(scale=scale, **kw)
+
+
+# extended zoo families live in zoo_extra.py; re-exported here so
+# `from paddle.vision.models import densenet121` works as upstream
+from .zoo_extra import (  # noqa: E402,F401
+    AlexNet, DenseNet, GoogLeNet, InceptionV3, MobileNetV1, MobileNetV3,
+    ShuffleNetV2, SqueezeNet, alexnet, densenet121, densenet161,
+    densenet169, densenet201, googlenet, inception_v3, mobilenet_v1,
+    mobilenet_v3_large, mobilenet_v3_small, shufflenet_v2_x0_25,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, squeezenet1_0, squeezenet1_1)

@@ -1,0 +1,263 @@
+"""paddle.vision.ops (upstream: python/paddle/vision/ops.py — nms,
+roi_align, roi_pool, deform_conv2d, box_coder).
+
+TPU-native design notes:
+- `nms` computes the full IoU matrix on device (one [N,N] batched op —
+  MXU/VPU friendly) and runs the inherently-sequential suppression scan
+  in a `lax.fori_loop`; the dynamic-size index list materializes on
+  host (eager op — dynamic shapes cannot live under jit anyway).
+- `roi_align` / `deform_conv2d` are gather+bilinear formulations: XLA
+  lowers the gathers and the interpolation arithmetic fuses; there is
+  no CUDA-style per-thread kernel to port.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply_op, to_jax
+
+__all__ = ['nms', 'roi_align', 'roi_pool', 'deform_conv2d', 'box_iou',
+           'box_coder']
+
+
+def _iou_matrix(boxes):
+    """[N,4] xyxy -> [N,N] IoU."""
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_iou(boxes1, boxes2) -> Tensor:
+    """Pairwise IoU between two box sets ([N,4] x [M,4] -> [N,M])."""
+    def f(a, b):
+        both = jnp.concatenate([a, b], axis=0)
+        return _iou_matrix(both)[:a.shape[0], a.shape[0]:]
+    return apply_op(f, boxes1, boxes2, _name='box_iou')
+
+
+@jax.jit
+def _nms_keep(boxes, scores, iou_threshold):
+    order = jnp.argsort(-scores)
+    iou = _iou_matrix(boxes[order])
+
+    def body(i, keep):
+        # suppressed if any higher-scoring kept box overlaps > threshold
+        over = (iou[i] > iou_threshold) & keep & \
+            (jnp.arange(keep.shape[0]) < i)
+        return keep.at[i].set(~jnp.any(over))
+
+    keep = jax.lax.fori_loop(0, boxes.shape[0], body,
+                             jnp.ones(boxes.shape[0], bool))
+    return order, keep
+
+
+def nms(boxes, scores=None, iou_threshold=0.3, score_threshold=None,
+        category_idxs=None, categories=None, top_k=None):
+    """Hard-NMS; returns kept indices ordered by descending score.
+    With `category_idxs`, suppression is per category (multiclass NMS)."""
+    bv = jnp.asarray(to_jax(boxes), jnp.float32)
+    sv = jnp.asarray(to_jax(scores), jnp.float32) if scores is not None \
+        else jnp.zeros(bv.shape[0])
+    if score_threshold is not None:
+        valid = np.asarray(sv) >= score_threshold
+    else:
+        valid = np.ones(bv.shape[0], bool)
+    if category_idxs is not None:
+        # offset boxes per category so cross-category IoU is zero
+        cv = jnp.asarray(to_jax(category_idxs))
+        span = (bv.max() - bv.min()) + 1.0
+        bv = bv + (cv[:, None].astype(jnp.float32) * span)
+    order, keep = _nms_keep(bv, sv, jnp.float32(iou_threshold))
+    order, keep = np.asarray(order), np.asarray(keep)
+    kept = order[keep & valid[order]]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, jnp.int32))
+
+
+def _bilinear(feat, y, x):
+    """feat [C,H,W]; y/x sample grids of equal shape -> [C, *grid]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = jnp.clip(y - y0, 0, 1)
+    wx = jnp.clip(x - x0, 0, 1)
+    y0i, y1i, x0i, x1i = (v.astype(jnp.int32) for v in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (Mask R-CNN): average of bilinear samples per output bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xv, rois, nper):
+        xv = xv.astype(jnp.float32)
+        rois = rois.astype(jnp.float32)
+        img_of_roi = jnp.repeat(jnp.arange(nper.shape[0]), nper,
+                                total_repeat_length=rois.shape[0])
+        off = 0.5 if aligned else 0.0
+        ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+        def one(roi, img_idx):
+            x1, y1, x2, y2 = roi * spatial_scale
+            rw = jnp.maximum(x2 - x1, 1e-4)
+            rh = jnp.maximum(y2 - y1, 1e-4)
+            bin_h, bin_w = rh / ph, rw / pw
+            iy = jnp.arange(ph)[:, None, None, None]
+            ix = jnp.arange(pw)[None, :, None, None]
+            sy = jnp.arange(ratio)[None, None, :, None]
+            sx = jnp.arange(ratio)[None, None, None, :]
+            yy = y1 - off + (iy + (sy + 0.5) / ratio) * bin_h
+            xx = x1 - off + (ix + (sx + 0.5) / ratio) * bin_w
+            samp = _bilinear(xv[img_idx], yy, xx)  # [C,ph,pw,r,r]
+            return samp.mean(axis=(-1, -2))
+
+        return jax.vmap(one)(rois, img_of_roi)
+
+    return apply_op(f, x, boxes, boxes_num, _name='roi_align')
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """RoIPool (Fast R-CNN): hard max over each quantized bin. Static
+    shapes for XLA: every bin gathers a fixed 8x8 grid of rounded
+    integer cells (exact for bins up to 8px; a dense approximation of
+    the per-bin max beyond that)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xv, rois, nper):
+        xv = xv.astype(jnp.float32)
+        rois = rois.astype(jnp.float32)
+        H, W = xv.shape[-2:]
+        img_of_roi = jnp.repeat(jnp.arange(nper.shape[0]), nper,
+                                total_repeat_length=rois.shape[0])
+        ratio = 8
+
+        def one(roi, img_idx):
+            x1, y1, x2, y2 = jnp.round(roi * spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            iy = jnp.arange(ph)[:, None, None, None]
+            ix = jnp.arange(pw)[None, :, None, None]
+            sy = jnp.arange(ratio)[None, None, :, None]
+            sx = jnp.arange(ratio)[None, None, None, :]
+            yy = jnp.round(y1 + (iy + sy / (ratio - 1)) * (rh / ph))
+            xx = jnp.round(x1 + (ix + sx / (ratio - 1)) * (rw / pw))
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            samp = xv[img_idx][:, yi, xi]  # [C,ph,pw,r,r]
+            return samp.max(axis=(-1, -2))
+
+        return jax.vmap(one)(rois, img_of_roi)
+
+    return apply_op(f, x, boxes, boxes_num, _name='roi_pool')
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 (dai et al.): bilinear-sample the input at
+    offset-shifted taps, then a dense matmul with the kernel — the
+    gather feeds the MXU instead of a custom CUDA kernel."""
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError('deform_conv2d supports groups=1, '
+                                  'deformable_groups=1')
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    has_mask, has_bias = mask is not None, bias is not None
+
+    def f(xv, ov, wv, *rest):
+        mv = rest[0] if has_mask else None
+        bv = rest[1 if has_mask else 0] if has_bias else None
+        xv = xv.astype(jnp.float32)
+        N, C, H, W = xv.shape
+        out_c, _, kh, kw = wv.shape
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (padding[0], padding[0]),
+                          (padding[1], padding[1])))
+        Hp, Wp = xp.shape[-2:]
+        Ho = (Hp - (dilation[0] * (kh - 1) + 1)) // stride[0] + 1
+        Wo = (Wp - (dilation[1] * (kw - 1) + 1)) // stride[1] + 1
+        oy = ov[:, 0::2].reshape(N, kh, kw, Ho, Wo)
+        ox = ov[:, 1::2].reshape(N, kh, kw, Ho, Wo)
+        base_y = (jnp.arange(Ho) * stride[0])[None, None, :, None] \
+            + (jnp.arange(kh) * dilation[0])[:, None, None, None]
+        base_x = (jnp.arange(Wo) * stride[1])[None, None, None, :] \
+            + (jnp.arange(kw) * dilation[1])[None, :, None, None]
+        yy = base_y + oy  # [N,kh,kw,Ho,Wo]
+        xx = base_x + ox
+
+        def sample_img(img, y, x):
+            return _bilinear(img, y, x)  # [C,kh,kw,Ho,Wo]
+
+        cols = jax.vmap(sample_img)(xp, yy, xx)
+        if has_mask:
+            cols = cols * mv.reshape(N, 1, kh, kw, Ho, Wo)
+        cols = cols.reshape(N, C * kh * kw, Ho * Wo)
+        out = jnp.einsum('ok,nkp->nop', wv.reshape(out_c, -1), cols)
+        out = out.reshape(N, out_c, Ho, Wo)
+        if has_bias:
+            out = out + bv.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(f, *args, _name='deform_conv2d')
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type='encode_center_size', box_normalized=True):
+    """Encode/decode boxes against priors (SSD-style)."""
+    def f(pb, pbv, tb):
+        pb, pbv, tb = (v.astype(jnp.float32) for v in (pb, pbv, tb))
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == 'encode_center_size':
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            return jnp.stack([
+                (tcx - pcx) / pw / pbv[:, 0],
+                (tcy - pcy) / ph / pbv[:, 1],
+                jnp.log(tw / pw) / pbv[:, 2],
+                jnp.log(th / ph) / pbv[:, 3]], axis=1)
+        # decode_center_size
+        dcx = tb[:, 0] * pbv[:, 0] * pw + pcx
+        dcy = tb[:, 1] * pbv[:, 1] * ph + pcy
+        dw = jnp.exp(tb[:, 2] * pbv[:, 2]) * pw
+        dh = jnp.exp(tb[:, 3] * pbv[:, 3]) * ph
+        return jnp.stack([dcx - dw * 0.5 + norm * 0.5,
+                          dcy - dh * 0.5 + norm * 0.5,
+                          dcx + dw * 0.5 - norm * 0.5,
+                          dcy + dh * 0.5 - norm * 0.5], axis=1)
+
+    return apply_op(f, prior_box, prior_box_var, target_box,
+                    _name='box_coder')

@@ -181,3 +181,159 @@ def hflip(img):
 
 def center_crop(img, output_size):
     return CenterCrop(output_size)(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode='constant'):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = tuple(padding)  # left, top, right, bottom
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        l, t, r, b = self.padding
+        spec = ((t, b), (l, r), (0, 0))
+        if self.padding_mode == 'constant':
+            return np.pad(img, spec, constant_values=self.fill)
+        return np.pad(img, spec, mode=self.padding_mode)
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue on HWC images (each
+    factor sampled like upstream: U[max(0,1-f), 1+f]; hue in [-h, h])."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    @staticmethod
+    def _factor(f):
+        # upstream accepts float f -> U[max(0,1-f), 1+f], or an explicit
+        # (min, max) range
+        if isinstance(f, (tuple, list)):
+            return np.random.uniform(f[0], f[1])
+        return np.random.uniform(max(0.0, 1 - f), 1 + f)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        was_u8 = img.dtype == np.uint8
+        f = img.astype(np.float32) / (255.0 if was_u8 else 1.0)
+        if self.brightness:
+            f = f * self._factor(self.brightness)
+        if self.contrast:
+            mean = f.mean()
+            f = (f - mean) * self._factor(self.contrast) + mean
+        if self.saturation:
+            grey = f.mean(axis=2, keepdims=True)
+            f = (f - grey) * self._factor(self.saturation) + grey
+        if self.hue and f.shape[2] == 3:
+            # cheap hue rotation: roll channels by a blended amount
+            h = self.hue if isinstance(self.hue, (tuple, list)) \
+                else (-self.hue, self.hue)
+            theta = np.random.uniform(h[0], h[1]) * 2 * np.pi
+            cos_t, sin_t = np.cos(theta), np.sin(theta)
+            one3 = 1.0 / 3.0
+            sq3 = np.sqrt(1.0 / 3.0)
+            m = (cos_t * np.eye(3)
+                 + (1 - cos_t) * np.full((3, 3), one3)
+                 + sin_t * sq3 * np.array([[0, -1, 1],
+                                           [1, 0, -1],
+                                           [-1, 1, 0]], np.float32))
+            f = f @ m.T.astype(np.float32)
+        f = np.clip(f, 0, 1)
+        return (f * 255).astype(np.uint8) if was_u8 else f
+
+
+class RandomRotation(BaseTransform):
+    """Rotate by a random angle in [-degrees, degrees] (bilinear, same
+    output size, zero fill) — pure numpy inverse-mapping."""
+
+    def __init__(self, degrees, interpolation='bilinear', fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = tuple(degrees)
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        h, w = img.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        cos_a, sin_a = np.cos(angle), np.sin(angle)
+        # inverse rotation: sample source coords for each dest pixel
+        sy = cos_a * (yy - cy) + sin_a * (xx - cx) + cy
+        sx = -sin_a * (yy - cy) + cos_a * (xx - cx) + cx
+        y0 = np.floor(sy).astype(int)
+        x0 = np.floor(sx).astype(int)
+        wy = (sy - y0)[..., None]
+        wx = (sx - x0)[..., None]
+        valid = (sy >= 0) & (sy <= h - 1) & (sx >= 0) & (sx <= w - 1)
+        y0c, x0c = y0.clip(0, h - 1), x0.clip(0, w - 1)
+        y1c, x1c = (y0 + 1).clip(0, h - 1), (x0 + 1).clip(0, w - 1)
+        f = img.astype(np.float32)
+        out = ((f[y0c, x0c] * (1 - wy) + f[y1c, x0c] * wy) * (1 - wx)
+               + (f[y0c, x1c] * (1 - wy) + f[y1c, x1c] * wy) * wx)
+        out = np.where(valid[..., None], out, np.float32(self.fill))
+        return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        grey = img.astype(np.float32).mean(axis=2, keepdims=True)
+        if self.num_output_channels == 3:
+            grey = np.repeat(grey, 3, axis=2)
+        return grey.astype(img.dtype) if img.dtype == np.uint8 else grey
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation='bilinear'):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(*np.log(self.ratio)))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = img[i:i + ch, j:j + cw]
+                return Resize(self.size, self.interpolation)(crop)
+        return Resize(self.size, self.interpolation)(
+            CenterCrop(min(h, w))(img))
+
+
+def pad(img, padding, fill=0, padding_mode='constant'):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def rotate(img, angle, interpolation='bilinear', fill=0):
+    t = RandomRotation((angle, angle), interpolation, fill)
+    return t._apply_image(img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1].copy()

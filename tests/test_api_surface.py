@@ -123,6 +123,40 @@ SURFACE = [
     'vision.transforms.Resize', 'vision.transforms.RandomCrop',
     'vision.transforms.RandomHorizontalFlip', 'vision.transforms.ToTensor',
     'vision.datasets.MNIST', 'vision.datasets.Cifar10',
+    # round-4 wideners: extended zoo, vision.ops, static/sparse/quant,
+    # fft/signal, math extras, nn utils
+    'vision.models.alexnet', 'vision.models.squeezenet1_0',
+    'vision.models.squeezenet1_1', 'vision.models.densenet121',
+    'vision.models.densenet161', 'vision.models.densenet169',
+    'vision.models.densenet201', 'vision.models.googlenet',
+    'vision.models.inception_v3', 'vision.models.mobilenet_v1',
+    'vision.models.mobilenet_v3_small', 'vision.models.mobilenet_v3_large',
+    'vision.models.shufflenet_v2_x1_0', 'vision.models.resnext50_32x4d',
+    'vision.models.resnext101_64x4d', 'vision.models.wide_resnet50_2',
+    'vision.models.wide_resnet101_2',
+    'vision.ops.nms', 'vision.ops.roi_align', 'vision.ops.roi_pool',
+    'vision.ops.deform_conv2d', 'vision.ops.box_coder',
+    'vision.transforms.Pad', 'vision.transforms.ColorJitter',
+    'vision.transforms.RandomRotation', 'vision.transforms.Grayscale',
+    'vision.transforms.RandomResizedCrop', 'vision.transforms.CenterCrop',
+    'static.data', 'static.Program', 'static.program_guard',
+    'static.Executor', 'static.default_main_program', 'static.InputSpec',
+    'enable_static', 'disable_static', 'in_dynamic_mode',
+    'sparse.sparse_coo_tensor', 'sparse.sparse_csr_tensor',
+    'sparse.matmul', 'sparse.masked_matmul', 'sparse.add',
+    'sparse.multiply', 'sparse.transpose', 'sparse.relu',
+    'quantization.QuantConfig', 'quantization.PTQ', 'quantization.QAT',
+    'fft.fft', 'fft.ifft', 'fft.rfft', 'fft.irfft', 'fft.fft2',
+    'fft.fftn', 'fft.fftshift', 'fft.fftfreq',
+    'signal.stft', 'signal.istft', 'signal.frame', 'signal.overlap_add',
+    'tensordot', 'cdist', 'bucketize', 'flops', 'summary',
+    'linalg.lu', 'linalg.lu_unpack', 'linalg.pinv', 'linalg.lstsq',
+    'nn.Conv3DTranspose', 'nn.SpectralNorm', 'nn.utils.weight_norm',
+    'nn.utils.remove_weight_norm', 'nn.utils.spectral_norm',
+    'nn.utils.parameters_to_vector', 'nn.utils.vector_to_parameters',
+    'nn.functional.grid_sample', 'nn.functional.affine_grid',
+    'nn.functional.fold', 'nn.functional.temporal_shift',
+    'io.SubsetRandomSampler', 'io.WeightedRandomSampler',
 ]
 
 TENSOR_METHODS = [

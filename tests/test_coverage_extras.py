@@ -1,0 +1,287 @@
+"""Round-4 API wideners: paddle.fft, paddle.signal, tensordot/cdist/
+bucketize, linalg.lu, nn.functional grid_sample/affine_grid/fold/
+temporal_shift, nn.utils weight_norm/spectral_norm, paddle.flops,
+io.SubsetRandomSampler (upstream python/paddle/{fft,signal,...})."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestFFT:
+    def test_fft_roundtrip_matches_numpy(self):
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        out = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+        back = paddle.fft.ifft(out)
+        np.testing.assert_allclose(back.numpy().real, x, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(1).randn(8).astype(np.float32)
+        r = paddle.fft.rfft(paddle.to_tensor(x))
+        assert r.shape == [5]
+        np.testing.assert_allclose(
+            paddle.fft.irfft(r, n=8).numpy(), x, rtol=1e-4, atol=1e-5)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(2).randn(4, 4).astype(np.float32)
+        f2 = paddle.fft.fft2(paddle.to_tensor(x))
+        np.testing.assert_allclose(f2.numpy(), np.fft.fft2(x), rtol=1e-4,
+                                   atol=1e-4)
+        sh = paddle.fft.fftshift(f2)
+        np.testing.assert_allclose(sh.numpy(),
+                                   np.fft.fftshift(np.fft.fft2(x)),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+
+
+class TestSignal:
+    def test_stft_matches_manual_dft(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 64).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=16,
+                                  hop_length=8, center=False)
+        assert spec.shape == [1, 9, 7]
+        # frame 0 == rfft of the first 16 samples
+        np.testing.assert_allclose(spec.numpy()[0, :, 0],
+                                   np.fft.rfft(x[0, :16]), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 128).astype(np.float32)
+        win = np.hanning(16).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=16,
+                                  hop_length=4,
+                                  window=paddle.to_tensor(win))
+        back = paddle.signal.istft(spec, n_fft=16, hop_length=4,
+                                   window=paddle.to_tensor(win),
+                                   length=128)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-3)
+
+    def test_frame_overlap_add_inverse(self):
+        x = paddle.to_tensor(np.arange(20, dtype=np.float32))
+        fr = paddle.signal.frame(x, frame_length=4, hop_length=4)
+        assert fr.shape == [4, 5]
+        back = paddle.signal.overlap_add(fr, hop_length=4)
+        np.testing.assert_array_equal(back.numpy(), x.numpy())
+
+
+class TestMathExtras:
+    def test_tensordot_modes(self):
+        a = np.random.RandomState(0).randn(3, 4, 5).astype(np.float32)
+        b = np.random.RandomState(1).randn(4, 5, 6).astype(np.float32)
+        out = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                               axes=2)
+        np.testing.assert_allclose(out.numpy(), np.tensordot(a, b, 2),
+                                   rtol=1e-4)
+        out2 = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                                axes=[[1], [0]])
+        np.testing.assert_allclose(out2.numpy(),
+                                   np.tensordot(a, b, ([1], [0])),
+                                   rtol=1e-4)
+
+    @pytest.mark.parametrize('p', [2.0, 1.0, float('inf')])
+    def test_cdist(self, p):
+        a = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+        b = np.random.RandomState(3).randn(5, 3).astype(np.float32)
+        got = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b),
+                           p=p).numpy()
+        from scipy.spatial.distance import cdist as sp
+        metric = {2.0: 'euclidean', 1.0: 'cityblock',
+                  float('inf'): 'chebyshev'}[p]
+        np.testing.assert_allclose(got, sp(a, b, metric=metric),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bucketize(self):
+        out = paddle.bucketize(paddle.to_tensor([0.5, 1.0, 2.5, 9.0]),
+                               paddle.to_tensor([1.0, 2.0, 3.0]))
+        np.testing.assert_array_equal(out.numpy(), [0, 0, 2, 3])
+        right = paddle.bucketize(paddle.to_tensor([1.0]),
+                                 paddle.to_tensor([1.0, 2.0]), right=True)
+        assert int(right.numpy()[0]) == 1
+
+    def test_lu_reconstruction(self):
+        m = np.random.RandomState(4).randn(5, 5).astype(np.float32)
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(m))
+        assert piv.numpy().min() >= 1  # paddle pivots are 1-based
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+        np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), m,
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestFunctionalExtras:
+    def test_fold_inverts_unfold(self):
+        x = paddle.rand([2, 3, 8, 8])
+        cols = F.unfold(x, 2, strides=2)
+        back = F.fold(cols, output_sizes=(8, 8), kernel_sizes=2,
+                      strides=2)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+
+    def test_fold_sums_overlaps(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        cols = F.unfold(x, 3, strides=1)
+        back = F.fold(cols, (4, 4), 3, strides=1)
+        # center cells belong to 9 overlapping 3x3 patches
+        assert float(back.numpy()[0, 0, 1, 1]) == pytest.approx(4.0)
+
+    def test_affine_grid_identity_and_grid_sample(self):
+        theta = paddle.to_tensor(
+            np.array([[[1, 0, 0], [0, 1, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 1, 4, 4])
+        assert grid.shape == [1, 4, 4, 2]
+        x = paddle.rand([1, 2, 4, 4])
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_grid_sample_zeros_padding(self):
+        x = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+        grid = paddle.to_tensor(
+            np.array([[[[-3.0, -3.0], [0.0, 0.0]]]], np.float32))
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], [0.0, 1.0],
+                                   rtol=1e-6)
+
+    def test_temporal_shift_moves_channels(self):
+        nt, c = 4, 8  # n=2 segments of t=2
+        x = np.arange(nt * c * 1 * 1, dtype=np.float32) \
+            .reshape(nt, c, 1, 1)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        # first fold channels pulled from t+1; last timestep zero-filled
+        np.testing.assert_array_equal(out[0, :2], x[1, :2])
+        np.testing.assert_array_equal(out[1, :2], 0)
+
+
+class TestNNUtils:
+    def test_weight_norm_preserves_forward_and_trains(self):
+        paddle.seed(0)
+        lin = nn.Linear(6, 4)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin)
+        x = paddle.rand([3, 6])
+        np.testing.assert_allclose(
+            lin(x).numpy(), x.numpy() @ w0 + lin.bias.numpy(),
+            rtol=1e-5, atol=1e-6)
+        lin(x).sum().backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+        nn.utils.remove_weight_norm(lin)
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_spectral_norm_divides_by_sigma(self):
+        paddle.seed(1)
+        lin = nn.Linear(5, 7)
+        w = lin.weight.numpy().copy()
+        nn.utils.spectral_norm(lin, n_power_iterations=25)
+        x = paddle.rand([2, 5])
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(
+            lin(x).numpy(),
+            x.numpy() @ (w / sigma) + lin.bias.numpy(),
+            rtol=1e-3, atol=1e-4)
+
+    def test_spectral_norm_layer_form(self):
+        w = np.random.RandomState(5).randn(5, 7).astype(np.float32)
+        sn = nn.SpectralNorm([5, 7], power_iters=25)
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(sn(paddle.to_tensor(w)).numpy(),
+                                   w / sigma, rtol=1e-3, atol=1e-4)
+
+    def test_parameters_vector_roundtrip(self):
+        lin = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        assert vec.shape == [8]
+        nn.utils.vector_to_parameters(vec * 2.0, lin.parameters())
+        np.testing.assert_allclose(
+            nn.utils.parameters_to_vector(lin.parameters()).numpy(),
+            vec.numpy() * 2.0, rtol=1e-6)
+
+
+class TestFlopsAndSamplers:
+    def test_flops_counts_linear_and_conv(self):
+        m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                          nn.Flatten(), nn.Linear(8 * 64, 10))
+        total = paddle.flops(m, [1, 3, 8, 8])
+        want = 2 * 64 * 8 * 3 * 9 + 64 * 8 + 2 * 8 * 64 * 10
+        assert total == want
+
+    def test_flops_custom_ops_override(self):
+        m = nn.Linear(4, 4)
+        total = paddle.flops(m, [1, 4],
+                             custom_ops={nn.Linear: lambda l, i, o: 42})
+        assert total == 42
+
+    def test_subset_random_sampler(self):
+        from paddle_tpu.io import SubsetRandomSampler
+        s = SubsetRandomSampler([3, 5, 7], generator=0)
+        got = list(s)
+        assert sorted(got) == [3, 5, 7] and len(s) == 3
+
+    def test_conv3d_transpose_shape(self):
+        ct = nn.Conv3DTranspose(2, 3, 3, stride=2, padding=1)
+        out = ct(paddle.rand([1, 2, 4, 4, 4]))
+        assert out.shape == [1, 3, 7, 7, 7]
+
+
+class TestReviewRegressions:
+    """Round-4 review findings — each was a confirmed defect."""
+
+    def test_shufflenet_x0_25_has_own_widths(self):
+        from paddle_tpu.vision import models as M
+        m = M.shufflenet_v2_x0_25(num_classes=3)
+        # x0_25 tail conv outputs 512 channels (0.5 would be 1024)
+        assert m.fc.in_features == 512
+
+    def test_color_jitter_accepts_ranges(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255) \
+            .astype(np.uint8)
+        out = T.ColorJitter(brightness=(0.8, 1.2), contrast=(0.9, 1.1),
+                            saturation=(0.9, 1.1), hue=(-0.1, 0.1))(img)
+        assert out.shape == img.shape
+
+    def test_temporal_shift_nhwc_matches_nchw(self):
+        x = np.random.RandomState(1).randn(4, 8, 2, 3).astype(np.float32)
+        ref = F.temporal_shift(paddle.to_tensor(x), seg_num=2).numpy()
+        got = F.temporal_shift(
+            paddle.to_tensor(x.transpose(0, 2, 3, 1)), seg_num=2,
+            data_format='NHWC').numpy()
+        np.testing.assert_allclose(got.transpose(0, 3, 1, 2), ref,
+                                   rtol=1e-6)
+        with pytest.raises(ValueError, match='data_format'):
+            F.temporal_shift(paddle.to_tensor(x), 2, data_format='NCWH')
+
+    def test_lu_unpack_batched(self):
+        m = np.random.RandomState(2).randn(3, 4, 4).astype(np.float32)
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(m))
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+        rec = np.einsum('bij,bjk,bkl->bil', P.numpy(), L.numpy(),
+                        U.numpy())
+        np.testing.assert_allclose(rec, m, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize('padding_mode', ['zeros', 'border',
+                                              'reflection'])
+    @pytest.mark.parametrize('mode', ['bilinear', 'nearest'])
+    @pytest.mark.parametrize('align_corners', [True, False])
+    def test_grid_sample_matches_torch(self, padding_mode, mode,
+                                       align_corners):
+        torch = pytest.importorskip('torch')
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 5, 6).astype(np.float32)
+        grid = (rng.rand(2, 4, 7, 2).astype(np.float32) * 3 - 1.5)
+        want = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode,
+            padding_mode=padding_mode,
+            align_corners=align_corners).numpy()
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode=mode, padding_mode=padding_mode,
+                            align_corners=align_corners).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

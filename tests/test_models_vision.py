@@ -124,3 +124,170 @@ class TestDatasets:
     def test_download_rejected(self):
         with pytest.raises(RuntimeError, match='offline'):
             MNIST(download=True)
+
+
+class TestZooExtra:
+    """Round-4 zoo expansion (upstream python/paddle/vision/models/)."""
+
+    @pytest.mark.parametrize('factory,size', [
+        ('squeezenet1_1', 64), ('mobilenet_v1', 32),
+        ('shufflenet_v2_x1_0', 32), ('mobilenet_v3_small', 32),
+    ])
+    def test_small_models_forward(self, factory, size):
+        from paddle_tpu.vision import models as M
+        m = getattr(M, factory)(num_classes=7)
+        m.eval()
+        out = m(paddle.rand([2, 3, size, size]))
+        assert out.shape == [2, 7]
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize('factory,size', [
+        ('alexnet', 128), ('squeezenet1_0', 64), ('densenet121', 32),
+        ('mobilenet_v3_large', 32), ('resnext50_32x4d', 32),
+        ('wide_resnet50_2', 32),
+    ])
+    def test_big_models_forward(self, factory, size):
+        from paddle_tpu.vision import models as M
+        m = getattr(M, factory)(num_classes=7)
+        m.eval()
+        out = m(paddle.rand([1, 3, size, size]))
+        assert out.shape == [1, 7]
+
+    @pytest.mark.slow
+    def test_googlenet_aux_heads(self):
+        from paddle_tpu.vision import models as M
+        g = M.googlenet(num_classes=6)
+        g.eval()
+        out, a1, a2 = g(paddle.rand([1, 3, 96, 96]))
+        assert out.shape == [1, 6] and a1.shape == [1, 6] \
+            and a2.shape == [1, 6]
+
+    @pytest.mark.slow
+    def test_inception_v3_forward(self):
+        from paddle_tpu.vision import models as M
+        m = M.inception_v3(num_classes=4)
+        m.eval()
+        assert m(paddle.rand([1, 3, 128, 128])).shape == [1, 4]
+
+    def test_resnext_grouped_conv_wiring(self):
+        from paddle_tpu.vision import models as M
+        m = M.resnext50_32x4d(num_classes=3)
+        conv2 = m.layer1[0].conv2
+        assert conv2.groups == 32 and conv2.weight.shape[0] == 128
+
+    def test_shufflenet_trains(self):
+        from paddle_tpu.vision import models as M
+        paddle.seed(0)
+        m = M.shufflenet_v2_x0_5(num_classes=4)
+        x = paddle.rand([4, 3, 32, 32])
+        y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                    parameters=m.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(6):
+            loss = loss_fn(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestVisionOps:
+    """paddle.vision.ops (upstream python/paddle/vision/ops.py)."""
+
+    def test_nms_suppresses_overlaps(self):
+        from paddle_tpu.vision import ops as V
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [50, 50, 60, 60], [0, 0, 5, 5]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+        kept = V.nms(boxes, scores, iou_threshold=0.5).numpy()
+        np.testing.assert_array_equal(kept, [0, 2, 3])
+        # per-category: the overlapping pair survives in separate classes
+        cats = np.array([0, 1, 0, 0])
+        kept_mc = V.nms(boxes, scores, iou_threshold=0.5,
+                        category_idxs=cats, categories=[0, 1]).numpy()
+        assert 1 in kept_mc
+
+    def test_box_iou_values(self):
+        from paddle_tpu.vision import ops as V
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+        b = paddle.to_tensor(np.array([[0, 0, 10, 10],
+                                       [5, 5, 15, 15]], np.float32))
+        iou = V.box_iou(a, b).numpy()
+        np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(iou[0, 1], 25 / 175, rtol=1e-5)
+
+    def test_roi_align_constant_map(self):
+        from paddle_tpu.vision import ops as V
+        x = paddle.to_tensor(np.full((1, 1, 8, 8), 3.0, np.float32))
+        rois = paddle.to_tensor(np.array([[1, 1, 5, 5]], np.float32))
+        out = V.roi_align(x, rois, paddle.to_tensor(np.array([1])),
+                          output_size=2)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.full((1, 1, 2, 2), 3.0), rtol=1e-6)
+
+    def test_roi_pool_picks_max(self):
+        from paddle_tpu.vision import ops as V
+        grid = np.zeros((1, 1, 8, 8), np.float32)
+        grid[0, 0, 2, 2] = 9.0
+        out = V.roi_pool(paddle.to_tensor(grid),
+                         paddle.to_tensor(np.array([[0, 0, 4, 4]],
+                                                   np.float32)),
+                         paddle.to_tensor(np.array([1])), output_size=1)
+        assert float(out.numpy().max()) == pytest.approx(9.0, rel=1e-3)
+
+    def test_deform_conv2d_zero_offset_equals_conv(self):
+        from paddle_tpu.vision import ops as V
+        import paddle_tpu.nn.functional as F
+        x = paddle.rand([1, 4, 8, 8])
+        w = paddle.rand([6, 4, 3, 3])
+        off = paddle.zeros([1, 18, 6, 6])
+        got = V.deform_conv2d(x, off, w).numpy()
+        want = F.conv2d(x, w).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        from paddle_tpu.vision import ops as V
+        priors = paddle.to_tensor(np.array([[0, 0, 10, 10],
+                                            [5, 5, 20, 25]], np.float32))
+        var = paddle.to_tensor(np.full((2, 4), 0.1, np.float32))
+        targets = paddle.to_tensor(np.array([[1, 2, 9, 12],
+                                             [4, 6, 22, 24]], np.float32))
+        enc = V.box_coder(priors, var, targets,
+                          code_type='encode_center_size')
+        dec = V.box_coder(priors, var, enc,
+                          code_type='decode_center_size')
+        np.testing.assert_allclose(dec.numpy(), targets.numpy(),
+                                   rtol=1e-4, atol=1e-3)
+
+
+class TestTransformsExtra:
+    def test_pad_and_grayscale(self):
+        img = (np.random.RandomState(0).rand(16, 12, 3) * 255) \
+            .astype(np.uint8)
+        assert T.Pad(2)(img).shape == (20, 16, 3)
+        assert T.Pad((1, 2))(img).shape == (20, 14, 3)
+        assert T.Grayscale(3)(img).shape == (16, 12, 3)
+        g1 = T.Grayscale(1)(img)
+        assert g1.shape == (16, 12, 1)
+
+    def test_color_jitter_preserves_shape_dtype(self):
+        img = (np.random.RandomState(1).rand(8, 8, 3) * 255) \
+            .astype(np.uint8)
+        out = T.ColorJitter(0.5, 0.5, 0.5, 0.2)(img)
+        assert out.shape == img.shape and out.dtype == np.uint8
+
+    def test_rotation_identity_and_range(self):
+        img = (np.random.RandomState(2).rand(9, 9, 1) * 255) \
+            .astype(np.uint8)
+        same = T.rotate(img, 0)
+        np.testing.assert_array_equal(same, img)
+        rot = T.RandomRotation(45)(img)
+        assert rot.shape == img.shape
+
+    def test_random_resized_crop(self):
+        img = np.random.RandomState(3).rand(32, 24, 3).astype(np.float32)
+        out = T.RandomResizedCrop(16)(img)
+        assert out.shape == (16, 16, 3)
