@@ -45,6 +45,8 @@ from . import sparse
 from . import quantization
 from . import fft
 from . import signal
+from . import distribution
+from . import version
 from .utils.flops import flops, summary
 
 bool = bool_  # paddle.bool

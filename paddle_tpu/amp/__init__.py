@@ -215,3 +215,13 @@ class GradScaler:
         self.step(optimizer)
         self.update()
         optimizer.clear_grad()
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the native matmul dtype of every TPU generation (and CPU
+    XLA emulates it), so this is unconditionally true here."""
+    return True
+
+
+def is_float16_supported(device=None):
+    return True  # storage-supported on TPU; emulated on CPU

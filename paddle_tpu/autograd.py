@@ -349,3 +349,148 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     grads = [None if id(t) in unused_ids else res[uniq_pos[id(t)]]
              for t in inputs_l]
     return grads[0] if single else grads
+
+
+# ---------------------------------------------------------------------------
+# PyLayer: user-defined forward/backward (upstream:
+# python/paddle/autograd/py_layer.py)
+# ---------------------------------------------------------------------------
+
+class PyLayerContext:
+    """Passed as `ctx` to PyLayer.forward/backward."""
+
+    def __init__(self):
+        self._saved = ()
+        self.__dict__['_attrs'] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+
+class PyLayer:
+    """Custom op with a hand-written gradient:
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+            @staticmethod
+            def backward(ctx, grad):
+                x, = ctx.saved_tensor()
+                return 3 * x * x * grad
+
+        y = Cube.apply(x)
+
+    TPU-native mechanics: `forward` runs eagerly with the tape OFF (its
+    internal ops are not differentiated — `backward` IS the gradient),
+    then one custom Node is recorded whose vjp calls `backward` and
+    whose replayable primal re-runs `forward` (so paddle.grad
+    create_graph still works through PyLayers)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .tensor import Tensor
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+        tensors = [leaves[i] for i in t_idx]
+
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        out_is_seq = isinstance(out, (tuple, list))
+        outs = list(out) if out_is_seq else [out]
+        for o in outs:
+            if not isinstance(o, Tensor):
+                raise TypeError('PyLayer.forward must return Tensor(s)')
+
+        record = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensors)
+        if record:
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, (tuple, list)) \
+                    else (cotangents,)
+                with no_grad():
+                    gin = cls.backward(
+                        ctx, *[Tensor(jnp.asarray(c)) for c in cots])
+                gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                if len(gin) != len(tensors):
+                    raise RuntimeError(
+                        f'{cls.__name__}.backward returned {len(gin)} '
+                        f'grads for {len(tensors)} Tensor inputs')
+                return tuple(
+                    None if g is None
+                    else (g._data if isinstance(g, Tensor) else jnp.asarray(g))
+                    for g in gin)
+
+            def _run_fwd(vals):
+                ls = list(leaves)
+                for i, v in zip(t_idx, vals):
+                    ls[i] = Tensor(v)
+                a, k = jax.tree_util.tree_unflatten(treedef, ls)
+                c = PyLayerContext()
+                with no_grad():
+                    o = cls.forward(c, *a, **k)
+                os_ = list(o) if isinstance(o, (tuple, list)) else [o]
+                vals_out = [t._data for t in os_]
+                out_v = tuple(vals_out) if len(vals_out) > 1 \
+                    else vals_out[0]
+                return out_v, c
+
+            # The replayable primal must carry the USER's backward, not
+            # jax's derivative of the re-run forward (a straight-through
+            # PyLayer would otherwise silently lose its custom gradient
+            # under paddle.grad(create_graph=True)). custom_vjp residuals
+            # are the ctx's saved tensor values.
+            @jax.custom_vjp
+            def primal(*vals):
+                return _run_fwd(vals)[0]
+
+            def primal_fwd(*vals):
+                out_v, c = _run_fwd(vals)
+                return out_v, tuple(
+                    t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                    for t in c._saved)
+
+            def primal_bwd(saved_vals, cot):
+                c = PyLayerContext()
+                c._saved = tuple(Tensor(v) for v in saved_vals)
+                cots = cot if isinstance(cot, (tuple, list)) else (cot,)
+                with no_grad():
+                    gin = cls.backward(
+                        c, *[Tensor(jnp.asarray(v)) for v in cots])
+                gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                return tuple(
+                    jnp.zeros_like(vals) if g is None else
+                    (g._data if isinstance(g, Tensor) else jnp.asarray(g))
+                    for g, vals in zip(
+                        gin, [leaves[i]._data for i in t_idx]))
+
+            primal.defvjp(primal_fwd, primal_bwd)
+
+            out_vals = [o._data for o in outs]
+            _, out_td = jax.tree_util.tree_flatten(
+                tuple(out_vals) if len(out_vals) > 1 else out_vals[0])
+            node = Node(
+                [InputRef(t) for t in tensors], vjp_fn, primal,
+                [(tuple(v.shape), jnp.dtype(v.dtype)) for v in out_vals],
+                out_td, name=cls.__name__)
+            outs = [Tensor(v, stop_gradient=False, _node=node,
+                           _leaf_index=i)
+                    for i, v in enumerate(out_vals)]
+        if out_is_seq:
+            return type(out)(outs)
+        return outs[0]

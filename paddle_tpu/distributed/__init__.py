@@ -12,7 +12,8 @@ from . import fleet as _fleet_mod
 from . import moe, pipeline, ring_attention
 from .auto import (Partial, Placement, ProcessMesh, Replicate, Shard,
                    dtensor_from_fn, reshard, shard_tensor)
-from .collective import (P2POp, ReduceOp, all_gather, all_reduce, alltoall,
+from .collective import (P2POp, ReduceOp, all_gather,
+                         all_gather_object, all_reduce, alltoall, gather,
                          alltoall_single, barrier, batch_isend_irecv,
                          broadcast, irecv, isend, recv, reduce,
                          reduce_scatter, scatter, send, wait)

@@ -414,3 +414,28 @@ def barrier(group=None):
 def wait(tensor, group=None, use_calc_stream=True):
     jax.block_until_ready(_val(tensor))
     return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather all ranks' slices to dst (upstream communication/gather.py).
+    Single-controller semantics: the stacked result is materialized and
+    `gather_list` (meaningful on dst) is filled with the per-rank
+    slices."""
+    ax = _axis_of(group)
+    v, mesh, spec = _stacked_shard(_val(tensor), ax)
+    out = jax.device_put(v, NamedSharding(mesh, P()))
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
+    return Tensor(out)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather python objects (upstream: pickle over NCCL). In the
+    single-controller SPMD model every rank executes this call with its
+    own `obj`; here there is one process, so the gathered list is the
+    world-size replication of the local object."""
+    n = env.get_world_size(group)
+    object_list.clear()
+    object_list.extend(obj for _ in range(n))
+    return object_list

@@ -1,0 +1,197 @@
+"""paddle.distribution (upstream: python/paddle/distribution/) —
+Normal/Uniform/Categorical/Bernoulli with sample/log_prob/entropy/kl,
+built on the stateless PRNG (framework.next_rng_key) and tape ops so
+log_prob is differentiable."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from ..tensor import Tensor, apply_op, to_jax
+
+__all__ = ['Distribution', 'Normal', 'Uniform', 'Categorical',
+           'Bernoulli', 'kl_divergence']
+
+
+def _as_t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(to_jax(x),
+                                                              jnp.float32))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(jnp.exp, self.log_prob(value), _name='exp')
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def sample(self, shape=(), seed=0):
+        k = jax.random.key(seed) if seed else framework.next_rng_key()
+        shape = tuple(shape)
+
+        def f(loc, scale):
+            base = jnp.broadcast_shapes(loc.shape, scale.shape)
+            eps = jax.random.normal(k, shape + base, jnp.float32)
+            return loc + scale * eps
+        return apply_op(f, self.loc, self.scale, _name='normal_sample')
+
+    rsample = sample  # reparameterized by construction
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            var = scale * scale
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return apply_op(f, _as_t(value), self.loc, self.scale,
+                        _name='normal_log_prob')
+
+    def entropy(self):
+        return apply_op(
+            lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+            self.scale, _name='normal_entropy')
+
+    def kl_divergence(self, other: 'Normal'):
+        def f(l1, s1, l2, s2):
+            return (jnp.log(s2 / s1) + (s1 * s1 + (l1 - l2) ** 2)
+                    / (2 * s2 * s2) - 0.5)
+        return apply_op(f, self.loc, self.scale, other.loc, other.scale,
+                        _name='normal_kl')
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_t(low)
+        self.high = _as_t(high)
+
+    def sample(self, shape=(), seed=0):
+        k = jax.random.key(seed) if seed else framework.next_rng_key()
+        shape = tuple(shape)
+
+        def f(lo, hi):
+            base = jnp.broadcast_shapes(lo.shape, hi.shape)
+            u = jax.random.uniform(k, shape + base, jnp.float32)
+            return lo + (hi - lo) * u
+        return apply_op(f, self.low, self.high, _name='uniform_sample')
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply_op(f, _as_t(value), self.low, self.high,
+                        _name='uniform_log_prob')
+
+    def entropy(self):
+        return apply_op(lambda lo, hi: jnp.log(hi - lo), self.low,
+                        self.high, _name='uniform_entropy')
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _as_t(logits)
+
+    def sample(self, shape=(), seed=0):
+        k = jax.random.key(seed) if seed else framework.next_rng_key()
+        shape = tuple(shape)
+        return apply_op(
+            lambda lg: jax.random.categorical(
+                k, lg, axis=-1, shape=shape + lg.shape[:-1]),
+            self.logits, _name='categorical_sample')
+
+    def probs(self, value=None):
+        p = apply_op(lambda lg: jax.nn.softmax(lg, axis=-1), self.logits,
+                     _name='softmax')
+        if value is None:
+            return p
+        return apply_op(
+            lambda pv, idx: jnp.take_along_axis(
+                pv, idx.astype(jnp.int32)[..., None], axis=-1)[..., 0],
+            p, _as_t(value), _name='categorical_probs')
+
+    def log_prob(self, value):
+        def f(lg, idx):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            idx = idx.astype(jnp.int32).reshape(lg.shape[:-1])
+            return jnp.take_along_axis(logp, idx[..., None],
+                                       axis=-1)[..., 0]
+        return apply_op(f, self.logits, _as_t(value),
+                        _name='categorical_log_prob')
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return apply_op(f, self.logits, _name='categorical_entropy')
+
+    def kl_divergence(self, other: 'Categorical'):
+        def f(a, b):
+            pa = jax.nn.log_softmax(a, axis=-1)
+            pb = jax.nn.log_softmax(b, axis=-1)
+            return jnp.sum(jnp.exp(pa) * (pa - pb), axis=-1)
+        return apply_op(f, self.logits, other.logits,
+                        _name='categorical_kl')
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _as_t(probs)
+
+    def sample(self, shape=(), seed=0):
+        k = jax.random.key(seed) if seed else framework.next_rng_key()
+        shape = tuple(shape)
+        return apply_op(
+            lambda p: jax.random.bernoulli(
+                k, p, shape + p.shape).astype(jnp.float32),
+            self.probs, _name='bernoulli_sample')
+
+    def log_prob(self, value):
+        def f(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply_op(f, self.probs, _as_t(value),
+                        _name='bernoulli_log_prob')
+
+    def entropy(self):
+        def f(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply_op(f, self.probs, _name='bernoulli_entropy')
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """Dispatch on matched distribution types (upstream
+    paddle.distribution.kl_divergence)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            f'kl_divergence({type(p).__name__}, {type(q).__name__}) '
+            f'is not registered')
+    if hasattr(p, 'kl_divergence'):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f'kl_divergence not implemented for {type(p).__name__}')

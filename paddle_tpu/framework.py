@@ -254,3 +254,16 @@ def seed(s: int):
 
 def next_rng_key():
     return default_generator.next_key()
+
+
+def get_cuda_rng_state():
+    """CUDA-API shim (upstream python/paddle/framework/random.py): the
+    stateless threefry (seed, counter) pair is the only RNG state on
+    TPU — returned as a one-element list to mirror the per-device list
+    upstream returns."""
+    return [default_generator.state()]
+
+
+def set_cuda_rng_state(state):
+    if state:
+        default_generator.set_state(state[0])

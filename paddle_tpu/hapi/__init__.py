@@ -311,6 +311,9 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     def summary(self, input_size=None, dtype=None):
+        if input_size is not None:
+            from ..utils.flops import summary as _summary
+            return _summary(self.network, input_size=input_size)
         total = int(sum(np.prod(p.shape) for p in self.network.parameters()))
         trainable = int(sum(np.prod(p.shape)
                             for p in self.network.parameters()

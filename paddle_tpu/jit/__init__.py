@@ -469,3 +469,10 @@ def not_to_static(fn):
 
 def enable_to_static(flag=True):
     pass  # always-on eager→jit conversion path
+
+
+def ignore_module(modules):
+    """Upstream: paddle.jit.ignore_module — marks modules whose calls
+    to_static should not transcribe. The tape-based to_static here never
+    transcribes python source, so this is a recorded no-op."""
+    return None

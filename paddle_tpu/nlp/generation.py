@@ -136,11 +136,13 @@ class GenerationMixin:
     def _decode_jit(self, max_new_tokens: int, strategy: str,
                     temperature: float, top_k: int, top_p: float,
                     eos_token_id: int, pad_token_id: int,
-                    padded: bool = False):
+                    padded: bool = False, repetition_penalty: float = 1.0,
+                    min_new_tokens: int = 0):
         # per-instance cache (a class-level lru_cache would pin every model
         # instance and its compiled executables for the process lifetime)
         cache_key = (max_new_tokens, strategy, temperature, top_k, top_p,
-                     eos_token_id, pad_token_id, padded)
+                     eos_token_id, pad_token_id, padded,
+                     repetition_penalty, min_new_tokens)
         store = self.__dict__.setdefault('_generate_jit_cache', {})
         if cache_key in store:
             return store[cache_key]
@@ -148,6 +150,36 @@ class GenerationMixin:
         def decode(params, frozen, buffers, ids, keep, cache, key):
             b, s = ids.shape
             total = s + max_new_tokens
+
+            def processors(logits, seen, emit_idx):
+                """Upstream logits processors (generation_utils.py):
+                CTRL repetition penalty over every token already in the
+                sequence, and EOS suppression until min_new_tokens."""
+                if repetition_penalty != 1.0:
+                    pen = jnp.where(logits > 0,
+                                    logits / repetition_penalty,
+                                    logits * repetition_penalty)
+                    logits = jnp.where(seen, pen, logits)
+                if min_new_tokens > 0 and eos_token_id >= 0:
+                    v = logits.shape[-1]
+                    is_eos = (jnp.arange(v) == eos_token_id)[None, :]
+                    logits = jnp.where(
+                        is_eos & (emit_idx < min_new_tokens), _NEG_INF,
+                        logits)
+                return logits
+
+            track_seen = repetition_penalty != 1.0
+            if track_seen:
+                # OR-accumulate (add then >0): a plain .set() scatter has
+                # undefined write order when a pad slot and a real slot
+                # carry the same token id
+                contrib = (keep if padded
+                           else jnp.ones((b, s), bool)).astype(jnp.int32)
+                seen0 = (jnp.zeros((b, self.config.vocab_size), jnp.int32)
+                         .at[jnp.arange(b)[:, None], ids]
+                         .add(contrib)) > 0
+            else:
+                seen0 = jnp.zeros((b, 1), bool)  # unused placeholder
 
             def fwd(tok, cache, pos_offset, slot, mask):
                 (logits, new_cache), _ = functional_call(
@@ -171,23 +203,31 @@ class GenerationMixin:
                     return None
                 return padded_decode_mask(keep, total, jnp.int32(s) + i, 1)
 
+            def mark_seen(seen, tok):
+                if not track_seen:
+                    return seen
+                return seen.at[jnp.arange(b), tok].set(True)
+
             # prefill over the whole prompt
             logits, cache = fwd(ids, cache, offsets, jnp.int32(0),
                                 prefill_mask)
             key, sub = jax.random.split(key)
-            nxt, nxt_logp = _next_token(logits[:, -1], sub, strategy,
-                                        temperature, top_k, top_p)
+            nxt, nxt_logp = _next_token(
+                processors(logits[:, -1], seen0, jnp.int32(0)), sub,
+                strategy, temperature, top_k, top_p)
+            seen = mark_seen(seen0, nxt)
             out = jnp.full((b, max_new_tokens), pad_token_id, jnp.int32)
             scores = jnp.zeros((b,), jnp.float32)
             finished = jnp.zeros((b,), jnp.bool_)
 
             def cond(state):
-                i, _, _, _, _, finished, _, _ = state
+                i, _, _, _, _, finished, _, _, _ = state
                 return jnp.logical_and(i < max_new_tokens,
                                        jnp.logical_not(jnp.all(finished)))
 
             def body(state):
-                i, tok, tok_logp, out, cache, finished, scores, key = state
+                i, tok, tok_logp, out, cache, finished, scores, key, \
+                    seen = state
                 # emit `tok` (sampled last round) and count ITS log-prob
                 tok = jnp.where(finished, pad_token_id, tok)
                 out = jax.lax.dynamic_update_slice(
@@ -198,14 +238,16 @@ class GenerationMixin:
                                     offsets + s + i, jnp.int32(s) + i,
                                     step_mask(i))
                 key, sub = jax.random.split(key)
-                nxt, nxt_logp = _next_token(logits[:, -1], sub, strategy,
-                                            temperature, top_k, top_p)
+                nxt, nxt_logp = _next_token(
+                    processors(logits[:, -1], seen, i + 1), sub,
+                    strategy, temperature, top_k, top_p)
+                seen = mark_seen(seen, nxt)
                 return (i + 1, nxt, nxt_logp, out, cache, newly_done,
-                        scores, key)
+                        scores, key, seen)
 
             state = (jnp.int32(0), nxt, nxt_logp, out, cache, finished,
-                     scores, key)
-            _, _, _, out, _, _, scores, _ = jax.lax.while_loop(
+                     scores, key, seen)
+            _, _, _, out, _, _, scores, _, _ = jax.lax.while_loop(
                 cond, body, state)
             return out, scores
 
@@ -331,6 +373,8 @@ class GenerationMixin:
                  decode_strategy: str = 'greedy_search',
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                  num_beams: int = 1, length_penalty: float = 0.0,
+                 repetition_penalty: float = 1.0, min_new_tokens: int = 0,
+                 min_length: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None, use_cache: bool = True,
                  seed: Optional[int] = None,
@@ -360,6 +404,13 @@ class GenerationMixin:
             keep = jnp.ones((b, s), bool)
         if max_length is not None:
             max_new_tokens = max(int(max_length) - s, 1)
+        if min_length is not None:  # upstream name: total-length minimum
+            min_new_tokens = max(int(min_length) - s, min_new_tokens)
+        if decode_strategy == 'beam_search' and (
+                repetition_penalty != 1.0 or min_new_tokens > 0):
+            raise NotImplementedError(
+                'repetition_penalty/min_new_tokens are supported for '
+                'greedy_search and sampling (not beam_search)')
         cfg = getattr(self, 'config', None)
         max_pos = getattr(cfg, 'max_position_embeddings', None)
         if max_pos is not None and s + max_new_tokens > max_pos:
@@ -391,7 +442,10 @@ class GenerationMixin:
                 fn = self._decode_jit(int(max_new_tokens), decode_strategy,
                                       float(temperature), int(top_k),
                                       float(top_p), int(eos_token_id),
-                                      int(pad_token_id), padded=padded)
+                                      int(pad_token_id), padded=padded,
+                                      repetition_penalty=float(
+                                          repetition_penalty),
+                                      min_new_tokens=int(min_new_tokens))
                 out, scores = fn(params, frozen, buffers, ids, keep, cache,
                                  key)
         finally:

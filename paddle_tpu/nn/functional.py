@@ -1225,3 +1225,23 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 def alltoall(*a, **k):  # placed in distributed; import-compat shim
     from .. import distributed
     return distributed.alltoall(*a, **k)
+
+
+def gather_tree(ids, parents, name=None):
+    """Trace beam-search parent pointers back from the last step
+    (upstream: paddle.nn.functional.gather_tree; [T, B, K] layout)."""
+    def f(idv, par):
+        t = idv.shape[0]
+
+        def body(carry, xs):
+            beams = carry  # [B, K] beam index selected at step t+1
+            step_ids, step_par = xs
+            toks = jnp.take_along_axis(step_ids, beams, axis=1)
+            prev = jnp.take_along_axis(step_par, beams, axis=1)
+            return prev, toks
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2])[None, :],
+                                idv.shape[1:])
+        _, toks = jax.lax.scan(body, init, (idv[::-1], par[::-1]))
+        return toks[::-1]
+    return defop(f, name='gather_tree')(ids, parents)

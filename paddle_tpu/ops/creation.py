@@ -258,3 +258,26 @@ def create_parameter(shape, dtype=None, default_initializer=None,
         val = jax.random.uniform(framework.next_rng_key(), _shape(shape), dt,
                                  minval=-limit, maxval=limit)
     return Parameter(val, name=name or '')
+
+
+def poisson(x, name=None):
+    k = framework.next_rng_key()
+    lam = to_jax(x)
+    return Tensor(jax.random.poisson(k, lam).astype(lam.dtype))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    k = framework.next_rng_key()
+    return Tensor(jax.random.normal(k, _shape(shape), _dt(dtype)))
+
+
+def standard_gamma(x, name=None):
+    k = framework.next_rng_key()
+    alpha = to_jax(x)
+    return Tensor(jax.random.gamma(k, alpha).astype(alpha.dtype))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    from ._helpers import defop
+    return defop(lambda v: jnp.vander(v, N=n, increasing=increasing),
+                 name='vander')(x)

@@ -362,3 +362,143 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         ok = (v >= lo) & (v < lo + size)
         return jnp.where(ok, v - lo, ignore_value)
     return defop(f, name='shard_index')(input)
+
+
+# ---------------------------------------------------------------------------
+# round-4 wideners: stacking/splitting/scatter-view families
+# ---------------------------------------------------------------------------
+
+def atleast_1d(*inputs, name=None):
+    outs = [defop(jnp.atleast_1d, name='atleast_1d')(x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [defop(jnp.atleast_2d, name='atleast_2d')(x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [defop(jnp.atleast_3d, name='atleast_3d')(x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hstack(x, name=None):
+    return defop(lambda vs: jnp.hstack(vs), name='hstack')(builtins.list(x))
+
+
+def vstack(x, name=None):
+    return defop(lambda vs: jnp.vstack(vs), name='vstack')(builtins.list(x))
+
+
+def dstack(x, name=None):
+    return defop(lambda vs: jnp.dstack(vs), name='dstack')(builtins.list(x))
+
+
+def column_stack(x, name=None):
+    return defop(lambda vs: jnp.column_stack(vs),
+                 name='column_stack')(builtins.list(x))
+
+
+def block_diag(inputs, name=None):
+    import jax.scipy.linalg as jsl
+    return defop(lambda vs: jsl.block_diag(*[jnp.atleast_2d(v)
+                                             for v in vs]),
+                 name='block_diag')(builtins.list(inputs))
+
+
+def _split_indices(total, arg):
+    if isinstance(arg, int):
+        return arg
+    return [int(a) for a in arg]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(v):
+        return jnp.array_split(v, _split_indices(v.shape[axis],
+                                                 num_or_indices),
+                               axis=int(axis))
+    outs = defop(f, name='tensor_split')(x)
+    return builtins.list(outs) if isinstance(outs, (list, tuple)) else outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1, name=name)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0, name=name)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2, name=name)
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(v):
+        ax = int(axis) % v.ndim
+        tgt = builtins.list(int(s) for s in shape)
+        if -1 in tgt:
+            known = int(np.prod([s for s in tgt if s != -1]))
+            tgt[tgt.index(-1)] = v.shape[ax] // known
+        return v.reshape(v.shape[:ax] + tuple(tgt) + v.shape[ax + 1:])
+    return defop(f, name='unflatten')(x)
+
+
+def view_as(x, other, name=None):
+    return defop(lambda v, o: v.reshape(o.shape), name='view_as')(x, other)
+
+
+def take(x, index, mode='raise', name=None):
+    """Flat-index gather (paddle.take): negative indices wrap; 'clip'
+    clamps out-of-range."""
+    def f(v, idx):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        idx = idx.astype(jnp.int32)
+        if mode == 'wrap':
+            idx = idx % n
+        else:
+            idx = jnp.where(idx < 0, idx + n, idx)
+            idx = jnp.clip(idx, 0, n - 1)
+        return flat[idx]
+    return defop(f, name='take')(x, index)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(v, val):
+        idx = [builtins.slice(None)] * v.ndim
+        idx[int(axis)] = int(index)
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+    return defop(f, name='select_scatter')(x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(v, val):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(st), int(en), int(sd))
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+    return defop(f, name='slice_scatter')(x, value)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of `mask` with consecutive elements of
+    `value` (paddle.masked_scatter)."""
+    def f(v, m, val):
+        m = jnp.broadcast_to(m.astype(bool), v.shape)
+        flat_m = m.reshape(-1)
+        # k-th True position takes value.flatten()[k]
+        order = jnp.cumsum(flat_m) - 1
+        picked = val.reshape(-1)[jnp.clip(order, 0, val.size - 1)]
+        return jnp.where(flat_m, picked.astype(v.dtype),
+                         v.reshape(-1)).reshape(v.shape)
+    return defop(f, name='masked_scatter')(x, mask, value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(v, idx):
+        idx_t = [builtins.slice(None)] * v.ndim
+        idx_t[int(axis)] = idx
+        return v.at[tuple(idx_t)].set(jnp.asarray(value, v.dtype))
+    return defop(f, name='index_fill')(x, index)

@@ -187,3 +187,72 @@ def cdist(x, y, p=2.0, compute_mode='use_mm_for_euclid_dist_if_necessary',
         return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1),
                          1.0 / p)
     return defop(f, name='cdist')(x, y)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(yv, *rest):
+        xv = rest[0] if rest else None
+        return jnp.trapezoid(yv, x=xv, dx=1.0 if dx is None else dx,
+                             axis=int(axis))
+    args = (y,) if x is None else (y, x)
+    return defop(f, name='trapezoid')(*args)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            v = v.astype(jnp.dtype(dtype))
+        ax = int(axis) if axis is not None else None
+        if ax is None:
+            v = v.reshape(-1)
+            ax = 0
+        # exact parallel prefix: logaddexp is associative, so the scan
+        # keeps full numerical stability (no global-max trick needed)
+        return jax.lax.associative_scan(jnp.logaddexp, v, axis=ax)
+    return defop(f, name='logcumsumexp')(x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Scale slices along `axis` whose p-norm exceeds max_norm down to
+    max_norm (paddle.renorm)."""
+    def f(v):
+        ax = int(axis) % v.ndim
+        red = tuple(i for i in range(v.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) \
+            ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7),
+                          1.0)
+        return v * scale.astype(v.dtype)
+    return defop(f, name='renorm')(x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return defop(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                     axis2=axis2), name='trace')(x)
+
+
+def polygamma(x, n, name=None):
+    import jax.scipy.special as jss
+    return defop(lambda v: jss.polygamma(int(n), v), name='polygamma')(x)
+
+
+def signbit(x, name=None):
+    return defop(lambda v: jnp.signbit(v), name='signbit')(x)
+
+
+def sinc(x, name=None):
+    return defop(lambda v: jnp.sinc(v), name='sinc')(x)
+
+
+def polar(abs, angle, name=None):
+    return defop(lambda a, t: (a * jnp.cos(t)).astype(jnp.complex64)
+                 + 1j * (a * jnp.sin(t)).astype(jnp.complex64),
+                 name='polar')(abs, angle)
+
+
+def nextafter(x, y, name=None):
+    return defop(lambda a, b: jnp.nextafter(a, b), name='nextafter')(x, y)
+
+
+def ldexp(x, y, name=None):
+    return defop(lambda a, b: jnp.ldexp(a, b), name='ldexp')(x, y)

@@ -376,6 +376,189 @@ class Lamb(Optimizer):
         return p - lr * trust * r, slots
 
 
+class Adadelta(Optimizer):
+    """Upstream: optimizer/adadelta.py — accumulates squared grads and
+    squared updates; the effective step needs no external lr scale
+    (lr multiplies anyway, matching paddle)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _init_slots(self, p):
+        return {'avg_squared_grad': jnp.zeros(p.shape, jnp.float32),
+                'avg_squared_update': jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, step):
+        rho, eps = self._rho, self._epsilon
+        sg = rho * slots['avg_squared_grad'] + (1 - rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots['avg_squared_update'] + eps) \
+            / jnp.sqrt(sg + eps)
+        su = rho * slots['avg_squared_update'] + (1 - rho) * jnp.square(upd)
+        slots['avg_squared_grad'] = sg
+        slots['avg_squared_update'] = su
+        return p - lr * upd, slots
+
+
+class Adamax(Optimizer):
+    """Upstream: optimizer/adamax.py — Adam with an infinity-norm second
+    moment."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {'moment': jnp.zeros(p.shape, jnp.float32),
+                'inf_norm': jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots['moment'] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots['inf_norm'], jnp.abs(g))
+        slots['moment'] = m
+        slots['inf_norm'] = u
+        t = jnp.asarray(step, jnp.float32)
+        return p - (lr / (1 - jnp.power(b1, t))) * m \
+            / (u + self._epsilon), slots
+
+
+class NAdam(Adam):
+    """Adam with Nesterov momentum and the Dozat momentum-decay schedule
+    mu_t = beta1*(1 - 0.5*0.96^(t*psi)) (matches torch.optim.NAdam; the
+    running mu product lives in a scalar slot per leaf)."""
+
+    def __init__(self, *args, momentum_decay=0.004, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._momentum_decay = momentum_decay
+
+    def _init_slots(self, p):
+        s = super()._init_slots(p)
+        s['mu_product'] = jnp.ones((), jnp.float32)
+        return s
+
+    def _rule(self, g, p, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        psi = self._momentum_decay
+        m = b1 * slots['moment1'].astype(jnp.float32) + (1 - b1) * g
+        v = b2 * slots['moment2'].astype(jnp.float32) \
+            + (1 - b2) * jnp.square(g)
+        slots['moment1'] = m.astype(self._moment_dtype)
+        slots['moment2'] = v.astype(self._moment_dtype)
+        t = jnp.asarray(step, jnp.float32)
+        mu_t = b1 * (1 - 0.5 * jnp.power(0.96, t * psi))
+        mu_t1 = b1 * (1 - 0.5 * jnp.power(0.96, (t + 1) * psi))
+        mu_prod = slots['mu_product'] * mu_t
+        slots['mu_product'] = mu_prod
+        m_hat = mu_t1 * m / (1 - mu_prod * mu_t1) \
+            + (1 - mu_t) * g / (1 - mu_prod)
+        v_hat = v / (1 - jnp.power(b2, t))
+        return p - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon), slots
+
+
+class RAdam(Adam):
+    """Rectified Adam (upstream: incubate/radam): falls back to
+    unadapted SGD-with-momentum while the variance rectifier is
+    untrustworthy (rho_t <= 4)."""
+
+    def _rule(self, g, p, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots['moment1'].astype(jnp.float32) + (1 - b1) * g
+        v = b2 * slots['moment2'].astype(jnp.float32) \
+            + (1 - b2) * jnp.square(g)
+        slots['moment1'] = m.astype(self._moment_dtype)
+        slots['moment2'] = v.astype(self._moment_dtype)
+        t = jnp.asarray(step, jnp.float32)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * jnp.power(b2, t) / (1 - jnp.power(b2, t))
+        m_hat = m / (1 - jnp.power(b1, t))
+        r = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-9),
+            0.0))
+        # threshold 5 and eps-on-sqrt(v) match the torch/paddle
+        # implementations (the paper's nominal cutoff is 4)
+        adaptive = lr * r * m_hat * jnp.sqrt(1 - jnp.power(b2, t)) \
+            / (jnp.sqrt(v) + self._epsilon)
+        plain = lr * m_hat
+        return p - jnp.where(rho_t > 5.0, adaptive, plain), slots
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (upstream: optimizer/rprop.py) — per-weight
+    step sizes grown/shrunk by gradient sign agreement; gradients'
+    magnitudes are ignored."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._eta_minus, self._eta_plus = etas
+        self._lr_min, self._lr_max = learning_rate_range
+        try:
+            self._lr0 = float(learning_rate)
+        except (TypeError, ValueError):
+            self._lr0 = 1e-2  # scheduler-driven: seed step sizes modestly
+
+    def _init_slots(self, p):
+        return {'prev_grad': jnp.zeros(p.shape, jnp.float32),
+                'step_size': jnp.full(p.shape, self._lr0, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, step):
+        sign = jnp.sign(g * slots['prev_grad'])
+        factor = jnp.where(sign > 0, self._eta_plus,
+                           jnp.where(sign < 0, self._eta_minus, 1.0))
+        size = jnp.clip(slots['step_size'] * factor, self._lr_min,
+                        self._lr_max)
+        # on sign flip, skip the update and zero the remembered grad
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        slots['prev_grad'] = g_eff
+        slots['step_size'] = size
+        return p - size * jnp.sign(g_eff), slots
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (upstream: optimizer/asgd.py): steps with the mean
+    of the last `batch_num` gradients. The ring buffer of gradients is
+    optimizer state, exactly like upstream (paddle allocates a
+    [batch_num, *shape] accumulator per parameter — mind the HBM cost
+    for large batch_num)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._batch_num = max(int(batch_num), 1)
+
+    def _init_slots(self, p):
+        if self._batch_num == 1:
+            return {}
+        return {'grad_ring': jnp.zeros((self._batch_num,) + tuple(p.shape),
+                                       jnp.float32),
+                'grad_sum': jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, step):
+        if self._batch_num == 1:
+            return p - lr * g, slots
+        n = self._batch_num
+        t = step  # 1-based
+        idx = (t - 1) % n
+        old = slots['grad_ring'][idx]
+        ssum = slots['grad_sum'] - old + g
+        slots['grad_ring'] = slots['grad_ring'].at[idx].set(g)
+        slots['grad_sum'] = ssum
+        denom = jnp.minimum(t, n).astype(jnp.float32)
+        return p - lr * ssum / denom, slots
+
+
 # regularizer shims (upstream: python/paddle/regularizer.py)
 class L2Decay:
     def __init__(self, coeff=0.0):

@@ -296,3 +296,26 @@ class ReduceOnPlateau(LRScheduler):
             self.last_lr = max(self.last_lr * self.factor, self.min_lr)
             self.cooldown_counter = self.cooldown
             self.num_bad = 0
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    """SGDR (upstream: lr.py CosineAnnealingWarmRestarts): cosine decay
+    restarting every T_i epochs, periods growing by T_mult."""
+
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0.0,
+                 last_epoch=-1, verbose=False):
+        if T_0 <= 0 or T_mult < 1:
+            raise ValueError('T_0 must be > 0 and T_mult >= 1')
+        self.T_0 = T_0
+        self.T_mult = T_mult
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = max(self.last_epoch, 0)
+        T_i, t_cur = self.T_0, t
+        while t_cur >= T_i:
+            t_cur -= T_i
+            T_i *= self.T_mult
+        return self.eta_min + (self.base_lr - self.eta_min) \
+            * (1 + math.cos(math.pi * t_cur / T_i)) / 2

@@ -34,7 +34,8 @@ def _is_tensor(x):
 
 class Tensor:
     __slots__ = ('_data', 'stop_gradient', 'grad', '_node', '_leaf_index',
-                 'name', 'persistable', '_dist_spec', '__weakref__')
+                 'name', 'persistable', '_dist_spec', '_grad_hooks',
+                 '__weakref__')
 
     def __init__(self, data, stop_gradient: bool = True, name: str = '',
                  _node=None, _leaf_index: int = 0):
@@ -126,10 +127,34 @@ class Tensor:
         autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
 
     def _accumulate_grad(self, g_val):
+        hooks = getattr(self, '_grad_hooks', None)
+        if hooks:
+            g_t = Tensor(jnp.asarray(g_val, self.dtype))
+            for h in list(hooks.values()):
+                res = h(g_t)
+                if res is not None:
+                    g_t = res if isinstance(res, Tensor) else Tensor(res)
+            g_val = g_t._data
         if self.grad is None:
             self.grad = Tensor(jnp.asarray(g_val, self.dtype))
         else:
             self.grad = Tensor(self.grad._data + jnp.asarray(g_val, self.dtype))
+
+    def register_hook(self, hook):
+        """Register `hook(grad) -> grad | None`, run when this leaf's
+        gradient arrives in backward (upstream Tensor.register_hook).
+        Returns a handle with .remove()."""
+        hooks = getattr(self, '_grad_hooks', None)
+        if hooks is None:
+            hooks = {}
+            object.__setattr__(self, '_grad_hooks', hooks)
+        hid = max(hooks, default=-1) + 1
+        hooks[hid] = hook
+
+        class _Handle:
+            def remove(self_inner):
+                hooks.pop(hid, None)
+        return _Handle()
 
     def clear_grad(self):
         self.grad = None

@@ -1,3 +1,25 @@
 """paddle_tpu.utils — checkpointing, logging, misc support."""
 from . import checkpoint  # noqa: F401
 from . import logging  # noqa: F401
+
+
+class _DLPack:
+    """paddle.utils.dlpack (upstream: python/paddle/utils/dlpack.py) —
+    zero-copy exchange via the DLPack protocol on jax arrays."""
+
+    @staticmethod
+    def to_dlpack(x):
+        """Returns a DLPack-protocol object (the raw jax array — it
+        implements __dlpack__/__dlpack_device__; capsule-style dlpack
+        was removed from modern jax/numpy/torch)."""
+        from ..tensor import Tensor
+        return x.value if isinstance(x, Tensor) else x
+
+    @staticmethod
+    def from_dlpack(ext):
+        from ..tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.from_dlpack(ext))
+
+
+dlpack = _DLPack()

@@ -1,0 +1,26 @@
+"""paddle.version (upstream: generated python/paddle/version/__init__.py)."""
+full_version = '0.1.0'
+major = '0'
+minor = '1'
+patch = '0'
+rc = '0'
+commit = 'unknown'
+istaged = False
+with_pip = False
+cuda_version = 'False'   # TPU-native build: no CUDA
+cudnn_version = 'False'
+xpu_version = 'False'
+
+
+def show():
+    print(f'full_version: {full_version}')
+    print(f'commit: {commit}')
+    print('cuda: False (TPU-native build; device backend is PjRt/XLA)')
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -157,6 +157,25 @@ SURFACE = [
     'nn.functional.grid_sample', 'nn.functional.affine_grid',
     'nn.functional.fold', 'nn.functional.temporal_shift',
     'io.SubsetRandomSampler', 'io.WeightedRandomSampler',
+    # round-4 wideners part 2
+    'optimizer.Adadelta', 'optimizer.Adamax', 'optimizer.NAdam',
+    'optimizer.RAdam', 'optimizer.Rprop', 'optimizer.ASGD',
+    'optimizer.lr.CosineAnnealingWarmRestarts',
+    'autograd.PyLayer', 'autograd.PyLayerContext',
+    'distribution.Normal', 'distribution.Uniform',
+    'distribution.Categorical', 'distribution.Bernoulli',
+    'distribution.kl_divergence',
+    'version.full_version', 'utils.dlpack',
+    'amp.is_bfloat16_supported', 'amp.is_float16_supported',
+    'distributed.gather', 'distributed.all_gather_object',
+    'nn.functional.gather_tree', 'jit.ignore_module',
+    'poisson', 'standard_normal', 'vander', 'trapezoid', 'logcumsumexp',
+    'renorm', 'trace', 'polygamma', 'signbit', 'sinc', 'polar', 'take',
+    'select_scatter', 'slice_scatter', 'masked_scatter', 'index_fill',
+    'atleast_1d', 'atleast_2d', 'atleast_3d', 'block_diag',
+    'column_stack', 'hstack', 'vstack', 'dstack', 'hsplit', 'vsplit',
+    'dsplit', 'tensor_split', 'unflatten', 'view_as', 'nextafter',
+    'ldexp',
 ]
 
 TENSOR_METHODS = [

@@ -90,6 +90,39 @@ class TestLlama:
         want = np.take_along_axis(logp, out.numpy().astype(int), 1)[:, 0]
         np.testing.assert_allclose(scores.numpy(), want, atol=1e-4)
 
+    def test_generate_min_new_tokens_suppresses_eos(self):
+        """EOS must not be emitted before min_new_tokens (upstream
+        min_length logits processor)."""
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        ids = _ids(cfg, b=1, s=4)
+        with paddle.no_grad():
+            first = int(m(ids).numpy()[0, -1].argmax())
+        # without the processor, EOS would stop decode immediately
+        out, _ = m.generate(ids, max_new_tokens=5, eos_token_id=first,
+                            pad_token_id=99, min_new_tokens=5)
+        assert all(t != 99 for t in out.numpy()[0])
+
+    def test_generate_repetition_penalty_changes_output(self):
+        """CTRL penalty must steer greedy decode away from repeats; with
+        penalty=1.0 the path is bit-identical to the unpenalized one."""
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg).eval()
+        ids = _ids(cfg, b=2, s=6)
+        base, _ = m.generate(ids, max_new_tokens=8, eos_token_id=-1)
+        same, _ = m.generate(ids, max_new_tokens=8, eos_token_id=-1,
+                             repetition_penalty=1.0)
+        np.testing.assert_array_equal(base.numpy(), same.numpy())
+        pen, _ = m.generate(ids, max_new_tokens=8, eos_token_id=-1,
+                            repetition_penalty=5.0)
+        # base decode repeats token 85-style loops; penalized must differ
+        assert not np.array_equal(base.numpy(), pen.numpy())
+        # penalized sequences repeat strictly less
+        def max_repeats(a):
+            return max(np.max(np.unique(row, return_counts=True)[1])
+                       for row in a)
+        assert max_repeats(pen.numpy()) <= max_repeats(base.numpy())
+
     def test_generate_rejects_overflow_and_bad_mask(self):
         cfg = LlamaConfig.tiny()
         m = LlamaForCausalLM(cfg).eval()
