@@ -40,6 +40,12 @@ from .serialization import load, save
 from .nn.layer import ParamAttr
 from .optimizer import L1Decay, L2Decay
 
+from . import regularizer
+from . import audio
+from . import geometric
+from . import incubate
+from . import onnx
+from . import text
 from . import static
 from . import sparse
 from . import quantization

@@ -1,0 +1,5 @@
+"""paddle.audio (upstream: python/paddle/audio/)."""
+from . import backends, datasets, features, functional
+from .backends import load, save
+
+__all__ = ['backends', 'datasets', 'features', 'functional', 'load', 'save']

@@ -166,6 +166,15 @@ def backward(outputs, grad_tensors=None, retain_graph=False, capture=None,
     # (id(producing_node), output_leaf_index) — nodes are held strongly for
     # the whole walk, so no id-reuse hazard.
     cot: dict = {}
+    # Leaf partials are summed here and flushed once at the end of the walk
+    # so grad hooks see the full gradient, not each partial.
+    leaf_sums: dict = {}
+
+    def leaf_add(t, g_val):
+        if id(t) in leaf_sums:
+            leaf_sums[id(t)][1] = leaf_sums[id(t)][1] + g_val
+        else:
+            leaf_sums[id(t)] = [t, g_val]
 
     root_nodes = []
     for out, g in zip(outputs, grad_tensors):
@@ -183,7 +192,7 @@ def backward(outputs, grad_tensors=None, retain_graph=False, capture=None,
             cap_add(id(out), g_val)
         if out._node is None:
             if capture is None:
-                out._accumulate_grad(g_val)
+                leaf_add(out, g_val)
         else:
             key = (id(out._node), out._leaf_index)
             cot[key] = cot[key] + g_val if key in cot else g_val
@@ -221,9 +230,12 @@ def backward(outputs, grad_tensors=None, retain_graph=False, capture=None,
                 key = (id(ref.node), ref.leaf_index)
                 cot[key] = cot[key] + g if key in cot else g
             elif capture is None:
-                ref.target._accumulate_grad(g)
+                leaf_add(ref.target, g)
         if not retain_graph:
             node.release()
+
+    for t, g_val in leaf_sums.values():
+        t._accumulate_grad(g_val)
 
 
 def _build_pure(outputs, inputs, frozen_ids=()):
@@ -361,7 +373,6 @@ class PyLayerContext:
 
     def __init__(self):
         self._saved = ()
-        self.__dict__['_attrs'] = {}
 
     def save_for_backward(self, *tensors):
         self._saved = tuple(tensors)
@@ -460,14 +471,15 @@ class PyLayer:
                 return _run_fwd(vals)[0]
 
             def primal_fwd(*vals):
-                out_v, c = _run_fwd(vals)
-                return out_v, tuple(
-                    t._data if isinstance(t, Tensor) else jnp.asarray(t)
-                    for t in c._saved)
+                out_v, _ = _run_fwd(vals)
+                # residuals are the INPUT vals: backward re-runs forward to
+                # rebuild the full ctx (saved tensors AND any python attrs
+                # the user set on it — a ctx built from saved values alone
+                # would lose those)
+                return out_v, vals
 
             def primal_bwd(saved_vals, cot):
-                c = PyLayerContext()
-                c._saved = tuple(Tensor(v) for v in saved_vals)
+                _, c = _run_fwd(saved_vals)
                 cots = cot if isinstance(cot, (tuple, list)) else (cot,)
                 with no_grad():
                     gin = cls.backward(
@@ -494,3 +506,82 @@ class PyLayer:
         if out_is_seq:
             return type(out)(outs)
         return outs[0]
+
+
+# ---------------------------------------------------------------------------
+# jacobian / hessian (upstream: python/paddle/autograd/autodiff.py)
+# ---------------------------------------------------------------------------
+
+def _jac_single(y, x, batch_axis):
+    """Dense Jacobian of one output Tensor w.r.t. one input Tensor."""
+    from .tensor import Tensor
+
+    f, reachable = _build_pure([y], [x])
+    if id(x) not in reachable:
+        raise RuntimeError('xs is not reachable from ys on the tape')
+    jac = jax.jacrev(lambda v: f(v)[0])(x._data)  # y.shape + x.shape
+    if batch_axis is None:
+        return Tensor(jac.reshape(int(np_prod(y.shape)),
+                                  int(np_prod(x.shape))))
+    if batch_axis != 0:
+        raise NotImplementedError('batch_axis must be None or 0')
+    by, bx = y.shape[0], x.shape[0]
+    my = int(np_prod(y.shape)) // by
+    nx = int(np_prod(x.shape)) // bx
+    # [By, My, Bx, Nx] -> per-sample diagonal [B, My, Nx]
+    j4 = jac.reshape(by, my, bx, nx)
+    diag = jnp.diagonal(j4, axis1=0, axis2=2)  # [My, Nx, B]
+    return Tensor(jnp.moveaxis(diag, -1, 0))
+
+
+def np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """paddle.autograd.jacobian — dense Jacobian of `ys` w.r.t. `xs`,
+    evaluated by functionalizing the recorded tape and applying
+    `jax.jacrev` (upstream computes this with repeated backward passes;
+    one traced jacrev is the TPU-native equivalent).
+
+    batch_axis=None -> [ys.numel, xs.numel]; batch_axis=0 -> per-sample
+    diagonal [B, ys.numel/B, xs.numel/B]. Lists map to (tuples of)
+    results like upstream.
+    """
+    from .tensor import Tensor
+
+    ys_l = [ys] if isinstance(ys, Tensor) else list(ys)
+    xs_l = [xs] if isinstance(xs, Tensor) else list(xs)
+    rows = [tuple(_jac_single(y, x, batch_axis) for x in xs_l) for y in ys_l]
+    rows = [r[0] if isinstance(xs, Tensor) else r for r in rows]
+    return rows[0] if isinstance(ys, Tensor) else tuple(rows)
+
+
+def hessian(ys, xs, batch_axis=None):
+    """paddle.autograd.hessian — Hessian of a scalar `ys` w.r.t. `xs`
+    via `jax.hessian` over the functionalized tape."""
+    from .tensor import Tensor
+
+    if not isinstance(ys, Tensor) or ys.size != 1:
+        raise ValueError('hessian requires a scalar ys Tensor')
+    if batch_axis is not None:
+        raise NotImplementedError('hessian supports batch_axis=None only')
+    xs_l = [xs] if isinstance(xs, Tensor) else list(xs)
+    f, reachable = _build_pure([ys], xs_l)
+    for x in xs_l:
+        if id(x) not in reachable:
+            raise RuntimeError('xs is not reachable from ys on the tape')
+    scalar = lambda *vals: f(*vals)[0].reshape(())
+    hess = jax.hessian(scalar, argnums=tuple(range(len(xs_l))))(
+        *[x._data for x in xs_l])
+    out = tuple(
+        tuple(Tensor(hess[i][j].reshape(np_prod(xi.shape),
+                                        np_prod(xj.shape)))
+              for j, xj in enumerate(xs_l))
+        for i, xi in enumerate(xs_l))
+    if isinstance(xs, Tensor):
+        return out[0][0]
+    return out

@@ -1,0 +1,122 @@
+"""paddle.geometric — graph message-passing ops (upstream:
+python/paddle/geometric/: math.py segment ops, message_passing/send_recv.py).
+
+TPU-native design: every op lowers to `jax.ops.segment_*` — XLA turns
+these into sorted-scatter reductions, which is exactly how the
+reference's CUDA segment kernels behave, minus the hand-written atomics.
+`out_size`/eager-max give the static segment count jit needs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops._helpers import defop
+from .tensor import to_jax
+
+__all__ = ['segment_sum', 'segment_mean', 'segment_min', 'segment_max',
+           'send_u_recv', 'send_ue_recv']
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids_val = to_jax(ids)
+    if isinstance(ids_val, jax.core.Tracer):
+        raise ValueError(
+            'segment ops need a static segment count under jit: pass '
+            'out_size=<num_segments> when calling from traced code')
+    return int(jax.device_get(jnp.max(ids_val))) + 1
+
+
+def _segment(op_name):
+    jfn = getattr(jax.ops, f'segment_{op_name}')
+
+    def f(data, segment_ids, out_size=None, name=None):
+        # out_size is a jit escape hatch (an extension over upstream's
+        # signature): segment_ids is a tracer under jit, so the eager
+        # max cannot run — pass the static segment count instead
+        n = _num_segments(segment_ids, out_size)
+
+        def g(d, ids):
+            return jfn(d, ids, num_segments=n)
+        return defop(g, name=f'segment_{op_name}')(data, segment_ids)
+    f.__name__ = f'segment_{op_name}'
+    return f
+
+
+segment_sum = _segment('sum')
+segment_min = _segment('min')
+segment_max = _segment('max')
+
+
+def segment_mean(data, segment_ids, out_size=None, name=None):
+    n = _num_segments(segment_ids, out_size)
+
+    def g(d, ids):
+        tot = jax.ops.segment_sum(d, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape[0], d.dtype), ids,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return tot / jnp.maximum(cnt.reshape(shape), 1)
+    return defop(g, name='segment_mean')(data, segment_ids)
+
+
+_REDUCERS = {'sum': 'sum', 'mean': 'mean', 'min': 'min', 'max': 'max'}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op='sum', out_size=None,
+                name=None):
+    """Gather `x` rows at src_index, reduce them into dst_index buckets
+    (upstream: paddle.geometric.send_u_recv)."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f'unsupported reduce_op {reduce_op!r}')
+    n = out_size if out_size is not None \
+        else _num_segments(dst_index, None)
+    n = max(int(n), int(to_jax(x).shape[0]) if out_size is None else int(n))
+
+    def g(xv, src, dst):
+        msgs = xv[src]
+        if reduce_op == 'mean':
+            tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones(dst.shape[0], xv.dtype), dst,
+                                      num_segments=n)
+            return tot / jnp.maximum(
+                cnt.reshape((n,) + (1,) * (msgs.ndim - 1)), 1)
+        out = getattr(jax.ops, f'segment_{reduce_op}')(
+            msgs, dst, num_segments=n)
+        if reduce_op in ('min', 'max'):
+            # empty buckets come back +/-inf; upstream zeroes them
+            out = jnp.where(jnp.isinf(out), jnp.zeros_like(out), out)
+        return out
+    return defop(g, name='send_u_recv')(x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op='add',
+                 reduce_op='sum', out_size=None, name=None):
+    """Message = x[src] (op) y[edge]; then reduce into dst buckets
+    (upstream: paddle.geometric.send_ue_recv)."""
+    ops_ = {'add': jnp.add, 'sub': jnp.subtract, 'mul': jnp.multiply,
+            'div': jnp.divide}
+    if message_op not in ops_:
+        raise ValueError(f'unsupported message_op {message_op!r}')
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f'unsupported reduce_op {reduce_op!r}')
+    n = out_size if out_size is not None \
+        else _num_segments(dst_index, None)
+    n = max(int(n), int(to_jax(x).shape[0]) if out_size is None else int(n))
+
+    def g(xv, yv, src, dst):
+        msgs = ops_[message_op](xv[src], yv)
+        if reduce_op == 'mean':
+            tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones(dst.shape[0], tot.dtype), dst,
+                                      num_segments=n)
+            return tot / jnp.maximum(
+                cnt.reshape((n,) + (1,) * (msgs.ndim - 1)), 1)
+        out = getattr(jax.ops, f'segment_{reduce_op}')(
+            msgs, dst, num_segments=n)
+        if reduce_op in ('min', 'max'):
+            out = jnp.where(jnp.isinf(out), jnp.zeros_like(out), out)
+        return out
+    return defop(g, name='send_ue_recv')(x, y, src_index, dst_index)

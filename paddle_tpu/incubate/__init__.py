@@ -1,0 +1,22 @@
+"""paddle.incubate — experimental-API surface (upstream: python/paddle/incubate/).
+
+On TPU the "fused" incubate ops are the natural form: XLA fuses the
+norm/matmul/activation chains these APIs name, and the attention core
+rides the same flash path as F.scaled_dot_product_attention. The module
+exists for import-path parity; the implementations delegate to the
+already-fused compute paths.
+"""
+from . import nn
+from ..geometric import segment_sum, segment_mean, segment_min, segment_max
+
+__all__ = ['nn', 'segment_sum', 'segment_mean', 'segment_min', 'segment_max',
+           'graph_send_recv']
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type='sum', out_size=None,
+                    name=None):
+    """Pre-2.4 name of geometric.send_u_recv (upstream:
+    python/paddle/incubate/operators/graph_send_recv.py)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)

@@ -122,3 +122,44 @@ def accuracy(input, label, k=1):
     """Functional top-k accuracy for a single batch."""
     m = Accuracy(topk=(k,))
     return float(np.asarray(m.update(m.compute(input, label))))
+
+
+class Auc(Metric):
+    """Streaming ROC-AUC via thresholded TP/FP histograms (upstream:
+    paddle.metric.Auc, python/paddle/metric/metrics.py — same
+    num_thresholds binning scheme)."""
+
+    def __init__(self, curve='ROC', num_thresholds=4095, name=None):
+        if curve != 'ROC':
+            raise NotImplementedError('only ROC curve is supported')
+        super().__init__(name or 'auc')
+        self.num_thresholds = int(num_thresholds)
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def update(self, preds, labels):
+        """preds: [N, 2] class probabilities (or [N] prob-of-positive);
+        labels: [N] or [N, 1] in {0, 1}."""
+        p = _np(preds)
+        if p.ndim == 2:
+            p = p[:, -1]
+        p = p.reshape(-1)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        bins = np.minimum((p * self.num_thresholds).astype(np.int64),
+                          self.num_thresholds)
+        np.add.at(self._stat_pos, bins[l == 1], 1)
+        np.add.at(self._stat_neg, bins[l == 0], 1)
+
+    def accumulate(self):
+        # sweep thresholds high->low accumulating TP/FP; trapezoid area
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tpr = np.concatenate([[0.0], tp / tot_pos])
+        fpr = np.concatenate([[0.0], fp / tot_neg])
+        return float(np.trapezoid(tpr, fpr))

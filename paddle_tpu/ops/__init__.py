@@ -29,11 +29,14 @@ _METHOD_NAMES = [
     'less_equal', 'equal_all', 'allclose', 'isclose', 'logical_and',
     'logical_or', 'logical_xor', 'logical_not', 'bitwise_and', 'bitwise_or',
     'bitwise_xor', 'bitwise_not', 'isnan', 'isinf', 'isfinite', 'deg2rad',
-    'rad2deg', 'conj', 'real', 'imag', 'angle',
+    'rad2deg', 'conj', 'real', 'imag', 'angle', 'sgn', 'trapezoid',
+    'cumulative_trapezoid', 'logcumsumexp', 'is_complex',
+    'is_floating_point', 'is_integer',
     # reduction
     'sum', 'mean', 'prod', 'max', 'min', 'amax', 'amin', 'all', 'any',
     'std', 'var', 'median', 'quantile', 'logsumexp', 'cumsum', 'cumprod',
-    'cummax', 'cummin', 'count_nonzero', 'nansum', 'nanmean',
+    'cummax', 'cummin', 'count_nonzero', 'nansum', 'nanmean', 'nanmedian',
+    'nanquantile',
     # manipulation
     'reshape', 'reshape_', 'flatten', 'squeeze', 'unsqueeze', 'transpose',
     't', 'moveaxis', 'swapaxes', 'split', 'chunk', 'unbind', 'tile', 'expand',
@@ -41,7 +44,7 @@ _METHOD_NAMES = [
     'gather_nd', 'scatter', 'scatter_', 'scatter_nd_add', 'index_select',
     'index_sample', 'index_add', 'index_put', 'take_along_axis',
     'put_along_axis', 'repeat_interleave', 'pad', 'diagonal', 'kron', 'diff',
-    'as_complex', 'as_real', 'slice', 'strided_slice',
+    'as_complex', 'as_real', 'slice', 'strided_slice', 'unfold',
     # linalg
     'matmul', 'mm', 'bmm', 'dot', 'mv', 'norm', 'dist', 'cross', 'histogram',
     'matrix_power', 'cholesky', 'inv',

@@ -391,6 +391,50 @@ def vstack(x, name=None):
     return defop(lambda vs: jnp.vstack(vs), name='vstack')(builtins.list(x))
 
 
+def row_stack(x, name=None):
+    return vstack(x, name=name)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows of length `size` with stride `step` along `axis`
+    (paddle.unfold / Tensor.unfold, torch.Tensor.unfold semantics: the
+    window dim is appended last)."""
+    def f(v):
+        ax = int(axis) % v.ndim
+        n = v.shape[ax]
+        num = (n - size) // step + 1
+        starts = jnp.arange(num) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]  # [num, size]
+        out = jnp.take(v, idx.reshape(-1), axis=ax)
+        out = out.reshape(v.shape[:ax] + (num, size) + v.shape[ax + 1:])
+        # window dim goes last
+        return jnp.moveaxis(out, ax + 1, -1)
+    return defop(f, name='unfold')(x)
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors -> [prod(len_i), len(x)]."""
+    def f(vs):
+        grids = jnp.meshgrid(*vs, indexing='ij')
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return defop(f, name='cartesian_prod')(builtins.list(x))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """r-length combinations of a 1-D tensor's elements (paddle.combinations).
+    Index sets are computed statically on host; the gather is traced."""
+    import itertools
+    import numpy as np
+
+    def f(v):
+        n = v.shape[0]
+        it = itertools.combinations_with_replacement(range(n), int(r)) \
+            if with_replacement else itertools.combinations(range(n), int(r))
+        idx = np.array(builtins.list(it), dtype=np.int32).reshape(-1, int(r))
+        return v[jnp.asarray(idx)]
+    return defop(f, name='combinations')(x)
+
+
 def dstack(x, name=None):
     return defop(lambda vs: jnp.dstack(vs), name='dstack')(builtins.list(x))
 
@@ -423,7 +467,10 @@ def tensor_split(x, num_or_indices, axis=0, name=None):
 
 
 def hsplit(x, num_or_indices, name=None):
-    return tensor_split(x, num_or_indices, axis=1, name=name)
+    # numpy semantics: 1-D inputs split along axis 0
+    from ..tensor import to_jax
+    ax = 0 if to_jax(x).ndim == 1 else 1
+    return tensor_split(x, num_or_indices, axis=ax, name=name)
 
 
 def vsplit(x, num_or_indices, name=None):
@@ -458,7 +505,10 @@ def take(x, index, mode='raise', name=None):
         idx = idx.astype(jnp.int32)
         if mode == 'wrap':
             idx = idx % n
-        else:
+        elif mode == 'clip':
+            # numpy clip semantics: pure clamp, negatives go to 0 (no wrap)
+            idx = jnp.clip(idx, 0, n - 1)
+        else:  # 'raise': python-style negative indexing, then clamp
             idx = jnp.where(idx < 0, idx + n, idx)
             idx = jnp.clip(idx, 0, n - 1)
         return flat[idx]

@@ -7,6 +7,8 @@ surrounding matmuls, so no hand-written fusion is needed.
 """
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 
@@ -256,3 +258,59 @@ def nextafter(x, y, name=None):
 
 def ldexp(x, y, name=None):
     return defop(lambda a, b: jnp.ldexp(a, b), name='ldexp')(x, y)
+
+
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| for complex (0 where x==0), jnp.sign
+    for real (paddle.sgn)."""
+    def f(v):
+        if jnp.iscomplexobj(v):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, jnp.zeros_like(v), v / jnp.where(
+                mag == 0, jnp.ones_like(mag), mag))
+        return jnp.sign(v)
+    return defop(f, name='sgn')(x)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral along `axis`
+    (paddle.cumulative_trapezoid; output has size-1-smaller axis)."""
+    def f(yv, *rest):
+        ax = int(axis) % yv.ndim
+        sl0 = [builtins.slice(None)] * yv.ndim
+        sl1 = [builtins.slice(None)] * yv.ndim
+        sl0[ax] = builtins.slice(None, -1)
+        sl1[ax] = builtins.slice(1, None)
+        avg = (yv[tuple(sl0)] + yv[tuple(sl1)]) * 0.5
+        if rest:
+            xv = rest[0]
+            step = xv[tuple(sl1)] - xv[tuple(sl0)] if xv.ndim == yv.ndim \
+                else jnp.expand_dims(
+                    jnp.diff(xv), tuple(i for i in range(yv.ndim) if i != ax))
+        else:
+            step = 1.0 if dx is None else dx
+        return jnp.cumsum(avg * step, axis=ax)
+    args = (y,) if x is None else (y, x)
+    return defop(f, name='cumulative_trapezoid')(*args)
+
+
+def complex(real, imag, name=None):
+    return defop(lambda r, i: jax.lax.complex(r, i), name='complex')(real, imag)
+
+
+def is_complex(x) -> builtins.bool:
+    import numpy as _np
+    from ..tensor import to_jax
+    return _np.issubdtype(to_jax(x).dtype, _np.complexfloating)
+
+
+def is_floating_point(x) -> builtins.bool:
+    import numpy as _np
+    from ..tensor import to_jax
+    return _np.issubdtype(to_jax(x).dtype, _np.floating)
+
+
+def is_integer(x) -> builtins.bool:
+    import numpy as _np
+    from ..tensor import to_jax
+    return _np.issubdtype(to_jax(x).dtype, _np.integer)

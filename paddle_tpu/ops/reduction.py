@@ -61,6 +61,17 @@ def quantile(x, q, axis=None, keepdim=False, name=None):
                                         keepdims=keepdim), name='quantile')(x)
 
 
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return defop(lambda v: jnp.nanmedian(v, axis=_ax(axis), keepdims=keepdim),
+                 name='nanmedian')(x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return defop(lambda v: jnp.nanquantile(v, jnp.asarray(q), axis=_ax(axis),
+                                           keepdims=keepdim),
+                 name='nanquantile')(x)
+
+
 def logsumexp(x, axis=None, keepdim=False, name=None):
     return defop(lambda v: jax.scipy.special.logsumexp(
         v, axis=_ax(axis), keepdims=keepdim), name='logsumexp')(x)

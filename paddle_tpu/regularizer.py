@@ -1,0 +1,9 @@
+"""paddle.regularizer (upstream: python/paddle/regularizer.py).
+
+L1Decay/L2Decay live in the optimizer package (they are applied as
+functional weight-decay terms inside the jitted update); this module is
+the upstream import-path surface.
+"""
+from .optimizer import L1Decay, L2Decay
+
+__all__ = ['L1Decay', 'L2Decay']

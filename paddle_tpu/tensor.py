@@ -126,7 +126,9 @@ class Tensor:
     def backward(self, grad_tensor=None, retain_graph=False):
         autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
 
-    def _accumulate_grad(self, g_val):
+    def _run_grad_hooks(self, g_val):
+        """Apply registered hooks to the FULL gradient of one backward walk
+        (never per-partial — a clipping hook must see the accumulated sum)."""
         hooks = getattr(self, '_grad_hooks', None)
         if hooks:
             g_t = Tensor(jnp.asarray(g_val, self.dtype))
@@ -135,6 +137,10 @@ class Tensor:
                 if res is not None:
                     g_t = res if isinstance(res, Tensor) else Tensor(res)
             g_val = g_t._data
+        return g_val
+
+    def _accumulate_grad(self, g_val):
+        g_val = self._run_grad_hooks(g_val)
         if self.grad is None:
             self.grad = Tensor(jnp.asarray(g_val, self.dtype))
         else:

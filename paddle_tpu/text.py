@@ -1,0 +1,155 @@
+"""paddle.text (upstream: python/paddle/text/) — ViterbiDecoder plus the
+dataset surface (offline build: synthetic deterministic stand-ins, same
+pattern as vision.datasets).
+
+TPU-native note: viterbi_decode is a `lax.scan` over the sequence — the
+per-step [B, T, T] max-reduction vectorizes on the VPU, and the argmax
+backtrace is a second scan, so the whole decode stays on device.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .io import Dataset
+from .nn.layer import Layer
+from .ops._helpers import defop
+
+__all__ = ['viterbi_decode', 'ViterbiDecoder', 'Imdb', 'UCIHousing',
+           'Conll05st']
+
+
+def viterbi_decode(potentials, transition, lengths, include_bos_eos_tag=True,
+                   name=None):
+    """Hard Viterbi decode (upstream: paddle.text.viterbi_decode).
+
+    potentials: [B, L, T] unary emissions; transition: [T, T] (row = from);
+    lengths: [B] int. With include_bos_eos_tag=True the last two tag rows
+    are treated as BOS/EOS like upstream. Returns (scores [B], paths
+    [B, L] int64, right-padded with 0 past each length).
+    """
+    def f(pot, trans, lens):
+        b, seq_len, n_tags = pot.shape
+        if include_bos_eos_tag:
+            bos, eos = n_tags - 2, n_tags - 1
+            start = pot[:, 0] + trans[bos][None, :]
+        else:
+            start = pot[:, 0]
+
+        def step(carry, xs):
+            alpha, t_idx = carry
+            emit = xs  # [B, T]
+            # [B, Tfrom, Tto]
+            scores = alpha[:, :, None] + trans[None, :, :] + emit[:, None, :]
+            best = jnp.max(scores, axis=1)
+            back = jnp.argmax(scores, axis=1)
+            # positions past a sequence's length keep their alpha frozen
+            active = (t_idx < lens)[:, None]
+            new_alpha = jnp.where(active, best, alpha)
+            back = jnp.where(active, back,
+                             jnp.broadcast_to(jnp.arange(n_tags)[None, :],
+                                              back.shape))
+            return (new_alpha, t_idx + 1), back
+
+        (alpha, _), backs = jax.lax.scan(step, (start, jnp.ones((), jnp.int32)),
+                                         jnp.swapaxes(pot[:, 1:], 0, 1))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1)
+
+        def back_step(tag, back_t):
+            prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # ys = [tag_{L-1}, ..., tag_1]; final carry = tag_0
+        tag0, path_rev = jax.lax.scan(back_step, last_tag, backs[::-1])
+        paths = jnp.concatenate(
+            [tag0[:, None], path_rev[::-1].T], axis=1)  # [B, L]
+        # mask past-length positions to 0 (upstream pads with 0)
+        pos = jnp.arange(seq_len)[None, :]
+        paths = jnp.where(pos < lens[:, None], paths, 0)
+        return scores, paths.astype(jnp.int64)
+    return defop(f, name='viterbi_decode')(potentials, transition, lengths)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# -- datasets (synthetic offline stand-ins) ---------------------------------
+
+class Imdb(Dataset):
+    """Binary sentiment surface: token-id sequences + 0/1 labels."""
+
+    def __init__(self, data_file=None, mode='train', cutoff=150, seed=None):
+        if data_file is not None:
+            raise RuntimeError('offline build: archives unavailable; '
+                               'the synthetic stand-in is used instead')
+        rng = np.random.RandomState(
+            (0 if mode == 'train' else 1) if seed is None else seed)
+        n, vocab, length = (256 if mode == 'train' else 64), 5000, 64
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        # class-dependent token distribution so models can fit
+        shift = self.labels[:, None] * (vocab // 2)
+        self.docs = ((rng.randint(0, vocab // 2, (n, length)) + shift)
+                     .astype(np.int64))
+        self.word_idx = {f'tok{i}': i for i in range(vocab)}
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """13-feature regression surface with a fixed linear ground truth."""
+
+    def __init__(self, data_file=None, mode='train'):
+        if data_file is not None:
+            raise RuntimeError('offline build: archives unavailable; '
+                               'the synthetic stand-in is used instead')
+        rng = np.random.RandomState(0 if mode == 'train' else 1)
+        n = 404 if mode == 'train' else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = np.linspace(-1, 1, 13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(np.float32)[:, None]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Conll05st(Dataset):
+    """SRL-shaped surface: (tokens, predicate-mark, labels) triples."""
+
+    N_TAGS = 9
+
+    def __init__(self, data_file=None, mode='train'):
+        if data_file is not None:
+            raise RuntimeError('offline build: archives unavailable; '
+                               'the synthetic stand-in is used instead')
+        rng = np.random.RandomState(0 if mode == 'train' else 1)
+        n, vocab, length = (128 if mode == 'train' else 32), 2000, 32
+        self.tokens = rng.randint(0, vocab, (n, length)).astype(np.int64)
+        self.marks = (rng.rand(n, length) < 0.1).astype(np.int64)
+        self.labels = ((self.tokens + self.marks * 3) % self.N_TAGS) \
+            .astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.tokens[i], self.marks[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.tokens)

@@ -261,3 +261,33 @@ def box_coder(prior_box, prior_box_var, target_box,
 
     return apply_op(f, prior_box, prior_box_var, target_box,
                     _name='box_coder')
+
+
+from ..nn.layer import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
+    """Layer form of deform_conv2d (upstream: paddle.vision.ops.DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + ks, attr=weight_attr)
+        self.bias = self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, dilation=self.dilation,
+            deformable_groups=self.deformable_groups, groups=self.groups,
+            mask=mask)
+
+
+__all__.append('DeformConv2D')

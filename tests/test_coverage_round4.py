@@ -1,0 +1,521 @@
+"""Round-4 wideners, part 3: paddle.geometric, paddle.incubate fused ops,
+paddle.audio, paddle.text (viterbi), autograd.jacobian/hessian, metric.Auc,
+regularizer, DeformConv2D layer, onnx gate, and the small-op sweep
+(nanmedian/nanquantile/sgn/unfold/cartesian_prod/combinations/
+cumulative_trapezoid/complex) — upstream paths cited per class.
+"""
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def t(a, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(a, dtype=dtype))
+
+
+class TestSmallOps:
+    """Upstream: python/paddle/tensor/{math,stat,manipulation}.py."""
+
+    def test_nanmedian_nanquantile(self):
+        x = np.array([[3.0, np.nan, 1.0], [2.0, 4.0, np.nan]], np.float32)
+        np.testing.assert_allclose(paddle.nanmedian(t(x)).numpy(),
+                                   np.nanmedian(x), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.nanquantile(t(x), 0.5, axis=1).numpy(),
+            np.nanquantile(x, 0.5, axis=1), rtol=1e-6)
+
+    def test_sgn_real_and_complex(self):
+        np.testing.assert_allclose(
+            paddle.sgn(t([-2.0, 0.0, 5.0])).numpy(), [-1.0, 0.0, 1.0])
+        c = paddle.complex(t(3.0), t(4.0))
+        out = paddle.sgn(c).numpy()
+        np.testing.assert_allclose(out, 0.6 + 0.8j, rtol=1e-6)
+        assert paddle.sgn(paddle.complex(t(0.0), t(0.0))).numpy() == 0
+
+    def test_complex_predicates(self):
+        c = paddle.complex(t(1.0), t(2.0))
+        assert paddle.is_complex(c) and not paddle.is_complex(t(1.0))
+        assert paddle.is_floating_point(t(1.0))
+        assert not paddle.is_floating_point(t([1], np.int32))
+        assert paddle.is_integer(t([1], np.int32))
+
+    def test_unfold_matches_stride_trick(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 12)
+        out = paddle.unfold(t(x), 1, 4, 3).numpy()
+        expect = np.stack([x[:, s:s + 4] for s in range(0, 9, 3)], axis=1)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_cartesian_prod_and_combinations(self):
+        a, b = np.array([1, 2]), np.array([3, 4, 5])
+        out = paddle.cartesian_prod([t(a, np.int64), t(b, np.int64)]).numpy()
+        expect = np.array(list(itertools.product(a, b)))
+        np.testing.assert_array_equal(out, expect)
+        x = np.array([0, 1, 2, 3], np.int64)
+        np.testing.assert_array_equal(
+            paddle.combinations(t(x, np.int64), 2).numpy(),
+            np.array(list(itertools.combinations(x, 2))))
+        np.testing.assert_array_equal(
+            paddle.combinations(t(x, np.int64), 2,
+                                with_replacement=True).numpy(),
+            np.array(list(itertools.combinations_with_replacement(x, 2))))
+
+    def test_cumulative_trapezoid(self):
+        y = np.random.RandomState(0).rand(3, 7).astype(np.float32)
+        import scipy.integrate as si
+        np.testing.assert_allclose(
+            paddle.cumulative_trapezoid(t(y), axis=1).numpy(),
+            si.cumulative_trapezoid(y, axis=1), rtol=1e-5)
+        x = np.sort(np.random.RandomState(1).rand(7)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.cumulative_trapezoid(t(y), t(x), axis=1).numpy(),
+            si.cumulative_trapezoid(y, x, axis=1), rtol=1e-4)
+
+    def test_row_stack_alias(self):
+        a = np.ones((2, 3), np.float32)
+        np.testing.assert_array_equal(
+            paddle.row_stack([t(a), t(a * 2)]).numpy(), np.vstack([a, a * 2]))
+
+
+class TestGeometric:
+    """Upstream: python/paddle/geometric/ (segment ops, send_recv)."""
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        data = rng.randn(8, 3).astype(np.float32)
+        ids = np.array([0, 0, 1, 2, 2, 2, 4, 4])
+        return data, ids
+
+    def test_segment_ops_match_numpy(self):
+        data, ids = self._data()
+        n = ids.max() + 1
+        for op, red in [('segment_sum', np.sum), ('segment_mean', np.mean),
+                        ('segment_max', np.max), ('segment_min', np.min)]:
+            out = getattr(paddle.geometric, op)(t(data),
+                                                t(ids, np.int32)).numpy()
+            for s in range(n):
+                rows = data[ids == s]
+                if len(rows):
+                    np.testing.assert_allclose(out[s], red(rows, axis=0),
+                                               rtol=1e-5, atol=1e-6,
+                                               err_msg=op)
+
+    def test_send_u_recv(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(5, 2).astype(np.float32)
+        src = np.array([0, 1, 2, 0, 3])
+        dst = np.array([1, 2, 1, 0, 0])
+        for red in ['sum', 'mean', 'max', 'min']:
+            out = paddle.geometric.send_u_recv(
+                t(x), t(src, np.int32), t(dst, np.int32), red).numpy()
+            for d in range(5):
+                msgs = x[src[dst == d]]
+                if len(msgs) == 0:
+                    np.testing.assert_allclose(out[d], 0.0)
+                else:
+                    red_f = {'sum': np.sum, 'mean': np.mean, 'max': np.max,
+                             'min': np.min}[red]
+                    np.testing.assert_allclose(out[d], red_f(msgs, axis=0),
+                                               rtol=1e-5, err_msg=red)
+
+    def test_send_ue_recv_and_incubate_alias(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 2).astype(np.float32)
+        e = rng.randn(3, 2).astype(np.float32)
+        src = np.array([0, 1, 2])
+        dst = np.array([1, 1, 0])
+        out = paddle.geometric.send_ue_recv(
+            t(x), t(e), t(src, np.int32), t(dst, np.int32),
+            'mul', 'sum').numpy()
+        expect = np.zeros((4, 2), np.float32)
+        for i in range(3):
+            expect[dst[i]] += x[src[i]] * e[i]
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+        # pre-2.4 alias
+        out2 = paddle.incubate.graph_send_recv(
+            t(x), t(src, np.int32), t(dst, np.int32), 'sum').numpy()
+        assert out2.shape == (4, 2)
+
+    def test_segment_sum_differentiable(self):
+        data, ids = self._data()
+        xt = t(data)
+        xt.stop_gradient = False
+        paddle.geometric.segment_sum(xt, t(ids, np.int32)).sum().backward()
+        np.testing.assert_allclose(xt.grad.numpy(), np.ones_like(data))
+
+
+class TestIncubateFused:
+    """Upstream: python/paddle/incubate/nn/functional/fused_transformer.py."""
+
+    def test_fused_linear(self):
+        rng = np.random.RandomState(0)
+        x, w, b = (rng.randn(2, 4).astype(np.float32),
+                   rng.randn(4, 5).astype(np.float32),
+                   rng.randn(5).astype(np.float32))
+        IF = paddle.incubate.nn.functional
+        np.testing.assert_allclose(IF.fused_linear(t(x), t(w), t(b)).numpy(),
+                                   x @ w + b, rtol=1e-5)
+        np.testing.assert_allclose(
+            IF.fused_matmul_bias(t(x), t(w.T), t(b),
+                                 transpose_y=True).numpy(),
+            x @ w + b, rtol=1e-5)
+
+    def test_swiglu(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 8).astype(np.float32)
+        IF = paddle.incubate.nn.functional
+        a, b = x[:, :4], x[:, 4:]
+        expect = a / (1 + np.exp(-a)) * b
+        np.testing.assert_allclose(IF.swiglu(t(x)).numpy(), expect, rtol=1e-5)
+        np.testing.assert_allclose(IF.swiglu(t(a), t(b)).numpy(), expect,
+                                   rtol=1e-5)
+
+    def test_fused_norms(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 8).astype(np.float32)
+        w = rng.rand(8).astype(np.float32) + 0.5
+        IF = paddle.incubate.nn.functional
+        np.testing.assert_allclose(
+            IF.fused_rms_norm(t(x), t(w)).numpy(),
+            F.rms_norm(t(x), t(w)).numpy())
+        np.testing.assert_allclose(
+            IF.fused_layer_norm(t(x), t(w)).numpy(),
+            F.layer_norm(t(x), 8, weight=t(w)).numpy())
+
+    def test_fused_dropout_add_eval(self):
+        x = t(np.ones((4, 4), np.float32))
+        y = t(np.full((4, 4), 2.0, np.float32))
+        out = paddle.incubate.nn.functional.fused_dropout_add(
+            x, y, p=0.9, training=False).numpy()
+        np.testing.assert_allclose(out, 3.0)
+
+    def test_fused_multi_head_attention_matches_manual(self):
+        rng = np.random.RandomState(3)
+        b, s, nh, hd = 2, 5, 2, 4
+        e = nh * hd
+        x = rng.randn(b, s, e).astype(np.float32)
+        qkv_w = rng.randn(3, nh, hd, e).astype(np.float32) * 0.2
+        qkv_b = rng.randn(3, nh, hd).astype(np.float32) * 0.1
+        lin_w = rng.randn(e, e).astype(np.float32) * 0.2
+        lin_b = rng.randn(e).astype(np.float32) * 0.1
+        ln_w = rng.rand(e).astype(np.float32) + 0.5
+        ln_b = rng.randn(e).astype(np.float32) * 0.1
+        out = paddle.incubate.nn.functional.fused_multi_head_attention(
+            t(x), t(qkv_w), t(lin_w), pre_layer_norm=True,
+            pre_ln_scale=t(ln_w), pre_ln_bias=t(ln_b), qkv_bias=t(qkv_b),
+            linear_bias=t(lin_b), dropout_rate=0.0, attn_dropout_rate=0.0,
+            training=False).numpy()
+        # manual reference
+        h = F.layer_norm(t(x), e, weight=t(ln_w), bias=t(ln_b)).numpy()
+        qkv = np.einsum('bse,tnhe->tbsnh', h, qkv_w) + \
+            qkv_b[:, None, None]
+        q, k, v = qkv
+        scores = np.einsum('bsnh,btnh->bnst', q, k) / np.sqrt(hd)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        attn = np.einsum('bnst,btnh->bsnh', p, v)
+        ref = attn.reshape(b, s, e) @ lin_w + lin_b + x
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_fused_feedforward_matches_manual(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 3, 6).astype(np.float32)
+        w1 = rng.randn(6, 12).astype(np.float32) * 0.3
+        w2 = rng.randn(12, 6).astype(np.float32) * 0.3
+        out = paddle.incubate.nn.functional.fused_feedforward(
+            t(x), t(w1), t(w2), dropout1_rate=0.0, dropout2_rate=0.0,
+            pre_layer_norm=True, training=False).numpy()
+        h = F.layer_norm(t(x), 6).numpy()
+        ref = np.maximum(h @ w1, 0) @ w2 + x
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_rope_rotates_queries(self):
+        rng = np.random.RandomState(5)
+        q = rng.randn(2, 6, 2, 8).astype(np.float32)
+        k = rng.randn(2, 6, 2, 8).astype(np.float32)
+        qo, ko, _ = paddle.incubate.nn.functional \
+            .fused_rotary_position_embedding(t(q), t(k))
+        assert qo.shape == list(q.shape) and ko.shape == list(k.shape)
+        # position 0 is identity (angle 0)
+        np.testing.assert_allclose(qo.numpy()[:, 0], q[:, 0], rtol=1e-5)
+        # norms are preserved by rotation
+        np.testing.assert_allclose(
+            np.linalg.norm(qo.numpy(), axis=-1),
+            np.linalg.norm(q, axis=-1), rtol=1e-4)
+
+
+class TestAudio:
+    """Upstream: python/paddle/audio/."""
+
+    def test_windows_match_scipy(self):
+        sps = pytest.importorskip('scipy.signal')
+        for name in ['hann', 'hamming', 'blackman', 'bartlett', 'triang',
+                     'cosine']:
+            ours = paddle.audio.functional.get_window(name, 32).numpy()
+            ref = sps.get_window(name, 32, fftbins=True)
+            np.testing.assert_allclose(ours, ref, atol=1e-10, err_msg=name)
+
+    def test_mel_scale_roundtrip(self):
+        AF = paddle.audio.functional
+        for htk in (False, True):
+            f = AF.mel_to_hz(AF.hz_to_mel(440.0, htk), htk)
+            np.testing.assert_allclose(f, 440.0, rtol=1e-9)
+
+    def test_fbank_matrix_properties(self):
+        fb = paddle.audio.functional.compute_fbank_matrix(
+            16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()  # every filter is non-empty
+
+    def test_feature_layers_shapes_and_grad(self):
+        wav = t(np.random.RandomState(0).randn(2, 4000))
+        spec = paddle.audio.features.Spectrogram(n_fft=256)(wav)
+        assert spec.shape[:2] == [2, 129]
+        mel = paddle.audio.features.MelSpectrogram(
+            sr=16000, n_fft=256, n_mels=32)(wav)
+        assert mel.shape[:2] == [2, 32]
+        logmel = paddle.audio.features.LogMelSpectrogram(
+            sr=16000, n_fft=256, n_mels=32)(wav)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = paddle.audio.features.MFCC(
+            sr=16000, n_fft=256, n_mels=32, n_mfcc=13)(wav)
+        assert mfcc.shape[:2] == [2, 13]
+
+    def test_wav_roundtrip(self, tmp_path):
+        sig = np.sin(np.arange(1600) / 20).astype(np.float32)[None]
+        p = str(tmp_path / 'x.wav')
+        paddle.audio.save(p, t(sig), 8000)
+        back, sr = paddle.audio.load(p)
+        assert sr == 8000
+        np.testing.assert_allclose(back.numpy(), sig, atol=1e-4)
+
+    def test_synthetic_datasets(self):
+        ds = paddle.audio.datasets.ESC50(mode='dev')
+        wav, label = ds[0]
+        assert wav.shape == (8000,) and 0 <= label < 50
+        ds2 = paddle.audio.datasets.TESS(mode='train', feat_type='mfcc',
+                                         sr=16000, n_fft=256, n_mels=32,
+                                         n_mfcc=13)
+        feat, _ = ds2[0]
+        assert feat.shape[0] == 13
+
+
+class TestText:
+    """Upstream: python/paddle/text/ (viterbi_decode + datasets)."""
+
+    def _brute_force(self, pot, trans, length, with_tags):
+        # the decode argmaxes over the FULL tag set (BOS/EOS ids included),
+        # matching upstream; only the start/end transition scores are special
+        n_tags = pot.shape[-1]
+        best, best_path = -np.inf, None
+        for path in itertools.product(range(n_tags), repeat=length):
+            s = pot[0, path[0]]
+            if with_tags:
+                s += trans[n_tags - 2, path[0]]
+            for i in range(1, length):
+                s += trans[path[i - 1], path[i]] + pot[i, path[i]]
+            if with_tags:
+                s += trans[path[-1], n_tags - 1]
+            if s > best:
+                best, best_path = s, path
+        return best, best_path
+
+    @pytest.mark.parametrize('with_tags', [True, False])
+    def test_viterbi_matches_brute_force(self, with_tags):
+        rng = np.random.RandomState(0)
+        pot = rng.randn(2, 4, 5).astype(np.float32)
+        trans = rng.randn(5, 5).astype(np.float32)
+        lens = np.array([4, 3])
+        scores, paths = paddle.text.viterbi_decode(
+            t(pot), t(trans), t(lens, np.int64),
+            include_bos_eos_tag=with_tags)
+        for b in range(2):
+            s_ref, p_ref = self._brute_force(pot[b], trans, lens[b],
+                                             with_tags)
+            np.testing.assert_allclose(scores.numpy()[b], s_ref, rtol=1e-5)
+            np.testing.assert_array_equal(paths.numpy()[b, :lens[b]],
+                                          np.array(p_ref))
+            # positions past length are padded with 0
+            assert (paths.numpy()[b, lens[b]:] == 0).all()
+
+    def test_viterbi_decoder_layer(self):
+        rng = np.random.RandomState(1)
+        trans = t(rng.randn(4, 4).astype(np.float32))
+        dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pot = t(rng.randn(1, 3, 4).astype(np.float32))
+        scores, paths = dec(pot, t(np.array([3]), np.int64))
+        assert scores.shape == [1] and paths.shape == [1, 3]
+
+    def test_text_datasets(self):
+        imdb = paddle.text.Imdb(mode='train')
+        doc, label = imdb[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        housing = paddle.text.UCIHousing(mode='test')
+        x, y = housing[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        srl = paddle.text.Conll05st()
+        toks, marks, labels = srl[0]
+        assert toks.shape == marks.shape == labels.shape
+
+
+class TestAutodiff:
+    """Upstream: python/paddle/autograd/autodiff.py."""
+
+    def test_jacobian_dense(self):
+        x = t([1.0, 2.0, 3.0])
+        x.stop_gradient = False
+        A = np.array([[1.0, 2.0, 0.0], [0.0, 1.0, -1.0]], np.float32)
+        y = paddle.matmul(t(A), x)
+        J = paddle.autograd.jacobian(y, x)
+        np.testing.assert_allclose(J.numpy(), A, rtol=1e-6)
+
+    def test_jacobian_batch_axis(self):
+        x = t(np.random.RandomState(0).randn(4, 3))
+        x.stop_gradient = False
+        y = x * x  # elementwise => per-sample diag of 2x
+        J = paddle.autograd.jacobian(y, x, batch_axis=0)
+        assert J.shape == [4, 3, 3]
+        for b in range(4):
+            np.testing.assert_allclose(J.numpy()[b],
+                                       np.diag(2 * x.numpy()[b]), rtol=1e-5)
+
+    def test_hessian(self):
+        x = t([1.0, 2.0])
+        x.stop_gradient = False
+        # f = x0^2 * x1 => H = [[2*x1, 2*x0], [2*x0, 0]]
+        y = x[0] * x[0] * x[1]
+        H = paddle.autograd.hessian(y, x)
+        np.testing.assert_allclose(H.numpy(), [[4.0, 2.0], [2.0, 0.0]],
+                                   atol=1e-5)
+
+    def test_jacobian_unused_input_raises(self):
+        x = t([1.0])
+        x.stop_gradient = False
+        z = t([2.0])
+        z.stop_gradient = False
+        y = x * 2.0
+        with pytest.raises(RuntimeError):
+            paddle.autograd.jacobian(y, z)
+
+
+class TestMetricAuc:
+    """Upstream: python/paddle/metric/metrics.py::Auc."""
+
+    def test_auc_matches_exact(self):
+        rng = np.random.RandomState(0)
+        scores = rng.rand(500)
+        labels = (rng.rand(500) < scores).astype(np.int64)  # informative
+        m = paddle.metric.Auc(num_thresholds=4095)
+        preds = np.stack([1 - scores, scores], axis=1)
+        # feed in two chunks to exercise streaming
+        m.update(preds[:250], labels[:250])
+        m.update(preds[250:], labels[250:])
+        # exact AUC by rank statistic
+        pos, neg = scores[labels == 1], scores[labels == 0]
+        exact = np.mean([(p > neg).mean() + 0.5 * (p == neg).mean()
+                         for p in pos])
+        assert abs(m.accumulate() - exact) < 5e-3
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_auc_perfect_separation(self):
+        m = paddle.metric.Auc()
+        m.update(np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]]),
+                 np.array([0, 0, 1, 1]))
+        assert m.accumulate() == pytest.approx(1.0)
+
+
+class TestMisc:
+    def test_regularizer_module(self):
+        r = paddle.regularizer.L2Decay(1e-4)
+        assert paddle.regularizer.L1Decay is paddle.optimizer.L1Decay
+        assert r is not None
+
+    def test_deform_conv2d_layer_zero_offset_matches_conv(self):
+        rng = np.random.RandomState(0)
+        layer = paddle.vision.ops.DeformConv2D(3, 5, 3)
+        x = t(rng.randn(2, 3, 8, 8))
+        off = paddle.zeros([2, 18, 6, 6])
+        out = layer(x, off)
+        ref = F.conv2d(x, layer.weight, layer.bias)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_onnx_export_gate(self):
+        with pytest.raises(RuntimeError, match='jit.save'):
+            paddle.onnx.export(None, 'model')
+
+    def test_grad_hook_sees_accumulated_gradient(self):
+        # a clipping hook must see the SUM of partials, not each partial
+        w = t([1.0])
+        w.stop_gradient = False
+        w.register_hook(lambda g: g.clip(max=4.0))
+        y = (w * 2.0).sum() + (w * 3.0).sum()
+        y.backward()
+        np.testing.assert_allclose(w.grad.numpy(), [4.0])
+
+    def test_pylayer_ctx_attrs_survive_replay(self):
+        class Scale(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x, k):
+                ctx.k = k
+                return x * k
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * ctx.k
+
+        x = t([2.0])
+        x.stop_gradient = False
+        # create_graph replays through the custom primal; ctx.k must survive
+        g = paddle.grad(Scale.apply(x, 3.0).sum(), [x], create_graph=True)
+        np.testing.assert_allclose(g[0].numpy(), [3.0])
+
+    def test_take_clip_mode_clamps_negatives(self):
+        idx = t([-1, 7], np.int64)
+        np.testing.assert_array_equal(
+            paddle.take(paddle.arange(6), idx, mode='clip').numpy(), [0, 5])
+        np.testing.assert_array_equal(
+            paddle.take(paddle.arange(6), idx, mode='wrap').numpy(), [5, 1])
+
+    def test_hsplit_1d(self):
+        parts = paddle.hsplit(paddle.arange(6), 3)
+        assert [p.numpy().tolist() for p in parts] == [[0, 1], [2, 3], [4, 5]]
+
+    def test_segment_sum_under_jit_with_out_size(self):
+        from paddle_tpu import jit as pjit
+        sf = pjit.to_static(
+            lambda d, ids: paddle.geometric.segment_sum(d, ids, out_size=4))
+        out = sf(t(np.ones((6, 2))), t([0, 0, 1, 2, 3, 3], np.int32))
+        np.testing.assert_allclose(out.numpy()[:, 0], [2.0, 1.0, 1.0, 2.0])
+
+    def test_imdb_seed_honored(self):
+        a = paddle.text.Imdb(mode='train', seed=123)
+        b = paddle.text.Imdb(mode='train')
+        assert not np.array_equal(a.docs, b.docs)
+
+    def test_new_dotted_names_resolve(self):
+        names = [
+            'audio.features.MelSpectrogram', 'audio.functional.get_window',
+            'audio.load', 'audio.save', 'text.viterbi_decode',
+            'text.ViterbiDecoder', 'geometric.segment_sum',
+            'geometric.send_u_recv', 'geometric.send_ue_recv',
+            'incubate.nn.functional.fused_multi_head_attention',
+            'incubate.nn.functional.fused_feedforward',
+            'incubate.nn.functional.swiglu', 'regularizer.L1Decay',
+            'regularizer.L2Decay', 'autograd.jacobian', 'autograd.hessian',
+            'metric.Auc', 'vision.ops.DeformConv2D', 'onnx.export',
+            'nanmedian', 'nanquantile', 'sgn', 'unfold', 'cartesian_prod',
+            'combinations', 'cumulative_trapezoid', 'complex', 'is_complex',
+            'is_floating_point', 'row_stack',
+        ]
+        for n in names:
+            obj = paddle
+            for part in n.split('.'):
+                obj = getattr(obj, part)
+            assert obj is not None, n
