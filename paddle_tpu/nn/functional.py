@@ -602,6 +602,9 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format='NCHW', name=None):
+    if return_mask:
+        return max_pool2d_with_index(x, kernel_size, stride, padding,
+                                     ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
                     -jnp.inf, ceil_mode, name='max_pool2d')
 
@@ -1245,3 +1248,336 @@ def gather_tree(ids, parents, name=None):
         _, toks = jax.lax.scan(body, init, (idv[::-1], par[::-1]))
         return toks[::-1]
     return defop(f, name='gather_tree')(ids, parents)
+
+
+# ---------------------------------------------------------------------------
+# round-4 wideners (upstream: python/paddle/nn/functional/{activation,common,
+# loss,pooling,distance}.py)
+# ---------------------------------------------------------------------------
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return defop(lambda v: jnp.where(v > threshold, v, value),
+                 name='thresholded_relu')(x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    """Randomized leaky relu: random negative slope in [lower, upper] during
+    training, the mean slope at eval (upstream F.rrelu)."""
+    if not training:
+        mid = (lower + upper) / 2.0
+        return defop(lambda v: jnp.where(v >= 0, v, v * mid), name='rrelu')(x)
+    key = framework.next_rng_key()
+
+    def f(v):
+        a = jax.random.uniform(key, v.shape, jnp.float32, lower, upper)
+        return jnp.where(v >= 0, v, v * a.astype(v.dtype))
+    return defop(f, name='rrelu')(x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    """Max over `groups` consecutive channels (upstream F.maxout)."""
+    def f(v):
+        ax = int(axis) % v.ndim
+        c = v.shape[ax]
+        shape = (v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:])
+        return jnp.max(v.reshape(shape), axis=ax + 1)
+    return defop(f, name='maxout')(x)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (upstream F.alpha_dropout): dropped units are
+    set to alpha', then the output is affinely rescaled to keep mean/var."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(to_jax(x))
+    if p == 1.0:
+        return defop(lambda v: jnp.zeros_like(v), name='alpha_dropout')(x)
+    key = framework.next_rng_key()
+    alpha = 1.6732632423543772 * 1.0507009873554805  # selu alpha * scale
+
+    def f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = jnp.asarray(-alpha, v.dtype)
+        scale = (1.0 - p + p * alpha ** 2 * (1.0 - p)) ** -0.5
+        bias = -scale * p * (-alpha)
+        out = jnp.where(keep, v, a)
+        return out * scale + bias
+    return defop(f, name='alpha_dropout')(x)
+
+
+def channel_shuffle(x, groups, data_format='NCHW', name=None):
+    def f(v):
+        if data_format == 'NCHW':
+            n, c, h, w = v.shape
+            return v.reshape(n, groups, c // groups, h, w) \
+                .swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, groups, c // groups) \
+            .swapaxes(3, 4).reshape(n, h, w, c)
+    return defop(f, name='channel_shuffle')(x)
+
+
+def zeropad2d(x, padding, data_format='NCHW', name=None):
+    p = _tuplize(padding, 4)  # [left, right, top, bottom]
+
+    def f(v):
+        if data_format == 'NCHW':
+            cfg = [(0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])]
+        else:
+            cfg = [(0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+        return jnp.pad(v, cfg)
+    return defop(f, name='zeropad2d')(x)
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False, name=None):
+    """(out, flat-indices-into-H*W) pair — the mask max_unpool2d consumes
+    (upstream returns this from max_pool2d(return_mask=True))."""
+    k = _tuplize(kernel_size, 2)
+    s = _tuplize(stride if stride is not None else kernel_size, 2)
+    p = _conv_padding(padding, 2, s, (1, 1), k)
+
+    def f(v):
+        n, c, h, w = v.shape
+        vp = jnp.pad(v, [(0, 0), (0, 0), p[0], p[1]],
+                     constant_values=-jnp.inf)
+        hp, wp = vp.shape[-2:]
+        ho = (hp - k[0]) // s[0] + 1
+        wo = (wp - k[1]) // s[1] + 1
+        # window gather: [N, C, Ho, Wo, kh*kw]
+        oy = (jnp.arange(ho) * s[0])[:, None, None, None]
+        ox = (jnp.arange(wo) * s[1])[None, :, None, None]
+        dy = jnp.arange(k[0])[None, None, :, None]
+        dx = jnp.arange(k[1])[None, None, None, :]
+        yy, xx = oy + dy, ox + dx  # [Ho, Wo, kh, kw]
+        patches = vp[:, :, yy, xx].reshape(n, c, ho, wo, -1)
+        out = jnp.max(patches, axis=-1)
+        arg = jnp.argmax(patches, axis=-1)  # in-window index
+        # back to unpadded flat H*W coordinates
+        win_y = yy.reshape(ho, wo, -1) - p[0][0]
+        win_x = xx.reshape(ho, wo, -1) - p[1][0]
+        flat = win_y * w + win_x  # [Ho, Wo, kh*kw]
+        idx = jnp.take_along_axis(
+            jnp.broadcast_to(flat, (n, c) + flat.shape),
+            arg[..., None], axis=-1)[..., 0]
+        return out, idx.astype(jnp.int32)
+    return defop(f, name='max_pool2d_with_index')(x)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format='NCHW', name=None):
+    """Scatter pooled values back to their argmax positions (upstream
+    F.max_unpool2d; `indices` are flat H*W positions of the input that
+    was pooled)."""
+    if data_format != 'NCHW':
+        raise NotImplementedError('max_unpool2d supports NCHW')
+    k = _tuplize(kernel_size, 2)
+    s = _tuplize(stride if stride is not None else kernel_size, 2)
+    p = _tuplize(padding, 2)
+
+    def f(v, idx):
+        n, c, ho, wo = v.shape
+        if output_size is not None:
+            out_h, out_w = [int(o) for o in output_size[-2:]]
+        else:
+            out_h = (ho - 1) * s[0] - 2 * p[0] + k[0]
+            out_w = (wo - 1) * s[1] - 2 * p[1] + k[1]
+        flat = jnp.zeros((n, c, out_h * out_w), v.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1)].set(v.reshape(n, c, -1))
+        return flat.reshape(n, c, out_h, out_w)
+    return defop(f, name='max_unpool2d')(x, indices)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return defop(f, name='pairwise_distance')(x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of rows -> [N*(N-1)/2] (upstream
+    paddle.pdist / F.pdist)."""
+    def f(v):
+        n = v.shape[0]
+        iu, ju = jnp.triu_indices(n, k=1)
+        diff = v[iu] - v[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 0.0))
+        if p == float('inf'):
+            return jnp.max(jnp.abs(diff), -1)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), -1), 1.0 / p)
+    return defop(f, name='pdist')(x)
+
+
+# -- losses ------------------------------------------------------------------
+
+def soft_margin_loss(input, label, reduction='mean', name=None):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return defop(f, name='soft_margin_loss')(input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction='mean',
+                                 name=None):
+    def f(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return defop(f, name='multi_label_soft_margin_loss')(*args)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction='mean',
+                        name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.linalg.norm(u - v + epsilon, ord=p, axis=-1)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(d_pos - d_neg + margin, 0.0), reduction)
+    return defop(f, name='triplet_margin_loss')(input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction='mean',
+                                      name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_swap = distance_function(positive, negative)
+        d_neg = minimum_t(d_neg, d_swap)
+    return defop(lambda dp, dn: _reduce(jnp.maximum(dp - dn + margin, 0.0),
+                                        reduction),
+                 name='triplet_margin_with_distance_loss')(d_pos, d_neg)
+
+
+def minimum_t(a, b):
+    return defop(lambda x, y: jnp.minimum(x, y), name='minimum')(a, b)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction='mean', name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * _math.log(2 * _math.pi)
+        return _reduce(loss, reduction)
+    return defop(f, name='gaussian_nll_loss')(input, label, variance)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction='mean', name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for y! when y > 1
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * _math.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return defop(f, name='poisson_nll_loss')(input, label)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - dice coefficient over one-hot labels (upstream F.dice_loss:
+    input [N, ..., C] probabilities, label [N, ..., 1] int)."""
+    def f(x, y):
+        num_classes = x.shape[-1]
+        oh = jax.nn.one_hot(y[..., 0], num_classes, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * oh, axis=red)
+        denom = jnp.sum(x, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1.0 - 2.0 * inter / (denom + epsilon))
+    return defop(f, name='dice_loss')(input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (upstream F.npair_loss): softmax CE over the
+    anchor-positive similarity matrix + L2 on the embeddings."""
+    def f(a, pos, y):
+        reg = jnp.mean(jnp.sum(a * a, -1)) + jnp.mean(jnp.sum(pos * pos, -1))
+        reg = reg * 0.25 * l2_reg * a.shape[0]
+        sim = a @ pos.T  # [N, N]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        ce = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        return ce + reg
+    return defop(f, name='npair_loss')(anchor, positive, labels)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction='mean', norm_by_times=False, name=None):
+    """CTC loss (upstream F.ctc_loss / warpctc).
+
+    log_probs: [T, B, C] logits (softmax applied internally, matching
+    warpctc); labels: [B, L] padded with anything past label_lengths.
+    TPU-native: the alpha recursion over 2L+1 states is a `lax.scan` in
+    log space — each step is a vectorized [B, S] update, no per-sample
+    host loop.
+    """
+    def f(logits, lab, in_len, lab_len):
+        T, B, C = logits.shape
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        L = lab.shape[1]
+        S = 2 * L + 1
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        # allowed skip: ext[s] != ext[s-2] (and s odd — label positions)
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+        pos = jnp.arange(S)[None, :]
+        valid_state = pos < (2 * lab_len[:, None] + 1)
+
+        emit0 = jnp.take_along_axis(lp[0], ext, axis=1)  # [B, S]
+        alpha0 = jnp.where(pos < 2, emit0, neg_inf)
+        alpha0 = jnp.where(valid_state, alpha0, neg_inf)
+
+        def step(alpha, t):
+            prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(skip_ok, prev2, neg_inf)
+            tot = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            emit = jnp.take_along_axis(lp[t], ext, axis=1)
+            new = tot + emit
+            new = jnp.where(valid_state, new, neg_inf)
+            # frames past a sample's input length leave alpha frozen
+            active = (t < in_len)[:, None]
+            return jnp.where(active, new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        # final: logaddexp of the last two valid states
+        last = 2 * lab_len[:, None]  # blank after final label
+        a_last = jnp.take_along_axis(alpha, last, axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0), axis=1)[:, 0]
+        a_prev = jnp.where(lab_len > 0, a_prev, neg_inf)
+        nll = -jnp.logaddexp(a_last, a_prev)
+        if norm_by_times:
+            nll = nll / in_len.astype(nll.dtype)
+        if reduction == 'mean':
+            # upstream mean: per-sample loss / label_length, then batch mean
+            return jnp.mean(nll / jnp.maximum(lab_len, 1).astype(nll.dtype))
+        return _reduce(nll, reduction)
+    return defop(f, name='ctc_loss')(log_probs, labels, input_lengths,
+                                     label_lengths)
