@@ -396,6 +396,11 @@ class PyLayer:
 
         y = Cube.apply(x)
 
+    `forward` must be deterministic in its inputs: under jit / higher-order
+    grad the framework replays it (like jax.checkpoint) to rebuild `ctx`,
+    so a forward that draws fresh RNG or reads mutable globals would hand
+    `backward` a different ctx than the original call produced.
+
     TPU-native mechanics: `forward` runs eagerly with the tape OFF (its
     internal ops are not differentiated — `backward` IS the gradient),
     then one custom Node is recorded whose vjp calls `backward` and
@@ -479,17 +484,28 @@ class PyLayer:
                 return out_v, vals
 
             def primal_bwd(saved_vals, cot):
+                # Re-running forward here requires it to be deterministic
+                # w.r.t. its inputs: a forward that draws fresh RNG keys or
+                # reads mutable external state rebuilds a DIFFERENT ctx than
+                # the original backward saw. (Same contract as
+                # jax.checkpoint / upstream recompute.)
                 _, c = _run_fwd(saved_vals)
                 cots = cot if isinstance(cot, (tuple, list)) else (cot,)
                 with no_grad():
                     gin = cls.backward(
                         c, *[Tensor(jnp.asarray(v)) for v in cots])
                 gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                if len(gin) != len(saved_vals):
+                    raise RuntimeError(
+                        f'{cls.__name__}.backward returned {len(gin)} '
+                        f'grads for {len(saved_vals)} Tensor inputs')
+                # None-grad zeros come from saved_vals (the possibly
+                # vmapped/batched operands), not the captured eager leaves,
+                # so cotangent shapes track the traced call.
                 return tuple(
-                    jnp.zeros_like(vals) if g is None else
+                    jnp.zeros_like(sv) if g is None else
                     (g._data if isinstance(g, Tensor) else jnp.asarray(g))
-                    for g, vals in zip(
-                        gin, [leaves[i]._data for i in t_idx]))
+                    for g, sv in zip(gin, saved_vals))
 
             primal.defvjp(primal_fwd, primal_bwd)
 

@@ -578,13 +578,29 @@ def _pool_nd(x, kernel, stride, padding, n, reducer, init, ceil_mode=False,
         pad = _conv_padding(padding, n, s_t, (1,) * n, k_t)
         if isinstance(pad, str):
             raise ValueError('str padding unsupported in pool')
+        pad = list(pad)
+        # ceil_mode: allow a final partial window, realized as extra
+        # high-side padding — but only if that window starts inside the
+        # input-or-left-padding extent (torch/paddle rule)
+        extra = _ceil_mode_extra(v.shape[2:], k_t, s_t, pad) if ceil_mode \
+            else (0,) * n
         window = (1, 1) + k_t
         strides = (1, 1) + s_t
-        pads = [(0, 0), (0, 0)] + list(pad)
+        pads = [(0, 0), (0, 0)] + [(lo, hi + e)
+                                   for (lo, hi), e in zip(pad, extra)]
         out = jax.lax.reduce_window(v, init, reducer, window, strides, pads)
         if average:
-            if count_include_pad and any(p != (0, 0) for p in pad):
+            if count_include_pad and not any(extra):
                 out = out / float(np.prod(k_t))
+            elif count_include_pad:
+                # regular padding counts toward the divisor; the ceil-mode
+                # extra cells never do
+                ones = jnp.pad(jnp.ones(v.shape, v.dtype),
+                               [(0, 0), (0, 0)] + pad, constant_values=1)
+                cnt = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window, strides,
+                    [(0, 0), (0, 0)] + [(0, e) for e in extra])
+                out = out / cnt
             else:
                 ones = jnp.ones(v.shape, v.dtype)
                 cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
@@ -592,6 +608,20 @@ def _pool_nd(x, kernel, stride, padding, n, reducer, init, ceil_mode=False,
                 out = out / cnt
         return out
     return defop(f, name=name)(x)
+
+
+def _ceil_mode_extra(spatial, k_t, s_t, pad):
+    """Per-dim extra high-side padding a ceil-mode pool needs so the last
+    (partial) window exists; 0 where floor and ceil outputs coincide."""
+    extra = []
+    for i, h in enumerate(spatial):
+        lo, hi = pad[i]
+        eff = h + lo + hi - k_t[i]
+        out = -(-eff // s_t[i]) + 1  # ceil division
+        if (out - 1) * s_t[i] >= h + lo:
+            out -= 1
+        extra.append(max(0, (out - 1) * s_t[i] + k_t[i] - (h + lo + hi)))
+    return tuple(extra)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -1339,7 +1369,11 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
 
     def f(v):
         n, c, h, w = v.shape
-        vp = jnp.pad(v, [(0, 0), (0, 0), p[0], p[1]],
+        extra = _ceil_mode_extra((h, w), k, s, list(p)) if ceil_mode \
+            else (0, 0)
+        vp = jnp.pad(v, [(0, 0), (0, 0),
+                         (p[0][0], p[0][1] + extra[0]),
+                         (p[1][0], p[1][1] + extra[1])],
                      constant_values=-jnp.inf)
         hp, wp = vp.shape[-2:]
         ho = (hp - k[0]) // s[0] + 1
@@ -1349,7 +1383,7 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
         ox = (jnp.arange(wo) * s[1])[None, :, None, None]
         dy = jnp.arange(k[0])[None, None, :, None]
         dx = jnp.arange(k[1])[None, None, None, :]
-        yy, xx = oy + dy, ox + dx  # [Ho, Wo, kh, kw]
+        yy, xx = jnp.broadcast_arrays(oy + dy, ox + dx)  # [Ho, Wo, kh, kw]
         patches = vp[:, :, yy, xx].reshape(n, c, ho, wo, -1)
         out = jnp.max(patches, axis=-1)
         arg = jnp.argmax(patches, axis=-1)  # in-window index
@@ -1512,7 +1546,7 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
     anchor-positive similarity matrix + L2 on the embeddings."""
     def f(a, pos, y):
         reg = jnp.mean(jnp.sum(a * a, -1)) + jnp.mean(jnp.sum(pos * pos, -1))
-        reg = reg * 0.25 * l2_reg * a.shape[0]
+        reg = reg * 0.25 * l2_reg
         sim = a @ pos.T  # [N, N]
         same = (y[:, None] == y[None, :]).astype(a.dtype)
         tgt = same / jnp.sum(same, axis=1, keepdims=True)
