@@ -1,25 +1,50 @@
-"""paddle.distribution (upstream: python/paddle/distribution/) —
-Normal/Uniform/Categorical/Bernoulli with sample/log_prob/entropy/kl,
-built on the stateless PRNG (framework.next_rng_key) and tape ops so
-log_prob is differentiable."""
+"""paddle.distribution (upstream: python/paddle/distribution/) — the
+distribution zoo with sample/rsample/log_prob/entropy/mean/variance, a
+`register_kl` pair-dispatch registry, Independent/TransformedDistribution
+wrappers, and invertible transforms.
+
+TPU-native design: every density/statistic is a pure jnp computation
+recorded on the tape via apply_op (so log_prob is differentiable and
+jit-safe); sampling draws from the stateless threefry stream
+(framework.next_rng_key). Reparameterized sampling (`rsample`) is
+provided wherever upstream has it — gamma/beta/dirichlet ride
+jax.random.gamma's implicit-reparameterization gradients.
+"""
 from __future__ import annotations
 
 import math
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax.scipy import special as jsp
 
 from .. import framework
 from ..tensor import Tensor, apply_op, to_jax
+from .transform import (Transform, AffineTransform, ExpTransform,
+                        SigmoidTransform, TanhTransform, PowerTransform,
+                        AbsTransform, ChainTransform)
 
-__all__ = ['Distribution', 'Normal', 'Uniform', 'Categorical',
-           'Bernoulli', 'kl_divergence']
+__all__ = [
+    'Distribution', 'Normal', 'Uniform', 'Categorical', 'Bernoulli',
+    'Beta', 'Dirichlet', 'Gamma', 'Exponential', 'Geometric', 'Gumbel',
+    'Laplace', 'LogNormal', 'Multinomial', 'Poisson', 'StudentT',
+    'Independent', 'TransformedDistribution', 'kl_divergence',
+    'register_kl', 'Transform', 'AffineTransform', 'ExpTransform',
+    'SigmoidTransform', 'TanhTransform', 'PowerTransform', 'AbsTransform',
+    'ChainTransform',
+]
+
+_EULER = 0.5772156649015329  # Euler–Mascheroni
 
 
 def _as_t(x):
     return x if isinstance(x, Tensor) else Tensor(jnp.asarray(to_jax(x),
                                                               jnp.float32))
+
+
+def _key(seed=0):
+    return jax.random.key(seed) if seed else framework.next_rng_key()
 
 
 class Distribution:
@@ -53,7 +78,7 @@ class Normal(Distribution):
         return self.scale * self.scale
 
     def sample(self, shape=(), seed=0):
-        k = jax.random.key(seed) if seed else framework.next_rng_key()
+        k = _key(seed)
         shape = tuple(shape)
 
         def f(loc, scale):
@@ -78,11 +103,7 @@ class Normal(Distribution):
             self.scale, _name='normal_entropy')
 
     def kl_divergence(self, other: 'Normal'):
-        def f(l1, s1, l2, s2):
-            return (jnp.log(s2 / s1) + (s1 * s1 + (l1 - l2) ** 2)
-                    / (2 * s2 * s2) - 0.5)
-        return apply_op(f, self.loc, self.scale, other.loc, other.scale,
-                        _name='normal_kl')
+        return kl_divergence(self, other)
 
 
 class Uniform(Distribution):
@@ -90,8 +111,18 @@ class Uniform(Distribution):
         self.low = _as_t(low)
         self.high = _as_t(high)
 
+    @property
+    def mean(self):
+        return apply_op(lambda lo, hi: (lo + hi) / 2, self.low, self.high,
+                        _name='uniform_mean')
+
+    @property
+    def variance(self):
+        return apply_op(lambda lo, hi: (hi - lo) ** 2 / 12.0, self.low,
+                        self.high, _name='uniform_var')
+
     def sample(self, shape=(), seed=0):
-        k = jax.random.key(seed) if seed else framework.next_rng_key()
+        k = _key(seed)
         shape = tuple(shape)
 
         def f(lo, hi):
@@ -99,6 +130,8 @@ class Uniform(Distribution):
             u = jax.random.uniform(k, shape + base, jnp.float32)
             return lo + (hi - lo) * u
         return apply_op(f, self.low, self.high, _name='uniform_sample')
+
+    rsample = sample
 
     def log_prob(self, value):
         def f(v, lo, hi):
@@ -117,7 +150,7 @@ class Categorical(Distribution):
         self.logits = _as_t(logits)
 
     def sample(self, shape=(), seed=0):
-        k = jax.random.key(seed) if seed else framework.next_rng_key()
+        k = _key(seed)
         shape = tuple(shape)
         return apply_op(
             lambda lg: jax.random.categorical(
@@ -150,20 +183,24 @@ class Categorical(Distribution):
         return apply_op(f, self.logits, _name='categorical_entropy')
 
     def kl_divergence(self, other: 'Categorical'):
-        def f(a, b):
-            pa = jax.nn.log_softmax(a, axis=-1)
-            pb = jax.nn.log_softmax(b, axis=-1)
-            return jnp.sum(jnp.exp(pa) * (pa - pb), axis=-1)
-        return apply_op(f, self.logits, other.logits,
-                        _name='categorical_kl')
+        return kl_divergence(self, other)
 
 
 class Bernoulli(Distribution):
     def __init__(self, probs, name=None):
         self.probs = _as_t(probs)
 
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: p * (1 - p), self.probs,
+                        _name='bernoulli_var')
+
     def sample(self, shape=(), seed=0):
-        k = jax.random.key(seed) if seed else framework.next_rng_key()
+        k = _key(seed)
         shape = tuple(shape)
         return apply_op(
             lambda p: jax.random.bernoulli(
@@ -184,14 +221,711 @@ class Bernoulli(Distribution):
         return apply_op(f, self.probs, _name='bernoulli_entropy')
 
 
+class Beta(Distribution):
+    """Beta(alpha, beta) on (0, 1) (upstream distribution/beta.py)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _as_t(alpha)
+        self.beta = _as_t(beta)
+
+    @property
+    def mean(self):
+        return apply_op(lambda a, b: a / (a + b), self.alpha, self.beta,
+                        _name='beta_mean')
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+            self.alpha, self.beta, _name='beta_var')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(a, b):
+            base = jnp.broadcast_shapes(a.shape, b.shape)
+            return jax.random.beta(k, a, b, shape + base)
+        return apply_op(f, self.alpha, self.beta, _name='beta_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            logbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - logbeta
+        return apply_op(f, _as_t(value), self.alpha, self.beta,
+                        _name='beta_log_prob')
+
+    def entropy(self):
+        def f(a, b):
+            logbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+            return (logbeta - (a - 1) * jsp.digamma(a)
+                    - (b - 1) * jsp.digamma(b)
+                    + (a + b - 2) * jsp.digamma(a + b))
+        return apply_op(f, self.alpha, self.beta, _name='beta_entropy')
+
+
+class Dirichlet(Distribution):
+    """Dirichlet(concentration) on the simplex (upstream
+    distribution/dirichlet.py)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _as_t(concentration)
+
+    @property
+    def mean(self):
+        return apply_op(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                        self.concentration, _name='dirichlet_mean')
+
+    @property
+    def variance(self):
+        def f(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+        return apply_op(f, self.concentration, _name='dirichlet_var')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(c):
+            # gamma-normalization construction keeps implicit-reparam grads
+            g = jax.random.gamma(k, c, shape + c.shape)
+            return g / jnp.sum(g, axis=-1, keepdims=True)
+        return apply_op(f, self.concentration, _name='dirichlet_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, c):
+            logbeta = (jnp.sum(jsp.gammaln(c), -1)
+                       - jsp.gammaln(jnp.sum(c, -1)))
+            return jnp.sum((c - 1) * jnp.log(v), -1) - logbeta
+        return apply_op(f, _as_t(value), self.concentration,
+                        _name='dirichlet_log_prob')
+
+    def entropy(self):
+        def f(c):
+            c0 = jnp.sum(c, -1)
+            kdim = c.shape[-1]
+            logbeta = jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(c0)
+            return (logbeta + (c0 - kdim) * jsp.digamma(c0)
+                    - jnp.sum((c - 1) * jsp.digamma(c), -1))
+        return apply_op(f, self.concentration, _name='dirichlet_entropy')
+
+
+class Gamma(Distribution):
+    """Gamma(concentration k, rate β) (upstream distribution/gamma.py)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _as_t(concentration)
+        self.rate = _as_t(rate)
+
+    @property
+    def mean(self):
+        return apply_op(lambda a, b: a / b, self.concentration, self.rate,
+                        _name='gamma_mean')
+
+    @property
+    def variance(self):
+        return apply_op(lambda a, b: a / (b * b), self.concentration,
+                        self.rate, _name='gamma_var')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(a, b):
+            base = jnp.broadcast_shapes(a.shape, b.shape)
+            return jax.random.gamma(k, jnp.broadcast_to(a, shape + base)) \
+                / b
+        return apply_op(f, self.concentration, self.rate,
+                        _name='gamma_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - jsp.gammaln(a))
+        return apply_op(f, _as_t(value), self.concentration, self.rate,
+                        _name='gamma_log_prob')
+
+    def entropy(self):
+        def f(a, b):
+            return (a - jnp.log(b) + jsp.gammaln(a)
+                    + (1 - a) * jsp.digamma(a))
+        return apply_op(f, self.concentration, self.rate,
+                        _name='gamma_entropy')
+
+
+class Exponential(Distribution):
+    """Exponential(rate) (upstream distribution/exponential.py)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _as_t(rate)
+
+    @property
+    def mean(self):
+        return apply_op(lambda r: 1.0 / r, self.rate, _name='exp_mean')
+
+    @property
+    def variance(self):
+        return apply_op(lambda r: 1.0 / (r * r), self.rate,
+                        _name='exp_var')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(r):
+            u = jax.random.uniform(k, shape + r.shape, jnp.float32,
+                                   minval=jnp.finfo(jnp.float32).tiny)
+            return -jnp.log(u) / r
+        return apply_op(f, self.rate, _name='exponential_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return apply_op(lambda v, r: jnp.log(r) - r * v, _as_t(value),
+                        self.rate, _name='exponential_log_prob')
+
+    def entropy(self):
+        return apply_op(lambda r: 1.0 - jnp.log(r), self.rate,
+                        _name='exponential_entropy')
+
+
+class Geometric(Distribution):
+    """Geometric(probs): failures before the first success, support
+    {0, 1, 2, ...}, pmf(k) = (1-p)^k p (upstream
+    distribution/geometric.py; same convention as torch/scipy-shifted)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _as_t(probs)
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: (1 - p) / p, self.probs,
+                        _name='geometric_mean')
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: (1 - p) / (p * p), self.probs,
+                        _name='geometric_var')
+
+    def sample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(p):
+            u = jax.random.uniform(k, shape + p.shape, jnp.float32,
+                                   minval=jnp.finfo(jnp.float32).tiny)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return apply_op(f, self.probs, _name='geometric_sample')
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p), _as_t(value),
+            self.probs, _name='geometric_log_prob')
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return apply_op(f, self.probs, _name='geometric_entropy')
+
+
+class Gumbel(Distribution):
+    """Gumbel(loc, scale) (upstream distribution/gumbel.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+
+    @property
+    def mean(self):
+        return apply_op(lambda l, s: l + _EULER * s, self.loc, self.scale,
+                        _name='gumbel_mean')
+
+    @property
+    def variance(self):
+        return apply_op(lambda l, s: (math.pi ** 2 / 6.0) * s * s,
+                        self.loc, self.scale, _name='gumbel_var')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(l, s):
+            base = jnp.broadcast_shapes(l.shape, s.shape)
+            g = jax.random.gumbel(k, shape + base, jnp.float32)
+            return l + s * g
+        return apply_op(f, self.loc, self.scale, _name='gumbel_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return apply_op(f, _as_t(value), self.loc, self.scale,
+                        _name='gumbel_log_prob')
+
+    def entropy(self):
+        return apply_op(lambda l, s: jnp.log(s) + 1.0 + _EULER, self.loc,
+                        self.scale, _name='gumbel_entropy')
+
+
+class Laplace(Distribution):
+    """Laplace(loc, scale) (upstream distribution/laplace.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_op(lambda s: 2.0 * s * s, self.scale,
+                        _name='laplace_var')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(l, s):
+            base = jnp.broadcast_shapes(l.shape, s.shape)
+            u = jax.random.uniform(k, shape + base, jnp.float32,
+                                   minval=-0.5 + 1e-7, maxval=0.5)
+            return l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+        return apply_op(f, self.loc, self.scale, _name='laplace_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            return -jnp.abs(v - l) / s - jnp.log(2 * s)
+        return apply_op(f, _as_t(value), self.loc, self.scale,
+                        _name='laplace_log_prob')
+
+    def entropy(self):
+        return apply_op(lambda s: 1.0 + jnp.log(2 * s), self.scale,
+                        _name='laplace_entropy')
+
+
+class LogNormal(Distribution):
+    """LogNormal(loc, scale): exp of a Normal (upstream
+    distribution/lognormal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        self._base = Normal(loc, scale)
+
+    @property
+    def mean(self):
+        return apply_op(lambda l, s: jnp.exp(l + s * s / 2), self.loc,
+                        self.scale, _name='lognormal_mean')
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s),
+            self.loc, self.scale, _name='lognormal_var')
+
+    def rsample(self, shape=(), seed=0):
+        z = self._base.rsample(shape, seed)
+        return apply_op(jnp.exp, z, _name='lognormal_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            logv = jnp.log(v)
+            return (-((logv - l) ** 2) / (2 * s * s) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi) - logv)
+        return apply_op(f, _as_t(value), self.loc, self.scale,
+                        _name='lognormal_log_prob')
+
+    def entropy(self):
+        # base normal entropy + E[log x] = loc
+        return apply_op(
+            lambda l, s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l,
+            self.loc, self.scale, _name='lognormal_entropy')
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs) (upstream
+    distribution/multinomial.py). total_count is a python int (static
+    under jit, as upstream requires)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _as_t(probs)
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return apply_op(lambda p: n * p, self.probs,
+                        _name='multinomial_mean')
+
+    @property
+    def variance(self):
+        n = self.total_count
+        return apply_op(lambda p: n * p * (1 - p), self.probs,
+                        _name='multinomial_var')
+
+    def sample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+        n = self.total_count
+
+        def f(p):
+            logits = jnp.log(p)
+            kdim = p.shape[-1]
+            # n categorical draws -> one-hot counts; [n, shape..., batch]
+            draws = jax.random.categorical(
+                k, logits, axis=-1, shape=(n,) + shape + p.shape[:-1])
+            return jnp.sum(jax.nn.one_hot(draws, kdim, dtype=jnp.float32),
+                           axis=0)
+        return apply_op(f, self.probs, _name='multinomial_sample')
+
+    def log_prob(self, value):
+        def f(v, p):
+            # xlogy: 0 * log(0) = 0 for zero-prob categories with 0 count
+            return (jsp.gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(jsp.gammaln(v + 1), -1)
+                    + jnp.sum(jsp.xlogy(v, p), -1))
+        return apply_op(f, _as_t(value), self.probs,
+                        _name='multinomial_log_prob')
+
+
+class Poisson(Distribution):
+    """Poisson(rate) (upstream distribution/poisson.py)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _as_t(rate)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+        return apply_op(
+            lambda r: jax.random.poisson(
+                k, r, shape + r.shape).astype(jnp.float32),
+            self.rate, _name='poisson_sample')
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, r: v * jnp.log(r) - r - jsp.gammaln(v + 1),
+            _as_t(value), self.rate, _name='poisson_log_prob')
+
+    def entropy(self):
+        """Truncated-series entropy -Σ pmf·log pmf over k ≤ rate+10σ+10
+        (the same bounded-support evaluation upstream uses; needs a
+        concrete rate, i.e. eager mode)."""
+        rmax = float(jnp.max(to_jax(self.rate)))
+        upper = int(rmax + 10.0 * math.sqrt(max(rmax, 1.0)) + 10)
+
+        def f(r):
+            ks = jnp.arange(upper + 1, dtype=jnp.float32)
+            ks = ks.reshape((upper + 1,) + (1,) * r.ndim)
+            logp = ks * jnp.log(r) - r - jsp.gammaln(ks + 1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=0)
+        return apply_op(f, self.rate, _name='poisson_entropy')
+
+
+class StudentT(Distribution):
+    """StudentT(df, loc, scale) (upstream distribution/student_t.py)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _as_t(df)
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def f(df, s):
+            return jnp.where(df > 2, s * s * df / (df - 2), jnp.inf)
+        return apply_op(f, self.df, self.scale, _name='studentt_var')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(df, l, s):
+            base = jnp.broadcast_shapes(df.shape, l.shape, s.shape)
+            t = jax.random.t(k, df, shape + base)
+            return l + s * t
+        return apply_op(f, self.df, self.loc, self.scale,
+                        _name='studentt_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, df, l, s):
+            z = (v - l) / s
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+        return apply_op(f, _as_t(value), self.df, self.loc, self.scale,
+                        _name='studentt_log_prob')
+
+    def entropy(self):
+        def f(df, s):
+            half = (df + 1) / 2
+            logbeta = (jsp.gammaln(df / 2) + jsp.gammaln(0.5)
+                       - jsp.gammaln(df / 2 + 0.5))  # log B(df/2, 1/2)
+            return (half * (jsp.digamma(half) - jsp.digamma(df / 2))
+                    + 0.5 * jnp.log(df) + logbeta + jnp.log(s))
+        return apply_op(f, self.df, self.scale, _name='studentt_entropy')
+
+
+class Independent(Distribution):
+    """Reinterpret the last `reinterpreted_batch_ndims` batch dims of a
+    base distribution as event dims (upstream
+    distribution/independent.py): log_prob/entropy sum over them."""
+
+    def __init__(self, base, reinterpreted_batch_ndims=1, name=None):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    def _sum_event(self, t):
+        n = self.reinterpreted_batch_ndims
+        if n == 0:
+            return t
+        return apply_op(
+            lambda v: jnp.sum(v, axis=tuple(range(v.ndim - n, v.ndim))),
+            t, _name='independent_sum')
+
+    def sample(self, shape=(), seed=0):
+        return self.base.sample(shape, seed)
+
+    def rsample(self, shape=(), seed=0):
+        return self.base.rsample(shape, seed)
+
+    def log_prob(self, value):
+        return self._sum_event(self.base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_event(self.base.entropy())
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through invertible transforms (upstream
+    distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = [transforms] if isinstance(transforms, Transform) \
+            else list(transforms)
+
+    def _fwd(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=(), seed=0):
+        return self._fwd(self.base.sample(shape, seed))
+
+    def rsample(self, shape=(), seed=0):
+        return self._fwd(self.base.rsample(shape, seed))
+
+    def log_prob(self, value):
+        y = _as_t(value)
+        lp = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            lp = ld if lp is None else lp + ld
+            y = x
+        base_lp = self.base.log_prob(y)
+        return base_lp - lp if lp is not None else base_lp
+
+
+# ---------------------------------------------------------------------------
+# KL registry (upstream distribution/kl.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    """Decorator registering fn(p, q) as KL(p||q) for the class pair."""
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
 def kl_divergence(p: Distribution, q: Distribution):
-    """Dispatch on matched distribution types (upstream
-    paddle.distribution.kl_divergence)."""
-    if type(p) is not type(q):
+    """Pair-dispatched KL(p||q); falls back along the MRO like upstream's
+    dispatch."""
+    matches = [
+        (tp, tq) for (tp, tq) in _KL_REGISTRY
+        if isinstance(p, tp) and isinstance(q, tq)]
+    if not matches:
         raise NotImplementedError(
             f'kl_divergence({type(p).__name__}, {type(q).__name__}) '
             f'is not registered')
-    if hasattr(p, 'kl_divergence'):
-        return p.kl_divergence(q)
-    raise NotImplementedError(
-        f'kl_divergence not implemented for {type(p).__name__}')
+    # most-derived match first (smallest combined MRO distance)
+    tp, tq = min(matches, key=lambda m: (type(p).__mro__.index(m[0])
+                                         + type(q).__mro__.index(m[1])))
+    return _KL_REGISTRY[(tp, tq)](p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def f(l1, s1, l2, s2):
+        return (jnp.log(s2 / s1) + (s1 * s1 + (l1 - l2) ** 2)
+                / (2 * s2 * s2) - 0.5)
+    return apply_op(f, p.loc, p.scale, q.loc, q.scale, _name='kl_normal')
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def f(a, b):
+        pa = jax.nn.log_softmax(a, axis=-1)
+        pb = jax.nn.log_softmax(b, axis=-1)
+        return jnp.sum(jnp.exp(pa) * (pa - pb), axis=-1)
+    return apply_op(f, p.logits, q.logits, _name='kl_categorical')
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def f(a, b):
+        a = jnp.clip(a, 1e-7, 1 - 1e-7)
+        b = jnp.clip(b, 1e-7, 1 - 1e-7)
+        return (a * (jnp.log(a) - jnp.log(b))
+                + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+    return apply_op(f, p.probs, q.probs, _name='kl_bernoulli')
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def f(a1, b1, a2, b2):
+        logb = lambda a, b: (jsp.gammaln(a) + jsp.gammaln(b)  # noqa: E731
+                             - jsp.gammaln(a + b))
+        return (logb(a2, b2) - logb(a1, b1)
+                + (a1 - a2) * jsp.digamma(a1)
+                + (b1 - b2) * jsp.digamma(b1)
+                + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1))
+    return apply_op(f, p.alpha, p.beta, q.alpha, q.beta, _name='kl_beta')
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def f(c1, c2):
+        c10 = jnp.sum(c1, -1)
+        c20 = jnp.sum(c2, -1)
+        return (jsp.gammaln(c10) - jsp.gammaln(c20)
+                - jnp.sum(jsp.gammaln(c1) - jsp.gammaln(c2), -1)
+                + jnp.sum((c1 - c2) * (jsp.digamma(c1)
+                                       - jsp.digamma(c10)[..., None]), -1))
+    return apply_op(f, p.concentration, q.concentration,
+                    _name='kl_dirichlet')
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def f(a1, b1, a2, b2):
+        return ((a1 - a2) * jsp.digamma(a1) - jsp.gammaln(a1)
+                + jsp.gammaln(a2) + a2 * (jnp.log(b1) - jnp.log(b2))
+                + a1 * (b2 / b1 - 1.0))
+    return apply_op(f, p.concentration, p.rate, q.concentration, q.rate,
+                    _name='kl_gamma')
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return apply_op(
+        lambda r1, r2: jnp.log(r1) - jnp.log(r2) + r2 / r1 - 1.0,
+        p.rate, q.rate, _name='kl_exponential')
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def f(p1, p2):
+        return ((1 - p1) / p1 * (jnp.log1p(-p1) - jnp.log1p(-p2))
+                + jnp.log(p1) - jnp.log(p2))
+    return apply_op(f, p.probs, q.probs, _name='kl_geometric')
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def f(l1, s1, l2, s2):
+        d = jnp.abs(l1 - l2)
+        return (jnp.log(s2 / s1) + d / s2
+                + s1 / s2 * jnp.exp(-d / s1) - 1.0)
+    return apply_op(f, p.loc, p.scale, q.loc, q.scale, _name='kl_laplace')
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    # KL is invariant under the shared exp() pushforward
+    return _kl_normal(Normal(p.loc, p.scale), Normal(q.loc, q.scale))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return apply_op(
+        lambda r1, r2: r1 * (jnp.log(r1) - jnp.log(r2)) + r2 - r1,
+        p.rate, q.rate, _name='kl_poisson')
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def f(lo1, hi1, lo2, hi2):
+        inside = (lo2 <= lo1) & (hi1 <= hi2)
+        return jnp.where(inside, jnp.log((hi2 - lo2) / (hi1 - lo1)),
+                         jnp.inf)
+    return apply_op(f, p.low, p.high, q.low, q.high, _name='kl_uniform')
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    def f(l1, s1, l2, s2):
+        # KL(G1||G2) = log(s2/s1) + γ(s1/s2 - 1) + exp((l2-l1)/s2
+        #   + lgamma(1 + s1/s2)-ish — no simple closed form for s1≠s2;
+        # exact for equal scales, Taylor-free formula below covers the
+        # general case via E_p[z2 + exp(-z2)] with z2=(x-l2)/s2:
+        # E_p[z2] = (l1 - l2)/s2 + γ s1/s2
+        # E_p[exp(-z2)] = exp((l2 - l1)/s2) Γ(1 + s1/s2)
+        ez = (l1 - l2) / s2 + _EULER * s1 / s2
+        ee = jnp.exp((l2 - l1) / s2) * jnp.exp(jsp.gammaln(1 + s1 / s2))
+        entropy_p = jnp.log(s1) + 1.0 + _EULER
+        return ez + ee + jnp.log(s2) - entropy_p
+    return apply_op(f, p.loc, p.scale, q.loc, q.scale, _name='kl_gumbel')
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    if p.reinterpreted_batch_ndims != q.reinterpreted_batch_ndims:
+        raise NotImplementedError(
+            'kl_divergence between Independents with different '
+            'reinterpreted_batch_ndims')
+    return p._sum_event(kl_divergence(p.base, q.base))

@@ -1,0 +1,201 @@
+"""paddle.distribution.transform (upstream: python/paddle/distribution/
+transform.py) — invertible maps with log-det-Jacobians, the building
+blocks of TransformedDistribution. Pure jnp computations recorded on the
+tape via apply_op so everything stays differentiable."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply_op, to_jax
+
+__all__ = [
+    'Transform', 'AffineTransform', 'ExpTransform', 'SigmoidTransform',
+    'TanhTransform', 'PowerTransform', 'AbsTransform', 'ChainTransform',
+]
+
+
+def _as_t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(to_jax(x),
+                                                              jnp.float32))
+
+
+class Transform:
+    """Bijective map y = f(x). Subclasses implement `_forward`,
+    `_inverse`, `_forward_log_det_jacobian` as pure jnp functions."""
+
+    def forward(self, x):
+        return apply_op(self._forward, _as_t(x),
+                        _name=type(self).__name__ + '_fwd')
+
+    def inverse(self, y):
+        return apply_op(self._inverse, _as_t(y),
+                        _name=type(self).__name__ + '_inv')
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(self._forward_log_det_jacobian, _as_t(x),
+                        _name=type(self).__name__ + '_fldj')
+
+    def inverse_log_det_jacobian(self, y):
+        # d/dy f^{-1} = 1 / f'(f^{-1}(y))
+        x = self.inverse(y)
+        return apply_op(lambda v: -self._forward_log_det_jacobian(v), x,
+                        _name=type(self).__name__ + '_ildj')
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+
+    def forward(self, x):
+        return apply_op(lambda v, l, s: l + s * v, _as_t(x), self.loc,
+                        self.scale, _name='affine_fwd')
+
+    def inverse(self, y):
+        return apply_op(lambda v, l, s: (v - l) / s, _as_t(y), self.loc,
+                        self.scale, _name='affine_inv')
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(
+            lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                          jnp.broadcast_shapes(v.shape,
+                                                               s.shape)),
+            _as_t(x), self.scale, _name='affine_fldj')
+
+    def inverse_log_det_jacobian(self, y):
+        return apply_op(
+            lambda v, s: jnp.broadcast_to(-jnp.log(jnp.abs(s)),
+                                          jnp.broadcast_shapes(v.shape,
+                                                               s.shape)),
+            _as_t(y), self.scale, _name='affine_ildj')
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    @staticmethod
+    def _forward(v):
+        return jnp.exp(v)
+
+    @staticmethod
+    def _inverse(v):
+        return jnp.log(v)
+
+    @staticmethod
+    def _forward_log_det_jacobian(v):
+        return v
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    @staticmethod
+    def _forward(v):
+        return 1.0 / (1.0 + jnp.exp(-v))
+
+    @staticmethod
+    def _inverse(v):
+        return jnp.log(v) - jnp.log1p(-v)
+
+    @staticmethod
+    def _forward_log_det_jacobian(v):
+        # log sigmoid'(x) = log σ(x) + log σ(-x), stably via softplus
+        return -jnp.logaddexp(0.0, -v) - jnp.logaddexp(0.0, v)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    @staticmethod
+    def _forward(v):
+        return jnp.tanh(v)
+
+    @staticmethod
+    def _inverse(v):
+        return jnp.arctanh(v)
+
+    @staticmethod
+    def _forward_log_det_jacobian(v):
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - v - jnp.logaddexp(0.0, -2.0 * v))
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0)."""
+
+    def __init__(self, power):
+        self.power = _as_t(power)
+
+    def forward(self, x):
+        return apply_op(lambda v, p: jnp.power(v, p), _as_t(x), self.power,
+                        _name='power_fwd')
+
+    def inverse(self, y):
+        return apply_op(lambda v, p: jnp.power(v, 1.0 / p), _as_t(y),
+                        self.power, _name='power_inv')
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(
+            lambda v, p: jnp.log(jnp.abs(p)) + (p - 1.0) * jnp.log(v),
+            _as_t(x), self.power, _name='power_fldj')
+
+    def inverse_log_det_jacobian(self, y):
+        x = self.inverse(y)
+        return apply_op(
+            lambda v, p: -(jnp.log(jnp.abs(p)) + (p - 1.0) * jnp.log(v)),
+            x, self.power, _name='power_ildj')
+
+
+class AbsTransform(Transform):
+    """y = |x| — not bijective; inverse returns the positive branch
+    (upstream AbsTransform does the same)."""
+
+    @staticmethod
+    def _forward(v):
+        return jnp.abs(v)
+
+    @staticmethod
+    def _inverse(v):
+        return v
+
+    @staticmethod
+    def _forward_log_det_jacobian(v):
+        return jnp.zeros_like(v)
+
+
+class ChainTransform(Transform):
+    """Composition: y = fN(...f1(x))."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+    def inverse_log_det_jacobian(self, y):
+        total = None
+        for t in reversed(self.transforms):
+            ld = t.inverse_log_det_jacobian(y)
+            total = ld if total is None else total + ld
+            y = t.inverse(y)
+        return total

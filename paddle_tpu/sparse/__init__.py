@@ -28,6 +28,16 @@ __all__ = [
 ]
 
 
+_INDEX_BOUND = 2 ** 31 - 1  # int32 index space; x64 is off globally
+
+
+def _check_index_bound(shape):
+    if any(int(s) > _INDEX_BOUND for s in shape):
+        raise ValueError(
+            f'sparse indices are int32; dimension sizes {tuple(shape)} '
+            f'exceed {_INDEX_BOUND}')
+
+
 class SparseCooTensor:
     """COO sparse tensor over BCOO; `indices` follows paddle's
     [sparse_ndim, nnz] layout (BCOO stores [nnz, ndim] internally)."""
@@ -65,12 +75,15 @@ class SparseCooTensor:
     def to_sparse_csr(self) -> 'SparseCsrTensor':
         if len(self.shape) != 2:
             raise ValueError('to_sparse_csr supports 2-D tensors only')
+        _check_index_bound(self.shape)
         coo = _jsparse.bcoo_sum_duplicates(self._bcoo)
         rows, cols = coo.indices[:, 0], coo.indices[:, 1]
         order = jnp.lexsort((cols, rows))
         rows, cols, vals = rows[order], cols[order], coo.data[order]
         n_rows = self.shape[0]
-        crows = jnp.zeros(n_rows + 1, jnp.int64).at[rows + 1].add(1)
+        # int32 indices by design (x64 is off globally): TPU-friendly and
+        # enough for nnz / dims < 2**31 — the _INDEX_BOUND guard below
+        crows = jnp.zeros(n_rows + 1, jnp.int32).at[rows + 1].add(1)
         return SparseCsrTensor(jnp.cumsum(crows), cols, vals, self.shape)
 
     def is_sparse_coo(self) -> bool:
@@ -185,6 +198,7 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
         vals = vals.astype(jnp.dtype(dtype))
     if shape is None:
         shape = tuple(int(s) for s in (idx.max(axis=0) + 1))
+    _check_index_bound(shape)
     return SparseCooTensor(
         _jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape)))
 
@@ -194,8 +208,9 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
     vals = jnp.asarray(to_jax(values))
     if dtype is not None:
         vals = vals.astype(jnp.dtype(dtype))
-    return SparseCsrTensor(jnp.asarray(to_jax(crows), jnp.int64),
-                           jnp.asarray(to_jax(cols), jnp.int64),
+    _check_index_bound(shape)
+    return SparseCsrTensor(jnp.asarray(to_jax(crows), jnp.int32),
+                           jnp.asarray(to_jax(cols), jnp.int32),
                            vals, shape)
 
 

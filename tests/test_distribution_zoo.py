@@ -1,0 +1,372 @@
+"""Parity tests for the expanded paddle.distribution zoo (VERDICT r4
+Next #2; upstream python/paddle/distribution/): log_prob / entropy /
+mean / variance vs torch.distributions, sampling statistics, gradient
+flow through log_prob and rsample, Independent / TransformedDistribution
+wrappers, and the register_kl pair registry."""
+import numpy as np
+import pytest
+import torch
+import torch.distributions as td
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+RNG = np.random.RandomState(5)
+
+
+def _t(a, stop_gradient=True):
+    t = paddle.to_tensor(np.asarray(a, np.float32))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+# (name, ours-ctor, torch-ctor, support sampler) — params are arrays so
+# broadcasting is exercised too
+ALPHA = np.array([0.7, 1.5, 3.0], np.float32)
+BETA = np.array([1.2, 0.8, 2.5], np.float32)
+LOC = np.array([-0.5, 0.0, 1.5], np.float32)
+SCALE = np.array([0.4, 1.0, 2.2], np.float32)
+RATE = np.array([0.5, 1.3, 4.0], np.float32)
+PROB = np.array([0.2, 0.5, 0.8], np.float32)
+DF = np.array([3.0, 5.0, 10.0], np.float32)
+
+CASES = [
+    ('beta', lambda: D.Beta(_t(ALPHA), _t(BETA)),
+     lambda: td.Beta(torch.tensor(ALPHA), torch.tensor(BETA)),
+     lambda: RNG.uniform(0.05, 0.95, (4, 3)).astype(np.float32)),
+    ('gamma', lambda: D.Gamma(_t(ALPHA), _t(RATE)),
+     lambda: td.Gamma(torch.tensor(ALPHA), torch.tensor(RATE)),
+     lambda: RNG.uniform(0.1, 5.0, (4, 3)).astype(np.float32)),
+    ('exponential', lambda: D.Exponential(_t(RATE)),
+     lambda: td.Exponential(torch.tensor(RATE)),
+     lambda: RNG.uniform(0.05, 4.0, (4, 3)).astype(np.float32)),
+    ('geometric', lambda: D.Geometric(_t(PROB)),
+     lambda: td.Geometric(torch.tensor(PROB)),
+     lambda: RNG.randint(0, 8, (4, 3)).astype(np.float32)),
+    ('gumbel', lambda: D.Gumbel(_t(LOC), _t(SCALE)),
+     lambda: td.Gumbel(torch.tensor(LOC), torch.tensor(SCALE)),
+     lambda: RNG.standard_normal((4, 3)).astype(np.float32) * 2),
+    ('laplace', lambda: D.Laplace(_t(LOC), _t(SCALE)),
+     lambda: td.Laplace(torch.tensor(LOC), torch.tensor(SCALE)),
+     lambda: RNG.standard_normal((4, 3)).astype(np.float32) * 2),
+    ('lognormal', lambda: D.LogNormal(_t(LOC), _t(SCALE)),
+     lambda: td.LogNormal(torch.tensor(LOC), torch.tensor(SCALE)),
+     lambda: RNG.uniform(0.1, 6.0, (4, 3)).astype(np.float32)),
+    ('poisson', lambda: D.Poisson(_t(RATE)),
+     lambda: td.Poisson(torch.tensor(RATE)),
+     lambda: RNG.randint(0, 10, (4, 3)).astype(np.float32)),
+    ('studentt', lambda: D.StudentT(_t(DF), _t(LOC), _t(SCALE)),
+     lambda: td.StudentT(torch.tensor(DF), torch.tensor(LOC),
+                         torch.tensor(SCALE)),
+     lambda: RNG.standard_normal((4, 3)).astype(np.float32) * 2),
+]
+
+
+@pytest.mark.parametrize('name,ours,theirs,vals',
+                         CASES, ids=[c[0] for c in CASES])
+class TestScalarFamilies:
+    def test_log_prob(self, name, ours, theirs, vals):
+        v = vals()
+        got = ours().log_prob(_t(v)).numpy()
+        want = theirs().log_prob(torch.tensor(v)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_entropy(self, name, ours, theirs, vals):
+        if name == 'poisson':
+            pytest.skip('torch Poisson has no entropy; '
+                        'covered vs scipy in TestEntropyPoisson')
+        got = ours().entropy().numpy()
+        want = theirs().entropy().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_mean_variance(self, name, ours, theirs, vals):
+        np.testing.assert_allclose(ours().mean.numpy(),
+                                   theirs().mean.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(ours().variance.numpy(),
+                                   theirs().variance.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sample_statistics(self, name, ours, theirs, vals):
+        d = ours()
+        s = d.sample((4000,)).numpy()
+        assert s.shape == (4000, 3)
+        mean = d.mean.numpy()
+        var = d.variance.numpy()
+        if name == 'studentt':  # heavy tails: looser check on mean only
+            np.testing.assert_allclose(s.mean(0), mean, atol=0.5)
+            return
+        tol = 4.5 * np.sqrt(var / 4000) + 1e-2
+        assert np.all(np.abs(s.mean(0) - mean) < tol), \
+            (s.mean(0), mean, tol)
+
+    def test_log_prob_grad_flows(self, name, ours, theirs, vals):
+        d = ours()
+        v = vals()
+        params = [p for p in vars(d).values()
+                  if isinstance(p, paddle.Tensor)]
+        for p in params:
+            p.stop_gradient = False
+        lp = d.log_prob(_t(v)).sum()
+        grads = paddle.grad(lp, params, allow_unused=True)
+        assert any(g is not None and np.isfinite(g.numpy()).all()
+                   for g in grads)
+
+
+class TestEntropyPoisson:
+    def test_truncated_series_matches_scipy(self):
+        from scipy import stats
+        got = D.Poisson(_t(RATE)).entropy().numpy()
+        want = np.array([stats.poisson(r).entropy() for r in RATE])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+class TestRsample:
+    @pytest.mark.parametrize('maker', [
+        lambda: D.Gamma(_t([2.0]), _t([1.5])),
+        lambda: D.Beta(_t([2.0]), _t([3.0])),
+        lambda: D.Exponential(_t([1.2])),
+        lambda: D.Gumbel(_t([0.0]), _t([1.0])),
+        lambda: D.Laplace(_t([0.0]), _t([1.0])),
+        lambda: D.LogNormal(_t([0.0]), _t([0.5])),
+    ], ids=['gamma', 'beta', 'exponential', 'gumbel', 'laplace',
+            'lognormal'])
+    def test_rsample_grad_flows_to_params(self, maker):
+        d = maker()
+        params = [p for p in vars(d).values()
+                  if isinstance(p, paddle.Tensor)]
+        for p in params:
+            p.stop_gradient = False
+        s = d.rsample((256,)).sum()
+        grads = paddle.grad(s, params, allow_unused=True)
+        assert any(g is not None and float(np.abs(g.numpy()).sum()) > 0
+                   for g in grads)
+
+    def test_gamma_rsample_pathwise_derivative(self):
+        # d E[x] / d rate for Gamma(a, rate) is -a/rate^2; check the
+        # implicit-reparam estimate against the closed form
+        a, rate = 3.0, 2.0
+        r = _t([rate], stop_gradient=False)
+        d = D.Gamma(_t([a]), r)
+        s = d.rsample((20000,)).mean()
+        (g,) = paddle.grad(s, [r])
+        np.testing.assert_allclose(g.numpy(), [-a / rate ** 2], rtol=0.15)
+
+
+class TestDirichletMultinomial:
+    def test_dirichlet_log_prob_entropy(self):
+        conc = np.array([[0.8, 1.5, 2.0], [3.0, 1.0, 0.5]], np.float32)
+        x = RNG.dirichlet([1.0, 1.0, 1.0], 2).astype(np.float32)
+        ours = D.Dirichlet(_t(conc))
+        theirs = td.Dirichlet(torch.tensor(conc))
+        np.testing.assert_allclose(ours.log_prob(_t(x)).numpy(),
+                                   theirs.log_prob(torch.tensor(x)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ours.entropy().numpy(),
+                                   theirs.entropy().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ours.mean.numpy(),
+                                   theirs.mean.numpy(), rtol=1e-5)
+        s = ours.sample((2000,)).numpy()
+        assert s.shape == (2000, 2, 3)
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(s.mean(0), ours.mean.numpy(), atol=0.03)
+
+    def test_multinomial_zero_prob_zero_count_finite(self):
+        p = np.array([0.0, 0.5, 0.5], np.float32)
+        got = D.Multinomial(4, _t(p)).log_prob(_t([0., 2., 2.])).numpy()
+        want = td.Multinomial(4, torch.tensor(p)).log_prob(
+            torch.tensor([0., 2., 2.])).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_chain_inverse_log_det_jacobian(self):
+        ch = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                               D.ExpTransform()])
+        tch = td.ComposeTransform([
+            td.AffineTransform(torch.tensor(0.0), torch.tensor(2.0)),
+            td.ExpTransform()])
+        y = np.array([0.5, 2.0, 7.0], np.float32)
+        x = ch.inverse(_t(y))
+        np.testing.assert_allclose(
+            ch.inverse_log_det_jacobian(_t(y)).numpy(),
+            -tch.log_abs_det_jacobian(torch.tensor(x.numpy()),
+                                      torch.tensor(y)).numpy(),
+            rtol=1e-5)
+
+    def test_multinomial_log_prob_and_sample(self):
+        p = np.array([0.2, 0.3, 0.5], np.float32)
+        ours = D.Multinomial(10, _t(p))
+        theirs = td.Multinomial(10, torch.tensor(p))
+        x = np.array([[2., 3., 5.], [0., 4., 6.], [10., 0., 0.]],
+                     np.float32)
+        np.testing.assert_allclose(ours.log_prob(_t(x)).numpy(),
+                                   theirs.log_prob(torch.tensor(x)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        s = ours.sample((500,)).numpy()
+        assert s.shape == (500, 3)
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+        np.testing.assert_allclose(s.mean(0), 10 * p, atol=0.4)
+
+
+class TestWrappers:
+    def test_independent_log_prob_entropy(self):
+        loc = RNG.standard_normal((4, 3)).astype(np.float32)
+        scale = np.abs(RNG.standard_normal((4, 3))).astype(np.float32) + .3
+        v = RNG.standard_normal((4, 3)).astype(np.float32)
+        ours = D.Independent(D.Normal(_t(loc), _t(scale)), 1)
+        theirs = td.Independent(td.Normal(torch.tensor(loc),
+                                          torch.tensor(scale)), 1)
+        np.testing.assert_allclose(ours.log_prob(_t(v)).numpy(),
+                                   theirs.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(ours.entropy().numpy(),
+                                   theirs.entropy().numpy(), rtol=1e-5)
+        assert ours.sample((7,)).shape == [7, 4, 3]
+
+    def test_independent_kl(self):
+        ours = D.kl_divergence(
+            D.Independent(D.Normal(_t([0., 0.]), _t([1., 1.])), 1),
+            D.Independent(D.Normal(_t([1., -1.]), _t([2., 2.])), 1))
+        want = td.kl_divergence(
+            td.Independent(td.Normal(torch.zeros(2), torch.ones(2)), 1),
+            td.Independent(td.Normal(torch.tensor([1., -1.]),
+                                     torch.full((2,), 2.)), 1))
+        np.testing.assert_allclose(ours.numpy(), want.numpy(), rtol=1e-5)
+
+    def test_transformed_lognormal_equivalence(self):
+        # exp(Normal) must match LogNormal exactly
+        tdist = D.TransformedDistribution(D.Normal(0.3, 0.8),
+                                          D.ExpTransform())
+        ln = D.LogNormal(0.3, 0.8)
+        v = RNG.uniform(0.2, 4.0, (8,)).astype(np.float32)
+        np.testing.assert_allclose(tdist.log_prob(_t(v)).numpy(),
+                                   ln.log_prob(_t(v)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_transformed_affine_chain_vs_torch(self):
+        base_o = D.Normal(0.0, 1.0)
+        base_t = td.Normal(torch.tensor(0.0), torch.tensor(1.0))
+        ours = D.TransformedDistribution(
+            base_o, [D.AffineTransform(1.0, 2.0), D.TanhTransform()])
+        theirs = td.TransformedDistribution(
+            base_t, [td.AffineTransform(torch.tensor(1.0),
+                                        torch.tensor(2.0)),
+                     td.TanhTransform()])
+        v = np.array([-0.9, -0.2, 0.4, 0.99], np.float32)
+        np.testing.assert_allclose(
+            ours.log_prob(_t(v)).numpy(),
+            theirs.log_prob(torch.tensor(v)).numpy(), rtol=1e-4,
+            atol=1e-5)
+
+    def test_transform_roundtrip_and_ldj(self):
+        x = np.array([-1.5, 0.2, 2.0], np.float32)
+        for tr, ttr in [
+                (D.ExpTransform(), td.ExpTransform()),
+                (D.SigmoidTransform(), td.SigmoidTransform()),
+                (D.TanhTransform(), td.TanhTransform()),
+                (D.AffineTransform(0.5, -2.0),
+                 td.AffineTransform(torch.tensor(0.5),
+                                    torch.tensor(-2.0)))]:
+            y = tr.forward(_t(x))
+            np.testing.assert_allclose(
+                tr.inverse(y).numpy(), x, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(
+                tr.forward_log_det_jacobian(_t(x)).numpy(),
+                ttr.log_abs_det_jacobian(
+                    torch.tensor(x), ttr(torch.tensor(x))).numpy(),
+                rtol=1e-4, atol=1e-5)
+        pw = D.PowerTransform(2.0)
+        xp = np.array([0.5, 1.5, 3.0], np.float32)
+        np.testing.assert_allclose(pw.inverse(pw.forward(_t(xp))).numpy(),
+                                   xp, rtol=1e-5)
+        np.testing.assert_allclose(
+            pw.forward_log_det_jacobian(_t(xp)).numpy(),
+            np.log(2.0 * xp), rtol=1e-5)
+
+
+KL_CASES = [
+    ('normal', lambda: (D.Normal(_t(LOC), _t(SCALE)),
+                        D.Normal(_t(LOC + 1), _t(SCALE * 2))),
+     lambda: (td.Normal(torch.tensor(LOC), torch.tensor(SCALE)),
+              td.Normal(torch.tensor(LOC + 1), torch.tensor(SCALE * 2)))),
+    ('beta', lambda: (D.Beta(_t(ALPHA), _t(BETA)),
+                      D.Beta(_t(BETA), _t(ALPHA))),
+     lambda: (td.Beta(torch.tensor(ALPHA), torch.tensor(BETA)),
+              td.Beta(torch.tensor(BETA), torch.tensor(ALPHA)))),
+    ('gamma', lambda: (D.Gamma(_t(ALPHA), _t(RATE)),
+                       D.Gamma(_t(ALPHA * 2), _t(RATE * 0.5))),
+     lambda: (td.Gamma(torch.tensor(ALPHA), torch.tensor(RATE)),
+              td.Gamma(torch.tensor(ALPHA * 2),
+                       torch.tensor(RATE * 0.5)))),
+    ('dirichlet',
+     lambda: (D.Dirichlet(_t(ALPHA)), D.Dirichlet(_t(BETA))),
+     lambda: (td.Dirichlet(torch.tensor(ALPHA)),
+              td.Dirichlet(torch.tensor(BETA)))),
+    ('exponential', lambda: (D.Exponential(_t(RATE)),
+                             D.Exponential(_t(RATE * 3))),
+     lambda: (td.Exponential(torch.tensor(RATE)),
+              td.Exponential(torch.tensor(RATE * 3)))),
+    ('laplace', lambda: (D.Laplace(_t(LOC), _t(SCALE)),
+                         D.Laplace(_t(LOC - 1), _t(SCALE * 2))),
+     lambda: (td.Laplace(torch.tensor(LOC), torch.tensor(SCALE)),
+              td.Laplace(torch.tensor(LOC - 1),
+                         torch.tensor(SCALE * 2)))),
+    ('poisson', lambda: (D.Poisson(_t(RATE)), D.Poisson(_t(RATE * 2))),
+     lambda: (td.Poisson(torch.tensor(RATE)),
+              td.Poisson(torch.tensor(RATE * 2)))),
+    ('lognormal', lambda: (D.LogNormal(_t(LOC), _t(SCALE)),
+                           D.LogNormal(_t(LOC + 1), _t(SCALE * 2))),
+     lambda: (td.LogNormal(torch.tensor(LOC), torch.tensor(SCALE)),
+              td.LogNormal(torch.tensor(LOC + 1),
+                           torch.tensor(SCALE * 2)))),
+    ('geometric', lambda: (D.Geometric(_t(PROB)),
+                           D.Geometric(_t(PROB[::-1].copy()))),
+     lambda: (td.Geometric(torch.tensor(PROB)),
+              td.Geometric(torch.tensor(PROB[::-1].copy())))),
+    ('uniform', lambda: (D.Uniform(_t([0.5]), _t([1.0])),
+                         D.Uniform(_t([0.0]), _t([2.0]))),
+     lambda: (td.Uniform(torch.tensor([0.5]), torch.tensor([1.0])),
+              td.Uniform(torch.tensor([0.0]), torch.tensor([2.0])))),
+]
+
+
+@pytest.mark.parametrize('name,ours,theirs', KL_CASES,
+                         ids=[c[0] for c in KL_CASES])
+def test_kl_registry_vs_torch(name, ours, theirs):
+    p, q = ours()
+    tp, tq = theirs()
+    np.testing.assert_allclose(D.kl_divergence(p, q).numpy(),
+                               td.kl_divergence(tp, tq).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_kl_gumbel_montecarlo():
+    # no torch registration for Gumbel/Gumbel; check vs Monte Carlo
+    p = D.Gumbel(_t([0.0]), _t([1.0]))
+    q = D.Gumbel(_t([0.5]), _t([1.5]))
+    kl = float(D.kl_divergence(p, q).numpy()[0])
+    s = p.sample((200000,))
+    mc = float((p.log_prob(s) - q.log_prob(s)).numpy().mean())
+    np.testing.assert_allclose(kl, mc, rtol=0.05, atol=0.01)
+
+
+def test_kl_unregistered_raises():
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Gamma(_t([1.0]), _t([1.0])),
+                        D.Normal(0.0, 1.0))
+
+
+def test_register_kl_custom():
+    class MyDist(D.Normal):
+        pass
+
+    @D.register_kl(MyDist, MyDist)
+    def _kl_my(p, q):
+        return paddle.to_tensor([42.0])
+
+    # exact pair wins over the (Normal, Normal) base registration
+    got = D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0))
+    assert float(got.numpy()[0]) == 42.0
+    # base pair still dispatches for plain Normals
+    base = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(0.0, 1.0))
+    assert float(base.numpy()) == 0.0
