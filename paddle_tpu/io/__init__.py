@@ -141,11 +141,25 @@ class RandomSampler(Sampler):
         self.replacement = replacement
         self.num_samples = num_samples or len(data_source)
         self.generator = generator
+        self.epoch: Optional[int] = None  # set_epoch => deterministic order
+
+    def set_epoch(self, epoch: int):
+        """Seed this epoch's order from (generator seed, epoch) so the
+        sequence is reproducible across interruption/resume (upstream:
+        DistributedBatchSampler.set_epoch, extended to plain sampling)."""
+        self.epoch = int(epoch)
+
+    def _rng(self):
+        base = self.generator if isinstance(self.generator, int) else 0
+        if self.epoch is not None:
+            return np.random.RandomState(
+                (base * 1000003 + self.epoch) % (2 ** 31 - 1))
+        return np.random.RandomState(
+            self.generator if isinstance(self.generator, int) else None)
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = np.random.RandomState(
-            self.generator if isinstance(self.generator, int) else None)
+        rng = self._rng()
         if self.replacement:
             return iter(rng.randint(0, n, self.num_samples).tolist())
         return iter(rng.permutation(n)[:self.num_samples].tolist())
@@ -383,15 +397,55 @@ class DataLoader:
                     self.num_workers, slot_bytes=64 << 20)
             except Exception:
                 self._native = None
+        # mid-epoch resume cursor (SURVEY §5 "dataloader epoch/seed
+        # state"): epochs are deterministically seeded via set_epoch, so
+        # {epoch, batch_idx} fully determines the remaining sequence
+        self._epoch = 0
+        self._batch_idx = 0
+        self._pending_skip = 0
+        self._in_progress = False  # a pass started but never completed
 
     def __len__(self):
         if self._iterable:
             raise TypeError('DataLoader over IterableDataset has no len')
         return len(self.batch_sampler)
 
+    # -- mid-epoch resume ---------------------------------------------------
+    def set_epoch(self, epoch: int):
+        """Fix this epoch's shuffle order (forwarded to the sampler).
+        Called automatically at the start of each iteration with the
+        tracked epoch counter, so shuffle order is reproducible by
+        default — the property mid-epoch resume rests on."""
+        self._epoch = int(epoch)
+        bs = self.batch_sampler
+        if bs is None:
+            return
+        if hasattr(bs, 'set_epoch'):
+            bs.set_epoch(self._epoch)
+        elif getattr(bs, 'sampler', None) is not None \
+                and hasattr(bs.sampler, 'set_epoch'):
+            bs.sampler.set_epoch(self._epoch)
+
+    def state_dict(self) -> dict:
+        """Cursor {epoch, batch_idx}: how many batches of which epoch
+        have been consumed (upstream: fleet dataset/reader state)."""
+        return {'epoch': self._epoch, 'batch_idx': self._batch_idx}
+
+    def set_state_dict(self, state: dict):
+        """Resume mid-epoch: the next iteration replays epoch `epoch`'s
+        deterministic order and skips the first `batch_idx` batches."""
+        self._epoch = int(state['epoch'])
+        self._batch_idx = int(state['batch_idx'])
+        self._pending_skip = self._batch_idx
+        self._in_progress = False
+
     # -- iteration ----------------------------------------------------------
     def _index_batches(self) -> Iterator[List[int]]:
-        yield from self.batch_sampler
+        it = iter(self.batch_sampler)
+        for _ in range(self._pending_skip):
+            next(it, None)
+        self._pending_skip = 0
+        yield from it
 
     def _fetch(self, indices: List[int]):
         return [self.dataset[i] for i in indices]
@@ -413,14 +467,20 @@ class DataLoader:
 
     def _iter_sync(self):
         if self._iterable:
+            skip, self._pending_skip = self._pending_skip, 0
+            emitted = 0
             batch = []
             for sample in self.dataset:
                 batch.append(sample)
                 if len(batch) == self.batch_size:
-                    yield self._collate(batch)
+                    emitted += 1
+                    if emitted > skip:
+                        yield self._collate(batch)
                     batch = []
             if batch and not self.drop_last:
-                yield self._collate(batch)
+                emitted += 1
+                if emitted > skip:
+                    yield self._collate(batch)
             return
         for idx in self._index_batches():
             yield self._collate(self._fetch(idx))
@@ -430,6 +490,7 @@ class DataLoader:
         Backpressure: workers stall once `cap` collated batches are
         waiting, so prefetch depth (not dataset size) bounds host memory."""
         cap = self.num_workers * self.prefetch_factor
+        n_batches = max(0, len(self.batch_sampler) - self._pending_skip)
         index_it = enumerate(self._index_batches())
         lock = threading.Lock()
         stop = threading.Event()
@@ -464,7 +525,6 @@ class DataLoader:
                    for _ in range(self.num_workers)]
         for t in threads:
             t.start()
-        n_batches = len(self.batch_sampler)
         try:
             for want in range(n_batches):
                 with results_cv:
@@ -484,9 +544,31 @@ class DataLoader:
                 inflight.release()
 
     def __iter__(self):
+        if self._pending_skip == 0:
+            if self._in_progress:
+                # a previous pass was abandoned early (break / exception):
+                # move on so re-iterating gets a FRESH shuffle order, not
+                # a silent replay of the same leading batches
+                self._epoch += 1
+                self._in_progress = False
+            self._batch_idx = 0  # fresh (non-resume) pass restarts cursor
+        self.set_epoch(self._epoch)  # pin this epoch's shuffle order
         if self.num_workers > 0 and not self._iterable:
-            return self._iter_workers()
-        return self._iter_sync()
+            inner = self._iter_workers()
+        else:
+            inner = self._iter_sync()
+        return self._track(inner)
+
+    def _track(self, inner):
+        """Advance the resume cursor as batches are consumed; roll the
+        epoch when an iteration runs to completion."""
+        for batch in inner:
+            self._in_progress = True
+            self._batch_idx += 1
+            yield batch
+        self._epoch += 1
+        self._batch_idx = 0
+        self._in_progress = False
 
 
 def get_worker_info():

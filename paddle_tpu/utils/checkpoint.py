@@ -90,7 +90,7 @@ class CheckpointManager:
             else (np.asarray(x) if hasattr(x, 'shape') or isinstance(
                 x, (int, float)) else x), tree)
 
-    def _write(self, step: int, host_tree: Any):
+    def _write(self, step: int, host_tree: Any, cursor=None):
         d = self._step_dir(step)
         tmp = d + '.tmp'
         if os.path.exists(tmp):
@@ -105,17 +105,29 @@ class CheckpointManager:
                                        os.path.join(tmp, 'tree_sharded'))
         else:
             serialization.save(host_tree, os.path.join(tmp, 'tree.npz'))
+        committed = {'step': step, 'backend': self.backend}
+        if cursor is not None:
+            committed['dataloader'] = cursor
         with open(os.path.join(tmp, '_COMMITTED'), 'w') as f:
-            json.dump({'step': step, 'backend': self.backend}, f)
+            json.dump(committed, f)
         if os.path.exists(d):
             shutil.rmtree(d)
         os.replace(tmp, d)
         self._gc()
 
-    def save(self, step: int, tree: Any, force: bool = False):
-        """Snapshot `tree` at `step`. Respects save_interval unless forced."""
+    def save(self, step: int, tree: Any, force: bool = False,
+             dataloader: Any = None):
+        """Snapshot `tree` at `step`. Respects save_interval unless forced.
+
+        Pass `dataloader=` to record its mid-epoch cursor
+        ({epoch, batch_idx}, see DataLoader.state_dict) in the
+        _COMMITTED sidecar — outside the tree, so orbax template
+        restores are unaffected — letting resume replay the exact
+        remaining batch sequence (SURVEY §5 "dataloader epoch/seed
+        state")."""
         if not force and not self.should_save(step):
             return False
+        cursor = dataloader.state_dict() if dataloader is not None else None
         self.wait_until_finished()
         # snapshot to host SYNCHRONOUSLY: the train loop mutates live
         # Tensors in place, so deferring materialization to the writer
@@ -123,14 +135,30 @@ class CheckpointManager:
         host_tree = self._to_host(tree)
         if self.async_save:
             self._pending = threading.Thread(
-                target=self._write, args=(step, host_tree), daemon=True)
+                target=self._write, args=(step, host_tree, cursor),
+                daemon=True)
             self._pending.start()
         else:
-            self._write(step, host_tree)
+            self._write(step, host_tree, cursor)
         return True
 
     def restore(self, step: Optional[int] = None,
-                template: Any = None) -> Any:
+                template: Any = None, dataloader: Any = None) -> Any:
+        """Load a checkpoint tree; with `dataloader=`, also push the
+        cursor saved in the _COMMITTED sidecar back into it
+        (DataLoader.set_state_dict)."""
+        tree = self._restore_tree(step, template)
+        if dataloader is not None:
+            actual = step if step is not None else self.latest_step()
+            with open(os.path.join(self._step_dir(actual),
+                                   '_COMMITTED')) as f:
+                meta = json.load(f)
+            if 'dataloader' in meta:
+                dataloader.set_state_dict(meta['dataloader'])
+        return tree
+
+    def _restore_tree(self, step: Optional[int] = None,
+                      template: Any = None) -> Any:
         self.wait_until_finished()
         if step is None:
             step = self.latest_step()

@@ -237,3 +237,89 @@ class TestCheckpointManager:
         assert ck.all_steps() == [1]
         np.testing.assert_array_equal(ck.restore()['w'],
                                       np.ones((128, 128)))
+
+
+class TestMidEpochResume:
+    """VERDICT r4 Next #7: kill a run mid-epoch; resuming must replay the
+    exact remaining batch sequence (upstream: fleet dataset checkpoint)."""
+
+    def _make_loader(self, **kw):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        data = np.arange(40, dtype=np.int64)
+        return DataLoader(TensorDataset([data]), batch_size=4,
+                          shuffle=True, **kw)
+
+    def test_shuffle_is_epoch_deterministic(self):
+        a = [b[0].numpy().tolist() for b in self._make_loader()]
+        b = [b[0].numpy().tolist() for b in self._make_loader()]
+        assert a == b  # epoch-seeded order: reproducible by construction
+        loader = self._make_loader()
+        e0 = [b[0].numpy().tolist() for b in loader]
+        e1 = [b[0].numpy().tolist() for b in loader]
+        assert e0 != e1  # but different across epochs
+
+    @pytest.mark.parametrize('num_workers', [0, 2])
+    def test_resume_replays_remaining_batches(self, num_workers):
+        loader = self._make_loader(num_workers=num_workers)
+        full = []
+        for epoch in range(2):
+            full.append([b[0].numpy().tolist() for b in loader])
+
+        # interrupted run: consume 3 batches of epoch 0, snapshot cursor
+        loader2 = self._make_loader(num_workers=num_workers)
+        it = iter(loader2)
+        seen = [next(it)[0].numpy().tolist() for _ in range(3)]
+        state = loader2.state_dict()
+        assert state == {'epoch': 0, 'batch_idx': 3}
+        del it
+
+        # "new process": fresh loader, restore cursor, drain
+        loader3 = self._make_loader(num_workers=num_workers)
+        loader3.set_state_dict(state)
+        rest = [b[0].numpy().tolist() for b in loader3]
+        assert seen + rest == full[0]
+        # next epoch continues the uninterrupted sequence
+        nxt = [b[0].numpy().tolist() for b in loader3]
+        assert nxt == full[1]
+
+    def test_cursor_through_checkpoint_manager(self, tmp_path):
+        from paddle_tpu.utils.checkpoint import CheckpointManager
+        loader = self._make_loader()
+        it = iter(loader)
+        consumed = [next(it)[0].numpy().tolist() for _ in range(5)]
+        mgr = CheckpointManager(str(tmp_path / 'ck'), backend='npz')
+        mgr.save(0, {'params': {'w': paddle.ones([2])}}, force=True,
+                 dataloader=loader)
+        del it
+
+        loader2 = self._make_loader()
+        tree = mgr.restore(dataloader=loader2)
+        assert 'params' in tree
+        rest = [b[0].numpy().tolist() for b in loader2]
+        base = [b[0].numpy().tolist() for b in self._make_loader()]
+        assert consumed + rest == base
+
+    def test_early_break_gets_fresh_order_next_pass(self):
+        # breaking out of an epoch must NOT replay the same leading
+        # batches on the next pass (that would silently train on a
+        # fixed subset)
+        loader = self._make_loader()
+        first = [next(iter(loader))[0].numpy().tolist()
+                 for _ in range(1)][0]
+        it = iter(loader)
+        again = next(it)[0].numpy().tolist()
+        assert again != first
+
+    def test_iterable_dataset_resume(self):
+        from paddle_tpu.io import DataLoader, IterableDataset
+
+        class Stream(IterableDataset):
+            def __iter__(self):
+                return iter(range(20))
+
+        loader = DataLoader(Stream(), batch_size=4)
+        full = [b.numpy().tolist() for b in loader]
+        loader2 = DataLoader(Stream(), batch_size=4)
+        loader2.set_state_dict({'epoch': 0, 'batch_idx': 2})
+        rest = [b.numpy().tolist() for b in loader2]
+        assert rest == full[2:]

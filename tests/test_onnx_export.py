@@ -1,0 +1,311 @@
+"""paddle.onnx.export converter tests (VERDICT r4 Next #10; upstream
+python/paddle/onnx/export.py).
+
+The `onnx` package is absent in this image, so these tests drive the
+jaxpr→ONNX converter through `_onnx_api`, a minimal in-memory double of
+the onnx helper surface, then EXECUTE the emitted graph with a numpy
+evaluator and compare against the live layer forward. That validates
+node semantics, topology, initializers, and attribute plumbing — the
+protobuf serialization itself is the onnx package's job."""
+import types
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.onnx import build_model, export
+
+
+# ---------------------------------------------------------------------------
+# fake onnx API
+# ---------------------------------------------------------------------------
+
+class _Node:
+    def __init__(self, op_type, inputs, outputs, attrs):
+        self.op_type, self.input, self.output = op_type, inputs, outputs
+        self.attrs = attrs
+
+
+class _ValueInfo:
+    def __init__(self, name, elem_type, shape):
+        self.name, self.elem_type, self.shape = name, elem_type, shape
+
+
+class _Graph:
+    def __init__(self, nodes, name, inputs, outputs, initializer):
+        self.node, self.name = nodes, name
+        self.input, self.output = inputs, outputs
+        self.initializer = initializer
+
+
+class _Model:
+    def __init__(self, graph, opset):
+        self.graph, self.opset_import = graph, opset
+
+    def SerializeToString(self):
+        return b'fake'
+
+
+class _Init:
+    def __init__(self, arr, name):
+        self.name, self.array = name, arr
+
+
+_TP = types.SimpleNamespace(
+    FLOAT=1, UINT8=2, INT8=3, INT16=5, INT32=6, INT64=7, BOOL=9,
+    FLOAT16=10, DOUBLE=11, BFLOAT16=16)
+_TP_TO_NP = {1: np.float32, 2: np.uint8, 3: np.int8, 5: np.int16,
+             6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16,
+             11: np.float64, 16: np.float32}
+
+FAKE_ONNX = types.SimpleNamespace(
+    helper=types.SimpleNamespace(
+        make_node=lambda op, ins, outs, **a: _Node(op, ins, outs, a),
+        make_tensor_value_info=_ValueInfo,
+        make_graph=lambda nodes, name, inputs, outputs, initializer: _Graph(
+            nodes, name, inputs, outputs, initializer),
+        make_model=lambda g, opset_imports: _Model(g, opset_imports),
+        make_opsetid=lambda domain, version: (domain, version),
+    ),
+    numpy_helper=types.SimpleNamespace(from_array=_Init),
+    TensorProto=_TP,
+)
+
+
+# ---------------------------------------------------------------------------
+# numpy evaluator for the emitted graph
+# ---------------------------------------------------------------------------
+
+def _run_graph(model, feeds):
+    env = {i.name: np.asarray(i.array) for i in model.graph.initializer}
+    for vi, arr in zip(model.graph.input, feeds):
+        env[vi.name] = np.asarray(arr)
+    for nd in model.graph.node:
+        x = [env[n] for n in nd.input]
+        a = nd.attrs
+        op = nd.op_type
+        if op == 'Add':
+            r = x[0] + x[1]
+        elif op == 'Sub':
+            r = x[0] - x[1]
+        elif op == 'Mul':
+            r = x[0] * x[1]
+        elif op == 'Div':
+            r = x[0] / x[1]
+        elif op == 'Max':
+            r = np.maximum(x[0], x[1])
+        elif op == 'Min':
+            r = np.minimum(x[0], x[1])
+        elif op == 'Pow':
+            r = np.power(x[0], x[1])
+        elif op == 'Neg':
+            r = -x[0]
+        elif op == 'Exp':
+            r = np.exp(x[0])
+        elif op == 'Log':
+            r = np.log(x[0])
+        elif op == 'Tanh':
+            r = np.tanh(x[0])
+        elif op == 'Sqrt':
+            r = np.sqrt(x[0])
+        elif op == 'Erf':
+            from scipy.special import erf
+            r = erf(x[0])
+        elif op == 'Sigmoid':
+            r = 1.0 / (1.0 + np.exp(-x[0]))
+        elif op == 'Reciprocal':
+            r = 1.0 / x[0]
+        elif op == 'Abs':
+            r = np.abs(x[0])
+        elif op == 'Sign':
+            r = np.sign(x[0])
+        elif op == 'Floor':
+            r = np.floor(x[0])
+        elif op == 'Ceil':
+            r = np.ceil(x[0])
+        elif op == 'Round':
+            r = np.round(x[0])
+        elif op == 'Sin':
+            r = np.sin(x[0])
+        elif op == 'Cos':
+            r = np.cos(x[0])
+        elif op == 'Not':
+            r = ~x[0]
+        elif op == 'Or':
+            r = x[0] | x[1]
+        elif op == 'And':
+            r = x[0] & x[1]
+        elif op == 'IsInf':
+            r = np.isinf(x[0])
+        elif op == 'IsNaN':
+            r = np.isnan(x[0])
+        elif op == 'Where':
+            r = np.where(x[0], x[1], x[2])
+        elif op == 'Equal':
+            r = x[0] == x[1]
+        elif op == 'Greater':
+            r = x[0] > x[1]
+        elif op == 'GreaterOrEqual':
+            r = x[0] >= x[1]
+        elif op == 'Less':
+            r = x[0] < x[1]
+        elif op == 'LessOrEqual':
+            r = x[0] <= x[1]
+        elif op in ('ReduceSum', 'ReduceMax', 'ReduceMin', 'ReduceProd'):
+            # opset 13: ReduceSum takes axes as input; others as attribute
+            if op == 'ReduceSum':
+                assert len(x) == 2 and 'axes' not in a
+                axes = tuple(int(i) for i in x[1])
+            else:
+                assert len(x) == 1 and 'axes' in a
+                axes = tuple(int(i) for i in a['axes'])
+            fn = {'ReduceSum': np.sum, 'ReduceMax': np.max,
+                  'ReduceMin': np.min, 'ReduceProd': np.prod}[op]
+            r = fn(x[0], axis=axes, keepdims=bool(a.get('keepdims', 1)))
+        elif op in ('ArgMax', 'ArgMin'):
+            fn = np.argmax if op == 'ArgMax' else np.argmin
+            r = fn(x[0], axis=a['axis'])
+            if a.get('keepdims', 1):
+                r = np.expand_dims(r, a['axis'])
+        elif op == 'Reshape':
+            r = x[0].reshape([int(i) for i in x[1]])
+        elif op == 'Transpose':
+            r = np.transpose(x[0], a['perm'])
+        elif op == 'Expand':
+            r = np.broadcast_to(x[0], [int(i) for i in x[1]])
+        elif op == 'Concat':
+            r = np.concatenate(x, axis=a['axis'])
+        elif op == 'Slice':
+            starts, ends, axes = x[1], x[2], x[3]
+            steps = x[4] if len(x) > 4 else np.ones_like(starts)
+            sl = [slice(None)] * x[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                sl[int(ax)] = slice(int(s), int(e), int(st))
+            r = x[0][tuple(sl)]
+        elif op == 'Cast':
+            r = x[0].astype(_TP_TO_NP[a['to']])
+        elif op == 'Einsum':
+            r = np.einsum(a['equation'], *x)
+        elif op == 'Conv':
+            pads = a['pads']
+            nd2 = len(pads) // 2
+            t = torch.tensor(np.ascontiguousarray(x[0]), dtype=torch.float64)
+            w = torch.tensor(np.ascontiguousarray(x[1]), dtype=torch.float64)
+            assert pads[:nd2] == pads[nd2:], 'asymmetric pads in test'
+            fn = {1: tF.conv1d, 2: tF.conv2d, 3: tF.conv3d}[nd2]
+            r = fn(t, w, stride=a['strides'], padding=pads[:nd2],
+                   dilation=a['dilations'], groups=a['group']) \
+                .numpy().astype(x[0].dtype)
+        elif op == 'Identity':
+            r = x[0]
+        elif op == 'Mod':
+            r = np.fmod(x[0], x[1]) if a.get('fmod') else np.mod(x[0], x[1])
+        else:
+            raise NotImplementedError(f'evaluator missing {op}')
+        env[nd.output[0]] = r
+    return [env[o.name] for o in model.graph.output]
+
+
+def _export_and_run(layer, specs, feeds):
+    model = build_model(layer, specs, 13, FAKE_ONNX)
+    return model, _run_graph(model, feeds)
+
+
+def _first(out):
+    import jax
+    leaves = jax.tree_util.tree_leaves(out)
+    return leaves
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+RNG = np.random.RandomState(0)
+
+
+class TestConverter:
+    def test_linear(self):
+        m = nn.Linear(6, 4)
+        x = RNG.standard_normal((3, 6)).astype(np.float32)
+        model, got = _export_and_run(m, [InputSpec([None, 6])], [x])
+        want = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-6)
+        assert any(n.op_type == 'Einsum' for n in model.graph.node)
+        # params embedded as initializers
+        assert len(model.graph.initializer) >= 2
+        # dynamic batch dim symbolic
+        assert model.graph.input[0].shape[0] == 'dyn_0'
+
+    def test_mlp_gelu_layernorm(self):
+        m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.LayerNorm(16),
+                          nn.Linear(16, 5), nn.Softmax())
+        m.eval()
+        x = RNG.standard_normal((4, 8)).astype(np.float32)
+        _, got = _export_and_run(m, [InputSpec([4, 8])], [x])
+        want = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-5)
+
+    def test_conv_net(self):
+        m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                          nn.Conv2D(8, 4, 3, stride=2))
+        m.eval()
+        x = RNG.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        model, got = _export_and_run(
+            m, [InputSpec([None, 3, 8, 8])], [x])
+        want = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-5)
+        assert sum(n.op_type == 'Conv' for n in model.graph.node) == 2
+
+    def test_multihead_attention(self):
+        m = nn.MultiHeadAttention(16, 4)
+        m.eval()
+        x = RNG.standard_normal((2, 5, 16)).astype(np.float32)
+        # static shapes: attention's head-split reshapes bake batch size
+        _, got = _export_and_run(m, [InputSpec([2, 5, 16])], [x])
+        want = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-5)
+
+    def test_bf16_params_exported_as_fp32(self):
+        m = nn.Linear(4, 4)
+        m.to(dtype='bfloat16')
+        x = RNG.standard_normal((2, 4)).astype(np.float32)
+
+        class Wrap(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.inner = m
+
+            def forward(self, v):
+                return self.inner(v.astype('bfloat16')).astype('float32')
+
+        w = Wrap()
+        model, got = _export_and_run(w, [InputSpec([None, 4])], [x])
+        want = w(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got[0], want, rtol=2e-2, atol=2e-2)
+        for init in model.graph.initializer:
+            assert str(init.array.dtype) != 'bfloat16'
+
+    def test_gate_without_onnx(self, tmp_path):
+        with pytest.raises(RuntimeError, match='paddle.jit.save'):
+            export(nn.Linear(2, 2), str(tmp_path / 'm'),
+                   input_spec=[InputSpec([1, 2])])
+
+    def test_export_writes_file_with_api(self, tmp_path):
+        p = export(nn.Linear(2, 2), str(tmp_path / 'm'),
+                   input_spec=[InputSpec([1, 2])], _onnx_api=FAKE_ONNX)
+        assert p.endswith('.onnx')
+        with open(p, 'rb') as f:
+            assert f.read() == b'fake'
+
+    def test_unmapped_primitive_message(self):
+        class Weird(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=0)
+
+        with pytest.raises(NotImplementedError, match='paddle.jit.save'):
+            build_model(Weird(), [InputSpec([3, 3])], 13, FAKE_ONNX)
