@@ -346,6 +346,22 @@ class DistTrainStep:
             self._pp_per = self._pp_L // n_stage
             self._pp_nmicro = max(
                 int(st.pipeline_configs.get('accumulate_steps', 1)), 1)
+            # interleaved virtual stages (upstream: hybrid_configs
+            # pp_configs/virtual_pp_degree, Megatron-style)
+            self._pp_vpp = max(int(st.hybrid_configs.get(
+                'virtual_pp_degree',
+                st.pipeline_configs.get('virtual_pp_degree', 1))), 1)
+            if self._pp_vpp > 1 and self._pp_per % self._pp_vpp:
+                raise ValueError(
+                    f'{self._pp_per} blocks/stage not divisible by '
+                    f'virtual_pp_degree {self._pp_vpp}')
+            if self._pp_vpp > 1:
+                mode = st.pipeline_configs.get('schedule_mode')
+                if mode not in (None, '1F1B'):
+                    raise ValueError(
+                        f'virtual_pp_degree>1 uses the interleaved '
+                        f'schedule; schedule_mode={mode!r} is not '
+                        f'compatible')
             pre = self._pp_prefix + '.'
             if any(n.startswith(pre) for n, _ in layer.named_buffers()):
                 raise ValueError('pipelined blocks must be buffer-free '
@@ -511,12 +527,33 @@ class DistTrainStep:
                 hh, _ = lax.scan(body, x, (ks, ps, fps))
                 return hh
 
-            y = gpipe(stage_fn, (keys, stacked, f_stacked), mbs,
-                      mesh=self.mesh,
-                      batch_axis='dp' if self._dp > 1 else None,
-                      schedule=self.strategy.pipeline_configs.get(
-                          'schedule_mode', '1F1B'),
-                      remat=True)
+            if self._pp_vpp > 1:
+                # re-split each [pp, per] stage stack into v chunks of
+                # per//v blocks and arrange DEVICE-major round-robin
+                # ([pp, v, per//v, ...]) for the interleaved schedule
+                from .pipeline import (interleaved_pipeline,
+                                       stack_interleaved_params)
+                v = self._pp_vpp
+                cper = per // v
+                full = (keys, stacked, f_stacked)
+                chunk_trees = [
+                    _tree.tree_map(
+                        lambda p, c=c: p.reshape(
+                            (n_stage * per,) + p.shape[2:])
+                        [c * cper:(c + 1) * cper], full)
+                    for c in range(n_stage * v)]
+                inter = stack_interleaved_params(chunk_trees, n_stage)
+                y = interleaved_pipeline(
+                    stage_fn, inter, mbs, v, mesh=self.mesh,
+                    batch_axis='dp' if self._dp > 1 else None,
+                    remat=True)
+            else:
+                y = gpipe(stage_fn, (keys, stacked, f_stacked), mbs,
+                          mesh=self.mesh,
+                          batch_axis='dp' if self._dp > 1 else None,
+                          schedule=self.strategy.pipeline_configs.get(
+                              'schedule_mode', '1F1B'),
+                          remat=True)
             return y.reshape((B,) + y.shape[2:])
 
         return functional_call(self.layer, outer_p, f_outer, buffers,

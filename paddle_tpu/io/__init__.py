@@ -404,6 +404,8 @@ class DataLoader:
         self._batch_idx = 0
         self._pending_skip = 0
         self._in_progress = False  # a pass started but never completed
+        self._pushed_epoch: Optional[int] = None  # last epoch we seeded
+        self._iter_gen = 0  # only the newest iterator drives the cursor
 
     def __len__(self):
         if self._iterable:
@@ -543,7 +545,21 @@ class DataLoader:
             for _ in threads:  # unblock workers parked on the semaphore
                 inflight.release()
 
+    def _sampler_epoch(self) -> Optional[int]:
+        bs = self.batch_sampler
+        if bs is None:
+            return None
+        if getattr(bs, 'epoch', None) is not None:
+            return bs.epoch
+        return getattr(getattr(bs, 'sampler', None), 'epoch', None)
+
     def __iter__(self):
+        # honor the classic sampler.set_epoch resume idiom: if the user
+        # set an epoch on the (batch) sampler directly since we last
+        # seeded it, adopt it instead of clobbering with our counter
+        ext = self._sampler_epoch()
+        if ext is not None and ext != self._pushed_epoch:
+            self._epoch = int(ext)
         if self._pending_skip == 0:
             if self._in_progress:
                 # a previous pass was abandoned early (break / exception):
@@ -553,22 +569,28 @@ class DataLoader:
                 self._in_progress = False
             self._batch_idx = 0  # fresh (non-resume) pass restarts cursor
         self.set_epoch(self._epoch)  # pin this epoch's shuffle order
+        self._pushed_epoch = self._epoch
         if self.num_workers > 0 and not self._iterable:
             inner = self._iter_workers()
         else:
             inner = self._iter_sync()
-        return self._track(inner)
+        self._iter_gen += 1
+        return self._track(inner, self._iter_gen)
 
-    def _track(self, inner):
+    def _track(self, inner, gen):
         """Advance the resume cursor as batches are consumed; roll the
-        epoch when an iteration runs to completion."""
+        epoch when an iteration runs to completion. Only the newest
+        iterator moves the cursor — a stale concurrent iterator keeps
+        yielding but cannot corrupt resume state."""
         for batch in inner:
-            self._in_progress = True
-            self._batch_idx += 1
+            if gen == self._iter_gen:
+                self._in_progress = True
+                self._batch_idx += 1
             yield batch
-        self._epoch += 1
-        self._batch_idx = 0
-        self._in_progress = False
+        if gen == self._iter_gen:
+            self._epoch += 1
+            self._batch_idx = 0
+            self._in_progress = False
 
 
 def get_worker_info():

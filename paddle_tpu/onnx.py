@@ -49,6 +49,12 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
                 'self-contained StableHLO + params artifact that '
                 'paddle.jit.load runs on cpu/tpu without the original '
                 'model class.') from e
+    if not 13 <= int(opset_version) <= 17:
+        # the emitted node forms (ReduceSum axes-as-input, Reduce* axes
+        # attribute, Einsum, Where) are exactly the opset 13-17 dialect
+        raise ValueError(
+            f'paddle.onnx.export emits opset 13-17 semantics; '
+            f'got opset_version={opset_version}')
     model = build_model(layer, input_spec, opset_version, onnx_api)
     out_path = path if str(path).endswith('.onnx') else str(path) + '.onnx'
     with open(out_path, 'wb') as f:
@@ -70,8 +76,7 @@ def _example_arrays(input_spec):
             dyn = [i for i, s in enumerate(spec.shape) if s is None]
             dt = np.dtype(spec.dtype if isinstance(spec.dtype, str)
                           else str(spec.dtype))
-            arr = np.zeros(shape, dt) if dt.kind in 'iub' \
-                else np.zeros(shape, dt)
+            arr = np.zeros(shape, dt)
         else:
             arr = spec.numpy() if isinstance(spec, Tensor) \
                 else np.asarray(spec)
@@ -188,9 +193,10 @@ class _Converter:
 
     # -- graph pieces -------------------------------------------------------
     def _elem_type(self, dtype):
-        key = _DTYPE_TO_ONNX.get(np.dtype(dtype).name
-                                 if np.dtype(dtype).name != 'bfloat16'
-                                 else 'bfloat16')
+        key = _DTYPE_TO_ONNX.get(np.dtype(dtype).name)
+        if key is None:
+            raise NotImplementedError(
+                f'paddle.onnx.export: dtype {dtype} has no ONNX mapping')
         return getattr(self.api.TensorProto, key)
 
     def add_input(self, name, var, dyn_axes=()):
@@ -202,8 +208,17 @@ class _Converter:
 
     def add_initializer(self, name, arr, var=None):
         arr = np.asarray(arr)
-        if str(arr.dtype) == 'bfloat16':  # no ONNX numpy bf16 container
-            arr = arr.astype(np.float32)
+        if str(arr.dtype) == 'bfloat16':
+            # numpy has no bf16 container: store fp32 and Cast back to
+            # BFLOAT16 so the graph stays type-consistent where the
+            # traced computation runs in bf16
+            self.initializers.append(self.api.numpy_helper.from_array(
+                arr.astype(np.float32), name + '_fp32'))
+            cast = self.node('Cast', [name + '_fp32'],
+                             to=self.api.TensorProto.BFLOAT16)
+            if var is not None:
+                self.set_name(var, cast)
+            return cast
         self.initializers.append(
             self.api.numpy_helper.from_array(arr, name))
         if var is not None:
@@ -264,11 +279,13 @@ class _Converter:
         elif prim == 'square':
             name = self.node('Mul', [ins[0], ins[0]])
         elif prim == 'cbrt':
+            # sign(x) * |x|^(1/3): Pow alone NaNs on negative bases
             third = self.add_initializer(
                 self.fresh('third'),
                 np.asarray(1.0 / 3.0,
                            np.dtype(eqn.invars[0].aval.dtype)))
-            name = self.node('Pow', [ins[0], third])
+            mag = self.node('Pow', [self.node('Abs', [ins[0]]), third])
+            name = self.node('Mul', [self.node('Sign', [ins[0]]), mag])
         elif prim == 'erfc':
             one = self.add_initializer(
                 self.fresh('one'),

@@ -323,3 +323,22 @@ class TestMidEpochResume:
         loader2.set_state_dict({'epoch': 0, 'batch_idx': 2})
         rest = [b.numpy().tolist() for b in loader2]
         assert rest == full[2:]
+
+    def test_external_sampler_set_epoch_is_honored(self):
+        # classic resume idiom: user calls sampler.set_epoch(N) directly
+        loader = self._make_loader()
+        e0 = [b[0].numpy().tolist() for b in loader]
+        e1 = [b[0].numpy().tolist() for b in loader]
+        loader2 = self._make_loader()
+        loader2.batch_sampler.sampler.set_epoch(1)
+        got = [b[0].numpy().tolist() for b in loader2]
+        assert got == e1 and got != e0
+
+    def test_concurrent_iterators_do_not_corrupt_cursor(self):
+        loader = self._make_loader()
+        it1 = iter(loader)
+        next(it1)
+        it2 = iter(loader)  # newest iterator owns the cursor
+        next(it2); next(it2); next(it2)
+        next(it1)  # stale iterator must not advance the cursor
+        assert loader.state_dict()['batch_idx'] == 3

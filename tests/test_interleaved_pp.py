@@ -1,0 +1,160 @@
+"""Interleaved (virtual-stage) pipeline tests (VERDICT r4 Next #6;
+upstream fleet/meta_parallel/pipeline_parallel.py virtual pp): forward
+and gradient parity vs the unpipelined reference on the 8-device mesh,
+plus the statically-measured bubble comparison vs the stacked schedule."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.pipeline import (
+    _simulate_interleaved, interleaved_pipeline,
+    interleaved_schedule_stats, stack_interleaved_params, gpipe,
+    stack_stage_params)
+
+RNG = np.random.RandomState(0)
+
+
+def _chunk_params(n_chunks, h, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{'w': jnp.asarray(rng.standard_normal((h, h)) * 0.3,
+                              jnp.float32),
+             'b': jnp.asarray(rng.standard_normal((h,)) * 0.1,
+                              jnp.float32)}
+            for _ in range(n_chunks)]
+
+
+def _chunk_fn(p, x):
+    return jnp.tanh(x @ p['w'] + p['b'])
+
+
+def _reference(chunks, mbs):
+    def run(mb):
+        h = mb
+        for p in chunks:
+            h = _chunk_fn(p, h)
+        return h
+    return jax.vmap(run)(mbs)
+
+
+class TestSchedule:
+    def test_exact_counts_pp2_v2(self):
+        events, stats = _simulate_interleaved(2, 2, 4)
+        assert stats['chunk_steps'] == 9          # hand-derived
+        assert stats['stacked_chunk_steps'] == 10  # (4+2-1)*2
+        assert stats['bubble_fraction'] < stats['stacked_bubble_fraction']
+        # every (m, c) computed exactly once, on the right device
+        seen = set()
+        for t, row in enumerate(events):
+            for s, ev in enumerate(row):
+                if ev is not None:
+                    m, c = ev
+                    assert c % 2 == s
+                    seen.add((m, c))
+        assert seen == {(m, c) for m in range(4) for c in range(4)}
+
+    @pytest.mark.parametrize('pp,v,n', [(2, 2, 8), (4, 2, 8), (2, 4, 8),
+                                        (4, 4, 16)])
+    def test_bubble_shrinks_with_v(self, pp, v, n):
+        st = interleaved_schedule_stats(pp, v, n)
+        # interleaved fill/drain is (pp-1) chunk-steps; stacked is
+        # (pp-1)*v — the whole point of virtual stages
+        assert st['chunk_steps'] == n * v + (pp - 1)
+        assert st['stacked_chunk_steps'] == (n + pp - 1) * v
+        assert st['chunk_steps'] < st['stacked_chunk_steps']
+        assert st['bubble_fraction'] < st['stacked_bubble_fraction']
+
+    def test_dependencies_respected(self):
+        events, _ = _simulate_interleaved(4, 3, 8)
+        when = {}
+        for t, row in enumerate(events):
+            for s, ev in enumerate(row):
+                if ev is not None:
+                    when[ev] = t
+        for (m, c), t in when.items():
+            if c > 0:
+                assert when[(m, c - 1)] < t
+
+
+@pytest.mark.parametrize('pp,v', [(2, 2), (4, 2), (2, 3)])
+@pytest.mark.slow
+class TestParity:
+    def _mesh(self, pp):
+        devs = np.array(jax.devices()[:pp])
+        return Mesh(devs, ('pp',))
+
+    def test_forward_matches_reference(self, pp, v):
+        h, mb, n_micro = 8, 4, 6
+        chunks = _chunk_params(pp * v, h)
+        stacked = stack_interleaved_params(chunks, pp)
+        x = jnp.asarray(RNG.standard_normal((n_micro, mb, h)), jnp.float32)
+        got = interleaved_pipeline(_chunk_fn, stacked, x, v,
+                                   mesh=self._mesh(pp))
+        want = _reference(chunks, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_grad_matches_reference(self, pp, v):
+        h, mb, n_micro = 4, 2, 4
+        chunks = _chunk_params(pp * v, h, seed=3)
+        stacked = stack_interleaved_params(chunks, pp)
+        x = jnp.asarray(RNG.standard_normal((n_micro, mb, h)), jnp.float32)
+        mesh = self._mesh(pp)
+
+        def loss_pipe(sp):
+            return jnp.sum(
+                interleaved_pipeline(_chunk_fn, sp, x, v, mesh=mesh) ** 2)
+
+        def loss_ref(cs):
+            return jnp.sum(_reference(cs, x) ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_ref = jax.grad(loss_ref)(chunks)
+        g_ref_stacked = stack_interleaved_params(g_ref, pp)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                        jax.tree_util.tree_leaves(g_ref_stacked)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_matches_stacked_gpipe(self, pp, v):
+        # same model run through both schedules must agree
+        h, mb, n_micro = 4, 2, 5
+        chunks = _chunk_params(pp * v, h, seed=7)
+        mesh = self._mesh(pp)
+        x = jnp.asarray(RNG.standard_normal((n_micro, mb, h)), jnp.float32)
+        inter = interleaved_pipeline(
+            _chunk_fn, stack_interleaved_params(chunks, pp), x, v,
+            mesh=mesh)
+
+        def stage_fn(sp, xv):  # stacked: one stage = v consecutive chunks
+            for k in range(v):
+                xv = _chunk_fn(jax.tree_util.tree_map(
+                    lambda p: p[k], sp), xv)
+            return xv
+
+        stage_trees = [
+            jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *chunks[d * v:(d + 1) * v])
+            for d in range(pp)]
+        stacked = stack_stage_params(stage_trees)
+        gp = gpipe(stage_fn, stacked, x, mesh=mesh)
+        # NOTE: stacked gpipe places chunks CONTIGUOUSLY (dev d gets
+        # chunks d*v..), interleaved places them round-robin — but both
+        # compute the same chunk order 0..L-1, so outputs agree
+        np.testing.assert_allclose(np.asarray(inter), np.asarray(gp),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_single_device_fallback(self, pp, v):
+        h = 4
+        chunks = _chunk_params(pp * v, h, seed=1)
+        # build [1, pp*v, ...] layout for n_pp=1 (all chunks local)
+        stacked = stack_interleaved_params(chunks, 1)
+        x = jnp.asarray(RNG.standard_normal((3, 2, h)), jnp.float32)
+        devs = np.array(jax.devices()[:1])
+        got = interleaved_pipeline(_chunk_fn, stacked, x, pp * v,
+                                   mesh=Mesh(devs, ('pp',)))
+        want = _reference(chunks, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)

@@ -250,6 +250,8 @@ class TestConverter:
         want = m(paddle.to_tensor(x)).numpy()
         np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
+
     def test_conv_net(self):
         m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
                           nn.Conv2D(8, 4, 3, stride=2))
