@@ -116,6 +116,7 @@ class TestMathExtras:
 
 
 class TestFunctionalExtras:
+    @pytest.mark.slow
     def test_fold_inverts_unfold(self):
         x = paddle.rand([2, 3, 8, 8])
         cols = F.unfold(x, 2, strides=2)
@@ -129,6 +130,8 @@ class TestFunctionalExtras:
         back = F.fold(cols, (4, 4), 3, strides=1)
         # center cells belong to 9 overlapping 3x3 patches
         assert float(back.numpy()[0, 0, 1, 1]) == pytest.approx(4.0)
+
+    @pytest.mark.slow
 
     def test_affine_grid_identity_and_grid_sample(self):
         theta = paddle.to_tensor(
@@ -206,6 +209,7 @@ class TestNNUtils:
 
 
 class TestFlopsAndSamplers:
+    @pytest.mark.slow
     def test_flops_counts_linear_and_conv(self):
         m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
                           nn.Flatten(), nn.Linear(8 * 64, 10))
@@ -225,6 +229,8 @@ class TestFlopsAndSamplers:
         got = list(s)
         assert sorted(got) == [3, 5, 7] and len(s) == 3
 
+    @pytest.mark.slow
+
     def test_conv3d_transpose_shape(self):
         ct = nn.Conv3DTranspose(2, 3, 3, stride=2, padding=1)
         out = ct(paddle.rand([1, 2, 4, 4, 4]))
@@ -234,6 +240,7 @@ class TestFlopsAndSamplers:
 class TestReviewRegressions:
     """Round-4 review findings — each was a confirmed defect."""
 
+    @pytest.mark.slow
     def test_shufflenet_x0_25_has_own_widths(self):
         from paddle_tpu.vision import models as M
         m = M.shufflenet_v2_x0_25(num_classes=3)

@@ -32,6 +32,9 @@ def _torch_parity(pt_cls, pd_cls, steps=30, lr=0.05, tkw=None, pkw=None,
     np.testing.assert_allclose(pw.numpy(), tw.detach().numpy(), atol=tol)
 
 
+@pytest.mark.slow
+
+
 class TestNewOptimizers:
     """Each optimizer must track torch's trajectory over 30 steps."""
 
@@ -342,6 +345,7 @@ class TestReviewRegressions2:
         # mean(20, -20) = 0 -> parameter unchanged
         assert float(w.numpy()[0]) == -10.0
 
+    @pytest.mark.slow
     def test_repetition_penalty_padded_prompt_runs(self):
         from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
         cfg = LlamaConfig.tiny()

@@ -436,6 +436,8 @@ class TestMisc:
         assert paddle.regularizer.L1Decay is paddle.optimizer.L1Decay
         assert r is not None
 
+    @pytest.mark.slow
+
     def test_deform_conv2d_layer_zero_offset_matches_conv(self):
         rng = np.random.RandomState(0)
         layer = paddle.vision.ops.DeformConv2D(3, 5, 3)

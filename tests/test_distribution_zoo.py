@@ -87,6 +87,7 @@ class TestScalarFamilies:
                                    theirs().variance.numpy(),
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_sample_statistics(self, name, ours, theirs, vals):
         d = ours()
         s = d.sample((4000,)).numpy()
@@ -131,6 +132,7 @@ class TestRsample:
         lambda: D.LogNormal(_t([0.0]), _t([0.5])),
     ], ids=['gamma', 'beta', 'exponential', 'gumbel', 'laplace',
             'lognormal'])
+    @pytest.mark.slow
     def test_rsample_grad_flows_to_params(self, maker):
         d = maker()
         params = [p for p in vars(d).values()
@@ -142,6 +144,7 @@ class TestRsample:
         assert any(g is not None and float(np.abs(g.numpy()).sum()) > 0
                    for g in grads)
 
+    @pytest.mark.slow
     def test_gamma_rsample_pathwise_derivative(self):
         # d E[x] / d rate for Gamma(a, rate) is -a/rate^2; check the
         # implicit-reparam estimate against the closed form
@@ -154,6 +157,7 @@ class TestRsample:
 
 
 class TestDirichletMultinomial:
+    @pytest.mark.slow
     def test_dirichlet_log_prob_entropy(self):
         conc = np.array([[0.8, 1.5, 2.0], [3.0, 1.0, 0.5]], np.float32)
         x = RNG.dirichlet([1.0, 1.0, 1.0], 2).astype(np.float32)
@@ -339,6 +343,8 @@ def test_kl_registry_vs_torch(name, ours, theirs):
                                td.kl_divergence(tp, tq).numpy(),
                                rtol=1e-4, atol=1e-5)
 
+
+@pytest.mark.slow
 
 def test_kl_gumbel_montecarlo():
     # no torch registration for Gumbel/Gumbel; check vs Monte Carlo

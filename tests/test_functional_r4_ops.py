@@ -396,6 +396,7 @@ class TestCTCLoss:
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
     @pytest.mark.parametrize('red', ['mean', 'sum'])
+    @pytest.mark.slow
     def test_grads_vs_torch(self, red):
         logits, labels, in_len, lab_len = _ctc_case(
             13, 3, 6, 5, [13, 9, 11], [5, 3, 4], seed=11)

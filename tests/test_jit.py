@@ -33,6 +33,9 @@ def test_to_static_function():
         f(x, y).numpy(), (x * y + F.relu(x)).numpy(), rtol=1e-6)
 
 
+@pytest.mark.slow
+
+
 def test_trainstep_matches_eager_step():
     paddle.seed(0)
     net_a = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
@@ -67,6 +70,9 @@ def test_trainstep_matches_eager_step():
                                    atol=1e-5)
 
 
+@pytest.mark.slow
+
+
 def test_trainstep_single_compilation():
     net = _mlp()
     opt = Adam(learning_rate=0.01)
@@ -94,6 +100,9 @@ def test_trainstep_threads_bn_buffers():
     step(x, y)
     after = bn._mean.numpy()
     assert not np.allclose(before, after)  # running stats updated under jit
+
+
+@pytest.mark.slow
 
 
 def test_trainstep_loss_decreases():

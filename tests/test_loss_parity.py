@@ -17,6 +17,8 @@ import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 from paddle_tpu.jit import TrainStep
 
+pytestmark = pytest.mark.slow  # full-suite gate tier (VERDICT r4 #9)
+
 STEPS = 60
 LR = 0.05
 

@@ -17,11 +17,14 @@ def _ids(cfg, b=2, s=12, seed=0):
 
 
 class TestLlama:
+    @pytest.mark.slow
     def test_forward_shape_and_gqa(self):
         cfg = LlamaConfig.tiny()  # 4 heads, 2 kv heads -> GQA path
         m = LlamaForCausalLM(cfg)
         logits = m(_ids(cfg))
         assert logits.shape == [2, 12, cfg.vocab_size]
+
+    @pytest.mark.slow
 
     def test_backward_populates_grads(self):
         cfg = LlamaConfig.tiny()
@@ -32,6 +35,7 @@ class TestLlama:
         for n, p in m.named_parameters():
             assert p.grad is not None, n
 
+    @pytest.mark.slow
     def test_overfit_loss_decreases(self):
         cfg = LlamaConfig.tiny(num_hidden_layers=1)
         m = LlamaForCausalLM(cfg)
@@ -47,6 +51,7 @@ class TestLlama:
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0] * 0.6
 
+    @pytest.mark.slow
     def test_generate_cache_matches_full_forward(self):
         """Greedy decode with KV cache must equal re-running the full
         (cache-free) forward each step."""
@@ -66,6 +71,7 @@ class TestLlama:
                 cur = np.concatenate([cur, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(out.numpy(), np.stack(ref, axis=1))
 
+    @pytest.mark.slow
     def test_generate_eos_stops_and_pads(self):
         cfg = LlamaConfig.tiny()
         m = LlamaForCausalLM(cfg).eval()
@@ -76,6 +82,8 @@ class TestLlama:
                             pad_token_id=99)
         o = out.numpy()[0]
         assert o[0] == first and all(t == 99 for t in o[1:])
+
+    @pytest.mark.slow
 
     def test_generate_scores_are_emitted_token_logps(self):
         cfg = LlamaConfig.tiny()
@@ -90,6 +98,8 @@ class TestLlama:
         want = np.take_along_axis(logp, out.numpy().astype(int), 1)[:, 0]
         np.testing.assert_allclose(scores.numpy(), want, atol=1e-4)
 
+    @pytest.mark.slow
+
     def test_generate_min_new_tokens_suppresses_eos(self):
         """EOS must not be emitted before min_new_tokens (upstream
         min_length logits processor)."""
@@ -102,6 +112,8 @@ class TestLlama:
         out, _ = m.generate(ids, max_new_tokens=5, eos_token_id=first,
                             pad_token_id=99, min_new_tokens=5)
         assert all(t != 99 for t in out.numpy()[0])
+
+    @pytest.mark.slow
 
     def test_generate_repetition_penalty_changes_output(self):
         """CTRL penalty must steer greedy decode away from repeats; with
@@ -132,6 +144,8 @@ class TestLlama:
         with pytest.raises(ValueError):
             m.generate(ids, attention_mask=np.ones((1, 3)))
 
+    @pytest.mark.slow
+
     def test_generate_left_padded_matches_unpadded(self):
         """A left-padded prompt (attention_mask) must produce exactly the
         tokens the unpadded prompt produces — pad slots are masked out of
@@ -150,6 +164,7 @@ class TestLlama:
                             eos_token_id=-1)
         np.testing.assert_array_equal(got.numpy(), want.numpy())
 
+    @pytest.mark.slow
     def test_generate_padded_batch_matches_per_sequence(self):
         """Batched generation of different-length prompts (left-padded to a
         common length) must match generating each prompt alone."""
@@ -167,6 +182,8 @@ class TestLlama:
             want, _ = m.generate(p[None, :], max_new_tokens=4,
                                  eos_token_id=-1)
             np.testing.assert_array_equal(got.numpy()[i], want.numpy()[0])
+
+    @pytest.mark.slow
 
     def test_tied_embeddings(self):
         cfg = LlamaConfig.tiny(tie_word_embeddings=True)
@@ -216,6 +233,9 @@ def _ref_beam(m, prompt, K, max_new, eos, pad, length_penalty=0.0):
     norm = np.maximum(lengths, 1).astype(np.float32) ** length_penalty
     best = int(np.argmax(scores / norm))
     return out[best], float((scores / norm)[best])
+
+
+@pytest.mark.slow
 
 
 class TestBeamSearch:
@@ -270,6 +290,7 @@ class TestBeamSearch:
 
 
 class TestGPT:
+    @pytest.mark.slow
     def test_forward_and_generate(self):
         cfg = GPTConfig.tiny()
         m = GPTForCausalLM(cfg).eval()
@@ -283,6 +304,8 @@ class TestGPT:
                 np.testing.assert_array_equal(out.numpy()[:, step], nxt)
                 cur = np.concatenate([cur, nxt[:, None]], axis=1)
 
+    @pytest.mark.slow
+
     def test_sampling_reproducible_with_seed(self):
         cfg = GPTConfig.tiny()
         m = GPTForCausalLM(cfg).eval()
@@ -295,6 +318,7 @@ class TestGPT:
                           eos_token_id=-1)
         np.testing.assert_array_equal(a.numpy(), b.numpy())
 
+    @pytest.mark.slow
     def test_overfit(self):
         cfg = GPTConfig.tiny(num_hidden_layers=1)
         m = GPTForCausalLM(cfg)
@@ -314,12 +338,15 @@ class TestGPT:
 
 
 class TestBertErnie:
+    @pytest.mark.slow
     def test_bert_model_outputs(self):
         cfg = BertConfig.tiny()
         m = BertModel(cfg)
         seq, pooled = m(_ids(cfg))
         assert seq.shape == [2, 12, cfg.hidden_size]
         assert pooled.shape == [2, cfg.hidden_size]
+
+    @pytest.mark.slow
 
     def test_bert_mlm_ignore_index(self):
         cfg = BertConfig.tiny()
@@ -331,6 +358,7 @@ class TestBertErnie:
         assert np.isfinite(float(loss.numpy()))
         assert logits.shape == [2, 12, cfg.vocab_size]
 
+    @pytest.mark.slow
     def test_bert_cls_with_padding_mask(self):
         cfg = BertConfig.tiny()
         m = BertForSequenceClassification(cfg, num_classes=3)

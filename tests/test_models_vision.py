@@ -10,6 +10,8 @@ from paddle_tpu.vision import (LeNet, MobileNetV2, mobilenet_v2, resnet18,
 from paddle_tpu.vision.datasets import Cifar10, MNIST
 from paddle_tpu.vision import transforms as T
 
+pytestmark = pytest.mark.slow  # full-suite gate tier (VERDICT r4 #9)
+
 
 class TestModels:
     def test_lenet_forward_and_overfit(self):

@@ -25,6 +25,8 @@ class TestFunctionalParity:
         b = theirs(torch.tensor(x), **kw).numpy()
         assert_close(a, b, tol)
 
+    @pytest.mark.slow
+
     def test_activations(self):
         x = np.random.randn(4, 7).astype(np.float32)
         for ours, theirs in [
@@ -253,6 +255,7 @@ class TestFunctionalParity:
 
 
 class TestLayers:
+    @pytest.mark.slow
     def test_grad_flow_through_block(self):
         blk = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
         x = paddle.randn([2, 5, 16])
@@ -298,6 +301,7 @@ class TestLayers:
         lin(paddle.randn([1, 3]))
         assert calls == [1]
 
+    @pytest.mark.slow
     def test_mha_cache_decode(self):
         mha = nn.MultiHeadAttention(16, 4)
         mha.eval()

@@ -200,6 +200,7 @@ class TestLRSchedulers:
 
 
 class TestEndToEnd:
+    @pytest.mark.slow
     def test_mlp_overfit(self):
         paddle.seed(1)
         net = nn.Sequential(nn.Linear(2, 32), nn.Tanh(), nn.Linear(32, 1))

@@ -21,6 +21,7 @@ def _qkv(b=1, sq=256, sk=256, h=2, hkv=None, d=64, seed=0):
 
 
 @pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.slow
 def test_flash_fwd_matches_xla(causal):
     q, k, v = _qkv()
     ours = flash_attention_fwd(q, k, v, causal=causal, interpret=True)
@@ -29,12 +30,18 @@ def test_flash_fwd_matches_xla(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
+
+
 def test_flash_fwd_gqa():
     q, k, v = _qkv(h=4, hkv=2)
     ours = flash_attention_fwd(q, k, v, causal=True, interpret=True)
     ref = _attention_xla(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
 
 
 def test_flash_fwd_lse():
@@ -55,6 +62,7 @@ def test_flash_fwd_rejects_indivisible():
 
 
 @pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.slow
 def test_flash_own_backward_matches_xla(causal):
     """VERDICT r2 #8: the repo owns its flash bwd (dq/dk/dv kernels)."""
     q, k, v = _qkv(sq=128, sk=128)
@@ -73,6 +81,8 @@ def test_flash_own_backward_matches_xla(causal):
             np.asarray(ours), np.asarray(ref), rtol=5e-3, atol=1e-4,
             err_msg=f'd{name} mismatch (causal={causal})')
 
+
+@pytest.mark.slow
 
 def test_flash_own_backward_gqa():
     q, k, v = _qkv(sq=128, sk=128, h=4, hkv=2)
@@ -93,6 +103,8 @@ def test_flash_own_backward_gqa():
             err_msg=f'd{name} mismatch (gqa)')
 
 
+@pytest.mark.slow
+
 def test_flash_own_multiblock_causal():
     """Exercise the block-skip paths: 2x2 q/k block grid, causal."""
     q, k, v = _qkv(sq=256, sk=256, d=64, seed=3)
@@ -111,6 +123,8 @@ def test_flash_own_multiblock_causal():
             np.asarray(ours), np.asarray(ref), rtol=5e-3, atol=1e-5,
             err_msg=f'd{name} mismatch (multiblock)')
 
+
+@pytest.mark.slow
 
 def test_rms_norm_kernel_and_grad():
     rng = np.random.default_rng(5)

@@ -102,6 +102,8 @@ class TestSparse:
                                       [[0, 1, 2], [1, 2, 0]])
         np.testing.assert_array_equal(s.values().numpy(), [1, 2, 3])
 
+    @pytest.mark.slow
+
     def test_csr_create_and_convert(self):
         c = paddle.sparse.sparse_csr_tensor(
             [0, 1, 2, 3], [1, 2, 0], [1.0, 2.0, 3.0], [3, 3])
@@ -113,6 +115,8 @@ class TestSparse:
         csr = self._coo().to_sparse_csr()
         np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
         np.testing.assert_array_equal(csr.cols().numpy(), [1, 2, 0])
+
+    @pytest.mark.slow
 
     def test_add_subtract_multiply(self):
         a, b = self._coo(), self._coo()
@@ -154,6 +158,8 @@ class TestSparse:
         t = paddle.sparse.transpose(self._coo(), [1, 0])
         np.testing.assert_array_equal(t.to_dense().numpy(),
                                       self._coo().to_dense().numpy().T)
+
+    @pytest.mark.slow
 
     def test_coalesce_merges_duplicates(self):
         s = paddle.sparse.sparse_coo_tensor(
@@ -205,6 +211,7 @@ class TestQuantization:
         with pytest.raises(ValueError, match='no quantizable'):
             paddle.quantization.PTQ().quantize(NoLinear())
 
+    @pytest.mark.slow
     def test_qat_trains_through_fake_quant(self):
         paddle.seed(1)
         m = _TwoLayer()
