@@ -220,19 +220,15 @@ class TrainStep:
         self._n_calls = 0
         self.compile_count = 0
 
-        def step_fn(params, opt_state, buffers, frozen, key, lr, batch):
+        def loss_and_grads(params, buffers, frozen, key, batch):
             self.compile_count += 1  # python-level: counts traces, not runs
 
             def loss_of(pv):
                 inputs, labels = batch
-
-                def fwd(args):
-                    out, new_bufs = functional_call(
-                        self.layer, pv, frozen, buffers,
-                        args if isinstance(args, tuple) else (args,), {},
-                        rng_key=key)
-                    return out, new_bufs
-                out, new_bufs = fwd(inputs)
+                out, new_bufs = functional_call(
+                    self.layer, pv, frozen, buffers,
+                    inputs if isinstance(inputs, tuple) else (inputs,), {},
+                    rng_key=key)
                 with autograd.functional_scope():
                     wrapped_out = _tree.tree_map(Tensor, out)
                     wrapped_lab = _tree.tree_map(
@@ -243,10 +239,25 @@ class TrainStep:
                 return loss_v, new_bufs
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
+            return loss, grads, new_bufs
+
+        def step_fn(params, opt_state, buffers, frozen, key, lr, batch):
+            loss, grads, new_bufs = loss_and_grads(
+                params, buffers, frozen, key, batch)
             new_params, new_opt = self.optimizer.apply_gradients(
                 grads, params, opt_state, lr)
             return loss, new_params, new_opt, new_bufs
 
+        self._offload = getattr(optimizer, '_offload', None) == 'host'
+        if self._offload:
+            # host-offloaded optimizer state: jit ONLY the grad step
+            # (params persist in HBM, no donation); the update streams
+            # per-leaf through optimizer.offload.OffloadEngine
+            from ..optimizer.offload import OffloadEngine
+
+            self._jitted_grads = jax.jit(loss_and_grads,
+                                         donate_argnums=(1,))
+            self._engine = OffloadEngine(optimizer)
         self._jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     @staticmethod
@@ -265,9 +276,15 @@ class TrainStep:
         lower().compile() hits the jit cache, so after the step has run
         once this costs no recompile."""
         params, frozen, buffers = functional_state(self.layer)
+        key = jax.random.fold_in(self._step_key_root, 0)
+        if self._offload:
+            # offload path: HBM peak is the grad step (slots stream
+            # through one leaf at a time and never sit in HBM)
+            return self._jitted_grads.lower(
+                params, buffers, frozen, key,
+                self._as_batch(inputs, labels)).compile().memory_analysis()
         if self._opt_state is None:
             self._opt_state = self.optimizer.init_state(params)
-        key = jax.random.fold_in(self._step_key_root, 0)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         return self._jitted.lower(
             params, self._opt_state, buffers, frozen, key, lr,
@@ -275,14 +292,22 @@ class TrainStep:
 
     def __call__(self, inputs, labels):
         params, frozen, buffers = functional_state(self.layer)
-        if self._opt_state is None:
+        if self._opt_state is None and not self._offload:
             self._opt_state = self.optimizer.init_state(params)
         key = jax.random.fold_in(self._step_key_root, self._n_calls)
         self._n_calls += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         batch = self._as_batch(inputs, labels)
-        loss, new_params, self._opt_state, new_bufs = self._jitted(
-            params, self._opt_state, buffers, frozen, key, lr, batch)
+        if self._offload:
+            if self._opt_state is None:
+                self._opt_state = self._engine.init_state(params)
+            loss, grads, new_bufs = self._jitted_grads(
+                params, buffers, frozen, key, batch)
+            new_params, self._opt_state = self._engine.apply(
+                grads, params, self._opt_state, lr)
+        else:
+            loss, new_params, self._opt_state, new_bufs = self._jitted(
+                params, self._opt_state, buffers, frozen, key, lr, batch)
         # write back into the live Layer
         pmap = dict(self.layer.named_parameters())
         for n, v in new_params.items():

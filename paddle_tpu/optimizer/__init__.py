@@ -278,16 +278,24 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 amsgrad=False, moment_dtype=None, name=None):
+                 amsgrad=False, moment_dtype=None, offload=None, name=None):
         """moment_dtype: storage dtype for m/v (default fp32). 'bfloat16'
         halves optimizer HBM — how billion-param models fit one chip; the
-        moment *update* still computes in fp32 either way."""
+        moment *update* still computes in fp32 either way.
+
+        offload='host' keeps m/v (and masters) in pinned host memory and
+        streams per-leaf updates through HBM (upstream: fleet sharding
+        `offload`; see optimizer/offload.py). Honored by jit.TrainStep."""
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._amsgrad = amsgrad
         self._moment_dtype = jnp.dtype(moment_dtype) if moment_dtype \
             else jnp.float32
+        if offload not in (None, 'host'):
+            raise ValueError(f"offload must be None or 'host', got "
+                             f"{offload!r}")
+        self._offload = offload
 
     def _init_slots(self, p):
         s = {'moment1': jnp.zeros(p.shape, self._moment_dtype),
@@ -320,10 +328,10 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, amsgrad=False,
-                 moment_dtype=None, name=None):
+                 moment_dtype=None, offload=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         amsgrad, moment_dtype)
+                         amsgrad, moment_dtype, offload)
         self._apply_decay_fn = apply_decay_param_fun
 
     def _decoupled_decay(self):

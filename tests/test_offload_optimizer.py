@@ -1,0 +1,82 @@
+"""Host-offloaded optimizer state tests (VERDICT r4 Next #3; upstream
+fleet/meta_parallel/sharding group_sharded offload): the streamed
+pinned-host update must be bit-equivalent to the in-HBM fused update,
+and the slots must actually live in host memory."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import TrainStep
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.LayerNorm(16),
+                         nn.Linear(16, 4))
+
+
+def _loss(logits, labels):
+    return F.cross_entropy(logits, labels)
+
+
+def _run(offload, steps=5, **opt_kw):
+    m = _model()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m.parameters(),
+        weight_decay=0.01, offload=('host' if offload else None), **opt_kw)
+    step = TrainStep(m, _loss, opt)
+    rng = np.random.RandomState(0)
+    xs = [rng.standard_normal((4, 8)).astype(np.float32)
+          for _ in range(steps)]
+    ys = [rng.randint(0, 4, (4,)) for _ in range(steps)]
+    losses = [float(step(x, y).numpy()) for x, y in zip(xs, ys)]
+    return losses, {k: v.numpy() for k, v in m.state_dict().items()}, step
+
+
+class TestOffloadParity:
+    def test_losses_and_params_match_fused(self):
+        base_l, base_p, _ = _run(offload=False)
+        off_l, off_p, _ = _run(offload=True)
+        np.testing.assert_allclose(base_l, off_l, rtol=1e-6)
+        for k in base_p:
+            np.testing.assert_allclose(base_p[k], off_p[k], rtol=1e-6,
+                                       atol=1e-7)
+
+    def test_bf16_moments_match_fused(self):
+        base_l, base_p, _ = _run(offload=False, moment_dtype='bfloat16')
+        off_l, off_p, _ = _run(offload=True, moment_dtype='bfloat16')
+        np.testing.assert_allclose(base_l, off_l, rtol=1e-5)
+        for k in base_p:
+            np.testing.assert_allclose(base_p[k], off_p[k], rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_grad_clip_composes(self):
+        def run(off):
+            m = _model()
+            opt = paddle.optimizer.AdamW(
+                learning_rate=1e-2, parameters=m.parameters(),
+                grad_clip=paddle.nn.ClipGradByGlobalNorm(0.1),
+                offload=('host' if off else None))
+            step = TrainStep(m, _loss, opt)
+            x = np.random.RandomState(1).standard_normal(
+                (4, 8)).astype(np.float32)
+            y = np.array([0, 1, 2, 3])
+            return [float(step(x, y).numpy()) for _ in range(3)]
+        np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+    def test_slots_live_in_host_memory(self):
+        _, _, step = _run(offload=True, steps=2)
+        leaves = [v for s in
+                  paddle.jit.__dict__['_tree'].tree_leaves(
+                      step._opt_state['slots'])
+                  for v in [s]]
+        assert leaves, 'no slot arrays'
+        kinds = {getattr(v.sharding, 'memory_kind', None) for v in leaves}
+        assert kinds == {'pinned_host'}, kinds
+
+    def test_invalid_offload_value_rejected(self):
+        with pytest.raises(ValueError):
+            paddle.optimizer.Adam(parameters=_model().parameters(),
+                                  offload='disk')
