@@ -480,3 +480,157 @@ def _rms_bwd(eps, interpret, res, g):
 
 
 rms_norm.defvjp(_rms_fwd, _rms_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax cross-entropy over the vocab dim (VERDICT r4 #5;
+# upstream analogue: paddle/phi/kernels/gpu/cross_entropy_kernel.cu)
+# ---------------------------------------------------------------------------
+
+def _ce_fwd_kernel(lab_ref, x_ref, loss_ref, lse_ref, m_s, s_s, t_s, *,
+                   n_vblocks, block_v, vocab):
+    """Single-pass online-softmax CE forward: grid (rows, vocab-seq).
+    Scratch carries running (max, expsum, target-logit) per row; the
+    logits tile is read from HBM exactly ONCE (the XLA path reads it
+    for the max pass and again for the exp-sum pass)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        s_s[:] = jnp.zeros_like(s_s)
+        t_s[:] = jnp.zeros_like(t_s)
+
+    xf = x_ref[:].astype(jnp.float32)  # [rows, block_v]
+    rows = xf.shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, xf.shape, 1) + j * block_v
+    inb = cols < vocab
+    xf = jnp.where(inb, xf, _NEG_INF)
+    m_old = m_s[:, 0]
+    m_new = jnp.maximum(m_old, jnp.max(xf, axis=1))
+    scale = jnp.exp(m_old - m_new)
+    s_s[:, 0] = s_s[:, 0] * scale + jnp.sum(
+        jnp.exp(xf - m_new[:, None]), axis=1)
+    m_s[:, 0] = m_new
+    lab = lab_ref[:]  # [rows] int32
+    hit = (cols == lab[:, None]) & inb
+    t_s[:, 0] = t_s[:, 0] + jnp.sum(
+        jnp.where(hit, x_ref[:].astype(jnp.float32), 0.0), axis=1)
+
+    @pl.when(j == n_vblocks - 1)
+    def _fin():
+        lse = m_s[:, 0] + jnp.log(s_s[:, 0])
+        lse_ref[:] = lse
+        loss_ref[:] = lse - t_s[:, 0]
+
+
+def _ce_bwd_kernel(lab_ref, g_ref, x_ref, lse_ref, dx_ref, *, block_v,
+                   vocab):
+    """dx = (softmax(x) - onehot(lab)) * g, tile-local (no scan state):
+    grid (rows, vocab)."""
+    j = pl.program_id(1)
+    xf = x_ref[:].astype(jnp.float32)
+    cols = jax.lax.broadcasted_iota(jnp.int32, xf.shape, 1) + j * block_v
+    p = jnp.exp(xf - lse_ref[:][:, None])
+    onehot = (cols == lab_ref[:][:, None]).astype(jnp.float32)
+    dx = (p - onehot) * g_ref[:][:, None]
+    inb = cols < vocab
+    dx_ref[:] = jnp.where(inb, dx, 0.0).astype(dx_ref.dtype)
+
+
+def _ce_pad(n, b):
+    return -(-n // b) * b
+
+
+def softmax_cross_entropy_fwd(logits, labels, block_rows=256,
+                              block_v=2048, interpret=False):
+    """(per-row nll [N] f32, lse [N] f32) for logits [N, V], labels [N]
+    int32. Single HBM pass over the logits."""
+    n, v = logits.shape
+    np_, vp = _ce_pad(n, block_rows), _ce_pad(v, block_v)
+    if np_ != n:
+        logits = jnp.pad(logits, ((0, np_ - n), (0, 0)))
+        labels = jnp.pad(labels, (0, np_ - n))
+    if vp != v:
+        logits = jnp.pad(logits, ((0, 0), (0, vp - v)))
+    n_vblocks = vp // block_v
+    loss, lse = pl.pallas_call(
+        functools.partial(_ce_fwd_kernel, n_vblocks=n_vblocks,
+                          block_v=block_v, vocab=v),
+        grid=(np_ // block_rows, n_vblocks),
+        in_specs=[
+            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+            pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((np_,), jnp.float32),
+            jax.ShapeDtypeStruct((np_,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_rows, 1), jnp.float32),
+            pltpu.VMEM((block_rows, 1), jnp.float32),
+            pltpu.VMEM((block_rows, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'arbitrary')),
+        interpret=interpret,
+    )(labels.astype(jnp.int32), logits)
+    return loss[:n], lse[:n]
+
+
+def softmax_cross_entropy_bwd(logits, labels, lse, g, block_rows=256,
+                              block_v=2048, interpret=False):
+    """dlogits for the fused CE (one fused HBM pass, bf16 out)."""
+    n, v = logits.shape
+    np_, vp = _ce_pad(n, block_rows), _ce_pad(v, block_v)
+    if np_ != n:
+        logits = jnp.pad(logits, ((0, np_ - n), (0, 0)))
+        labels = jnp.pad(labels, (0, np_ - n))
+        lse = jnp.pad(lse, (0, np_ - n))
+        g = jnp.pad(g, (0, np_ - n))
+    if vp != v:
+        logits = jnp.pad(logits, ((0, 0), (0, vp - v)))
+    dx = pl.pallas_call(
+        functools.partial(_ce_bwd_kernel, block_v=block_v, vocab=v),
+        grid=(np_ // block_rows, vp // block_v),
+        in_specs=[
+            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+            pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((np_, vp), logits.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel')),
+        interpret=interpret,
+    )(labels.astype(jnp.int32), g, logits, lse)
+    return dx[:n, :v]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_cross_entropy(logits, labels, interpret=False):
+    """Differentiable fused CE: per-row nll [N] for [N, V] logits.
+    Residuals are (bf16 logits, f32 lse) — no fp32 [N, V] buffer ever
+    exists; backward recomputes softmax tile-by-tile."""
+    return _sce_fwd(logits, labels, interpret)[0]
+
+
+def _sce_fwd(logits, labels, interpret):
+    loss, lse = softmax_cross_entropy_fwd(logits, labels,
+                                          interpret=interpret)
+    return loss, (logits, labels, lse)
+
+
+def _sce_bwd(interpret, res, g):
+    logits, labels, lse = res
+    dx = softmax_cross_entropy_bwd(logits, labels, lse, g,
+                                   interpret=interpret)
+    return dx, None
+
+
+softmax_cross_entropy.defvjp(_sce_fwd, _sce_bwd)
