@@ -71,27 +71,37 @@ def _configs(on_tpu):
 
 def _7b_configs():
     """Llama-2 7B-shaped ladder (BASELINE headline #2): FULL 7B
-    hidden/FFN/head geometry (h=4096, ffn=11008, 32 heads, seq 4096),
-    depth reduced to what fits one 16 GB chip (bf16 params + bf16 Adam
-    moments are ~6 bytes/param: 32 layers = 40 GB, 8 layers = 11 GB),
-    full-block remat on. Reported per-layer metrics are geometry-honest;
-    the depth reduction is flagged in the output JSON."""
+    hidden/FFN/head geometry (h=4096, ffn=11008, 32 heads, seq 4096).
+
+    r5: Adam moments host-offloaded (optimizer offload='host',
+    VERDICT r4 #3 — upstream fleet sharding `offload`) so HBM holds only
+    bf16 params + grads + activations: 24 layers ≈ 10.3 GB params and
+    16 layers ≈ 7.1 GB now fit where the r4 in-HBM-moments ceiling was
+    8 layers. Deepest-first ladder; each rung flags depth + offload, and
+    the streamed-moment transfer cost shows up honestly in step_time."""
     from paddle_tpu.nlp import LlamaConfig
-    shape = dict(vocab_size=32000, hidden_size=4096,
-                 intermediate_size=11008, num_attention_heads=32,
-                 num_key_value_heads=32, max_position_embeddings=4096,
-                 num_hidden_layers=8)
-    l8_dots = LlamaConfig(use_recompute='dots_no_batch', **shape)
-    l8_full = LlamaConfig(use_recompute=True, **shape)
+
+    def mk(layers, remat):
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_attention_heads=32, num_key_value_heads=32,
+            max_position_embeddings=4096, num_hidden_layers=layers,
+            use_recompute=remat)
+    # no 24L rung: bf16 params+grads alone are 20.6 GB — past the chip's
+    # HBM no matter where the moments live; 16L (7.1+7.1 GB) is the
+    # deepest grads-in-HBM point and runs with offloaded moments
     return [
-        ('llama2_7b_shape_8L', l8_dots, 1, 4096, 6, 2, 'bfloat16'),
-        ('llama2_7b_shape_8L', l8_full, 4, 4096, 6, 2, 'bfloat16'),
-        ('llama2_7b_shape_8L', l8_full, 2, 4096, 6, 2, 'bfloat16'),
-        ('llama2_7b_shape_8L', l8_full, 2, 2048, 6, 2, 'bfloat16'),
+        ('llama2_7b_shape_16L', mk(16, True), 1, 2048, 4, 1, 'bfloat16',
+         'host'),
+        ('llama2_7b_shape_8L', mk(8, 'dots_no_batch'), 1, 4096, 6, 2,
+         'bfloat16', None),
+        ('llama2_7b_shape_8L', mk(8, True), 2, 2048, 6, 2, 'bfloat16',
+         None),
     ]
 
 
-def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
+def _run_config(name, cfg, batch, seq, steps, warmup, dtype,
+                offload=None):
     import jax
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
@@ -108,7 +118,8 @@ def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
         multi_precision=(dtype == 'bfloat16' and not big),
         # >1B params: bf16 moments are the difference between fitting a
         # single 16GB chip and OOM (fp32 m+v alone would be 10.7 GB)
-        moment_dtype=('bfloat16' if big else None))
+        moment_dtype=('bfloat16' if big else None),
+        offload=offload)
 
     def loss_fn(logits, labels):
         return F.cross_entropy(
@@ -138,6 +149,7 @@ def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
     except Exception:
         pass  # AOT introspection is best-effort; never kill the bench
 
+    result_offload = offload is not None
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     # model FLOPs: 3x forward (fwd + 2x bwd); fwd = 2*N_matmul*B*S weight
     # matmuls + 4*B*S^2*H attention matmuls per layer (remat recompute
@@ -159,7 +171,50 @@ def _run_config(name, cfg, batch, seq, steps, warmup, dtype):
         'params_m': round(n_params / 1e6, 1),
         'batch': batch, 'seq': seq, 'dtype': dtype,
         'peak_hbm_gb': round(peak_hbm / 2**30, 2),
+        'offload_optimizer': result_offload,
+        'layers': cfg.num_hidden_layers,
     }
+
+
+def _run_7b_overfit(steps=200, target=7.0):
+    """Correctness signal for the 7B geometry (VERDICT r4 Weak #3 / #4):
+    ~200 AdamW steps on ONE fixed small batch must drive the loss well
+    under ln(32000)=10.37 — a throughput-shaped block that can't learn
+    would stay pinned near random init."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_attention_heads=32, num_key_value_heads=32,
+        max_position_embeddings=4096, num_hidden_layers=8,
+        use_recompute=True)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(),
+        moment_dtype='bfloat16')
+    step = TrainStep(
+        model, lambda logits, labels: F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1])),
+        opt)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 512))
+    first = None
+    losses = []
+    for i in range(steps):
+        loss = float(step(ids, ids).numpy())
+        losses.append(loss)
+        if first is None:
+            first = loss
+        if loss < target and i >= 20:
+            break
+    return {'first_loss': round(first, 4),
+            'last_loss': round(losses[-1], 4),
+            'steps': len(losses), 'target': target,
+            'reached_target': losses[-1] < target}
 
 
 def _bench_flash_kernels():
@@ -171,25 +226,25 @@ def _bench_flash_kernels():
     import jax
     import jax.numpy as jnp
     from paddle_tpu.ops import pallas_kernels as pk
-    rng = np.random.RandomState(0)
-    shape = (4, 2048, 16, 128)
-    q0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-    k0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-    v0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-    n = 10
-
-    def time_fn(f):
-        def body(i, q):
-            dq = jax.grad(lambda a: jnp.sum(
-                f(a, k0, v0).astype(jnp.float32)))(q)
-            return (q + dq * jnp.bfloat16(1e-4)).astype(jnp.bfloat16)
-        g = jax.jit(lambda q: jax.lax.fori_loop(0, n, body, q))
-        jax.block_until_ready(g(q0))  # compile + warm
-        t0 = time.perf_counter()
-        jax.block_until_ready(g(q0))
-        return (time.perf_counter() - t0) / n * 1e3
-
     try:
+        rng = np.random.RandomState(0)
+        shape = (4, 2048, 16, 128)
+        q0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        k0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        v0 = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        n = 10
+
+        def time_fn(f):
+            def body(i, q):
+                dq = jax.grad(lambda a: jnp.sum(
+                    f(a, k0, v0).astype(jnp.float32)))(q)
+                return (q + dq * jnp.bfloat16(1e-4)).astype(jnp.bfloat16)
+            g = jax.jit(lambda q: jax.lax.fori_loop(0, n, body, q))
+            jax.block_until_ready(g(q0))  # compile + warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(g(q0))
+            return (time.perf_counter() - t0) / n * 1e3
+
         own_ms = time_fn(lambda a, b, c: pk.flash_attention_own(
             a, b, c, True, 512, 512, False))
         lib_ms = time_fn(lambda a, b, c: pk.flash_attention(a, b, c,
@@ -197,6 +252,8 @@ def _bench_flash_kernels():
         return {'flash_own_ms': round(own_ms, 2),
                 'flash_lib_ms': round(lib_ms, 2)}
     except Exception as e:  # never let the micro-bench kill the headline
+        print(f'# flash bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
         return {'flash_bench_error': type(e).__name__}
 
 
@@ -211,15 +268,30 @@ def _free_device_memory():
     gc.collect()
     jax.clear_caches()
     gc.collect()
+    # bench phases are independent: anything still resident between
+    # phases is garbage — delete it outright (tape cycles can survive
+    # two gc passes; r5: the 7B overfit's moments kept 7 GB pinned and
+    # OOMed the flash micro-bench's input allocation)
+    for a in jax.live_arrays():
+        try:
+            a.delete()
+        except Exception:
+            pass
+    gc.collect()
 
 
 def _run_ladder(configs):
     """Run the first config of a ladder that fits; (name, result) or
     (None, None) if every rung OOMs."""
-    for name, cfg, batch, seq, steps, warmup, dtype in configs:
+    for name, cfg, batch, seq, steps, warmup, dtype, *rest in configs:
         try:
-            return name, _run_config(name, cfg, batch, seq, steps, warmup,
-                                     dtype)
+            print(f'# rung {name} b{batch} s{seq} {dtype} '
+                  f'offload={rest[0] if rest else None}', file=sys.stderr)
+            res = _run_config(name, cfg, batch, seq, steps, warmup,
+                              dtype, *rest)
+            print(f'# rung {name} OK: {res["step_time_s"]:.3f}s/step',
+                  file=sys.stderr)
+            return name, res
         except Exception as e:
             msg = str(e).lower()
             if 'resource' in msg or 'memory' in msg or 'oom' in msg \
@@ -232,7 +304,7 @@ def _run_ladder(configs):
     return None, None
 
 
-def main():
+def _phase_headline():
     import jax
     on_tpu = jax.default_backend() not in ('cpu',)
     metric_name, result = _run_ladder(_configs(on_tpu))
@@ -241,7 +313,6 @@ def main():
     # only a different MODEL counts as a fallback (batch shrink within the
     # 1.3B config still benches the 1.3B headline)
     fell_back = on_tpu and metric_name != 'gpt3_1p3b'
-
     out = {
         'metric': f'{metric_name}_pretrain_tokens_per_sec_per_chip',
         'value': round(result['tokens_per_sec'], 1),
@@ -258,34 +329,75 @@ def main():
     }
     if result.get('peak_hbm_gb'):
         out['peak_hbm_gb'] = result['peak_hbm_gb']
-    if on_tpu:
-        # BASELINE headline #2: Llama-2 7B geometry (depth-reduced to fit
-        # one chip; reduction flagged — see _7b_configs). Never let the
-        # secondary ladder kill the already-measured headline.
-        _free_device_memory()
-        try:
-            name7, res7 = _run_ladder(_7b_configs())
-        except Exception as e:
-            name7, res7 = None, None
-            print(f'# 7B ladder failed: {type(e).__name__}: {e}',
-                  file=sys.stderr)
-        if res7 is not None:
-            out['llama2_7b_shape'] = {
-                'tokens_per_sec': round(res7['tokens_per_sec'], 1),
-                'mfu': round(res7['mfu'], 4),
-                'step_time_s': round(res7['step_time_s'], 4),
-                'loss': round(res7['loss'], 4),
-                'params_m': res7['params_m'],
-                'batch': res7['batch'], 'seq': res7['seq'],
-                'peak_hbm_gb': res7.get('peak_hbm_gb'),
-                'layers': 8, 'layers_full_7b': 32,
-                'depth_reduced_to_fit_hbm': True,
-            }
-        else:
-            out['llama2_7b_shape'] = {'error': 'all 7B-shape rungs OOMed'}
-        _free_device_memory()
-        out.update(_bench_flash_kernels())
+    return out
+
+
+def _phase_7b():
+    name7, res7 = _run_ladder(_7b_configs())
+    if res7 is None:
+        return {'llama2_7b_shape': {'error': 'all 7B-shape rungs OOMed'}}
+    return {'llama2_7b_shape': {
+        'tokens_per_sec': round(res7['tokens_per_sec'], 1),
+        'mfu': round(res7['mfu'], 4),
+        'step_time_s': round(res7['step_time_s'], 4),
+        'loss': round(res7['loss'], 4),
+        'params_m': res7['params_m'],
+        'batch': res7['batch'], 'seq': res7['seq'],
+        'peak_hbm_gb': res7.get('peak_hbm_gb'),
+        'layers': res7['layers'], 'layers_full_7b': 32,
+        'depth_reduced_to_fit_hbm': res7['layers'] < 32,
+        'optimizer_state_host_offload': res7['offload_optimizer'],
+    }}
+
+
+PHASES = {
+    'headline': _phase_headline,
+    '7b': _phase_7b,
+    'overfit': lambda: {'llama2_7b_overfit': _run_7b_overfit()},
+    'flash': _bench_flash_kernels,
+}
+
+
+def _run_phase_subprocess(phase, timeout_s):
+    """Each phase gets a FRESH process: a failed/OOMed rung cannot
+    fragment or leak HBM into the next phase (r5: after a too-deep 7B
+    attempt OOMed, even previously-fitting rungs OOMed in-process)."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, '--phase', phase],
+            capture_output=True, text=True, timeout=timeout_s)
+        sys.stderr.write(proc.stderr)
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() \
+            else ''
+        if proc.returncode != 0 or not line:
+            return {f'{phase}_error': f'exit {proc.returncode}'}
+        return json.loads(line)
+    except subprocess.TimeoutExpired:
+        return {f'{phase}_error': 'timeout'}
+    except Exception as e:
+        return {f'{phase}_error': type(e).__name__}
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == '--phase':
+        print(json.dumps(PHASES[sys.argv[2]]()))
+        return 0
+    # The orchestrating parent must NOT import jax: on the single-chip
+    # tunnel, a parent holding the TPU client blocks its own phase
+    # subprocesses from attaching (r5: the 7b phase hung for 15 min
+    # behind the parent's device handle).
+    out = _run_phase_subprocess('headline', 1500)
+    if 'metric' not in out:
+        raise RuntimeError(f'headline phase failed: {out}')
+    if str(out.get('device', '')).lower() in ('cpu', ''):
+        print(json.dumps(out))  # CPU smoke: headline only
+        return 0
+    out.update(_run_phase_subprocess('7b', 1500))
+    out.update(_run_phase_subprocess('overfit', 1200))
+    out.update(_run_phase_subprocess('flash', 600))
     print(json.dumps(out))
+    return 0
 
 
 if __name__ == '__main__':
