@@ -1001,7 +1001,33 @@ def _fused_softmax_ce(logits2d, safe_labels, valid):
     forward saves only (low-precision logits, fp32 lse); backward is a
     single fused elementwise pass (softmax minus iota-one-hot). This is
     what makes large-vocab LM training fit in HBM (a [B*S, V] fp32 copy
-    at GPT vocab sizes is ~2GB per buffer)."""
+    at GPT vocab sizes is ~2GB per buffer).
+
+    On TPU with a wide vocab the pallas online-softmax kernel takes over:
+    its forward reads the logits from HBM once (XLA's lowering reads
+    twice — max pass then exp-sum pass), which matters exactly when the
+    [B*S, V] logits dominate HBM traffic."""
+    from ..ops import pallas as _pallas
+    if (_pallas.pallas_ce_enabled() and logits2d.shape[-1] >= 8192
+            and logits2d.shape[-1] % 128 == 0):
+        try:
+            from ..ops import pallas_kernels as _pk
+            per = _pk.softmax_cross_entropy(logits2d, safe_labels)
+            return jnp.where(valid, per, 0.0)
+        except Exception as e:
+            # trace-time failure only — a Mosaic compile/runtime error
+            # inside an outer jit is NOT catchable here and will surface
+            # to the caller (use PADDLE_TPU_DISABLE_PALLAS_CE then)
+            import warnings
+            warnings.warn(f'pallas fused CE unavailable, using the XLA '
+                          f'path: {type(e).__name__}: {e}')
+    return _fused_softmax_ce_xla(logits2d, safe_labels, valid)
+
+
+def _fused_softmax_ce_xla(logits2d, safe_labels, valid):
+    """The XLA custom_vjp arm of _fused_softmax_ce (importable on its
+    own so the bench races the pallas kernel against the ACTUAL
+    fallback implementation, not a strawman)."""
 
     @jax.custom_vjp
     def ce(x):

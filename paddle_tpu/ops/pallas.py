@@ -30,6 +30,15 @@ def _pallas_enabled() -> bool:
         return False
 
 
+@functools.lru_cache(None)
+def pallas_ce_enabled() -> bool:
+    """Gate for the fused cross-entropy kernel (separable from the flash
+    gate so either can be disabled in isolation while benchmarking)."""
+    if os.environ.get('PADDLE_TPU_DISABLE_PALLAS_CE'):
+        return False
+    return _pallas_enabled()
+
+
 def rms_norm(v, epsilon=1e-6, axis=-1):
     """x / sqrt(mean(x^2) + eps). XLA fuses this; kept as the single
     choke-point so a pallas kernel can slot in for very wide rows."""
