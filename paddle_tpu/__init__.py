@@ -14,7 +14,7 @@ from .dtype import (bfloat16, bool_, complex64, complex128, finfo, float16,
 from .framework import (CPUPlace, CUDAPlace, Generator, Place, TPUPlace,
                         XLAPlace, device_guard, get_default_dtype, get_device,
                         seed, set_default_dtype, set_device)
-from .tensor import Parameter, Tensor
+from .tensor import Parameter, Tensor, set_printoptions
 
 # full op surface (also attaches Tensor methods/operators)
 from .ops import *  # noqa: F401,F403
@@ -37,9 +37,10 @@ from . import hapi
 from .hapi import Model
 from .hapi import callbacks_mod as callbacks
 from .serialization import load, save
-from .nn.layer import ParamAttr
+from .nn.layer import LazyGuard, ParamAttr
 from .optimizer import L1Decay, L2Decay
 
+from . import hub
 from . import regularizer
 from . import audio
 from . import geometric

@@ -48,7 +48,19 @@ class Embedding(Layer):
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.XavierNormal())
         if padding_idx is not None:
-            self.weight._data = self.weight._data.at[padding_idx].set(0.0)
+            if self.weight.is_lazy:
+                # LazyGuard: fold the padding-row zeroing into the
+                # recorded initializer so initialize() replays it too
+                base, shp, dt = self.weight._lazy_init
+
+                def _init_with_pad_row(shape, dtype, _base=base):
+                    v = _base(shape, dtype)
+                    v = v.value if isinstance(v, Tensor) else v
+                    return v.at[padding_idx].set(0.0)
+                self.weight._lazy_init = (_init_with_pad_row, shp, dt)
+            else:
+                self.weight._data = \
+                    self.weight._data.at[padding_idx].set(0.0)
 
     def forward(self, x):
         return F.embedding(x, self.weight, padding_idx=self.padding_idx)

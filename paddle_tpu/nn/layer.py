@@ -18,6 +18,25 @@ from ..dtype import convert_dtype
 from ..tensor import Parameter, Tensor
 from . import initializer as I
 
+_LAZY_GUARDS: List[object] = []
+
+
+class LazyGuard:
+    """Defer parameter materialization (upstream paddle.LazyGuard,
+    python/paddle/fluid/lazy_init.py). Layers built inside the guard
+    allocate NO device memory: each Parameter holds a ShapeDtypeStruct
+    plus its recorded initializer and materializes at `.initialize()`.
+    TPU-native payoff: build a bigger-than-HBM model skeleton, decide
+    shardings over the mesh, then initialize shard-by-shard."""
+
+    def __enter__(self):
+        _LAZY_GUARDS.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _LAZY_GUARDS.pop()
+        return False
+
 
 class ParamAttr:
     """Parameter configuration (upstream: python/paddle/base/param_attr.py)."""
@@ -135,9 +154,21 @@ class Layer:
         else:
             init = I.XavierUniform()
         shape = tuple(int(s) for s in shape)
-        val = init(shape, dt)
-        p = Parameter(val, name=(attr.name if attr else None) or '',
-                      trainable=(attr.trainable if attr else True))
+        if _LAZY_GUARDS:
+            # LazyGuard: no device allocation — the Parameter carries a
+            # ShapeDtypeStruct plus its recorded initializer; material-
+            # ization happens at p.initialize() (after the caller has
+            # e.g. placed a >HBM model's shards across a mesh)
+            import jax as _jax
+            p = Parameter(
+                _jax.ShapeDtypeStruct(shape, jnp.dtype(convert_dtype(dt))),
+                name=(attr.name if attr else None) or '',
+                trainable=(attr.trainable if attr else True))
+            p._lazy_init = (init, shape, dt)
+        else:
+            val = init(shape, dt)
+            p = Parameter(val, name=(attr.name if attr else None) or '',
+                          trainable=(attr.trainable if attr else True))
         if attr is not None:
             p.optimize_attr['learning_rate'] = attr.learning_rate
             p.regularizer = attr.regularizer

@@ -176,6 +176,20 @@ def numel(x):
     return Tensor(jnp.asarray(int(np.prod(np.shape(to_jax(x)))), INT64))
 
 
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def shape(x):
+    """Runtime shape as an int32 tensor (upstream paddle.shape returns a
+    1-D LoDTensor of the input's dimensions)."""
+    return Tensor(jnp.asarray(np.shape(to_jax(x)), jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(np.ndim(to_jax(x)), jnp.int32))
+
+
 # -- random creators -------------------------------------------------------
 
 def rand(shape, dtype=None, name=None):

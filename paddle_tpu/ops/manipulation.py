@@ -41,6 +41,10 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
     return defop(f, name='flatten')(x)
 
 
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._rebind(flatten(x, start_axis, stop_axis))
+
+
 def squeeze(x, axis=None, name=None):
     def f(v):
         if axis is None:

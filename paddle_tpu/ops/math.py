@@ -11,6 +11,7 @@ import builtins
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ._helpers import defop
 
@@ -240,6 +241,35 @@ def polygamma(x, n, name=None):
 
 def signbit(x, name=None):
     return defop(lambda v: jnp.signbit(v), name='signbit')(x)
+
+
+def isposinf(x, name=None):
+    return defop(lambda v: jnp.isposinf(v), name='isposinf')(x)
+
+
+def isneginf(x, name=None):
+    return defop(lambda v: jnp.isneginf(v), name='isneginf')(x)
+
+
+def positive(x, name=None):
+    return defop(lambda v: jnp.positive(v), name='positive')(x)
+
+
+def negative(x, name=None):
+    return defop(lambda v: jnp.negative(v), name='negative')(x)
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma (upstream paddle.multigammaln):
+    log Γ_p(x) = p(p-1)/4·log π + Σ_{i=1..p} lgamma(x + (1-i)/2)."""
+    import jax.lax as lax
+    p = int(p)
+
+    def f(v):
+        const = p * (p - 1) / 4.0 * np.log(np.pi)
+        terms = [lax.lgamma(v + (1.0 - i) / 2.0) for i in range(1, p + 1)]
+        return const + sum(terms)
+    return defop(f, name='multigammaln')(x)
 
 
 def sinc(x, name=None):

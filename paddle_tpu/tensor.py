@@ -32,6 +32,20 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
+_PRINT_OPTIONS = {'precision': 4, 'threshold': 40, 'edgeitems': 3,
+                  'linewidth': 80}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Repr formatting for Tensor (upstream paddle.set_printoptions;
+    sci_mode accepted for signature parity — numpy picks the notation)."""
+    for k, v in (('precision', precision), ('threshold', threshold),
+                 ('edgeitems', edgeitems), ('linewidth', linewidth)):
+        if v is not None:
+            _PRINT_OPTIONS[k] = int(v)
+
+
 class Tensor:
     __slots__ = ('_data', 'stop_gradient', 'grad', '_node', '_leaf_index',
                  'name', 'persistable', '_dist_spec', '_grad_hooks',
@@ -243,7 +257,10 @@ class Tensor:
     def __repr__(self):
         try:
             vals = np.asarray(self._data)
-            body = np.array2string(vals, precision=4, threshold=40)
+            body = np.array2string(vals, precision=_PRINT_OPTIONS['precision'],
+                                   threshold=_PRINT_OPTIONS['threshold'],
+                                   edgeitems=_PRINT_OPTIONS['edgeitems'],
+                                   max_line_width=_PRINT_OPTIONS['linewidth'])
         except Exception:
             body = '<traced>'
         return (f'Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}, '
@@ -258,7 +275,8 @@ class Tensor:
 
 class Parameter(Tensor):
     """Trainable leaf tensor (upstream: paddle/fluid/framework.py Parameter)."""
-    __slots__ = ('trainable', 'optimize_attr', 'regularizer', 'initializer_info')
+    __slots__ = ('trainable', 'optimize_attr', 'regularizer',
+                 'initializer_info', '_lazy_init')
 
     def __init__(self, data, name: str = '', trainable: bool = True):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -266,6 +284,22 @@ class Parameter(Tensor):
         self.optimize_attr = {'learning_rate': 1.0}
         self.regularizer = None
         self.persistable = True
+        self._lazy_init = None
+
+    def initialize(self):
+        """Materialize a parameter created under LazyGuard (upstream
+        lazy-init params run their recorded init op here). No-op for
+        eagerly-created parameters."""
+        if self._lazy_init is not None:
+            init, shape, dt = self._lazy_init
+            self._lazy_init = None
+            val = init(shape, dt)
+            self._data = val.value if isinstance(val, Tensor) else val
+        return self
+
+    @property
+    def is_lazy(self):
+        return self._lazy_init is not None
 
 
 class _IndexBox:

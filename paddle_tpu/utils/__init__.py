@@ -1,6 +1,7 @@
 """paddle_tpu.utils — checkpointing, logging, misc support."""
 from . import checkpoint  # noqa: F401
 from . import logging  # noqa: F401
+from . import unique_name  # noqa: F401
 
 
 class _DLPack:

@@ -26,6 +26,10 @@ SURFACE = [
     'topk', 'sort', 'argsort', 'unique', 'flip', 'roll',
     'repeat_interleave', 'take_along_axis', 'put_along_axis', 'diag',
     'diagonal', 'kron', 'seed', 'save', 'load', 'grad', 'no_grad',
+    'is_tensor', 'shape', 'rank', 'isposinf', 'isneginf', 'positive',
+    'negative', 'multigammaln', 'flatten_', 'set_printoptions', 'LazyGuard',
+    'hub.load', 'hub.list', 'hub.help', 'utils.unique_name.generate',
+    'utils.unique_name.guard', 'utils.unique_name.switch',
     'set_device', 'get_device', 'CPUPlace', 'CUDAPlace', 'Model',
     # linalg
     'linalg.cholesky', 'linalg.qr', 'linalg.svd', 'linalg.inv',

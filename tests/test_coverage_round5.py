@@ -1,0 +1,134 @@
+"""Round-5 surface additions: small top-level ops, printoptions,
+unique_name, LazyGuard lazy parameter init, and paddle.hub (local)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSmallOps:
+    def test_is_tensor(self):
+        assert paddle.is_tensor(paddle.ones([2]))
+        assert not paddle.is_tensor(np.ones(2))
+
+    def test_shape_rank(self):
+        x = paddle.ones([2, 3, 4])
+        assert paddle.shape(x).numpy().tolist() == [2, 3, 4]
+        assert int(paddle.rank(x).numpy()) == 3
+
+    def test_inf_sign_ops(self):
+        x = paddle.to_tensor([float('inf'), -float('inf'), 1.0, -2.0])
+        assert paddle.isposinf(x).numpy().tolist() == [True, False, False,
+                                                       False]
+        assert paddle.isneginf(x).numpy().tolist() == [False, True, False,
+                                                       False]
+        np.testing.assert_allclose(paddle.positive(x[2:]).numpy(),
+                                   [1.0, -2.0])
+        np.testing.assert_allclose(paddle.negative(x[2:]).numpy(),
+                                   [-1.0, 2.0])
+
+    def test_multigammaln_vs_scipy(self):
+        from scipy.special import multigammaln as ref
+        x = np.array([3.2, 5.5, 9.1])
+        for p in (1, 2, 3):
+            got = paddle.multigammaln(paddle.to_tensor(x), p).numpy()
+            want = np.array([ref(v, p) for v in x])
+            np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_flatten_inplace(self):
+        x = paddle.ones([2, 3, 4])
+        y = paddle.flatten_(x, 1, 2)
+        assert y is x and x.shape == [2, 12]
+
+    def test_set_printoptions(self):
+        paddle.set_printoptions(precision=2)
+        try:
+            s = repr(paddle.to_tensor([3.14159]))
+            assert '3.14' in s and '3.1416' not in s
+        finally:
+            paddle.set_printoptions(precision=4)
+
+
+class TestUniqueName:
+    def test_generate_sequence(self):
+        from paddle_tpu.utils import unique_name
+        with unique_name.guard():
+            assert unique_name.generate('fc') == 'fc_0'
+            assert unique_name.generate('fc') == 'fc_1'
+            assert unique_name.generate('conv') == 'conv_0'
+
+    def test_guard_scoping_and_prefix(self):
+        from paddle_tpu.utils import unique_name
+        with unique_name.guard():
+            a = unique_name.generate('x')
+            with unique_name.guard('blk_'):
+                assert unique_name.generate('x') == 'blk_x_0'
+            # inner guard did not advance the outer sequence
+            assert unique_name.generate('x') == 'x_1'
+            assert a == 'x_0'
+
+
+class TestLazyGuard:
+    def test_lazy_params_materialize(self):
+        paddle.seed(7)
+        with paddle.LazyGuard():
+            net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                       paddle.nn.Linear(16, 4))
+        ps = list(net.parameters())
+        assert all(p.is_lazy for p in ps)
+        # metadata is available before materialization
+        assert ps[0].shape == [8, 16] and str(ps[0].dtype) == 'float32'
+        for p in ps:
+            p.initialize()
+        assert not any(p.is_lazy for p in ps)
+        out = net(paddle.ones([2, 8]))
+        assert out.shape == [2, 4]
+
+    def test_initialize_matches_eager_under_same_seed(self):
+        paddle.seed(11)
+        eager = paddle.nn.Linear(6, 5)
+        paddle.seed(11)
+        with paddle.LazyGuard():
+            lazy = paddle.nn.Linear(6, 5)
+        for p in lazy.parameters():
+            p.initialize()
+        np.testing.assert_allclose(eager.weight.numpy(),
+                                   lazy.weight.numpy())
+
+    def test_lazy_embedding_padding_idx(self):
+        paddle.seed(3)
+        with paddle.LazyGuard():
+            emb = paddle.nn.Embedding(10, 4, padding_idx=0)
+        emb.weight.initialize()
+        w = emb.weight.numpy()
+        np.testing.assert_allclose(w[0], 0.0)
+        assert np.abs(w[1:]).sum() > 0
+
+    def test_eager_param_initialize_is_noop(self):
+        lin = paddle.nn.Linear(3, 3)
+        w = lin.weight.numpy()
+        lin.weight.initialize()
+        np.testing.assert_allclose(lin.weight.numpy(), w)
+
+
+class TestHub:
+    def test_local_hub_roundtrip(self, tmp_path):
+        (tmp_path / 'hubconf.py').write_text(
+            "import paddle_tpu as paddle\n"
+            "def tiny_mlp(width=4):\n"
+            "    '''A tiny MLP.'''\n"
+            "    return paddle.nn.Linear(2, width)\n")
+        d = str(tmp_path)
+        assert 'tiny_mlp' in paddle.hub.list(d)
+        assert 'tiny MLP' in paddle.hub.help(d, 'tiny_mlp')
+        m = paddle.hub.load(d, 'tiny_mlp', width=6)
+        assert m(paddle.ones([1, 2])).shape == [1, 6]
+
+    def test_remote_source_rejected(self, tmp_path):
+        with pytest.raises(RuntimeError, match='network'):
+            paddle.hub.load('user/repo', 'model', source='github')
+
+    def test_missing_entry_point(self, tmp_path):
+        (tmp_path / 'hubconf.py').write_text('x = 1\n')
+        with pytest.raises(ValueError, match='entry point'):
+            paddle.hub.load(str(tmp_path), 'nope')
