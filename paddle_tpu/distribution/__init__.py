@@ -929,3 +929,13 @@ def _kl_independent(p, q):
             'kl_divergence between Independents with different '
             'reinterpreted_batch_ndims')
     return p._sum_event(kl_divergence(p.base, q.base))
+
+
+# the remaining upstream families live in families2.py; imported last so
+# its `from . import Distribution, ...` sees the bases defined above
+from .families2 import (Binomial, Cauchy, Chi2,  # noqa: E402
+                        ContinuousBernoulli, LKJCholesky,
+                        MultivariateNormal)
+
+__all__ += ['Binomial', 'Cauchy', 'Chi2', 'ContinuousBernoulli',
+            'LKJCholesky', 'MultivariateNormal']

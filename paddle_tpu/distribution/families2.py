@@ -1,0 +1,448 @@
+"""Remaining upstream distribution families (upstream:
+python/paddle/distribution/{binomial,cauchy,chi2,continuous_bernoulli,
+multivariate_normal,lkj_cholesky}.py).
+
+Same TPU-native contract as the rest of the zoo: densities/statistics are
+pure jnp computations recorded on the tape via apply_op; sampling uses the
+stateless threefry stream; rsample is provided where upstream has a
+reparameterized path."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy import special as jsp
+
+from ..tensor import Tensor, apply_op, to_jax
+
+
+# imported at the END of distribution/__init__, after the base classes
+# exist on the package module — so this direct import is not circular
+from . import Distribution, Gamma, _as_t, _key, register_kl
+
+class Binomial(Distribution):
+    """Binomial(total_count n, probs p) (upstream
+    distribution/binomial.py)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _as_t(total_count)
+        self.probs = _as_t(probs)
+
+    @property
+    def mean(self):
+        return apply_op(lambda n, p: n * p, self.total_count,
+                        self.probs, _name='binomial_mean')
+
+    @property
+    def variance(self):
+        return apply_op(lambda n, p: n * p * (1 - p), self.total_count,
+                        self.probs, _name='binomial_var')
+
+    def sample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(n, p):
+            base = jnp.broadcast_shapes(n.shape, p.shape)
+            return jax.random.binomial(k, n, p, shape=shape + base)
+        return apply_op(f, self.total_count, self.probs,
+                        _name='binomial_sample')
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            comb = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1))
+            return comb + jsp.xlogy(v, p) + jsp.xlog1py(n - v, -p)
+        return apply_op(f, _as_t(value), self.total_count, self.probs,
+                        _name='binomial_log_prob')
+
+    def entropy(self):
+        """Exact entropy by support summation (support is concrete:
+        total_count is data, not a traced value)."""
+        nmax = int(np.max(np.asarray(to_jax(self.total_count))))
+
+        def f(n, p):
+            ks = jnp.arange(nmax + 1, dtype=jnp.float32)
+            kshape = ks.reshape((-1,) + (1,) * max(n.ndim, p.ndim))
+            lp = (jsp.gammaln(n + 1) - jsp.gammaln(kshape + 1)
+                  - jsp.gammaln(n - kshape + 1)
+                  + jsp.xlogy(kshape, p) + jsp.xlog1py(n - kshape, -p))
+            lp = jnp.where(kshape <= n, lp, -jnp.inf)
+            return -jnp.sum(jnp.where(jnp.isfinite(lp),
+                                      jnp.exp(lp) * lp, 0.0), axis=0)
+        return apply_op(f, self.total_count, self.probs,
+                        _name='binomial_entropy')
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) (upstream distribution/cauchy.py). Mean and
+    variance are undefined and raise, as upstream does."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+
+    @property
+    def mean(self):
+        raise ValueError('Cauchy distribution has no mean')
+
+    @property
+    def variance(self):
+        raise ValueError('Cauchy distribution has no variance')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(m, g):
+            base = jnp.broadcast_shapes(m.shape, g.shape)
+            return m + g * jax.random.cauchy(k, shape + base,
+                                             jnp.float32)
+        return apply_op(f, self.loc, self.scale, _name='cauchy_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, m, g):
+            z = (v - m) / g
+            return -math.log(math.pi) - jnp.log(g) - jnp.log1p(z * z)
+        return apply_op(f, _as_t(value), self.loc, self.scale,
+                        _name='cauchy_log_prob')
+
+    def entropy(self):
+        return apply_op(lambda g: jnp.log(4 * math.pi * g),
+                        self.scale, _name='cauchy_entropy')
+
+    def cdf(self, value):
+        def f(v, m, g):
+            return jnp.arctan((v - m) / g) / math.pi + 0.5
+        return apply_op(f, _as_t(value), self.loc, self.scale,
+                        _name='cauchy_cdf')
+
+class Chi2(Gamma):
+    """Chi-squared(df) = Gamma(df/2, rate=1/2) (upstream
+    distribution/chi2.py). Inherits Gamma's sampling/density — and
+    the registered Gamma-Gamma KL via MRO dispatch."""
+
+    def __init__(self, df, name=None):
+        df = _as_t(df)
+        super().__init__(concentration=df * 0.5, rate=0.5)
+        self.df = df
+
+class ContinuousBernoulli(Distribution):
+    """CB(λ) on [0,1] (upstream distribution/continuous_bernoulli.py;
+    Loaiza-Ganem & Cunningham 2019). `lims` brackets the unstable
+    region around λ=0.5 where the closed forms 0/0 — inside it a
+    Taylor expansion is used, as upstream does."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _as_t(probs)
+        self._lims = lims
+
+    def _unstable(self, lam):
+        lo, hi = self._lims
+        return (lam > lo) & (lam < hi)
+
+    def _log_norm(self, lam):
+        """log C(λ), C = 2 atanh(1-2λ)/(1-2λ) for λ≠1/2, 2 at 1/2."""
+        safe = jnp.where(self._unstable(lam), 0.25, lam)
+        x = 1.0 - 2.0 * safe
+        exact = jnp.log(2.0 * jnp.arctanh(x) / x)
+        t = 1.0 - 2.0 * lam  # small inside lims
+        taylor = math.log(2.0) + (t * t) / 3.0 + (t ** 4) * 2.0 / 15.0
+        return jnp.where(self._unstable(lam), taylor, exact)
+
+    @property
+    def mean(self):
+        def f(lam):
+            safe = jnp.where(self._unstable(lam), 0.25, lam)
+            exact = safe / (2.0 * safe - 1.0) \
+                + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+            t = lam - 0.5
+            taylor = 0.5 + t / 3.0  # series about λ=1/2
+            return jnp.where(self._unstable(lam), taylor, exact)
+        return apply_op(f, self.probs, _name='cb_mean')
+
+    @property
+    def variance(self):
+        def f(lam):
+            safe = jnp.where(self._unstable(lam), 0.25, lam)
+            x = 1.0 - 2.0 * safe
+            at = jnp.arctanh(x)
+            exact = safe * (safe - 1.0) / (x * x) + 1.0 / (4.0 * at * at)
+            t = lam - 0.5
+            taylor = 1.0 / 12.0 - (t * t) / 15.0
+            return jnp.where(self._unstable(lam), taylor, exact)
+        return apply_op(f, self.probs, _name='cb_var')
+
+    def icdf(self, value):
+        def f(u, lam):
+            safe = jnp.where(self._unstable(lam), 0.25, lam)
+            num = jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+            den = jnp.log(safe) - jnp.log1p(-safe)
+            return jnp.where(self._unstable(lam), u, num / den)
+        return apply_op(f, _as_t(value), self.probs, _name='cb_icdf')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(lam):
+            u = jax.random.uniform(k, shape + lam.shape, jnp.float32)
+            safe = jnp.where(self._unstable(lam), 0.25, lam)
+            num = jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+            den = jnp.log(safe) - jnp.log1p(-safe)
+            return jnp.where(self._unstable(lam), u, num / den)
+        return apply_op(f, self.probs, _name='cb_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, lam):
+            return (jsp.xlogy(v, lam) + jsp.xlog1py(1.0 - v, -lam)
+                    + self._log_norm(lam))
+        return apply_op(f, _as_t(value), self.probs,
+                        _name='cb_log_prob')
+
+    def entropy(self):
+        def f(lam):
+            safe = jnp.where(self._unstable(lam), 0.25, lam)
+            exact_mean = safe / (2.0 * safe - 1.0) \
+                + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+            t = lam - 0.5
+            mu = jnp.where(self._unstable(lam), 0.5 + t / 3.0,
+                           exact_mean)
+            return -(self._log_norm(lam) + jsp.xlogy(mu, lam)
+                     + jsp.xlog1py(1.0 - mu, -lam))
+        return apply_op(f, self.probs, _name='cb_entropy')
+
+class MultivariateNormal(Distribution):
+    """MVN(loc, covariance_matrix | precision_matrix | scale_tril)
+    (upstream distribution/multivariate_normal.py). Internally
+    parameterized by the Cholesky factor L — every density/sampling
+    op is a triangular solve or matmul, which XLA maps onto the
+    MXU."""
+
+    def __init__(self, loc, covariance_matrix=None,
+                 precision_matrix=None, scale_tril=None, name=None):
+        self.loc = _as_t(loc)
+        given = [a is not None for a in
+                 (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError('pass exactly one of covariance_matrix, '
+                             'precision_matrix, scale_tril')
+        if scale_tril is not None:
+            self.scale_tril = _as_t(scale_tril)
+        elif covariance_matrix is not None:
+            self.scale_tril = apply_op(jnp.linalg.cholesky,
+                                       _as_t(covariance_matrix),
+                                       _name='mvn_chol')
+        else:
+            def inv_chol(prec):
+                # prec = Lp Lpᵀ  ⇒  cov = Lp⁻ᵀ Lp⁻¹ (batched)
+                lp = jnp.linalg.cholesky(prec)
+                eye = jnp.broadcast_to(
+                    jnp.eye(prec.shape[-1], dtype=prec.dtype), prec.shape)
+                linv = jax.scipy.linalg.solve_triangular(
+                    lp, eye, lower=True)
+                cov = jnp.swapaxes(linv, -1, -2) @ linv
+                return jnp.linalg.cholesky(cov)
+            self.scale_tril = apply_op(inv_chol, _as_t(precision_matrix),
+                                       _name='mvn_prec_chol')
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        return apply_op(lambda l: l @ jnp.swapaxes(l, -1, -2),
+                        self.scale_tril, _name='mvn_cov')
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda l: jnp.sum(l * l, axis=-1), self.scale_tril,
+            _name='mvn_var')
+
+    def rsample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+
+        def f(mu, l):
+            d = l.shape[-1]
+            base = jnp.broadcast_shapes(mu.shape[:-1], l.shape[:-2])
+            eps = jax.random.normal(k, shape + base + (d,), jnp.float32)
+            return mu + jnp.einsum('...ij,...j->...i', l, eps)
+        return apply_op(f, self.loc, self.scale_tril,
+                        _name='mvn_sample')
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(v, mu, l):
+            d = l.shape[-1]
+            diff = v - mu
+            # solve_triangular does not broadcast batch dims — align them
+            bshape = jnp.broadcast_shapes(diff.shape[:-1], l.shape[:-2])
+            diff = jnp.broadcast_to(diff, bshape + diff.shape[-1:])
+            lb = jnp.broadcast_to(l, bshape + l.shape[-2:])
+            z = jax.scipy.linalg.solve_triangular(
+                lb, diff[..., None], lower=True)[..., 0]
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(l, axis1=-2, axis2=-1)), axis=-1)
+            return (-0.5 * jnp.sum(z * z, axis=-1) - half_logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+        return apply_op(f, _as_t(value), self.loc, self.scale_tril,
+                        _name='mvn_log_prob')
+
+    def entropy(self):
+        def f(l):
+            d = l.shape[-1]
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(l, axis1=-2, axis2=-1)), axis=-1)
+            return 0.5 * d * (1.0 + math.log(2 * math.pi)) + half_logdet
+        return apply_op(f, self.scale_tril, _name='mvn_entropy')
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices
+    (upstream distribution/lkj_cholesky.py). Sampling uses the onion
+    construction — d-1 Beta draws plus points on spheres — expressed
+    as one batched computation (no python-per-row device work)."""
+
+    def __init__(self, dim, concentration=1.0,
+                 sample_method='onion', name=None):
+        if dim < 2:
+            raise ValueError('LKJCholesky needs dim >= 2')
+        if sample_method not in ('onion', 'cvine'):
+            raise ValueError(f'unknown sample_method {sample_method!r}')
+        self.dim = int(dim)
+        self.concentration = _as_t(concentration)
+        self.sample_method = sample_method
+
+    def sample(self, shape=(), seed=0):
+        k = _key(seed)
+        shape = tuple(shape)
+        d = self.dim
+        f = self._sample_onion if self.sample_method == 'onion' \
+            else self._sample_cvine
+        return apply_op(lambda conc: f(conc, k, shape),
+                        self.concentration, _name='lkj_sample')
+
+    def _sample_onion(self, conc, k, shape):
+        d = self.dim
+        batch = shape + conc.shape
+        # onion: row i (1-based, i>=1) needs y~Beta(i/2, off_i)
+        # with offset walking down from conc + (d-2)/2
+        ks = jax.random.split(k, 2)
+        i = jnp.arange(1, d, dtype=jnp.float32)
+        offs = conc[..., None] + (d - 2) / 2.0 - (i - 1) / 2.0
+        y = jax.random.beta(ks[0], i / 2.0, offs,
+                            batch + (d - 1,))
+        z = jax.random.normal(ks[1], batch + (d - 1, d),
+                              jnp.float32)
+        # unit vectors on the first i coords of each row
+        cols = jnp.arange(d)[None, :]
+        rowmask = cols < i[:, None]
+        z = jnp.where(rowmask, z, 0.0)
+        u = z / jnp.maximum(
+            jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-20)
+        w = jnp.sqrt(y)[..., None] * u
+        low = jnp.zeros(batch + (d, d), jnp.float32)
+        low = low.at[..., 1:, :].set(w)
+        diag = jnp.concatenate(
+            [jnp.ones(batch + (1,), jnp.float32),
+             jnp.sqrt(1.0 - y)], axis=-1)
+        eye = jnp.eye(d, dtype=jnp.float32)
+        return low * (1.0 - eye) + diag[..., None] * eye
+
+    def _sample_cvine(self, conc, k, shape):
+        """C-vine (Lewandowski et al. 2009 §3.1): partial correlations
+        z_ij ~ 2·Beta(a_j, a_j) − 1 with a_j = conc + (d−2−j)/2 by tree
+        level j, mapped to the Cholesky factor by the recursive
+        sqrt(1−z²) cumulative product — here one batched cumprod."""
+        d = self.dim
+        batch = shape + conc.shape
+        j = jnp.arange(d, dtype=jnp.float32)
+        # level-wise Beta parameter, aligned to the trailing (row, level)
+        # axes of the draw shape
+        a = conc[..., None, None] + (d - 2.0 - j) / 2.0
+        y = jax.random.beta(k, a, a, batch + (d - 1, d))
+        z = 2.0 * y - 1.0  # partial correlations in (-1, 1)
+        rows = jnp.arange(1, d)[:, None]
+        cols = jnp.arange(d)[None, :]
+        mask = cols < rows  # row i uses levels j = 0..i-1
+        z = jnp.where(mask, z, 0.0)
+        # cum_ij = prod_{k<j} sqrt(1 - z_ik^2)  (exclusive cumprod)
+        s = jnp.sqrt(jnp.clip(1.0 - z * z, 1e-20, None))
+        cum = jnp.cumprod(jnp.where(mask, s, 1.0), axis=-1)
+        excl = jnp.concatenate(
+            [jnp.ones(batch + (d - 1, 1), jnp.float32),
+             cum[..., :-1]], axis=-1)
+        w = jnp.where(mask, z * excl, 0.0)
+        # L_ii = prod_{k<i} sqrt(1 - z_ik^2) = cum at the last used level
+        diag_low = jnp.take_along_axis(
+            cum, jnp.broadcast_to(rows - 1, batch + (d - 1, 1)).astype(int),
+            axis=-1)[..., 0]
+        low = jnp.zeros(batch + (d, d), jnp.float32)
+        low = low.at[..., 1:, :].set(w)
+        diag = jnp.concatenate(
+            [jnp.ones(batch + (1,), jnp.float32), diag_low], axis=-1)
+        eye = jnp.eye(d, dtype=jnp.float32)
+        return low * (1.0 - eye) + diag[..., None] * eye
+
+    def log_prob(self, value):
+        d = self.dim
+
+        def f(l, conc):
+            i = jnp.arange(1, d, dtype=jnp.float32)
+            order = 2.0 * (conc[..., None] - 1.0) + d - i - 1.0
+            diags = jnp.diagonal(l, axis1=-2, axis2=-1)[..., 1:]
+            unnorm = jnp.sum(order * jnp.log(diags), axis=-1)
+            # normalization constant (LKJ 2009 p.1999, Cholesky-factor
+            # density): ½(d−1)·log π + log Γ_{d−1}(α − ½) − (d−1)·log Γ(α)
+            # with α = conc + (d−1)/2 and Γ_p the multivariate gamma
+            dm1 = d - 1
+            alpha = conc + 0.5 * dm1
+            j = jnp.arange(1, dm1 + 1, dtype=jnp.float32)
+            mvlg = dm1 * (dm1 - 1) / 4.0 * math.log(math.pi) + jnp.sum(
+                jsp.gammaln(alpha[..., None] - 0.5 + (1.0 - j) / 2.0),
+                axis=-1)
+            norm = (0.5 * dm1 * math.log(math.pi) + mvlg
+                    - dm1 * jsp.gammaln(alpha))
+            return unnorm - norm
+        return apply_op(f, _as_t(value), self.concentration,
+                        _name='lkj_log_prob')
+
+# closed-form KLs for the new pairs
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    def f(m0, g0, m1, g1):
+        return jnp.log(((g0 + g1) ** 2 + (m0 - m1) ** 2)
+                       / (4.0 * g0 * g1))
+    return apply_op(f, p.loc, p.scale, q.loc, q.scale,
+                    _name='kl_cauchy')
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def f(mu0, l0, mu1, l1):
+        d = l0.shape[-1]
+        # align batch dims: solve_triangular does not broadcast
+        bshape = jnp.broadcast_shapes(l0.shape[:-2], l1.shape[:-2],
+                                      mu0.shape[:-1], mu1.shape[:-1])
+        l0 = jnp.broadcast_to(l0, bshape + l0.shape[-2:])
+        l1 = jnp.broadcast_to(l1, bshape + l1.shape[-2:])
+        diff = jnp.broadcast_to(mu1 - mu0, bshape + (d,))
+        half0 = jnp.sum(jnp.log(jnp.diagonal(l0, axis1=-2, axis2=-1)),
+                        axis=-1)
+        half1 = jnp.sum(jnp.log(jnp.diagonal(l1, axis1=-2, axis2=-1)),
+                        axis=-1)
+        m = jax.scipy.linalg.solve_triangular(l1, l0, lower=True)
+        tr = jnp.sum(m * m, axis=(-2, -1))
+        z = jax.scipy.linalg.solve_triangular(
+            l1, diff[..., None], lower=True)[..., 0]
+        return half1 - half0 + 0.5 * (tr + jnp.sum(z * z, axis=-1) - d)
+    return apply_op(f, p.loc, p.scale_tril, q.loc, q.scale_tril,
+                    _name='kl_mvn')
+

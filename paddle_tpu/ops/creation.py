@@ -118,18 +118,22 @@ def eye(num_rows, num_columns=None, dtype=None, name=None):
 
 
 def diag(x, offset=0, padding_value=0, name=None):
-    v = to_jax(x)
-    if v.ndim == 1 and padding_value != 0:
-        n = v.shape[0] + abs(offset)
-        base = jnp.full((n, n), to_jax(padding_value), v.dtype)
-        d = jnp.diag(v, k=offset)
-        mask = jnp.eye(n, k=offset, dtype=bool)
-        return Tensor(jnp.where(mask, d, base))
-    return Tensor(jnp.diag(v, k=offset))
+    from ._helpers import defop
+
+    def f(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), to_jax(padding_value), v.dtype)
+            d = jnp.diag(v, k=offset)
+            mask = jnp.eye(n, k=offset, dtype=bool)
+            return jnp.where(mask, d, base)
+        return jnp.diag(v, k=offset)
+    return defop(f, name='diag')(x)
 
 
 def diagflat(x, offset=0, name=None):
-    return Tensor(jnp.diagflat(to_jax(x), k=offset))
+    from ._helpers import defop
+    return defop(lambda v: jnp.diagflat(v, k=offset), name='diagflat')(x)
 
 
 def meshgrid(*args, **kwargs):

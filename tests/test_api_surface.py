@@ -30,6 +30,9 @@ SURFACE = [
     'negative', 'multigammaln', 'flatten_', 'set_printoptions', 'LazyGuard',
     'hub.load', 'hub.list', 'hub.help', 'utils.unique_name.generate',
     'utils.unique_name.guard', 'utils.unique_name.switch',
+    'distribution.Binomial', 'distribution.Cauchy', 'distribution.Chi2',
+    'distribution.ContinuousBernoulli', 'distribution.LKJCholesky',
+    'distribution.MultivariateNormal',
     'set_device', 'get_device', 'CPUPlace', 'CUDAPlace', 'Model',
     # linalg
     'linalg.cholesky', 'linalg.qr', 'linalg.svd', 'linalg.inv',

@@ -49,6 +49,32 @@ class TestSmallOps:
             paddle.set_printoptions(precision=4)
 
 
+class TestDiagGrad:
+    def test_diag_vector_gradient_flows(self):
+        # diag/diagflat used to wrap raw jnp results, silently detaching
+        # the tape — exp(v) -> diag -> sum must backprop exp(v)
+        v = paddle.to_tensor(np.array([0.1, 0.4], np.float32))
+        v.stop_gradient = False
+        m = paddle.diag(paddle.exp(v))
+        assert not m.stop_gradient
+        (g,) = paddle.grad(m.sum(), [v])
+        np.testing.assert_allclose(g.numpy(), np.exp([0.1, 0.4]),
+                                   rtol=1e-6)
+
+    def test_diagflat_gradient_flows(self):
+        v = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+        v.stop_gradient = False
+        (g,) = paddle.grad(paddle.diagflat(v * 2.0).sum(), [v])
+        np.testing.assert_allclose(g.numpy(), [[2.0, 2.0]])
+
+    def test_diag_extract_and_padding(self):
+        m = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+        np.testing.assert_allclose(paddle.diag(m).numpy(), [0, 4, 8])
+        d = paddle.diag(paddle.to_tensor(np.array([1.0, 2.0], np.float32)),
+                        padding_value=7.0)
+        np.testing.assert_allclose(d.numpy(), [[1, 7], [7, 2]])
+
+
 class TestUniqueName:
     def test_generate_sequence(self):
         from paddle_tpu.utils import unique_name

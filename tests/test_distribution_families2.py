@@ -1,0 +1,300 @@
+"""Parity tests for the final distribution families (upstream
+python/paddle/distribution/{binomial,cauchy,chi2,continuous_bernoulli,
+multivariate_normal,lkj_cholesky}.py) vs torch.distributions."""
+import numpy as np
+import pytest
+import torch
+import torch.distributions as td
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+RNG = np.random.RandomState(9)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestBinomial:
+    N = np.array([10.0, 6.0, 20.0], np.float32)
+    P = np.array([0.25, 0.5, 0.9], np.float32)
+
+    def _pair(self):
+        return (D.Binomial(_t(self.N), _t(self.P)),
+                td.Binomial(torch.tensor(self.N), torch.tensor(self.P)))
+
+    def test_log_prob(self):
+        v = np.array([[3, 2, 17], [0, 6, 20]], np.float32)
+        ours, ref = self._pair()
+        np.testing.assert_allclose(ours.log_prob(_t(v)).numpy(),
+                                   ref.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mean_variance(self):
+        ours, ref = self._pair()
+        np.testing.assert_allclose(ours.mean.numpy(), ref.mean.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(ours.variance.numpy(),
+                                   ref.variance.numpy(), rtol=1e-6)
+
+    def test_entropy_vs_scipy(self):
+        from scipy import stats
+        ours, _ = self._pair()
+        want = np.array([stats.binom(int(n), p).entropy()
+                         for n, p in zip(self.N, self.P)])
+        np.testing.assert_allclose(ours.entropy().numpy(), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sample_statistics(self):
+        ours, _ = self._pair()
+        s = ours.sample((3000,)).numpy()
+        assert s.shape == (3000, 3)
+        np.testing.assert_allclose(s.mean(0), ours.mean.numpy(),
+                                   atol=0.35)
+        assert s.min() >= 0 and np.all(s.max(0) <= self.N)
+
+
+class TestCauchy:
+    LOC = np.array([-1.0, 0.0, 2.0], np.float32)
+    SCALE = np.array([0.5, 1.0, 3.0], np.float32)
+
+    def _pair(self):
+        return (D.Cauchy(_t(self.LOC), _t(self.SCALE)),
+                td.Cauchy(torch.tensor(self.LOC),
+                          torch.tensor(self.SCALE)))
+
+    def test_log_prob_entropy_cdf(self):
+        v = RNG.standard_normal((4, 3)).astype(np.float32) * 3
+        ours, ref = self._pair()
+        np.testing.assert_allclose(ours.log_prob(_t(v)).numpy(),
+                                   ref.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ours.entropy().numpy(),
+                                   ref.entropy().numpy(), rtol=1e-5)
+        np.testing.assert_allclose(ours.cdf(_t(v)).numpy(),
+                                   ref.cdf(torch.tensor(v)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mean_variance_raise(self):
+        ours, _ = self._pair()
+        with pytest.raises(ValueError):
+            ours.mean
+        with pytest.raises(ValueError):
+            ours.variance
+
+    def test_kl(self):
+        p = D.Cauchy(_t([0.0]), _t([1.0]))
+        q = D.Cauchy(_t([1.0]), _t([2.0]))
+        want = td.kl_divergence(td.Cauchy(torch.tensor([0.0]),
+                                          torch.tensor([1.0])),
+                                td.Cauchy(torch.tensor([1.0]),
+                                          torch.tensor([2.0])))
+        np.testing.assert_allclose(D.kl_divergence(p, q).numpy(),
+                                   want.numpy(), rtol=1e-5)
+
+
+class TestChi2:
+    DF = np.array([1.0, 4.0, 11.0], np.float32)
+
+    def test_against_torch(self):
+        v = RNG.uniform(0.2, 8.0, (4, 3)).astype(np.float32)
+        ours = D.Chi2(_t(self.DF))
+        ref = td.Chi2(torch.tensor(self.DF))
+        np.testing.assert_allclose(ours.log_prob(_t(v)).numpy(),
+                                   ref.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ours.mean.numpy(), ref.mean.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(ours.variance.numpy(),
+                                   ref.variance.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(ours.entropy().numpy(),
+                                   ref.entropy().numpy(), rtol=1e-4)
+
+    def test_kl_dispatches_through_gamma(self):
+        p, q = D.Chi2(_t([3.0])), D.Chi2(_t([5.0]))
+        want = td.kl_divergence(td.Chi2(torch.tensor([3.0])),
+                                td.Chi2(torch.tensor([5.0])))
+        np.testing.assert_allclose(D.kl_divergence(p, q).numpy(),
+                                   want.numpy(), rtol=1e-5)
+
+
+class TestContinuousBernoulli:
+    # include the unstable λ≈0.5 region torch also special-cases
+    LAM = np.array([0.05, 0.3, 0.4999, 0.5, 0.62, 0.95], np.float32)
+
+    def _pair(self):
+        return (D.ContinuousBernoulli(_t(self.LAM)),
+                td.ContinuousBernoulli(torch.tensor(self.LAM)))
+
+    def test_log_prob(self):
+        v = RNG.uniform(0.0, 1.0, (4, 6)).astype(np.float32)
+        ours, ref = self._pair()
+        np.testing.assert_allclose(ours.log_prob(_t(v)).numpy(),
+                                   ref.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_mean_variance_entropy(self):
+        ours, ref = self._pair()
+        np.testing.assert_allclose(ours.mean.numpy(), ref.mean.numpy(),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(ours.variance.numpy(),
+                                   ref.variance.numpy(), rtol=2e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(ours.entropy().numpy(),
+                                   ref.entropy().numpy(), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_icdf_roundtrip_and_rsample_grad(self):
+        d = D.ContinuousBernoulli(_t(self.LAM))
+        u = RNG.uniform(0.02, 0.98, (5, 6)).astype(np.float32)
+        x = d.icdf(_t(u)).numpy()
+        assert np.all((x >= 0) & (x <= 1))
+        lam = _t(self.LAM)
+        lam.stop_gradient = False
+        d2 = D.ContinuousBernoulli(lam)
+        s = d2.rsample((16,)).sum()
+        (g,) = paddle.grad(s, [lam])
+        assert np.isfinite(g.numpy()).all() and np.abs(g.numpy()).sum() > 0
+
+
+class TestMultivariateNormal:
+    COV = np.array([[2.0, 0.4, 0.1], [0.4, 1.0, -0.2],
+                    [0.1, -0.2, 1.5]], np.float32)
+    MU = np.array([0.5, -1.0, 2.0], np.float32)
+
+    def _pair(self):
+        return (D.MultivariateNormal(_t(self.MU),
+                                     covariance_matrix=_t(self.COV)),
+                td.MultivariateNormal(
+                    torch.tensor(self.MU),
+                    covariance_matrix=torch.tensor(self.COV)))
+
+    def test_log_prob_entropy(self):
+        v = RNG.standard_normal((5, 3)).astype(np.float32)
+        ours, ref = self._pair()
+        np.testing.assert_allclose(ours.log_prob(_t(v)).numpy(),
+                                   ref.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(ours.entropy().numpy(),
+                                   ref.entropy().numpy(), rtol=1e-5)
+
+    def test_parameterizations_agree(self):
+        l = np.linalg.cholesky(self.COV).astype(np.float32)
+        prec = np.linalg.inv(self.COV).astype(np.float32)
+        v = RNG.standard_normal((4, 3)).astype(np.float32)
+        lp_cov = D.MultivariateNormal(
+            _t(self.MU), covariance_matrix=_t(self.COV)).log_prob(_t(v))
+        lp_tril = D.MultivariateNormal(
+            _t(self.MU), scale_tril=_t(l)).log_prob(_t(v))
+        lp_prec = D.MultivariateNormal(
+            _t(self.MU), precision_matrix=_t(prec)).log_prob(_t(v))
+        np.testing.assert_allclose(lp_cov.numpy(), lp_tril.numpy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(lp_cov.numpy(), lp_prec.numpy(),
+                                   rtol=1e-3, atol=1e-4)
+        with pytest.raises(ValueError):
+            D.MultivariateNormal(_t(self.MU))
+
+    def test_kl(self):
+        cov2 = (self.COV + 0.5 * np.eye(3)).astype(np.float32)
+        p_ref = td.MultivariateNormal(torch.tensor(self.MU),
+                                      torch.tensor(self.COV))
+        q_ref = td.MultivariateNormal(torch.zeros(3),
+                                      torch.tensor(cov2))
+        p = D.MultivariateNormal(_t(self.MU), covariance_matrix=_t(self.COV))
+        q = D.MultivariateNormal(_t(np.zeros(3, np.float32)),
+                                 covariance_matrix=_t(cov2))
+        np.testing.assert_allclose(
+            D.kl_divergence(p, q).numpy(),
+            td.kl_divergence(p_ref, q_ref).numpy(), rtol=1e-4)
+
+    @pytest.mark.slow
+    def test_sample_statistics(self):
+        ours, _ = self._pair()
+        s = ours.rsample((20000,)).numpy()
+        np.testing.assert_allclose(s.mean(0), self.MU, atol=0.06)
+        np.testing.assert_allclose(np.cov(s.T), self.COV, atol=0.12)
+
+
+
+    def test_batched_mvn(self):
+        # batched scale_tril with unbatched loc/value (torch supports it)
+        covs = np.stack([self.COV, self.COV + 0.5 * np.eye(3)]
+                        ).astype(np.float32)
+        ls = np.linalg.cholesky(covs).astype(np.float32)
+        prec = np.linalg.inv(covs).astype(np.float32)
+        v = RNG.standard_normal(3).astype(np.float32)
+        ours = D.MultivariateNormal(_t(np.zeros(3, np.float32)),
+                                    scale_tril=_t(ls))
+        ref = td.MultivariateNormal(torch.zeros(3),
+                                    scale_tril=torch.tensor(ls))
+        np.testing.assert_allclose(ours.log_prob(_t(v)).numpy(),
+                                   ref.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        # batched precision ctor
+        ours_p = D.MultivariateNormal(_t(np.zeros(3, np.float32)),
+                                      precision_matrix=_t(prec))
+        np.testing.assert_allclose(ours_p.log_prob(_t(v)).numpy(),
+                                   ref.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-3, atol=1e-3)
+        # batched-vs-unbatched KL broadcasts
+        q = D.MultivariateNormal(_t(self.MU), covariance_matrix=_t(self.COV))
+        kl = D.kl_divergence(ours, q).numpy()
+        ref_kl = td.kl_divergence(
+            ref, td.MultivariateNormal(torch.tensor(self.MU),
+                                       torch.tensor(self.COV))).numpy()
+        np.testing.assert_allclose(kl, ref_kl, rtol=1e-4, atol=1e-4)
+
+    def test_rsample_grad(self):
+        mu = _t(self.MU)
+        mu.stop_gradient = False
+        d = D.MultivariateNormal(mu, covariance_matrix=_t(self.COV))
+        (g,) = paddle.grad(d.rsample((8,)).sum(), [mu])
+        np.testing.assert_allclose(g.numpy(), 8.0 * np.ones(3), rtol=1e-5)
+
+
+class TestLKJCholesky:
+    def test_sample_is_valid_cholesky_of_correlation(self):
+        d = D.LKJCholesky(4, 1.5)
+        L = d.sample((64,)).numpy()
+        assert L.shape == (64, 4, 4)
+        # lower-triangular with unit-norm rows -> unit-diagonal corr
+        assert np.allclose(np.triu(L, 1), 0.0, atol=1e-6)
+        corr = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        offdiag = corr[:, np.triu_indices(4, 1)[0], np.triu_indices(4, 1)[1]]
+        assert np.all(np.abs(offdiag) <= 1.0 + 1e-6)
+
+    def test_log_prob_vs_torch(self):
+        ref = td.LKJCholesky(3, concentration=2.0)
+        L = ref.sample((6,))
+        ours = D.LKJCholesky(3, 2.0)
+        np.testing.assert_allclose(
+            ours.log_prob(_t(L.numpy())).numpy(),
+            ref.log_prob(L).numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_concentration_shifts_mass(self):
+        # high concentration -> correlations near 0 (identity-ish)
+        lo = D.LKJCholesky(3, 1.0).sample((256,), seed=1).numpy()
+        hi = D.LKJCholesky(3, 50.0).sample((256,), seed=2).numpy()
+        off = lambda L: np.abs((L @ np.swapaxes(L, -1, -2))[:, 0, 1]).mean()
+        assert off(hi) < off(lo)
+
+    def test_cvine_valid_and_matches_onion_marginal(self):
+        d = D.LKJCholesky(4, 2.0, sample_method='cvine')
+        L = d.sample((2048,), seed=3).numpy()
+        assert np.allclose(np.triu(L, 1), 0.0, atol=1e-6)
+        corr = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        # both exact LKJ samplers: the (0,1) marginal must agree
+        Lo = D.LKJCholesky(4, 2.0).sample((2048,), seed=4).numpy()
+        corr_o = Lo @ np.swapaxes(Lo, -1, -2)
+        r_c, r_o = corr[:, 0, 1], corr_o[:, 0, 1]
+        assert abs(r_c.mean() - r_o.mean()) < 0.05
+        assert abs(r_c.std() - r_o.std()) < 0.05
+        # and the analytic density must fit the cvine draws too
+        lp = d.log_prob(paddle.to_tensor(L[:8])).numpy()
+        assert np.isfinite(lp).all()
