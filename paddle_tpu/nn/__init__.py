@@ -7,30 +7,39 @@ from .utils import SpectralNorm
 from . import initializer
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                    clip_grad_norm_)
-from .common_layers import (GLU, AlphaDropout, Bilinear, CELU, CosineSimilarity,
+from .common_layers import (GLU, AlphaDropout, Bilinear, CELU,
+                            ChannelShuffle, CosineSimilarity,
                             Dropout, Dropout2D, Dropout3D, ELU, Embedding,
-                            Flatten, GELU, Hardshrink, Hardsigmoid, Hardswish,
-                            Hardtanh, Identity, LayerDict, LayerList,
-                            LeakyReLU, Linear, LogSigmoid, LogSoftmax, Mish,
+                            Flatten, Fold, GELU, Hardshrink, Hardsigmoid,
+                            Hardswish, Hardtanh, Identity, LayerDict,
+                            LayerList, LeakyReLU, Linear, LogSigmoid,
+                            LogSoftmax, Maxout, Mish,
                             Pad1D, Pad2D, Pad3D, ParameterList, PixelShuffle,
-                            PReLU, ReLU, ReLU6, SELU, Sequential, Sigmoid,
+                            PixelUnshuffle, PReLU, ReLU, ReLU6, RReLU, SELU,
+                            Sequential, Sigmoid,
                             Silu, Softmax, Softplus, Softshrink, Softsign,
-                            Swish, Tanh, Tanhshrink, Unfold, Upsample,
+                            Swish, Tanh, Tanhshrink, ThresholdedReLU,
+                            Unfold, Upsample,
                             UpsamplingBilinear2D, UpsamplingNearest2D,
                             ZeroPad2D)
 from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
                    Conv3D, Conv3DTranspose)
 from .layer import Layer, ParamAttr
-from .loss_layers import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
+from .loss_layers import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
+                          CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
                           HingeEmbeddingLoss, KLDivLoss, L1Loss,
-                          MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss)
+                          MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss,
+                          MultiMarginLoss, NLLLoss, PoissonNLLLoss,
+                          SmoothL1Loss, SoftMarginLoss, TripletMarginLoss)
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
                    LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
                       AdaptiveMaxPool1D, AdaptiveMaxPool2D, AvgPool1D,
-                      AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D)
-from .rnn import GRU, LSTM, SimpleRNN
+                      AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+                      MaxUnPool2D)
+from .rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, BiRNN, SimpleRNN,
+                  SimpleRNNCell, RNNCellBase)
 from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                           TransformerDecoderLayer, TransformerEncoder,
                           TransformerEncoderLayer)

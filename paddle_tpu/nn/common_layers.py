@@ -285,6 +285,10 @@ Softplus = _act_layer('softplus', 'Softplus')
 Softsign = _act_layer('softsign', 'Softsign')
 LogSigmoid = _act_layer('logsigmoid', 'LogSigmoid')
 GLU = _act_layer('glu', 'GLU')
+ThresholdedReLU = _act_layer('thresholded_relu', 'ThresholdedReLU')
+Maxout = _act_layer('maxout', 'Maxout')
+ChannelShuffle = _act_layer('channel_shuffle', 'ChannelShuffle')
+PixelUnshuffle = _act_layer('pixel_unshuffle', 'PixelUnshuffle')
 Softmax = _act_layer('softmax', 'Softmax')
 LogSoftmax = _act_layer('log_softmax', 'LogSoftmax')
 
@@ -447,3 +451,31 @@ class ParameterList(Layer):
     def append(self, p):
         self.add_parameter(str(len(self._parameters)), p)
         return self
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (upstream paddle.nn.RReLU): random negative
+    slope in [lower, upper] while training, fixed mean slope in eval."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Fold(Layer):
+    """col2im (upstream paddle.nn.Fold) — inverse of Unfold."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides, self.paddings = strides, paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes,
+                      self.strides, self.paddings, self.dilations)

@@ -1641,3 +1641,45 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(nll, reduction)
     return defop(f, name='ctc_loss')(log_probs, labels, input_lengths,
                                      label_lengths)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction='mean', name=None):
+    """1 − cos(x1,x2) for label=1, max(0, cos − margin) for label=−1
+    (reference paddle.nn.functional.cosine_embedding_loss)."""
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1),
+            1e-12)
+        loss = jnp.where(y > 0, 1.0 - cos,
+                         jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return defop(f, name='cosine_embedding_loss')(input1, input2, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction='mean', name=None):
+    """Multi-class margin loss mean_j max(0, margin − x_y + x_j)^p
+    (reference paddle.nn.functional.multi_margin_loss)."""
+    def f(x, y, *w):
+        n, c = x.shape
+        y = y.astype(jnp.int32)
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if w:
+            m = m * jnp.take(w[0], y)[:, None]
+        # the true-class column contributes margin^p — mask it out
+        cols = jnp.arange(c)[None, :]
+        m = jnp.where(cols == y[:, None], 0.0, m)
+        return _reduce(jnp.sum(m, axis=1) / c, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return defop(f, name='multi_margin_loss')(*args)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Elementwise negative log likelihood of probabilities (reference
+    paddle.nn.functional.log_loss; no reduction, matching upstream)."""
+    def f(x, y):
+        return -(y * jnp.log(x + epsilon)
+                 + (1.0 - y) * jnp.log1p(-x + epsilon))
+    return defop(f, name='log_loss')(input, label)

@@ -22,8 +22,17 @@ class MaxPool1D(_Pool):
 
 
 class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format='NCHW',
+                 name=None):
+        # upstream positional order puts return_mask BEFORE ceil_mode
+        super().__init__(kernel_size, stride, padding, ceil_mode,
+                         data_format=data_format)
+        self.return_mask = return_mask
+
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
                             ceil_mode=self.ceil_mode)
 
 
@@ -80,3 +89,20 @@ class AdaptiveMaxPool1D(_AdaptivePool):
 class AdaptiveMaxPool2D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    """Partial inverse of MaxPool2D(return_mask=True) (upstream
+    paddle.nn.MaxUnPool2D)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format='NCHW', output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.output_size = padding, output_size
+        self.data_format = data_format
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, data_format=self.data_format,
+                              output_size=self.output_size)

@@ -94,7 +94,7 @@ class _RNNBase(Layer):
                     else:
                         init_state = (initial_states[idx],)
 
-                cell = type(self)._cell
+                cell = self._cell
                 reverse = bool(d)
                 time_major = self.time_major
 
@@ -153,8 +153,10 @@ class SimpleRNN(_RNNBase):
     def __init__(self, *args, activation='tanh', **kwargs):
         self._act = activation
         super().__init__(*args, **kwargs)
-        type(self)._cell = staticmethod(
-            _simple_cell_tanh if activation == 'tanh' else _simple_cell_relu)
+        # per-instance: a second cell with a different activation must
+        # not rewire existing instances
+        self._cell = _simple_cell_tanh if activation == 'tanh' \
+            else _simple_cell_relu
 
     def _init_carry(self, batch, dtype):
         return jnp.zeros((batch, self.hidden_size), dtype)
@@ -206,3 +208,156 @@ class GRU(_RNNBase):
 
     def _init_carry(self, batch, dtype):
         return jnp.zeros((batch, self.hidden_size), dtype)
+
+
+class RNNCellBase(Layer):
+    """Single-step recurrent cells (upstream paddle.nn.LSTMCell/GRUCell/
+    SimpleRNNCell, python/paddle/nn/layer/rnn.py). The step is one fused
+    [B, G*H] matmul pair — MXU-shaped; for full sequences prefer the
+    scan-based LSTM/GRU/SimpleRNN layers, which compile the time loop."""
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        init = _uniform_init(hidden_size)
+        g = self.GATES
+        self.weight_ih = self.create_parameter(
+            (g * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (g * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (g * hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            (g * hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    # single step on raw arrays: (carry, xt, wih, whh, bih, bhh)
+    _step = None
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        from ..ops.creation import full
+        mk = lambda: full((b, self.hidden_size), init_value,
+                          dtype or 'float32')
+        return (mk(), mk()) if self.STATES == 2 else mk()
+
+    STATES = 1
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        step = self._step
+        single = self.STATES == 1
+        sts = (states,) if single else tuple(states)
+
+        def f(x, wih, whh, bih, bhh, *st):
+            carry = st[0] if single else tuple(st)
+            carry2, out = step(carry, x, wih, whh, bih, bhh)
+            if single:
+                return out, carry2
+            return (out,) + tuple(carry2)
+        res = apply_op(f, inputs, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, *sts,
+                       _name=type(self).__name__.lower())
+        if single:
+            out, new = res
+            return out, new
+        return res[0], tuple(res[1:])
+
+
+class SimpleRNNCell(RNNCellBase):
+    GATES = 1
+    STATES = 1
+
+    def __init__(self, input_size, hidden_size, activation='tanh',
+                 **kwargs):
+        super().__init__(input_size, hidden_size, **kwargs)
+        self.activation = activation
+        self._step = _simple_cell_tanh if activation == 'tanh' \
+            else _simple_cell_relu
+
+
+class LSTMCell(RNNCellBase):
+    GATES = 4
+    STATES = 2
+    _step = staticmethod(LSTM._cell)
+
+
+class GRUCell(RNNCellBase):
+    GATES = 3
+    STATES = 1
+    _step = staticmethod(GRU._cell)
+
+
+class RNN(Layer):
+    """Wraps any cell into a sequence runner (upstream paddle.nn.RNN).
+    DyGraph semantics: a python step loop over the cell — works with
+    custom cells; the builtin LSTM/GRU/SimpleRNN layers remain the
+    compiled-scan fast path for full sequences."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import stack
+        from ..ops.search import where
+        axis = 0 if self.time_major else 1
+        T = inputs.shape[axis]
+        idxs = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        if sequence_length is not None and states is None:
+            # masking blends new vs old states from step one, so the
+            # initial carry must exist up front (a reverse scan's first
+            # processed step is a PAD step for shorter sequences)
+            ref = inputs[0] if self.time_major else inputs[:, 0]
+            states = self.cell.get_initial_states(ref)
+        outs = [None] * T
+        for t in idxs:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            out, new_states = self.cell(xt, states)
+            if sequence_length is not None:
+                # pad steps are no-ops: carry keeps its value and the
+                # output is zero (upstream mask semantics) — for the
+                # reverse direction this makes the scan effectively
+                # start at each sequence's last valid token
+                valid = (sequence_length > t).unsqueeze(-1)
+                out = where(valid, out, out * 0.0)
+                if isinstance(new_states, tuple):
+                    states = tuple(where(valid, n, o)
+                                   for n, o in zip(new_states, states))
+                else:
+                    states = where(valid, new_states, states)
+            else:
+                states = new_states
+            outs[t] = out
+        return stack(outs, axis=axis), states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over one sequence, outputs concatenated
+    (upstream paddle.nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        of, sf = self.rnn_fw(inputs, sf, sequence_length)
+        ob, sb = self.rnn_bw(inputs, sb, sequence_length)
+        return concat([of, ob], axis=-1), (sf, sb)

@@ -112,6 +112,17 @@ def _attach_methods():
     Tensor.exp_ = lambda s: s._rebind(math.exp(s))
     Tensor.sqrt_ = lambda s: s._rebind(math.sqrt(s))
     Tensor.zero_ = lambda s: _fill_(s, 0)
+    Tensor.floor_ = lambda s: s._rebind(math.floor(s))
+    Tensor.ceil_ = lambda s: s._rebind(math.ceil(s))
+    Tensor.masked_fill_ = lambda s, m, v: s._rebind(
+        search.masked_fill(s, m, v))
+    Tensor.index_fill_ = lambda s, idx, axis, v: s._rebind(
+        manipulation.index_fill(s, idx, axis, v))
+    Tensor.uniform_ = _uniform_
+    Tensor.normal_ = _normal_
+    Tensor.exponential_ = _exponential_
+    Tensor.element_size = lambda s: s.dtype.itemsize
+    Tensor.set_value = _set_value
 
     Tensor.__iadd__ = lambda s, o: s._rebind(math.add(s, o))
     Tensor.__isub__ = lambda s, o: s._rebind(math.subtract(s, o))
@@ -127,6 +138,54 @@ def _fill_(t, v):
     t._data = jnp.full_like(t._data, v)
     t._node = None
     return t
+
+
+def _uniform_(t, min=-1.0, max=1.0, seed=0):
+    import jax
+    from .. import framework
+    k = jax.random.key(seed) if seed else framework.next_rng_key()
+    t._data = jax.random.uniform(k, t._data.shape, t._data.dtype,
+                                 minval=min, maxval=max)
+    t._node = None
+    return t
+
+
+def _normal_(t, mean=0.0, std=1.0, seed=0):
+    import jax
+    from .. import framework
+    k = jax.random.key(seed) if seed else framework.next_rng_key()
+    t._data = mean + std * jax.random.normal(k, t._data.shape,
+                                             t._data.dtype)
+    t._node = None
+    return t
+
+
+def _exponential_(t, lam=1.0, seed=0):
+    import jax
+    import jax.numpy as jnp
+    from .. import framework
+    k = jax.random.key(seed) if seed else framework.next_rng_key()
+    u = jax.random.uniform(k, t._data.shape, t._data.dtype,
+                           minval=jnp.finfo(t._data.dtype).tiny)
+    t._data = -jnp.log(u) / lam
+    t._node = None
+    return t
+
+
+def _set_value(t, value):
+    import jax.numpy as jnp
+    import numpy as np
+    v = value._data if isinstance(value, Tensor) else np.asarray(value)
+    t._data = jnp.asarray(v, t._data.dtype).reshape(t._data.shape)
+    t._node = None
+    return t
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Resulting broadcast shape of the two shape lists (upstream
+    paddle.broadcast_shape)."""
+    import jax.numpy as jnp
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
 
 
 _attach_methods()

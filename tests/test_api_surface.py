@@ -33,6 +33,14 @@ SURFACE = [
     'distribution.Binomial', 'distribution.Cauchy', 'distribution.Chi2',
     'distribution.ContinuousBernoulli', 'distribution.LKJCholesky',
     'distribution.MultivariateNormal',
+    'nn.LSTMCell', 'nn.GRUCell', 'nn.SimpleRNNCell', 'nn.RNN', 'nn.BiRNN',
+    'nn.Fold', 'nn.MaxUnPool2D', 'nn.ThresholdedReLU', 'nn.Maxout',
+    'nn.RReLU', 'nn.ChannelShuffle', 'nn.PixelUnshuffle', 'nn.CTCLoss',
+    'nn.SoftMarginLoss', 'nn.MultiLabelSoftMarginLoss',
+    'nn.TripletMarginLoss', 'nn.PoissonNLLLoss', 'nn.GaussianNLLLoss',
+    'nn.CosineEmbeddingLoss', 'nn.MultiMarginLoss',
+    'nn.functional.cosine_embedding_loss', 'nn.functional.multi_margin_loss',
+    'nn.functional.log_loss', 'broadcast_shape',
     'set_device', 'get_device', 'CPUPlace', 'CUDAPlace', 'Model',
     # linalg
     'linalg.cholesky', 'linalg.qr', 'linalg.svd', 'linalg.inv',

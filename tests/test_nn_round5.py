@@ -1,0 +1,271 @@
+"""Round-5 nn additions: RNN cells + RNN/BiRNN wrappers, layer classes
+over the r4 functional ops, the three new F losses (parity vs torch),
+and Tensor in-place/utility methods."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(3)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestNewLosses:
+    def test_cosine_embedding_loss_vs_torch(self):
+        a = RNG.standard_normal((5, 7)).astype(np.float32)
+        b = RNG.standard_normal((5, 7)).astype(np.float32)
+        y = np.array([1, -1, 1, -1, 1], np.float32)
+        for margin in (0.0, 0.3):
+            for red in ('mean', 'sum', 'none'):
+                got = F.cosine_embedding_loss(_t(a), _t(b), _t(y),
+                                              margin=margin,
+                                              reduction=red).numpy()
+                want = tF.cosine_embedding_loss(
+                    torch.tensor(a), torch.tensor(b), torch.tensor(y),
+                    margin=margin, reduction=red).numpy()
+                np.testing.assert_allclose(got, want, rtol=1e-5,
+                                           atol=1e-6)
+
+    def test_multi_margin_loss_vs_torch(self):
+        x = RNG.standard_normal((6, 4)).astype(np.float32)
+        y = RNG.randint(0, 4, (6,)).astype(np.int64)
+        w = RNG.uniform(0.5, 1.5, (4,)).astype(np.float32)
+        for p in (1, 2):
+            got = F.multi_margin_loss(_t(x), paddle.to_tensor(y), p=p,
+                                      margin=0.8).numpy()
+            want = tF.multi_margin_loss(torch.tensor(x), torch.tensor(y),
+                                        p=p, margin=0.8).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        got = F.multi_margin_loss(_t(x), paddle.to_tensor(y),
+                                  weight=_t(w)).numpy()
+        want = tF.multi_margin_loss(torch.tensor(x), torch.tensor(y),
+                                    weight=torch.tensor(w)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_log_loss(self):
+        p = np.array([0.1, 0.7, 0.95], np.float32)
+        y = np.array([0.0, 1.0, 1.0], np.float32)
+        got = F.log_loss(_t(p), _t(y), epsilon=1e-4).numpy()
+        want = -(y * np.log(p + 1e-4) + (1 - y) * np.log1p(-p + 1e-4))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestLayerWrappers:
+    """Each wrapper must hit its functional op with its stored config."""
+
+    def test_activation_wrappers(self):
+        x = _t(RNG.standard_normal((3, 8)) * 2)
+        pairs = [
+            (paddle.nn.ThresholdedReLU(1.0), F.thresholded_relu(x, 1.0)),
+            (paddle.nn.Maxout(2), F.maxout(x.reshape([3, 8, 1, 1]), 2)),
+            (paddle.nn.ChannelShuffle(2),
+             F.channel_shuffle(x.reshape([1, 8, 3, 1]), 2)),
+        ]
+        m, want = pairs[0]
+        np.testing.assert_allclose(m(x).numpy(), want.numpy())
+        m, want = pairs[1]
+        np.testing.assert_allclose(m(x.reshape([3, 8, 1, 1])).numpy(),
+                                   want.numpy())
+        m, want = pairs[2]
+        np.testing.assert_allclose(m(x.reshape([1, 8, 3, 1])).numpy(),
+                                   want.numpy())
+
+    def test_rrelu_train_vs_eval(self):
+        x = _t(-np.ones((64, 64), np.float32))
+        m = paddle.nn.RReLU(0.1, 0.3)
+        m.eval()
+        # eval: fixed mean slope 0.2
+        np.testing.assert_allclose(m(x).numpy(), -0.2, rtol=1e-6)
+        m.train()
+        out = m(x).numpy()
+        assert out.min() >= -0.3 - 1e-6 and out.max() <= -0.1 + 1e-6
+        assert out.std() > 0  # actually random
+
+    def test_fold_unfold_roundtrip(self):
+        x = _t(RNG.standard_normal((2, 3, 8, 8)))
+        cols = paddle.nn.Unfold([2, 2], 2)(x)
+        back = paddle.nn.Fold((8, 8), [2, 2], 2)(cols)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+
+    def test_max_unpool2d_roundtrip(self):
+        x = _t(RNG.standard_normal((2, 3, 8, 8)))
+        y, mask = paddle.nn.MaxPool2D(2, return_mask=True)(x)
+        up = paddle.nn.MaxUnPool2D(2)(y, mask)
+        # unpooled map keeps exactly the max entries
+        ref = tF.max_unpool2d(
+            *tF.max_pool2d(torch.tensor(x.numpy()), 2, return_indices=True),
+            2)
+        np.testing.assert_allclose(up.numpy(), ref.numpy())
+
+    def test_loss_layer_wrappers_match_functional(self):
+        a = _t(RNG.standard_normal((4, 6)))
+        b = _t(RNG.standard_normal((4, 6)))
+        y1 = paddle.to_tensor(np.array([1, -1, 1, 1], np.float32))
+        np.testing.assert_allclose(
+            paddle.nn.CosineEmbeddingLoss(margin=0.2)(a, b, y1).numpy(),
+            F.cosine_embedding_loss(a, b, y1, margin=0.2).numpy())
+        lab = paddle.to_tensor(RNG.randint(0, 6, (4,)))
+        np.testing.assert_allclose(
+            paddle.nn.MultiMarginLoss(p=2)(a, lab).numpy(),
+            F.multi_margin_loss(a, lab, p=2).numpy())
+        bin_lab = _t((RNG.uniform(size=(4, 6)) > 0.5))
+        np.testing.assert_allclose(
+            paddle.nn.MultiLabelSoftMarginLoss()(a, bin_lab).numpy(),
+            F.multi_label_soft_margin_loss(a, bin_lab).numpy())
+        np.testing.assert_allclose(
+            paddle.nn.SoftMarginLoss()(a, y1.unsqueeze(-1)).numpy(),
+            F.soft_margin_loss(a, y1.unsqueeze(-1)).numpy())
+        np.testing.assert_allclose(
+            paddle.nn.TripletMarginLoss()(a, b, _t(
+                RNG.standard_normal((4, 6)))).numpy(),
+            F.triplet_margin_loss(a, b, _t(
+                RNG.standard_normal((4, 6)))).numpy(), rtol=1.0)
+        v = _t(RNG.uniform(0.5, 2.0, (4, 6)))
+        np.testing.assert_allclose(
+            paddle.nn.GaussianNLLLoss()(a, b, v).numpy(),
+            F.gaussian_nll_loss(a, b, v).numpy())
+        np.testing.assert_allclose(
+            paddle.nn.PoissonNLLLoss()(a, _t(
+                RNG.randint(0, 5, (4, 6)))).numpy(),
+            F.poisson_nll_loss(a, _t(RNG.randint(0, 5, (4, 6)))).numpy(),
+            rtol=1.0)
+
+
+class TestRNNCells:
+    def test_lstm_cell_matches_torch(self):
+        cell = paddle.nn.LSTMCell(5, 7)
+        tcell = torch.nn.LSTMCell(5, 7)
+        with torch.no_grad():
+            tcell.weight_ih.copy_(torch.tensor(cell.weight_ih.numpy()))
+            tcell.weight_hh.copy_(torch.tensor(cell.weight_hh.numpy()))
+            tcell.bias_ih.copy_(torch.tensor(cell.bias_ih.numpy()))
+            tcell.bias_hh.copy_(torch.tensor(cell.bias_hh.numpy()))
+        x = RNG.standard_normal((3, 5)).astype(np.float32)
+        h0 = RNG.standard_normal((3, 7)).astype(np.float32)
+        c0 = RNG.standard_normal((3, 7)).astype(np.float32)
+        out, (h, c) = cell(_t(x), (_t(h0), _t(c0)))
+        th, tc = tcell(torch.tensor(x), (torch.tensor(h0),
+                                         torch.tensor(c0)))
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), tc.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(out.numpy(), h.numpy())
+
+    def test_gru_cell_shapes_and_default_state(self):
+        cell = paddle.nn.GRUCell(4, 6)
+        out, h = cell(_t(RNG.standard_normal((2, 4))))
+        assert out.shape == [2, 6] and h.shape == [2, 6]
+        np.testing.assert_allclose(out.numpy(), h.numpy())
+
+    def test_rnn_wrapper_equals_manual_loop(self):
+        cell = paddle.nn.SimpleRNNCell(4, 6)
+        x = _t(RNG.standard_normal((2, 5, 4)))
+        outs, final = paddle.nn.RNN(cell)(x)
+        st = None
+        for t in range(5):
+            o, st = cell(x[:, t], st)
+        np.testing.assert_allclose(final.numpy(), st.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(outs[:, -1].numpy(), o.numpy(),
+                                   rtol=1e-6)
+
+    def test_birnn_concat_and_grad(self):
+        fw, bw = paddle.nn.GRUCell(4, 3), paddle.nn.GRUCell(4, 3)
+        rnn = paddle.nn.BiRNN(fw, bw)
+        x = _t(RNG.standard_normal((2, 5, 4)))
+        x.stop_gradient = False
+        out, (sf, sb) = rnn(x)
+        assert out.shape == [2, 5, 6]
+        (g,) = paddle.grad(out.sum(), [x])
+        assert np.isfinite(g.numpy()).all() and np.abs(g.numpy()).sum() > 0
+
+    def test_rnn_reverse(self):
+        cell = paddle.nn.SimpleRNNCell(4, 6)
+        x = _t(RNG.standard_normal((2, 5, 4)))
+        fwd, _ = paddle.nn.RNN(cell)(x)
+        rev, _ = paddle.nn.RNN(cell, is_reverse=True)(x)
+        flipped = _t(x.numpy()[:, ::-1].copy())
+        ref, _ = paddle.nn.RNN(cell)(flipped)
+        np.testing.assert_allclose(rev.numpy()[:, ::-1], ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestRNNCellIsolation:
+    def test_mixed_activations_do_not_cross_wire(self):
+        # constructing a relu cell used to rewire existing tanh cells
+        # (the step fn was assigned to the CLASS)
+        a = paddle.nn.SimpleRNNCell(4, 6, activation='tanh')
+        x = _t(RNG.standard_normal((2, 4)) * 3)
+        o1, _ = a(x)
+        paddle.nn.SimpleRNNCell(4, 6, activation='relu')
+        o2, _ = a(x)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy())
+        assert float(o1.min().numpy()) < 0  # really tanh, not relu
+        r1 = paddle.nn.SimpleRNN(4, 6, activation='relu')
+        t1 = paddle.nn.SimpleRNN(4, 6, activation='tanh')
+        seq = _t(RNG.standard_normal((2, 3, 4)) * 3)
+        out_r, _ = r1(seq)
+        assert float(out_r.min().numpy()) >= 0.0
+        out_t, _ = t1(seq)
+        assert float(out_t.min().numpy()) < 0.0
+
+    def test_maxpool_positional_return_mask(self):
+        # upstream order: MaxPool2D(kernel, stride, padding, return_mask)
+        y, mask = paddle.nn.MaxPool2D(2, 2, 0, True)(paddle.randn(
+            [1, 1, 4, 4]))
+        assert y.shape == [1, 1, 2, 2] and mask.shape == [1, 1, 2, 2]
+
+    def test_rnn_sequence_length_masks_states(self):
+        cell = paddle.nn.GRUCell(4, 6)
+        x = _t(RNG.standard_normal((2, 5, 4)))
+        lens = paddle.to_tensor(np.array([3, 5]))
+        outs, final = paddle.nn.RNN(cell)(x, sequence_length=lens)
+        # sequence 0: outputs past t=2 are zero, final == state at t=2
+        np.testing.assert_allclose(outs.numpy()[0, 3:], 0.0)
+        st = None
+        for t in range(3):
+            o, st = cell(x[0:1, t], st)
+        np.testing.assert_allclose(final.numpy()[0], st.numpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+        # reverse direction: pad steps are no-ops, so the scan starts at
+        # each sequence's last valid token
+        outs_r, final_r = paddle.nn.RNN(cell, is_reverse=True)(
+            x, sequence_length=lens)
+        short = _t(x.numpy()[0:1, :3])
+        ref_r, ref_final = paddle.nn.RNN(cell, is_reverse=True)(short)
+        np.testing.assert_allclose(final_r.numpy()[0], ref_final.numpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(outs_r.numpy()[0, :3],
+                                   ref_r.numpy()[0], rtol=1e-5, atol=1e-6)
+
+
+class TestTensorMethods:
+    def test_inplace_random_fills(self):
+        paddle.seed(0)
+        t = paddle.zeros([64, 64])
+        t.uniform_(2.0, 3.0)
+        assert 2.0 <= float(t.min().numpy()) and float(t.max().numpy()) <= 3.0
+        t.normal_(mean=5.0, std=0.1)
+        assert abs(float(t.mean().numpy()) - 5.0) < 0.05
+        t.exponential_(lam=2.0)
+        assert float(t.min().numpy()) > 0
+        assert abs(float(t.mean().numpy()) - 0.5) < 0.05
+
+    def test_misc_methods(self):
+        t = paddle.ones([2, 3])
+        assert t.element_size() == 4
+        assert paddle.ones([2], dtype='int8').element_size() == 1
+        t.set_value(np.arange(6).reshape(2, 3).astype(np.float32))
+        np.testing.assert_allclose(t.numpy()[1], [3, 4, 5])
+        t.floor_(); t.ceil_()
+        m = paddle.to_tensor(np.array([[True, False], [False, True]]))
+        t2 = paddle.zeros([2, 2])
+        t2.masked_fill_(m, 3.0)
+        np.testing.assert_allclose(t2.numpy(), [[3, 0], [0, 3]])
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
