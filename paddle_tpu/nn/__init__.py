@@ -26,7 +26,7 @@ from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
                    Conv3D, Conv3DTranspose)
 from .layer import Layer, ParamAttr
 from .loss_layers import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
-                          CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
+                          CrossEntropyLoss, CTCLoss, GaussianNLLLoss, HSigmoidLoss,
                           HingeEmbeddingLoss, KLDivLoss, L1Loss,
                           MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss,
                           MultiMarginLoss, NLLLoss, PoissonNLLLoss,

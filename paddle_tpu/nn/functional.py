@@ -1683,3 +1683,58 @@ def log_loss(input, label, epsilon=1e-4, name=None):
         return -(y * jnp.log(x + epsilon)
                  + (1.0 - y) * jnp.log1p(-x + epsilon))
     return defop(f, name='log_loss')(input, label)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference
+    paddle.nn.functional.hsigmoid_loss): classify by walking a binary
+    tree of `num_classes - 1` internal nodes, paying a binary logistic
+    loss at each step. Default tree is the complete binary tree in heap
+    layout (root 0, children 2i+1/2i+2, leaf c at node c + C - 1); a
+    custom Huffman-style tree comes in via path_table/path_code
+    ([N, L], -1-padded). The walk is a fixed log2(C)-step masked loop —
+    no data-dependent shapes, so it jits."""
+    def f(x, lab, w, *rest):
+        i = 0
+        b = rest[i] if bias is not None else None
+        if bias is not None:
+            i += 1
+        if path_table is not None:
+            pt = rest[i].astype(jnp.int32)
+            pc = rest[i + 1].astype(jnp.float32)
+            valid = (pt >= 0)
+            nodes = jnp.maximum(pt, 0)
+            codes = pc
+        else:
+            C = int(num_classes)
+            depth = max(1, int(np.ceil(np.log2(max(C, 2)))) + 1)
+            node = lab.astype(jnp.int32) + (C - 1)  # leaf id (heap)
+            nodes_l, codes_l, valid_l = [], [], []
+            for _ in range(depth):
+                parent = (node - 1) // 2
+                is_right = (node == 2 * parent + 2)
+                alive = node > 0
+                nodes_l.append(jnp.where(alive, parent, 0))
+                codes_l.append(is_right.astype(jnp.float32))
+                valid_l.append(alive)
+                node = jnp.where(alive, parent, 0)
+            nodes = jnp.stack(nodes_l, axis=-1)   # [N, D] internal ids
+            codes = jnp.stack(codes_l, axis=-1)   # [N, D] 0/1
+            valid = jnp.stack(valid_l, axis=-1)
+        wn = w[nodes]                              # [N, D, F]
+        z = jnp.einsum('nf,ndf->nd', x.astype(jnp.float32),
+                       wn.astype(jnp.float32))
+        if b is not None:
+            z = z + b[nodes].astype(jnp.float32)
+        # BCE-with-logits at each step, target = code
+        step_loss = jax.nn.softplus(z) - codes * z
+        per = jnp.sum(jnp.where(valid, step_loss, 0.0), axis=-1)
+        return per[:, None]  # upstream returns per-sample [N, 1]
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if path_table is not None:
+        args += [path_table, path_code]
+    return defop(f, name='hsigmoid_loss')(*args)

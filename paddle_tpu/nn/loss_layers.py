@@ -215,3 +215,29 @@ class MultiMarginLoss(Layer):
         return F.multi_margin_loss(input, label, p=self.p,
                                    margin=self.margin, weight=self.weight,
                                    reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (upstream paddle.nn.HSigmoidLoss): holds the
+    [num_classes - 1, feature_size] internal-node weights for the
+    default complete binary tree (or the custom-tree variant via
+    path_table/path_code at call time)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError('num_classes must be >= 2')
+        self.feature_size, self.num_classes = feature_size, num_classes
+        n_nodes = num_classes - 1
+        self.weight = self.create_parameter((n_nodes, feature_size),
+                                            attr=weight_attr)
+        self.bias = self.create_parameter((n_nodes,), attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)

@@ -218,3 +218,118 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
             fn = jax.vmap(fn)
         return fn(lu_mat, piv)
     return defop(f, name='lu_unpack')(x, y)
+
+
+def matrix_exp(x, name=None):
+    """e^A via scaling-and-squaring Padé (upstream paddle.linalg.matrix_exp)."""
+    import jax.scipy.linalg as jsl
+
+    def f(v):
+        one = jsl.expm
+        fn = one
+        for _ in range(v.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(v)
+    return defop(f, name='matrix_exp')(x)
+
+
+def matrix_norm(x, p='fro', axis=(-2, -1), keepdim=False, name=None):
+    def f(v):
+        a1, a2 = [a % v.ndim for a in axis]
+        # jnp.linalg.matrix_norm always reduces the last two dims —
+        # move the requested pair there first
+        v = jnp.moveaxis(v, (a1, a2), (-2, -1))
+        out = jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim)
+        if keepdim:
+            out = jnp.moveaxis(out, (-2, -1), (a1, a2))
+        return out
+    return defop(f, name='matrix_norm')(x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return defop(lambda v: jnp.linalg.vector_norm(v, ord=p, axis=axis,
+                                                  keepdims=keepdim),
+                 name='vector_norm')(x)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return defop(lambda a, b: jnp.linalg.vecdot(a, b, axis=axis),
+                 name='vecdot')(x, y)
+
+
+def householder_product(x, tau, name=None):
+    """Q of the QR factorization from Householder reflectors (upstream
+    paddle.linalg.householder_product; LAPACK orgqr)."""
+    from jax.lax import linalg as lxl
+    return defop(lambda a, t: lxl.householder_product(a, t),
+                 name='householder_product')(x, tau)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply by the implicit Q from geqrf output (upstream
+    paddle.linalg.ormqr; LAPACK ormqr): Q@other, Qᵀ@other, other@Q or
+    other@Qᵀ."""
+    from jax.lax import linalg as lxl
+
+    def f(a, t, o):
+        # LAPACK ormqr applies the FULL m×m Q; pad the k reflectors
+        # with identity ones to materialize it
+        m, k = a.shape[-2], t.shape[-1]
+        if k < m:
+            a = jnp.concatenate(
+                [a, jnp.zeros(a.shape[:-1] + (m - k,), a.dtype)], axis=-1)
+            t = jnp.concatenate(
+                [t, jnp.zeros(t.shape[:-1] + (m - k,), t.dtype)], axis=-1)
+        q = lxl.householder_product(a, t)
+        qq = jnp.swapaxes(q, -1, -2) if transpose else q
+        return qq @ o if left else o @ qq
+    return defop(f, name='ormqr')(x, tau, other)
+
+
+def _rand_lowrank_q(a, q, niter, key):
+    """Randomized range finder (Halko et al. 2011): Q spans the top-q
+    column space of a after `niter` power iterations."""
+    m, n = a.shape[-2], a.shape[-1]
+    r = jax.random.normal(key, a.shape[:-2] + (n, q), a.dtype)
+    y = a @ r
+    qm, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        y = jnp.swapaxes(a, -1, -2) @ qm
+        qn, _ = jnp.linalg.qr(y)
+        y = a @ qn
+        qm, _ = jnp.linalg.qr(y)
+    return qm
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized top-q SVD (upstream paddle.linalg.svd_lowrank; Halko
+    et al.) — q(q+7)-sized dense work instead of full [m, n] SVD."""
+    from .. import framework
+    key = framework.next_rng_key()  # seed-controlled like every RNG op
+
+    def f(a, *m):
+        if m:
+            a = a - m[0]
+        qm = _rand_lowrank_q(a, min(q, *a.shape[-2:]), niter, key)
+        b = jnp.swapaxes(qm, -1, -2) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qm @ u, s, jnp.swapaxes(vh, -1, -2)
+    args = (x,) if M is None else (x, M)
+    return defop(f, name='svd_lowrank')(*args)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (upstream paddle.linalg.pca_lowrank): top-q
+    principal directions of the (optionally centered) data matrix."""
+    from .. import framework
+    key = framework.next_rng_key()
+
+    def f(a):
+        k = q if q is not None else min(6, *a.shape[-2:])
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        qm = _rand_lowrank_q(a, min(k, *a.shape[-2:]), niter, key)
+        b = jnp.swapaxes(qm, -1, -2) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qm @ u, s, jnp.swapaxes(vh, -1, -2)
+    return defop(f, name='pca_lowrank')(x)

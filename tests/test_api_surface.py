@@ -40,7 +40,10 @@ SURFACE = [
     'nn.TripletMarginLoss', 'nn.PoissonNLLLoss', 'nn.GaussianNLLLoss',
     'nn.CosineEmbeddingLoss', 'nn.MultiMarginLoss',
     'nn.functional.cosine_embedding_loss', 'nn.functional.multi_margin_loss',
-    'nn.functional.log_loss', 'broadcast_shape',
+    'nn.functional.log_loss', 'broadcast_shape', 'nn.HSigmoidLoss',
+    'nn.functional.hsigmoid_loss', 'linalg.matrix_exp', 'linalg.matrix_norm',
+    'linalg.vector_norm', 'linalg.vecdot', 'linalg.householder_product',
+    'linalg.ormqr', 'linalg.svd_lowrank', 'linalg.pca_lowrank',
     'set_device', 'get_device', 'CPUPlace', 'CUDAPlace', 'Model',
     # linalg
     'linalg.cholesky', 'linalg.qr', 'linalg.svd', 'linalg.inv',

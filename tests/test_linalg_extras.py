@@ -1,0 +1,91 @@
+"""Round-5 linalg additions (upstream python/paddle/tensor/linalg.py):
+matrix_exp, matrix/vector norms, vecdot, householder_product, ormqr,
+randomized svd_lowrank / pca_lowrank."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestMatrixExp:
+    def test_vs_scipy(self):
+        from scipy.linalg import expm
+        a = RNG.standard_normal((4, 4)).astype(np.float32) * 0.3
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_exp(_t(a)).numpy(), expm(a),
+            rtol=1e-4, atol=1e-5)
+
+    def test_batched(self):
+        from scipy.linalg import expm
+        a = RNG.standard_normal((3, 4, 4)).astype(np.float32) * 0.2
+        got = paddle.linalg.matrix_exp(_t(a)).numpy()
+        for i in range(3):
+            np.testing.assert_allclose(got[i], expm(a[i]), rtol=1e-4,
+                                       atol=1e-5)
+
+
+class TestNorms:
+    def test_matrix_vector_norm_vecdot(self):
+        a = RNG.standard_normal((5, 7)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_norm(_t(a)).numpy(),
+            np.linalg.norm(a, 'fro'), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.vector_norm(_t(a), p=1.0, axis=1).numpy(),
+            np.abs(a).sum(1), rtol=1e-5)
+        b = RNG.standard_normal((5, 7)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.vecdot(_t(a), _t(b)).numpy(),
+            (a * b).sum(-1), rtol=1e-5)
+
+
+class TestHouseholder:
+    def test_product_and_ormqr(self):
+        import scipy.linalg as sl
+        m = RNG.standard_normal((12, 6)).astype(np.float32)
+        (a, taus), _ = sl.qr(m, mode='raw')  # LAPACK geqrf layout
+        a = np.ascontiguousarray(a).astype(np.float32)
+        taus = taus.astype(np.float32)
+        q = paddle.linalg.householder_product(_t(a), _t(taus))
+        qref, _ = np.linalg.qr(m)
+        np.testing.assert_allclose(np.abs(q.numpy()), np.abs(qref),
+                                   rtol=1e-3, atol=1e-4)
+        # ormqr applies the FULL 12x12 Q
+        other = RNG.standard_normal((12, 3)).astype(np.float32)
+        got = paddle.linalg.ormqr(_t(a), _t(taus), _t(other))
+        ref = paddle.linalg.householder_product(
+            _t(np.concatenate([a, np.zeros((12, 6), np.float32)], 1)),
+            _t(np.concatenate([taus, np.zeros(6, np.float32)]))).numpy()
+        np.testing.assert_allclose(got.numpy(), ref @ other,
+                                   rtol=1e-3, atol=1e-4)
+        gotT = paddle.linalg.ormqr(_t(a), _t(taus), _t(other),
+                                   transpose=True)
+        np.testing.assert_allclose(gotT.numpy(), ref.T @ other,
+                                   rtol=1e-3, atol=1e-4)
+        # the full Q really is orthogonal and extends the reduced Q
+        np.testing.assert_allclose(ref.T @ ref, np.eye(12), atol=1e-4)
+        np.testing.assert_allclose(np.abs(ref[:, :6]), np.abs(qref),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestLowRank:
+    def test_svd_lowrank_recovers_low_rank(self):
+        m = (RNG.standard_normal((50, 5))
+             @ RNG.standard_normal((5, 20))).astype(np.float32)
+        u, s, v = paddle.linalg.svd_lowrank(_t(m), q=10)
+        sref = np.linalg.svd(m, compute_uv=False)
+        np.testing.assert_allclose(s.numpy()[:5], sref[:5], rtol=1e-4)
+        rec = (u.numpy()[:, :5] * s.numpy()[:5]) @ v.numpy().T[:5]
+        np.testing.assert_allclose(rec, m, atol=1e-3)
+
+    def test_pca_lowrank_centers(self):
+        m = (RNG.standard_normal((40, 4))
+             @ RNG.standard_normal((4, 15)) + 5.0).astype(np.float32)
+        _, s, _ = paddle.linalg.pca_lowrank(_t(m), q=4)
+        sref = np.linalg.svd(m - m.mean(0), compute_uv=False)
+        np.testing.assert_allclose(s.numpy()[:4], sref[:4], rtol=1e-3)

@@ -137,6 +137,65 @@ class TestLayerWrappers:
             rtol=1.0)
 
 
+class TestHSigmoid:
+    C, FD, N = 10, 6, 7
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        x = rng.standard_normal((self.N, self.FD)).astype(np.float32)
+        lab = rng.randint(0, self.C, (self.N,))
+        w = rng.standard_normal((self.C - 1, self.FD)).astype(
+            np.float32) * 0.3
+        b = rng.standard_normal((self.C - 1,)).astype(np.float32) * 0.1
+        return x, lab, w, b
+
+    def test_matches_python_reference(self):
+        x, lab, w, b = self._data()
+        total = 0.0
+        for n in range(self.N):  # independent per-sample tree walk
+            node = lab[n] + self.C - 1
+            while node > 0:
+                parent = (node - 1) // 2
+                code = 1.0 if node == 2 * parent + 2 else 0.0
+                z = float(x[n] @ w[parent] + b[parent])
+                total += np.log1p(np.exp(z)) - code * z
+                node = parent
+        want = total / self.N
+        per = F.hsigmoid_loss(
+            _t(x), paddle.to_tensor(lab), self.C, _t(w), _t(b))
+        assert per.shape == [self.N, 1]  # upstream per-sample layout
+        np.testing.assert_allclose(float(per.mean().numpy()), want,
+                                   rtol=1e-5)
+
+    def test_custom_path_tree(self):
+        x, lab, w, b = self._data()
+        # trivial custom tree: every class has a one-node path through
+        # node 0 with code = class parity
+        pt = np.zeros((self.N, 1), np.int64)
+        pc = (lab % 2).astype(np.float32)[:, None]
+        got = F.hsigmoid_loss(
+            _t(x), paddle.to_tensor(lab), self.C, _t(w), _t(b),
+            path_table=paddle.to_tensor(pt),
+            path_code=_t(pc)).numpy()
+        z = x @ w[0] + b[0]
+        want = (np.log1p(np.exp(z)) - pc[:, 0] * z)[:, None]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_layer_trains(self):
+        x, lab, _, _ = self._data()
+        paddle.seed(4)
+        m = paddle.nn.HSigmoidLoss(self.FD, self.C)
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=m.parameters())
+        first = last = None
+        for i in range(50):
+            loss = m(_t(x), paddle.to_tensor(lab)).mean()
+            loss.backward(); opt.step(); opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+            last = float(loss.numpy())
+        assert last < first * 0.3
+
+
 class TestRNNCells:
     def test_lstm_cell_matches_torch(self):
         cell = paddle.nn.LSTMCell(5, 7)
