@@ -18,8 +18,9 @@ from .collective import (P2POp, ReduceOp, all_gather,
                          broadcast, irecv, isend, recv, reduce,
                          reduce_scatter, scatter, send, wait)
 from .data_parallel import DataParallel
-from .env import (get_group, get_mesh, get_rank, get_world_size,
-                  init_parallel_env, is_initialized, new_group, set_mesh)
+from .env import (destroy_process_group, get_group, get_mesh, get_rank,
+                  get_world_size, init_parallel_env, is_initialized,
+                  new_group, set_mesh, spawn)
 from .fleet import (DistTrainStep, DistributedStrategy, fleet,
                     shard_optimizer_state)
 from .launch import init_on_pod

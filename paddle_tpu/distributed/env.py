@@ -103,7 +103,10 @@ def get_group(axis=None) -> ProcessGroup:
     """The group for a mesh axis; default = the whole mesh (all axes)."""
     mesh = get_mesh()
     if axis is None:
-        return ProcessGroup(tuple(mesh.axis_names), mesh)
+        key = ('__default__',) + tuple(mesh.axis_names)
+        if key not in _state.groups:
+            _state.groups[key] = ProcessGroup(tuple(mesh.axis_names), mesh)
+        return _state.groups[key]
     if isinstance(axis, ProcessGroup):
         return axis
     if axis not in _state.groups:
@@ -135,6 +138,39 @@ def get_rank(group=None) -> int:
 
 def is_initialized() -> bool:
     return _state.initialized
+
+
+def destroy_process_group(group=None):
+    """Tear down the parallel env (upstream
+    paddle.distributed.destroy_process_group). Drops the mesh and all
+    groups so a later init_parallel_env starts fresh; passing a specific
+    group removes just that group."""
+    if group is not None:
+        _state.groups = {k: g for k, g in _state.groups.items()
+                         if g is not group}
+        return
+    _state.mesh = None
+    _state.strategy = None
+    _state.groups = {}
+    _state.initialized = False
+
+
+def spawn(func, args=(), nprocs=-1, **options):
+    """Upstream paddle.distributed.spawn forks one python process per
+    GPU. The TPU-native execution model is SPMD: ONE process drives
+    every local chip through jit/pjit over the mesh, and multi-host
+    scale-out goes through `distributed.launch` (jax.distributed). So
+    spawn runs `func` once in this process with the parallel env
+    initialized — the body's collectives see the full local mesh —
+    and rejects nprocs>1 with a pointer at the SPMD path."""
+    if nprocs not in (-1, 1):
+        raise NotImplementedError(
+            'per-device process fork is a GPU/NCCL pattern; on TPU one '
+            'process drives all local chips (SPMD). Use the mesh-aware '
+            'API directly, or distributed.launch for multi-host.')
+    if not _state.initialized:
+        init_parallel_env()
+    return func(*args)
 
 
 def parallel_device_count() -> int:

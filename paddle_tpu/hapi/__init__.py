@@ -22,10 +22,12 @@ from ..nn.layer import Layer
 from ..tensor import Tensor
 from . import callbacks as callbacks_mod
 from .callbacks import (Callback, CallbackList, EarlyStopping,
+                        ReduceLROnPlateau,
                         LRSchedulerCallback, ModelCheckpoint, ProgBarLogger,
                         VisualDL)
 
 __all__ = ['Model', 'Callback', 'EarlyStopping', 'LRSchedulerCallback',
+           'ReduceLROnPlateau',
            'ModelCheckpoint', 'ProgBarLogger', 'VisualDL', 'callbacks_mod']
 
 

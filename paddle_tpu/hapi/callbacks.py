@@ -141,6 +141,23 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, 'final'))
 
 
+def _resolve_mode(mode, monitor):
+    """'auto' -> 'max' for accuracy-like monitors, else 'min' (shared by
+    EarlyStopping and ReduceLROnPlateau)."""
+    if mode == 'auto':
+        return 'max' if 'acc' in monitor else 'min'
+    return mode
+
+
+def _extract_metric(logs, monitor):
+    """Pull a scalar metric out of a hapi logs dict (metrics may arrive
+    as 1-element lists); None if absent."""
+    cur = (logs or {}).get(monitor)
+    if cur is None:
+        return None
+    return float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor='loss', mode='auto', patience=0,
                  min_delta=0, baseline=None, save_best_model=True):
@@ -150,9 +167,7 @@ class EarlyStopping(Callback):
         self.min_delta = abs(min_delta)
         self.baseline = baseline
         self.save_best_model = save_best_model
-        if mode == 'auto':
-            mode = 'max' if 'acc' in monitor else 'min'
-        self.mode = mode
+        self.mode = _resolve_mode(mode, monitor)
         self.stopped = False
         self.wait = 0
         self.best = None
@@ -165,11 +180,9 @@ class EarlyStopping(Callback):
             else cur < best - delta
 
     def on_eval_end(self, logs=None):
-        logs = logs or {}
-        cur = logs.get(self.monitor)
+        cur = _extract_metric(logs, self.monitor)
         if cur is None:
             return
-        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
         if self._better(cur, self.best):
             self.best = cur
             self.wait = 0
@@ -226,3 +239,73 @@ class VisualDL(Callback):
 # upstream name parity: paddle.callbacks.LRScheduler
 # (python/paddle/hapi/callbacks.py exposes the class under this name)
 LRScheduler = LRSchedulerCallback
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the LR when the monitored metric plateaus (upstream
+    paddle.callbacks.ReduceLROnPlateau). Works on the optimizer the
+    hapi Model was prepared with."""
+
+    def __init__(self, monitor='loss', factor=0.1, patience=10,
+                 mode='auto', min_delta=1e-4, cooldown=0, min_lr=0.0,
+                 verbose=1):
+        super().__init__()
+        if not 0.0 < factor < 1.0:
+            raise ValueError('factor must be in (0, 1), got '
+                             f'{factor!r}')
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.verbose = verbose
+        self.mode = _resolve_mode(mode, monitor)
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+        self._eval_seen_this_epoch = False
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == 'max':
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def _on_metric(self, logs):
+        cur = _extract_metric(logs, self.monitor)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience and self.cooldown_counter == 0:
+            opt = getattr(self.model, '_optimizer', None)
+            if opt is None:
+                return
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f'ReduceLROnPlateau: lr {old:.2e} -> {new:.2e}')
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        # eval metrics win: remember we saw them so the epoch-end train
+        # metrics for the same epoch don't double-count patience
+        self._eval_seen_this_epoch = True
+        self._on_metric(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._eval_seen_this_epoch:
+            self._eval_seen_this_epoch = False
+            return
+        self._on_metric(logs)

@@ -88,6 +88,36 @@ def random_split(dataset, lengths, generator=None):
     return out
 
 
+class ConcatDataset(Dataset):
+    """End-to-end concatenation of map-style datasets (upstream
+    paddle.io.ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError('ConcatDataset needs at least one dataset')
+        self.cumulative_sizes = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __getitem__(self, i):
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(f'index {i - n if i < 0 else i} out of '
+                             f'range for ConcatDataset of length {n}')
+        import bisect
+        di = bisect.bisect_right(self.cumulative_sizes, i)
+        prev = self.cumulative_sizes[di - 1] if di else 0
+        return self.datasets[di][i - prev]
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+
 class ComposeDataset(Dataset):
     def __init__(self, datasets):
         self.datasets = list(datasets)
@@ -598,6 +628,7 @@ def get_worker_info():
 
 
 __all__ = [
+    'ConcatDataset',
     'BatchSampler', 'ChainDataset', 'ComposeDataset', 'DataLoader',
     'Dataset', 'DistributedBatchSampler', 'IterableDataset',
     'RandomSampler', 'Sampler', 'SequenceSampler', 'Subset',

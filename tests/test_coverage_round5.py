@@ -137,6 +137,90 @@ class TestLazyGuard:
         np.testing.assert_allclose(lin.weight.numpy(), w)
 
 
+class TestIoCallbacksDistributed:
+    def test_concat_dataset(self):
+        from paddle_tpu.io import ConcatDataset, Dataset
+
+        class R(Dataset):
+            def __init__(self, lo, hi):
+                self.v = list(range(lo, hi))
+
+            def __getitem__(self, i):
+                return self.v[i]
+
+            def __len__(self):
+                return len(self.v)
+
+        d = ConcatDataset([R(0, 3), R(10, 15)])
+        assert len(d) == 8
+        assert [d[i] for i in range(8)] == [0, 1, 2, 10, 11, 12, 13, 14]
+        assert d[-1] == 14
+
+    def test_reduce_lr_on_plateau(self):
+        m = paddle.nn.Linear(2, 2)
+        opt = paddle.optimizer.Adam(learning_rate=1.0,
+                                    parameters=m.parameters())
+        cb = paddle.callbacks.ReduceLROnPlateau(monitor='loss', factor=0.5,
+                                                patience=2, verbose=0)
+
+        class FakeModel:
+            _optimizer = opt
+        cb.model = FakeModel()
+        cb.on_epoch_end(0, {'loss': 1.0})
+        for e in range(1, 4):  # no improvement for patience=2 epochs
+            cb.on_epoch_end(e, {'loss': 1.0})
+        assert abs(opt.get_lr() - 0.5) < 1e-9
+
+    def test_reduce_lr_plateau_eval_takes_precedence(self):
+        m = paddle.nn.Linear(2, 2)
+        opt = paddle.optimizer.Adam(learning_rate=1.0,
+                                    parameters=m.parameters())
+        cb = paddle.callbacks.ReduceLROnPlateau(monitor='loss', factor=0.5,
+                                                patience=2, verbose=0)
+
+        class FakeModel:
+            _optimizer = opt
+        cb.model = FakeModel()
+        # eval improves while train plateaus: eval wins, no LR cut even
+        # after many epochs (the old double-count would have cut twice)
+        for e in range(6):
+            cb.on_eval_end({'loss': 1.0 - 0.1 * e})
+            cb.on_epoch_end(e, {'loss': 5.0})
+        assert opt.get_lr() == 1.0
+
+    def test_reduce_lr_plateau_rejects_bad_factor(self):
+        with pytest.raises(ValueError, match='factor'):
+            paddle.callbacks.ReduceLROnPlateau(factor=1.5)
+
+    def test_concat_dataset_out_of_range(self):
+        from paddle_tpu.io import ConcatDataset, TensorDataset
+        d = ConcatDataset([TensorDataset([np.zeros((3, 2))])])
+        with pytest.raises(IndexError):
+            d[3]
+        with pytest.raises(IndexError):
+            d[-4]
+
+    def test_destroy_specific_default_group(self):
+        import paddle_tpu.distributed as dist
+        dist.init_parallel_env()
+        g = dist.get_group()
+        assert dist.get_group() is g  # cached => identity-stable
+        dist.destroy_process_group(g)
+        assert dist.get_group() is not g  # really removed, fresh next time
+        dist.destroy_process_group()
+
+    def test_spawn_and_destroy(self):
+        import paddle_tpu.distributed as dist
+        got = dist.spawn(lambda a, b: a + b, args=(2, 3))
+        assert got == 5 and dist.is_initialized()
+        with pytest.raises(NotImplementedError):
+            dist.spawn(lambda: None, nprocs=4)
+        dist.destroy_process_group()
+        assert not dist.is_initialized()
+        dist.init_parallel_env()  # fresh init works after teardown
+        assert dist.is_initialized()
+
+
 class TestHub:
     def test_local_hub_roundtrip(self, tmp_path):
         (tmp_path / 'hubconf.py').write_text(
