@@ -438,6 +438,12 @@ def _run_phase_subprocess(phase, timeout_s, env_extra=None):
 
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == '--phase':
+        import os
+        if os.environ.get('BENCH_FORCE_CPU'):
+            # test hook for the phase flow: the axon preload ignores
+            # JAX_PLATFORMS, so CPU must be forced in-process
+            import jax
+            jax.config.update('jax_platforms', 'cpu')
         print(json.dumps(PHASES[sys.argv[2]]()))
         return 0
     # The orchestrating parent must NOT import jax: on the single-chip

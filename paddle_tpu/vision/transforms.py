@@ -15,6 +15,13 @@ import numpy as np
 from ..tensor import Tensor
 
 
+def _is_chw(img):
+    """Heuristic for channel-first layout (what ToTensor emits): a
+    leading 1/3/4-channel dim with a non-channel-sized trailing dim."""
+    return (img.ndim == 3 and img.shape[0] in (1, 3, 4)
+            and img.shape[2] not in (1, 3, 4))
+
+
 def _as_hwc(img):
     img = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
     if img.ndim == 2:
@@ -265,23 +272,13 @@ class RandomRotation(BaseTransform):
         angle = np.deg2rad(np.random.uniform(*self.degrees))
         h, w = img.shape[:2]
         cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
-        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
         cos_a, sin_a = np.cos(angle), np.sin(angle)
-        # inverse rotation: sample source coords for each dest pixel
-        sy = cos_a * (yy - cy) + sin_a * (xx - cx) + cy
-        sx = -sin_a * (yy - cy) + cos_a * (xx - cx) + cx
-        y0 = np.floor(sy).astype(int)
-        x0 = np.floor(sx).astype(int)
-        wy = (sy - y0)[..., None]
-        wx = (sx - x0)[..., None]
-        valid = (sy >= 0) & (sy <= h - 1) & (sx >= 0) & (sx <= w - 1)
-        y0c, x0c = y0.clip(0, h - 1), x0.clip(0, w - 1)
-        y1c, x1c = (y0 + 1).clip(0, h - 1), (x0 + 1).clip(0, w - 1)
-        f = img.astype(np.float32)
-        out = ((f[y0c, x0c] * (1 - wy) + f[y1c, x0c] * wy) * (1 - wx)
-               + (f[y0c, x1c] * (1 - wy) + f[y1c, x1c] * wy) * wx)
-        out = np.where(valid[..., None], out, np.float32(self.fill))
-        return out.astype(img.dtype) if img.dtype == np.uint8 else out
+        # inverse rotation as a 3x3 (x, y, 1) map into _warp_inverse
+        inv = np.array(
+            [[cos_a, -sin_a, cx - cos_a * cx + sin_a * cy],
+             [sin_a, cos_a, cy - sin_a * cx - cos_a * cy],
+             [0.0, 0.0, 1.0]], np.float32)
+        return _warp_inverse(img, inv, self.fill)
 
 
 class Grayscale(BaseTransform):
@@ -337,3 +334,239 @@ def to_grayscale(img, num_output_channels=1):
 
 def vflip(img):
     return _as_hwc(img)[::-1].copy()
+
+
+class Transpose(BaseTransform):
+    """HWC ndarray -> CHW (upstream paddle.vision.transforms.Transpose;
+    default order (2, 0, 1))."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        return np.transpose(_as_hwc(img), self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    """Single-factor brightness jitter (upstream transforms of the same
+    name): value in [max(0,1-v), 1+v] like ColorJitter's one channel."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        return ColorJitter(brightness=self.value)._apply_image(img)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        return ColorJitter(contrast=self.value)._apply_image(img)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        return ColorJitter(saturation=self.value)._apply_image(img)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        return ColorJitter(hue=self.value)._apply_image(img)
+
+
+class RandomErasing(BaseTransform):
+    """Erase a random rectangle (upstream RandomErasing / arXiv
+    1708.04896): area ratio in `scale`, aspect in `ratio`, filled with
+    `value` (or per-pixel noise when value='random')."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale, self.ratio = scale, ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        chw = _is_chw(img)  # post-ToTensor layout: erase spatially
+        if chw:
+            img = np.transpose(img, (1, 2, 0))
+        img = _as_hwc(img)
+        if np.random.uniform() >= self.prob:
+            return np.transpose(img, (2, 0, 1)) if chw else img
+        h, w = img.shape[:2]
+        area = h * w
+        out = img if self.inplace else img.copy()
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(*np.log(self.ratio)))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if isinstance(self.value, str):  # 'random'
+                    patch = np.random.uniform(
+                        0, 255 if img.dtype == np.uint8 else 1.0,
+                        (eh, ew) + img.shape[2:])
+                    out[i:i + eh, j:j + ew] = patch.astype(img.dtype)
+                else:
+                    out[i:i + eh, j:j + ew] = self.value
+                break
+        return np.transpose(out, (2, 0, 1)) if chw else out
+
+
+def _warp_inverse(img, inv3x3, fill=0):
+    """Bilinear warp by an inverse 3x3 projective map (dest -> src),
+    shared by RandomAffine / RandomPerspective."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    ones = np.ones_like(xx)
+    src = inv3x3 @ np.stack([xx.ravel(), yy.ravel(), ones.ravel()])
+    sx = (src[0] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2]))
+    sy = (src[1] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2]))
+    sx, sy = sx.reshape(h, w), sy.reshape(h, w)
+    # epsilon tolerance: float32 homography math can put border pixels a
+    # hair outside [0, size-1] and they must not drop to fill
+    tol = 1e-3
+    valid = (sy >= -tol) & (sy <= h - 1 + tol) \
+        & (sx >= -tol) & (sx <= w - 1 + tol)
+    sy = sy.clip(0, h - 1)
+    sx = sx.clip(0, w - 1)
+    y0 = np.floor(sy).astype(int)
+    x0 = np.floor(sx).astype(int)
+    wy = (sy - y0)[..., None]
+    wx = (sx - x0)[..., None]
+    y0c, x0c = y0.clip(0, h - 1), x0.clip(0, w - 1)
+    y1c, x1c = (y0 + 1).clip(0, h - 1), (x0 + 1).clip(0, w - 1)
+    f = img.astype(np.float32)
+    out = ((f[y0c, x0c] * (1 - wy) + f[y1c, x0c] * wy) * (1 - wx)
+           + (f[y0c, x1c] * (1 - wy) + f[y1c, x1c] * wy) * wx)
+    out = np.where(valid[..., None], out, np.float32(fill))
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class RandomAffine(BaseTransform):
+    """Random rotation + translation + scale + shear (upstream
+    RandomAffine), realized as one inverse-mapped bilinear warp."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation='bilinear', fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = tuple(degrees)
+        self.translate = translate
+        self.scale_range = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        s = np.random.uniform(*self.scale_range) \
+            if self.scale_range is not None else 1.0
+        shx = shy = 0.0
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, numbers.Number):
+                sh = (-sh, sh)
+            shx = np.deg2rad(np.random.uniform(sh[0], sh[1]))
+            if len(sh) == 4:
+                shy = np.deg2rad(np.random.uniform(sh[2], sh[3]))
+        cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if self.center is None \
+            else (self.center[1], self.center[0])
+        cos_a, sin_a = np.cos(angle), np.sin(angle)
+        # forward: T(center) @ R @ Shear @ S @ T(-center) + t
+        rs = np.array([[cos_a, -sin_a], [sin_a, cos_a]], np.float32) @ \
+            np.array([[1, np.tan(shx)], [np.tan(shy), 1]], np.float32) * s
+        fwd = np.eye(3, dtype=np.float32)
+        fwd[:2, :2] = rs
+        fwd[0, 2] = cx + tx - rs[0, 0] * cx - rs[0, 1] * cy
+        fwd[1, 2] = cy + ty - rs[1, 0] * cx - rs[1, 1] * cy
+        inv = np.linalg.inv(fwd)
+        return _warp_inverse(img, inv.astype(np.float32), self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    """Random 4-point perspective warp (upstream RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation='bilinear', fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    @staticmethod
+    def _solve_homography(src, dst):
+        """3x3 H with H @ src_i ~ dst_i (both [4, 2], x-y order)."""
+        a = []
+        for (x, y), (u, v) in zip(src, dst):
+            a.append([x, y, 1, 0, 0, 0, -u * x, -u * y, -u])
+            a.append([0, 0, 0, x, y, 1, -v * x, -v * y, -v])
+        _, _, vt = np.linalg.svd(np.asarray(a, np.float64))
+        hmat = vt[-1].reshape(3, 3)
+        return (hmat / hmat[2, 2]).astype(np.float32)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if np.random.uniform() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        dx, dy = w * d / 2.0, h * d / 2.0
+        corners = np.array([[0, 0], [w - 1, 0], [w - 1, h - 1],
+                            [0, h - 1]], np.float32)
+        jitter = np.random.uniform(0, 1, (4, 2)).astype(np.float32) * \
+            np.array([dx, dy], np.float32)
+        signs = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1]], np.float32)
+        dst = corners + jitter * signs
+        # inverse map: dest corners -> source corners
+        inv = self._solve_homography(dst, corners)
+        return _warp_inverse(img, inv, self.fill)
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width].copy()
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    img = np.asarray(img)
+    if _is_chw(img):  # channel-first: erase the spatial rectangle
+        out = img if inplace else img.copy()
+        out[:, i:i + h, j:j + w] = v
+        return out
+    img = _as_hwc(img)
+    out = img if inplace else img.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    return ColorJitter(
+        brightness=(brightness_factor, brightness_factor))._apply_image(img)
+
+
+def adjust_contrast(img, contrast_factor):
+    return ColorJitter(
+        contrast=(contrast_factor, contrast_factor))._apply_image(img)
+
+
+def adjust_hue(img, hue_factor):
+    return ColorJitter(hue=(hue_factor, hue_factor))._apply_image(img)

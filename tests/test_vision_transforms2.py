@@ -1,0 +1,138 @@
+"""Round-5 vision.transforms additions (upstream
+python/paddle/vision/transforms/): single-factor jitters, RandomErasing,
+RandomAffine, RandomPerspective, Transpose, crop/erase/adjust_* ops."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+T = paddle.vision.transforms
+
+
+def _img(h=32, w=48):
+    return np.random.RandomState(0).uniform(0, 255, (h, w, 3)).astype(
+        np.uint8)
+
+
+class TestSimpleTransforms:
+    def test_transpose(self):
+        out = T.Transpose()(_img())
+        assert out.shape == (3, 32, 48)
+        np.testing.assert_array_equal(out[0], _img()[:, :, 0])
+
+    def test_single_factor_jitters_change_image(self):
+        np.random.seed(1)
+        img = _img()
+        for cls in (T.BrightnessTransform, T.ContrastTransform,
+                    T.SaturationTransform, T.HueTransform):
+            out = cls(0.4)(img)
+            assert out.shape == img.shape and out.dtype == img.dtype
+        # zero-value jitter is identity
+        np.testing.assert_array_equal(T.BrightnessTransform(0)(img), img)
+
+    def test_adjust_ops(self):
+        img = _img()
+        b = T.adjust_brightness(img, 1.5)
+        assert float(b.mean()) > float(img.mean()) * 1.2
+        d = T.adjust_brightness(img, 0.5)
+        assert float(d.mean()) < float(img.mean()) * 0.6
+        c = T.adjust_contrast(img, 0.0)  # zero contrast -> flat image
+        assert np.ptp(c.astype(np.float32).mean(axis=2)) <= 1.0
+
+    def test_crop_and_erase(self):
+        img = _img()
+        c = T.crop(img, 4, 6, 10, 12)
+        np.testing.assert_array_equal(c, img[4:14, 6:18])
+        e = T.erase(img, 2, 3, 5, 7, 0)
+        assert (e[2:7, 3:10] == 0).all()
+        assert (e[0:2] == img[0:2]).all()
+        # inplace=False left the original untouched
+        assert not (img[2:7, 3:10] == 0).all()
+
+
+class TestRandomErasing:
+    def test_erases_with_prob_one(self):
+        np.random.seed(0)
+        img = _img()
+        out = T.RandomErasing(prob=1.0, value=0)(img)
+        erased = (out == 0).all(axis=2).sum()
+        assert erased >= int(0.02 * 32 * 48 * 0.9)
+        np.testing.assert_array_equal(
+            T.RandomErasing(prob=0.0)(img), img)
+
+    def test_chw_input_erases_spatial_patch(self):
+        # upstream applies RandomErasing AFTER ToTensor (CHW float32):
+        # the erased region must be a spatial rectangle, not a
+        # cross-channel band
+        np.random.seed(5)
+        chw = T.ToTensor()(_img())
+        out = T.RandomErasing(prob=1.0, value=0)(chw)
+        assert out.shape == chw.shape
+        zero = (out == 0).all(axis=0)
+        ys, xs = np.nonzero(zero)
+        rect = (ys.max() - ys.min() + 1) * (xs.max() - xs.min() + 1)
+        assert len(ys) == rect  # contiguous spatial rectangle
+        e = T.erase(chw, 2, 3, 5, 7, 0.0)
+        assert (e[:, 2:7, 3:10] == 0).all()
+
+    def test_rotation_through_shared_warp(self):
+        img = _img(20, 20)
+        np.testing.assert_array_equal(T.rotate(img, 0), img)
+        np.testing.assert_array_equal(T.rotate(img, 180),
+                                      img[::-1, ::-1])
+
+    def test_random_fill(self):
+        np.random.seed(0)
+        out = T.RandomErasing(prob=1.0, value='random')(_img())
+        assert out.shape == (32, 48, 3)
+
+
+class TestWarps:
+    def test_identity_affine_and_perspective_are_exact(self):
+        np.random.seed(2)
+        img = _img()
+        np.testing.assert_array_equal(
+            T.RandomAffine(degrees=(0, 0))(img), img)
+        np.testing.assert_array_equal(
+            T.RandomPerspective(prob=1.0, distortion_scale=0.0)(img), img)
+
+    def test_pure_translation_shifts(self):
+        np.random.seed(0)
+        img = np.zeros((16, 16, 1), np.float32)
+        img[8, 8, 0] = 1.0
+        # translate range (d, d) forces a deterministic |shift| <= d*16
+        out = T.RandomAffine(degrees=(0, 0), translate=(0.25, 0.25))(img)
+        # mass is conserved away from borders
+        assert abs(out.sum() - 1.0) < 1e-4
+        ys, xs = np.nonzero(out[:, :, 0] > 1e-6)
+        assert len(ys) >= 1  # landed somewhere (possibly split bilinear)
+
+    def test_affine_scale_shrinks_content(self):
+        np.random.seed(0)
+        img = np.ones((20, 20, 1), np.float32)
+        out = T.RandomAffine(degrees=(0, 0), scale=(2.0, 2.0))(img)
+        # scale=2 zooms OUT content in inverse map convention or IN —
+        # either way the warp must keep values in [0, 1]
+        assert out.min() >= 0.0 and out.max() <= 1.0 + 1e-6
+
+    def test_perspective_distorts(self):
+        np.random.seed(3)
+        img = _img()
+        out = T.RandomPerspective(prob=1.0, distortion_scale=0.5)(img)
+        assert out.shape == img.shape
+        assert np.abs(out.astype(int) - img.astype(int)).mean() > 1.0
+
+
+class TestComposeIntegration:
+    def test_augmentation_pipeline(self):
+        np.random.seed(4)
+        pipe = T.Compose([
+            T.Resize(40),
+            T.RandomCrop(32),
+            T.RandomHorizontalFlip(),
+            T.BrightnessTransform(0.2),
+            T.RandomErasing(prob=1.0),
+            T.ToTensor(),
+        ])
+        out = pipe(_img(48, 64))
+        assert list(out.shape) == [3, 32, 32]
+        assert str(out.dtype) == 'float32'
