@@ -1,5 +1,7 @@
 """paddle.vision — model zoo, transforms, datasets, ops."""
-from . import datasets, models, ops, transforms  # noqa: F401
+from . import datasets, image, models, ops, transforms  # noqa: F401
+from .image import (get_image_backend, image_load,  # noqa: F401
+                    set_image_backend)
 from .models import (AlexNet, DenseNet, GoogLeNet,  # noqa: F401
                      InceptionV3, LeNet, MobileNetV1, MobileNetV2,
                      MobileNetV3, ResNet, ShuffleNetV2, SqueezeNet, VGG,

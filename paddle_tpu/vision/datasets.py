@@ -145,3 +145,87 @@ class Cifar100(Cifar10):
 
     def _members(self, mode):
         return ['train'] if mode == 'train' else ['test']
+
+
+IMG_EXTENSIONS = ('.jpg', '.jpeg', '.png', '.ppm', '.bmp', '.pgm',
+                  '.tif', '.tiff', '.webp')
+
+
+def _scan_files(root, extensions, is_valid_file):
+    """Deterministic recursive file scan shared by DatasetFolder and
+    ImageFolder; default filter = extension allowlist."""
+    if is_valid_file is None:
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+
+        def is_valid_file(p):
+            return p.lower().endswith(exts)
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            p = os.path.join(dirpath, fn)
+            if is_valid_file(p):
+                out.append(p)
+    return out
+
+
+def _default_loader(path):
+    from . import image as _image
+    return _image.image_load(path)
+
+
+class DatasetFolder(Dataset):
+    """Generic folder-of-class-subdirs dataset (upstream
+    paddle.vision.datasets.DatasetFolder): root/class_x/xxx.ext -> label
+    by sorted class-dir order."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f'no class folders under {root!r}')
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for p in _scan_files(os.path.join(root, c), extensions,
+                                 is_valid_file):
+                self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f'no valid files under {root!r}')
+
+    def __getitem__(self, i):
+        path, label = self.samples[i]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (unlabeled) image folder (upstream
+    paddle.vision.datasets.ImageFolder): returns [img] per sample."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        self.samples = _scan_files(root, extensions, is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f'no valid files under {root!r}')
+
+    def __getitem__(self, i):
+        img = self.loader(self.samples[i])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -2,6 +2,7 @@
 python/paddle/vision/transforms/): single-factor jitters, RandomErasing,
 RandomAffine, RandomPerspective, Transpose, crop/erase/adjust_* ops."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 
@@ -120,6 +121,53 @@ class TestWarps:
         out = T.RandomPerspective(prob=1.0, distortion_scale=0.5)(img)
         assert out.shape == img.shape
         assert np.abs(out.astype(int) - img.astype(int)).mean() > 1.0
+
+
+class TestImageFolders:
+    def _make_tree(self, tmp_path):
+        from PIL import Image
+        for cls in ('cat', 'dog'):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                arr = np.random.RandomState(i).randint(
+                    0, 255, (8, 8, 3), np.uint8)
+                Image.fromarray(arr).save(str(d / f'{i}.png'))
+                (d / f'{i}.txt').write_text('not an image')
+        return str(tmp_path)
+
+    def test_dataset_folder(self, tmp_path):
+        root = self._make_tree(tmp_path)
+        ds = paddle.vision.datasets.DatasetFolder(root)
+        assert ds.classes == ['cat', 'dog'] and len(ds) == 6
+        img, lab = ds[0]
+        assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+        assert sorted({l for _, l in ds.samples}) == [0, 1]
+
+    def test_image_folder_and_loader_pipeline(self, tmp_path):
+        root = self._make_tree(tmp_path)
+        flat = paddle.vision.datasets.ImageFolder(root)
+        assert len(flat) == 6 and flat[0][0].shape == (8, 8, 3)
+        t = T.Compose([T.Resize(16), T.ToTensor()])
+        ds = paddle.vision.datasets.DatasetFolder(root, transform=t)
+        from paddle_tpu.io import DataLoader
+        xb, yb = next(iter(DataLoader(ds, batch_size=4, shuffle=True)))
+        assert list(xb.shape) == [4, 3, 16, 16] and list(yb.shape) == [4]
+
+    def test_image_load_and_backend(self, tmp_path):
+        from PIL import Image
+        p = str(tmp_path / 'x.png')
+        arr = np.random.RandomState(0).randint(0, 255, (6, 7, 3), np.uint8)
+        Image.fromarray(arr).save(p)
+        got = paddle.vision.image_load(p)
+        np.testing.assert_array_equal(got, arr)
+        assert paddle.vision.get_image_backend() == 'pil'
+        with pytest.raises(ValueError):
+            paddle.vision.set_image_backend('nope')
+
+    def test_empty_folder_raises(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            paddle.vision.datasets.DatasetFolder(str(tmp_path))
 
 
 class TestComposeIntegration:
