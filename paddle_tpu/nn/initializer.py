@@ -207,3 +207,43 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == 'selu':
         return 3.0 / 4
     return 1.0
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel for transposed convs (upstream
+    paddle.nn.initializer.Bilinear): each [kh, kw] slice is the tent
+    filter that makes ConvTranspose an interpolation."""
+
+    def __call__(self, shape, dtype=None):
+        dt = dtype or framework.get_default_dtype()
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError('Bilinear initializer expects a 4-D conv '
+                             f'weight, got shape {shape}')
+        kh, kw = shape[2], shape[3]
+
+        def tent(k):
+            f = (k + 1) // 2
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            return 1 - np.abs(np.arange(k) / f - c)
+        kern = np.outer(tent(kh), tent(kw)).astype(np.float32)
+        # upstream fills EVERY [out, in] slice with the tent kernel
+        w = np.broadcast_to(kern, shape).copy()
+        return jnp.asarray(w, dt)
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the default parameter initializers for layers built
+    afterwards (upstream paddle.nn.initializer.set_global_initializer);
+    pass None to restore the built-in defaults."""
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+def _global_default(is_bias):
+    return _GLOBAL_BIAS_INIT if is_bias else _GLOBAL_WEIGHT_INIT

@@ -149,6 +149,8 @@ class Layer:
             init = attr.initializer
         elif default_initializer is not None:
             init = default_initializer
+        elif I._global_default(is_bias) is not None:
+            init = I._global_default(is_bias)
         elif is_bias:
             init = I.Constant(0.0)
         else:

@@ -319,3 +319,49 @@ class CosineAnnealingWarmRestarts(LRScheduler):
             T_i *= self.T_mult
         return self.eta_min + (self.base_lr - self.eta_min) \
             * (1 + math.cos(math.pi * t_cur / T_i)) / 2
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr *= lr_lambda(epoch) each step (upstream
+    paddle.optimizer.lr.MultiplicativeDecay). The factor applies
+    cumulatively from epoch 1; the running product is tracked
+    incrementally (O(1) per sequential step) and only rebuilt on epoch
+    jumps (set_state_dict / explicit step(epoch))."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        self._prod = 1.0
+        self._prod_epoch = 0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch == self._prod_epoch + 1:
+            self._prod *= self.lr_lambda(self.last_epoch)
+        elif self.last_epoch != self._prod_epoch:
+            self._prod = 1.0
+            for e in range(1, self.last_epoch + 1):
+                self._prod *= self.lr_lambda(e)
+        self._prod_epoch = self.last_epoch
+        return self.base_lr * self._prod
+
+
+class LinearLR(LRScheduler):
+    """Linear ramp of the LR factor from start_factor to end_factor over
+    total_steps (upstream paddle.optimizer.lr.LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError('total_steps must be positive')
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(self.last_epoch, self.total_steps)
+        frac = t / self.total_steps
+        factor = self.start_factor + \
+            (self.end_factor - self.start_factor) * frac
+        return self.base_lr * factor

@@ -49,6 +49,59 @@ class TestSmallOps:
             paddle.set_printoptions(precision=4)
 
 
+class TestLrAndInit:
+    def test_linear_lr_vs_torch(self):
+        import torch
+        from paddle_tpu.optimizer.lr import LinearLR
+        s = LinearLR(0.1, total_steps=4, start_factor=0.5)
+        topt = torch.optim.SGD(torch.nn.Linear(1, 1).parameters(), lr=0.1)
+        ts = torch.optim.lr_scheduler.LinearLR(topt, start_factor=0.5,
+                                               total_iters=4)
+        for i in range(7):
+            np.testing.assert_allclose(s(), ts.get_last_lr()[0],
+                                       rtol=1e-6)
+            s.step(); topt.step(); ts.step()
+
+    def test_multiplicative_decay_vs_torch(self):
+        import torch
+        from paddle_tpu.optimizer.lr import MultiplicativeDecay
+        m = MultiplicativeDecay(0.1, lambda e: 0.9)
+        topt = torch.optim.SGD(torch.nn.Linear(1, 1).parameters(), lr=0.1)
+        tms = torch.optim.lr_scheduler.MultiplicativeLR(topt,
+                                                        lambda e: 0.9)
+        for i in range(5):
+            np.testing.assert_allclose(m(), tms.get_last_lr()[0],
+                                       rtol=1e-6)
+            m.step(); topt.step(); tms.step()
+
+    def test_bilinear_initializer_interpolates(self):
+        I = paddle.nn.initializer
+        w = np.asarray(I.Bilinear()((1, 1, 4, 4)))
+        # tent filter: symmetric, peaks in the middle
+        np.testing.assert_allclose(w[0, 0], w[0, 0].T, rtol=1e-6)
+        assert w[0, 0, 1, 1] == w[0, 0].max()
+        # a stride-2 transposed conv with this kernel upsamples a
+        # constant image to a constant image (interpolation property)
+        conv = paddle.nn.Conv2DTranspose(
+            1, 1, 4, stride=2, padding=1,
+            weight_attr=paddle.ParamAttr(initializer=I.Bilinear()),
+            bias_attr=False)
+        out = conv(paddle.ones([1, 1, 6, 6])).numpy()
+        np.testing.assert_allclose(out[0, 0, 2:-2, 2:-2], 1.0, rtol=1e-5)
+
+    def test_set_global_initializer(self):
+        I = paddle.nn.initializer
+        I.set_global_initializer(I.Constant(3.0), I.Constant(-1.0))
+        try:
+            lin = paddle.nn.Linear(2, 2)
+        finally:
+            I.set_global_initializer(None)
+        assert float(lin.weight.numpy().min()) == 3.0
+        assert float(lin.bias.numpy()[0]) == -1.0
+        # defaults restored for layers built after reset
+        assert float(paddle.nn.Linear(2, 2).weight.numpy().std()) > 0
+
+
 class TestDiagGrad:
     def test_diag_vector_gradient_flows(self):
         # diag/diagflat used to wrap raw jnp results, silently detaching
