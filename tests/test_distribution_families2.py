@@ -255,6 +255,7 @@ class TestMultivariateNormal:
 
 
 class TestLKJCholesky:
+    @pytest.mark.slow
     def test_sample_is_valid_cholesky_of_correlation(self):
         d = D.LKJCholesky(4, 1.5)
         L = d.sample((64,)).numpy()
@@ -282,6 +283,7 @@ class TestLKJCholesky:
         off = lambda L: np.abs((L @ np.swapaxes(L, -1, -2))[:, 0, 1]).mean()
         assert off(hi) < off(lo)
 
+    @pytest.mark.slow
     def test_cvine_valid_and_matches_onion_marginal(self):
         d = D.LKJCholesky(4, 2.0, sample_method='cvine')
         L = d.sample((2048,), seed=3).numpy()

@@ -181,6 +181,7 @@ class TestHSigmoid:
         want = (np.log1p(np.exp(z)) - pc[:, 0] * z)[:, None]
         np.testing.assert_allclose(got, want, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_layer_trains(self):
         x, lab, _, _ = self._data()
         paddle.seed(4)
@@ -234,6 +235,7 @@ class TestRNNCells:
         np.testing.assert_allclose(outs[:, -1].numpy(), o.numpy(),
                                    rtol=1e-6)
 
+    @pytest.mark.slow
     def test_birnn_concat_and_grad(self):
         fw, bw = paddle.nn.GRUCell(4, 3), paddle.nn.GRUCell(4, 3)
         rnn = paddle.nn.BiRNN(fw, bw)

@@ -44,6 +44,7 @@ class TestOffloadParity:
             np.testing.assert_allclose(base_p[k], off_p[k], rtol=1e-6,
                                        atol=1e-7)
 
+    @pytest.mark.slow
     def test_bf16_moments_match_fused(self):
         base_l, base_p, _ = _run(offload=False, moment_dtype='bfloat16')
         off_l, off_p, _ = _run(offload=True, moment_dtype='bfloat16')
@@ -52,6 +53,7 @@ class TestOffloadParity:
             np.testing.assert_allclose(base_p[k], off_p[k], rtol=1e-5,
                                        atol=1e-6)
 
+    @pytest.mark.slow
     def test_grad_clip_composes(self):
         def run(off):
             m = _model()

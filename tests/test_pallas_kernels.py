@@ -155,8 +155,10 @@ class TestFusedCE:
 
     @pytest.mark.parametrize('n,v,dtype', [
         (256, 2048, 'float32'),
-        (200, 5000, 'bfloat16'),     # pad both dims
-        (64, 50304, 'bfloat16'),     # GPT vocab
+        pytest.param(200, 5000, 'bfloat16',
+                     marks=pytest.mark.slow),  # pad both dims
+        pytest.param(64, 50304, 'bfloat16',
+                     marks=pytest.mark.slow),  # GPT vocab
     ])
     def test_fwd_bwd_match_xla(self, n, v, dtype):
         import jax
