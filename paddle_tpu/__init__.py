@@ -41,6 +41,7 @@ from .nn.layer import LazyGuard, ParamAttr
 from .optimizer import L1Decay, L2Decay
 
 from . import hub
+from . import sysconfig
 from . import regularizer
 from . import audio
 from . import geometric
@@ -62,6 +63,26 @@ __version__ = '0.1.0'
 
 disable_static = static.disable_static
 enable_static = static.enable_static
+
+
+# single source for the CUDA-compat shims: framework.py
+from .framework import is_compiled_with_cuda  # noqa: E402
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = '') -> bool:
+    return False
+
+
+def get_cudnn_version():
+    return None  # no CUDA in this build
 
 
 def in_dynamic_mode() -> bool:

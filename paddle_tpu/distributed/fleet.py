@@ -164,6 +164,9 @@ def get_hybrid_communicate_group():
     return _fleet.get_hybrid_communicate_group()
 
 
+from . import fleet_utils as utils  # noqa: E402  (fleet.utils.recompute)
+_fleet.utils = utils
+
 fleet = _fleet  # upstream spells it fleet.fleet sometimes
 
 

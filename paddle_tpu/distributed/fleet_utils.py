@@ -1,0 +1,66 @@
+"""paddle.distributed.fleet.utils (upstream:
+python/paddle/distributed/fleet/utils/__init__.py): user-level
+activation recompute plus small helpers.
+
+TPU-native: real rematerialization happens where memory pressure exists
+— inside the jitted train step, where `recompute` wraps the segment in
+`jax.checkpoint` and XLA replays it in backward instead of storing its
+activations. In eager mode the call is executed directly on the tape
+(gradients to every parameter the segment touches are exact); eager
+Python keeps activations alive in the recorded graph regardless, so
+pretending to save memory there would be a lie — use jit.TrainStep (or
+a model's `use_recompute` flag) for the memory win, as the reference's
+fleet training path does."""
+from __future__ import annotations
+
+import jax
+
+from .. import autograd
+from ..tensor import Tensor, to_jax
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)`, rematerializing it in backward when called
+    inside a functional/jit trace (upstream fleet.utils.recompute;
+    analogue of torch.utils.checkpoint).
+
+    kwargs `use_reentrant`/`preserve_rng_state` are accepted and
+    ignored — dropout keys are explicit inputs in this framework, so
+    the replayed forward is bitwise the original by construction."""
+    kwargs.pop('use_reentrant', None)
+    kwargs.pop('preserve_rng_state', None)
+    if not autograd._state.functional:
+        # eager: direct tape execution — exact grads for every tensor
+        # the segment touches (incl. layer weights captured by closure)
+        return function(*args, **kwargs)
+
+    # functional/jit trace: raw-value domain; closed-over tracers (the
+    # functionalized layer params) differentiate through jax.checkpoint
+    def inner(*vals):
+        wrapped = [Tensor(v) if not isinstance(v, Tensor) else v
+                   for v in vals]
+        out = function(*wrapped, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o.value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out.value if isinstance(out, Tensor) else out
+
+    vals = [to_jax(a) for a in args]
+    out = jax.checkpoint(inner)(*vals)
+    if isinstance(out, tuple):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    raise NotImplementedError(
+        'global_scatter/global_gather are the reference MoE dispatch '
+        'primitives; this framework dispatches experts with '
+        'distributed.moe.MoELayer (GShard all-to-all over the mesh)')
+
+
+def global_gather(x, local_count, global_count, group=None):
+    raise NotImplementedError(
+        'global_scatter/global_gather are the reference MoE dispatch '
+        'primitives; this framework dispatches experts with '
+        'distributed.moe.MoELayer (GShard all-to-all over the mesh)')

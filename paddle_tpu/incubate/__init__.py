@@ -6,11 +6,13 @@ rides the same flash path as F.scaled_dot_product_attention. The module
 exists for import-path parity; the implementations delegate to the
 already-fused compute paths.
 """
+from . import autograd
 from . import nn
+from . import optimizer
 from ..geometric import segment_sum, segment_mean, segment_min, segment_max
 
-__all__ = ['nn', 'segment_sum', 'segment_mean', 'segment_min', 'segment_max',
-           'graph_send_recv']
+__all__ = ['autograd', 'nn', 'optimizer', 'segment_sum', 'segment_mean',
+           'segment_min', 'segment_max', 'graph_send_recv']
 
 
 def graph_send_recv(x, src_index, dst_index, pool_type='sum', out_size=None,

@@ -1,0 +1,132 @@
+"""paddle.incubate.autograd (upstream:
+python/paddle/incubate/autograd/): functional forward/reverse
+differentiation — jvp, vjp, Jacobian, Hessian.
+
+TPU-native: these are direct jax transforms over a functionalized call;
+forward-mode (jvp) is native here where the reference emulates it with
+double-vjp."""
+from __future__ import annotations
+
+import jax
+
+from ..tensor import Tensor, apply_op, to_jax
+
+
+def _functionalize(func):
+    """Wrap a Tensor-level callable into a raw-array callable."""
+    def raw(*vals):
+        from ..autograd import functional_scope
+        wrapped = [Tensor(v) for v in vals]
+        with functional_scope():
+            out = func(*wrapped)
+        if isinstance(out, (tuple, list)):
+            return tuple(o.value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out.value if isinstance(out, Tensor) else out
+    return raw
+
+
+def _as_vals(xs):
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    return tuple(to_jax(x) for x in xs)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: (func(xs), J·v). v defaults to ones (upstream
+    incubate.autograd.jvp)."""
+    vals = _as_vals(xs)
+    tangents = _as_vals(v) if v is not None else tuple(
+        jax.numpy.ones_like(x) for x in vals)
+    out, tang = jax.jvp(_functionalize(func), vals, tangents)
+    wrap = lambda o: tuple(Tensor(t) for t in o) \
+        if isinstance(o, tuple) else Tensor(o)
+    return wrap(out), wrap(tang)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: (func(xs), vᵀ·J). v defaults to ones and must
+    mirror the output structure for multi-output funcs (upstream
+    incubate.autograd.vjp)."""
+    vals = _as_vals(xs)
+    out, pull = jax.vjp(_functionalize(func), *vals)
+    if v is not None:
+        cvals = _as_vals(v)
+        cot = tuple(cvals) if isinstance(out, tuple) else cvals[0]
+        if isinstance(out, tuple) and len(cvals) != len(out):
+            raise ValueError(f'v has {len(cvals)} cotangents for '
+                             f'{len(out)} outputs')
+    else:
+        cot = jax.tree_util.tree_map(jax.numpy.ones_like, out)
+    grads = pull(cot)
+    gw = tuple(Tensor(g) for g in grads)
+    return Tensor(out) if not isinstance(out, tuple) \
+        else tuple(Tensor(o) for o in out), \
+        gw if len(gw) > 1 else gw[0]
+
+
+class Jacobian:
+    """Lazy full Jacobian of func at xs (upstream
+    incubate.autograd.Jacobian): index/slice like an array; [:] gives
+    the whole matrix."""
+
+    def __init__(self, func, xs, is_batched=False):
+        import jax.numpy as jnp
+        vals = _as_vals(xs)
+        f = _functionalize(func)
+        argnums = tuple(range(len(vals)))
+        if is_batched:
+            jac = jax.vmap(jax.jacrev(f, argnums=argnums))(*vals)
+        else:
+            jac = jax.jacrev(f, argnums=argnums)(*vals)
+        if len(vals) == 1:
+            self._jac = jac[0]
+        else:
+            # multiple inputs: flatten each input's dims and concat the
+            # blocks along the last axis (out_dims..., sum n_i)
+            out_ndim = jac[0].ndim - vals[0].ndim
+            blocks = [j.reshape(j.shape[:out_ndim] + (-1,)) for j in jac]
+            self._jac = jnp.concatenate(blocks, axis=-1)
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac[idx])
+
+    @property
+    def shape(self):
+        return list(self._jac.shape)
+
+
+class Hessian:
+    """Lazy Hessian of a scalar func at xs (upstream
+    incubate.autograd.Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        vals = _as_vals(xs)
+        f = _functionalize(func)
+
+        def scalar(*a):
+            out = f(*a)
+            return out.reshape(())
+        import jax.numpy as jnp
+        argnums = tuple(range(len(vals)))
+        if is_batched:
+            hes = jax.vmap(jax.hessian(scalar, argnums=argnums))(*vals)
+        else:
+            hes = jax.hessian(scalar, argnums=argnums)(*vals)
+        if len(vals) == 1:
+            self._hes = hes[0][0]
+        else:
+            # assemble the full block matrix over flattened inputs
+            sizes = [int(jnp.size(v)) for v in vals]
+            rows = []
+            for i, hrow in enumerate(hes):
+                row = [h.reshape(sizes[i], sizes[j])
+                       for j, h in enumerate(hrow)]
+                rows.append(jnp.concatenate(row, axis=1))
+            self._hes = jnp.concatenate(rows, axis=0)
+
+    def __getitem__(self, idx):
+        return Tensor(self._hes[idx])
+
+    @property
+    def shape(self):
+        return list(self._hes.shape)

@@ -1,3 +1,4 @@
 from . import functional
+from .functional import memory_efficient_attention
 
-__all__ = ['functional']
+__all__ = ['functional', 'memory_efficient_attention']

@@ -21,6 +21,7 @@ from ...ops._helpers import defop
 __all__ = ['fused_linear', 'fused_matmul_bias', 'fused_dropout_add',
            'fused_rms_norm', 'fused_layer_norm', 'swiglu',
            'fused_multi_head_attention', 'fused_feedforward',
+           'memory_efficient_attention',
            'fused_rotary_position_embedding']
 
 
@@ -217,3 +218,16 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             args.append(position_ids)
         outs.append(defop(f, name='fused_rope')(*args))
     return tuple(outs)
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True, name=None):
+    """upstream paddle.incubate.nn.memory_efficient_attention (the
+    xformers-style API): on TPU this IS the flash path —
+    F.scaled_dot_product_attention lowers to the pallas kernel, which
+    never materializes the [B, H, Sq, Sk] logits."""
+    if scale is not None:
+        query = query * scale * (query.shape[-1] ** 0.5)
+    return F.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias, dropout_p=p,
+        is_causal=False, training=training)

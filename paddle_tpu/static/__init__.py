@@ -170,3 +170,58 @@ class Executor:
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
+
+
+class _StaticNN:
+    """paddle.static.nn (upstream: python/paddle/static/nn/): the
+    classic static-graph layer helpers. Here each call builds the same
+    nn.Layer and applies it immediately — under program_guard the tape
+    records it into the Program like any other op."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as _nn
+        from ..tensor import Tensor
+        import numpy as np
+        v = x if isinstance(x, Tensor) else Tensor(x)
+        in_dim = int(np.prod(v.shape[num_flatten_dims:]))
+        if v.ndim > num_flatten_dims + 1:
+            v = v.reshape(list(v.shape[:num_flatten_dims]) + [-1])
+        layer = _nn.Linear(in_dim, size)
+        out = layer(v)
+        if activation:
+            out = getattr(_nn.functional, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, is_test=False, momentum=0.9, epsilon=1e-5,
+                   data_layout='NCHW', name=None):
+        from .. import nn as _nn
+        ch = input.shape[1 if data_layout == 'NCHW' else -1]
+        layer = _nn.BatchNorm(ch, momentum=momentum, epsilon=epsilon,
+                              data_format=data_layout)
+        if is_test:
+            layer.eval()
+        return layer(input)
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               dilation=1, groups=1, act=None, name=None):
+        from .. import nn as _nn
+        layer = _nn.Conv2D(input.shape[1], num_filters, filter_size,
+                           stride=stride, padding=padding,
+                           dilation=dilation, groups=groups)
+        out = layer(input)
+        if act:
+            out = getattr(_nn.functional, act)(out)
+        return out
+
+    @staticmethod
+    def embedding(input, size, is_sparse=False, padding_idx=None,
+                  name=None):
+        from .. import nn as _nn
+        layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx)
+        return layer(input)
+
+
+nn = _StaticNN()

@@ -1,0 +1,17 @@
+"""paddle.sysconfig (upstream: python/paddle/sysconfig.py)."""
+from __future__ import annotations
+
+import os
+
+
+def get_include() -> str:
+    """Directory of the C headers shipped with the package (the native
+    runtime sources under csrc/ are the compilation surface here)."""
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'csrc')
+
+
+def get_lib() -> str:
+    """Directory where compiled native libraries land (the runtime
+    builds them on first use under csrc/build/)."""
+    return os.path.join(get_include(), 'build')

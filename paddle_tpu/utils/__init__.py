@@ -24,3 +24,63 @@ class _DLPack:
 
 
 dlpack = _DLPack()
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module or raise with an install hint (upstream
+    paddle.utils.try_import)."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f'{module_name} is required for this API; '
+                       f'install it first') from e
+
+
+def deprecated(update_to='', since='', reason='', level=0):
+    """Decorator stamping a DeprecationWarning on calls (upstream
+    paddle.utils.deprecated)."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f'API {fn.__name__} is deprecated'
+            if since:
+                msg += f' since {since}'
+            if update_to:
+                msg += f'; use {update_to} instead'
+            if reason:
+                msg += f' ({reason})'
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def run_check():
+    """Sanity-check the install: device visible, one matmul + grad on
+    the real backend, and a psum collective across all local devices
+    (upstream paddle.utils.run_check)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    dev = jax.devices()[0]
+    kind = getattr(dev, 'device_kind', jax.default_backend())
+    x = paddle.randn([4, 4])
+    x.stop_gradient = False
+    y = (paddle.matmul(x, x) ** 2).sum()
+    y.backward()
+    assert x.grad is not None
+    n = jax.device_count()
+    # real collective over every LOCAL device (pmap cannot span hosts)
+    nl = jax.local_device_count()
+    psum = jax.pmap(lambda v: jax.lax.psum(v, 'i'), axis_name='i')(
+        jnp.ones((nl,)))
+    assert np.allclose(np.asarray(psum), nl)
+    print(f'paddle_tpu is installed successfully! '
+          f'backend={jax.default_backend()} device_kind={kind} '
+          f'device_count={n} (matmul+grad OK, psum over {n} device(s) OK)')

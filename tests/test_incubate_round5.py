@@ -1,0 +1,255 @@
+"""Round-5 incubate/static/fleet.utils additions: recompute,
+incubate.autograd transforms, LookAhead/ModelAverage, static.nn
+helpers, memory_efficient_attention, misc paddle.utils."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(7)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestRecompute:
+    def test_grad_parity_with_direct(self):
+        from paddle_tpu.distributed import fleet
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 8)
+        x = _t(RNG.standard_normal((4, 8)))
+        x.stop_gradient = False
+        out = fleet.utils.recompute(lambda v: F.gelu(lin(v)) ** 2, x)
+        (g,) = paddle.grad(out.sum(), [x])
+        out2 = F.gelu(lin(x)) ** 2
+        (g2,) = paddle.grad(out2.sum(), [x])
+        np.testing.assert_allclose(g.numpy(), g2.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-6)
+
+    def test_jit_trainstep_with_recompute_matches_direct(self):
+        # inside the jitted step recompute is REAL remat
+        # (jax.checkpoint); the training trajectory must be identical
+        # to the un-recomputed model
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.jit import TrainStep
+
+        def build(use_rc):
+            class Net(paddle.nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.l1 = paddle.nn.Linear(16, 32)
+                    self.l2 = paddle.nn.Linear(32, 1)
+
+                def forward(self, x):
+                    if use_rc:
+                        h = fleet.utils.recompute(
+                            lambda v: F.gelu(self.l1(v)), x)
+                    else:
+                        h = F.gelu(self.l1(x))
+                    return self.l2(h)
+            paddle.seed(3)
+            net = Net()
+            opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters())
+            return TrainStep(net, lambda o, l: ((o - l) ** 2).mean(), opt)
+
+        X = RNG.standard_normal((8, 16)).astype(np.float32)
+        y = X.sum(1, keepdims=True).astype(np.float32)
+        a = build(True)
+        b = build(False)
+        la = [float(a(X, y).numpy()) for _ in range(10)]
+        lb = [float(b(X, y).numpy()) for _ in range(10)]
+        np.testing.assert_allclose(la, lb, rtol=1e-4)
+        assert la[-1] < la[0] * 0.5
+
+    def test_accepts_torch_style_kwargs(self):
+        from paddle_tpu.distributed import fleet
+        x = _t(RNG.standard_normal((2, 3)))
+        out = fleet.utils.recompute(lambda v: v * 2, x,
+                                    use_reentrant=False,
+                                    preserve_rng_state=True)
+        np.testing.assert_allclose(out.numpy(), x.numpy() * 2)
+
+
+class TestIncubateAutograd:
+    def test_jvp_vjp(self):
+        from paddle_tpu.incubate import autograd as ia
+        x = _t([1.0, 2.0, 3.0])
+        _, tang = ia.jvp(lambda v: v ** 3, x)
+        np.testing.assert_allclose(tang.numpy(), 3 * np.array([1, 4, 9.]),
+                                   rtol=1e-6)
+        _, g = ia.vjp(lambda v: v ** 3, x)
+        np.testing.assert_allclose(g.numpy(), 3 * np.array([1, 4, 9.]),
+                                   rtol=1e-6)
+        # custom tangent/cotangent
+        v = _t([2.0, 0.0, 1.0])
+        _, tang2 = ia.jvp(lambda a: a ** 2, x, v)
+        np.testing.assert_allclose(tang2.numpy(), 2 * x.numpy() * v.numpy(),
+                                   rtol=1e-6)
+
+    def test_vjp_multi_output(self):
+        from paddle_tpu.incubate import autograd as ia
+        x = _t([1.0, 2.0])
+        outs, g = ia.vjp(lambda v: (v * 2, v * 3), x)
+        assert isinstance(outs, tuple) and len(outs) == 2
+        np.testing.assert_allclose(g.numpy(), [5.0, 5.0])  # 2+3 each
+        _, g2 = ia.vjp(lambda v: (v * 2, v * 3), x,
+                       v=[_t([1.0, 0.0]), _t([0.0, 1.0])])
+        np.testing.assert_allclose(g2.numpy(), [2.0, 3.0])
+        with pytest.raises(ValueError, match='cotangents'):
+            ia.vjp(lambda v: (v * 2, v * 3), x, v=[_t([1.0, 0.0])])
+
+    def test_jacobian_hessian_multi_input(self):
+        from paddle_tpu.incubate import autograd as ia
+        x, y = _t([1.0, 2.0]), _t([3.0])
+        J = ia.Jacobian(lambda a, b: a * b[0], [x, y])
+        # blocks: d(out)/dx = diag(y), d(out)/dy = x
+        want = np.concatenate([np.diag([3.0, 3.0]),
+                               np.array([[1.0], [2.0]])], axis=1)
+        np.testing.assert_allclose(J[:].numpy(), want, rtol=1e-6)
+        H = ia.Hessian(lambda a, b: (a * a * b[0]).sum(), [x, y])
+        # d2/dx2 = 2*y0*I; d2/dxdy = 2x; d2/dy2 = 0
+        want_h = np.block([
+            [np.diag([6.0, 6.0]), np.array([[2.0], [4.0]])],
+            [np.array([[2.0, 4.0]]), np.zeros((1, 1))]])
+        np.testing.assert_allclose(H[:].numpy(), want_h, rtol=1e-6)
+
+    def test_jacobian_hessian(self):
+        from paddle_tpu.incubate import autograd as ia
+        x = _t([1.0, 2.0])
+        J = ia.Jacobian(lambda v: v ** 2, x)
+        np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 4.0]),
+                                   rtol=1e-6)
+        assert J.shape == [2, 2]
+        H = ia.Hessian(lambda v: (v ** 3).sum(), x)
+        np.testing.assert_allclose(H[:].numpy(), np.diag([6.0, 12.0]),
+                                   rtol=1e-6)
+
+
+class TestIncubateOptimizers:
+    def _problem(self):
+        rng = np.random.RandomState(0)  # order-independent data
+        X = rng.standard_normal((16, 4)).astype(np.float32)
+        w = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+        return X, X @ w
+
+    def test_lookahead_converges_and_resets_fast_weights(self):
+        paddle.seed(0)
+        X, y = self._problem()
+        m = paddle.nn.Linear(4, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=m.parameters())
+        la = paddle.incubate.optimizer.LookAhead(inner, alpha=0.5, k=2)
+        for i in range(80):
+            loss = ((m(_t(X)) - _t(y)) ** 2).mean()
+            loss.backward(); la.step(); la.clear_grad()
+        assert float(loss.numpy()) < 0.01
+        with pytest.raises(ValueError):
+            paddle.incubate.optimizer.LookAhead(inner, alpha=1.5)
+
+    def test_model_average_double_apply_keeps_backup(self):
+        paddle.seed(2)
+        m = paddle.nn.Linear(2, 1)
+        ma = paddle.incubate.optimizer.ModelAverage(
+            parameters=m.parameters(), max_average_window=10)
+        live = m.weight.numpy().copy()
+        m.weight._data = m.weight.value + 1.0
+        ma.step()
+        ma.apply()
+        ma.apply()  # second apply must NOT clobber the restore point
+        ma.restore()
+        np.testing.assert_allclose(m.weight.numpy(), live + 1.0)
+
+    def test_recompute_kwargs_and_tuple_outputs(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.jit import TrainStep
+        x = _t(RNG.standard_normal((2, 4)))
+        # eager kwargs pass-through
+        out = fleet.utils.recompute(lambda v, scale=1.0: v * scale, x,
+                                    scale=3.0)
+        np.testing.assert_allclose(out.numpy(), x.numpy() * 3)
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 4)
+
+            def forward(self, v):
+                a, b = fleet.utils.recompute(
+                    lambda t, scale=1.0: (self.lin(t) * scale, t + 1.0),
+                    v, scale=2.0)
+                return (a + b).sum(axis=-1, keepdim=True)
+        paddle.seed(0)
+        net = Net()
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        step = TrainStep(net, lambda o, l: ((o - l) ** 2).mean(), opt)
+        X = RNG.standard_normal((2, 4)).astype(np.float32)
+        y = np.ones((2, 1), np.float32)
+        l0 = float(step(X, y).numpy())
+        l1 = float(step(X, y).numpy())
+        assert np.isfinite(l0) and l1 < l0  # tuple path trains
+
+    def test_model_average_apply_restore(self):
+        paddle.seed(1)
+        X, y = self._problem()
+        m = paddle.nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        ma = paddle.incubate.optimizer.ModelAverage(
+            parameters=m.parameters(), max_average_window=100)
+        snaps = []
+        for i in range(4):
+            loss = ((m(_t(X)) - _t(y)) ** 2).mean()
+            loss.backward(); opt.step(); opt.clear_grad(); ma.step()
+            snaps.append(m.weight.numpy().copy())
+        live = m.weight.numpy().copy()
+        ma.apply()
+        np.testing.assert_allclose(m.weight.numpy(),
+                                   np.mean(snaps, axis=0), rtol=1e-5)
+        ma.restore()
+        np.testing.assert_allclose(m.weight.numpy(), live)
+
+
+class TestStaticNNAndMisc:
+    def test_static_nn_helpers(self):
+        x = _t(RNG.standard_normal((2, 6)))
+        out = paddle.static.nn.fc(x, 3, activation='relu')
+        assert out.shape == [2, 3] and float(out.min().numpy()) >= 0
+        img = _t(RNG.standard_normal((2, 3, 8, 8)))
+        out = paddle.static.nn.conv2d(img, 4, 3, act='relu')
+        assert out.shape == [2, 4, 6, 6]
+        out = paddle.static.nn.batch_norm(img)
+        assert out.shape == [2, 3, 8, 8]
+        ids = paddle.to_tensor(np.array([[1, 2]]))
+        assert paddle.static.nn.embedding(ids, (10, 5)).shape == [1, 2, 5]
+
+    def test_memory_efficient_attention_matches_sdpa(self):
+        q = _t(RNG.standard_normal((1, 8, 2, 16)))
+        k = _t(RNG.standard_normal((1, 8, 2, 16)))
+        v = _t(RNG.standard_normal((1, 8, 2, 16)))
+        got = paddle.incubate.nn.memory_efficient_attention(q, k, v)
+        want = F.scaled_dot_product_attention(q, k, v)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5)
+
+    def test_utils_misc(self):
+        assert paddle.utils.try_import('math').pi > 3
+        with pytest.raises(ImportError, match='hint'):
+            paddle.utils.try_import('definitely_not_a_module', 'hint')
+
+        @paddle.utils.deprecated(update_to='paddle.new_api', since='2.0')
+        def old_api():
+            return 42
+        with pytest.warns(DeprecationWarning, match='paddle.new_api'):
+            assert old_api() == 42
+        assert not paddle.is_compiled_with_cuda()
+        assert not paddle.is_compiled_with_rocm()
+        assert not paddle.is_compiled_with_xpu()
+        assert paddle.get_cudnn_version() is None
+        assert paddle.sysconfig.get_include().endswith('csrc')
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert 'successfully' in capsys.readouterr().out
