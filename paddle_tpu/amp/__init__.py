@@ -14,6 +14,8 @@ for bf16 (whose exponent range equals fp32's).
 """
 from __future__ import annotations
 
+from . import debugging  # noqa: F401  (paddle.amp.debugging)
+
 import contextlib
 import threading
 from typing import Iterable, Optional, Sequence

@@ -77,11 +77,26 @@ class Profiler:
         self._step_count = 0
         self._step_times: List[float] = []
         self._last_step_t: Optional[float] = None
+        # upstream scheduler protocol: a fn(step)->ProfilerState driving
+        # windowed recording; tuple (start, end) means RECORD in [a, b)
+        if isinstance(scheduler, tuple):
+            a, b = scheduler
+            if b <= a:
+                raise ValueError(f'scheduler window ({a}, {b}) is empty')
+            # upstream tuple scheduler: ONE record window [a, b)
+            scheduler = make_scheduler(closed=a, ready=0, record=b - a,
+                                       repeat=1)
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._window_open = False
 
     def start(self):
         _host.active = True
         _host.totals.clear()
         _host.counts.clear()
+        if self._scheduler is not None and self._scheduler(0) in (
+                ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._window_open = True
         self._last_step_t = time.perf_counter()
         if self.trace_dir and not self.timer_only:
             os.makedirs(self.trace_dir, exist_ok=True)
@@ -98,6 +113,24 @@ class Profiler:
             self._step_times.append(now - self._last_step_t)
         self._last_step_t = now
         self._step_count += 1
+        if self._scheduler is not None:
+            # schedules are 0-based; step() is the boundary between
+            # completed step (count-1) and upcoming step (count)
+            rec = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+            prev = self._scheduler(self._step_count - 1)
+            if self._window_open and (
+                    prev == ProfilerState.RECORD_AND_RETURN
+                    or prev not in rec):
+                self._window_open = False
+                if self._on_trace_ready is not None:
+                    self._on_trace_ready(self)
+            if not self._window_open \
+                    and self._scheduler(self._step_count) in rec:
+                self._window_open = True
+                # a window exports ITS steps only: reset the host
+                # aggregates when it opens
+                _host.totals.clear()
+                _host.counts.clear()
 
     def stop(self):
         _host.active = False
@@ -146,3 +179,77 @@ def profile(trace_dir: Optional[str] = None, timer_only=False):
         yield p
     finally:
         p.stop()
+
+
+class ProfilerState:
+    """Scheduler states (upstream paddle.profiler.ProfilerState)."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget:
+    """Hardware targets (upstream paddle.profiler.ProfilerTarget);
+    CUSTOM_DEVICE covers the TPU backend here."""
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 3  # alias: the custom device of this build
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Windowed profiling schedule (upstream
+    paddle.profiler.make_scheduler): skip_first steps, then cycles of
+    closed -> ready -> record; repeat=0 cycles forever."""
+    cycle = closed + ready + record
+    if cycle <= 0:
+        raise ValueError('closed + ready + record must be positive')
+
+    def schedule(step: int) -> int:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    """on_trace_ready factory writing chrome://tracing JSON of the host
+    regions (upstream paddle.profiler.export_chrome_tracing). Device
+    timelines ride the jax perfetto trace in `trace_dir`."""
+    def handler(prof: 'Profiler'):
+        os.makedirs(dir_name, exist_ok=True)
+        events = []
+        t = 0.0
+        for name, total in _host.totals.items():
+            events.append({
+                'name': name, 'ph': 'X', 'pid': 0,
+                'tid': worker_name or 'host',
+                'ts': int(t * 1e6), 'dur': int(total * 1e6),
+                'args': {'calls': _host.counts[name]},
+            })
+            t += total
+        path = os.path.join(
+            dir_name, f'paddle_tpu_trace_{prof._step_count}.json')
+        with open(path, 'w') as f:
+            json.dump({'traceEvents': events}, f)
+        return path
+    return handler
+
+
+def load_profiler_result(path: str):
+    """Read back a chrome-tracing JSON written by
+    export_chrome_tracing (upstream load_profiler_result)."""
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,162 @@
+"""Round-5 profiler scheduler API and paddle.amp.debugging (upstream
+python/paddle/profiler/, python/paddle/amp/debugging.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+P = paddle.profiler
+
+
+class TestScheduler:
+    def test_make_scheduler_states(self):
+        sched = P.make_scheduler(closed=2, ready=1, record=3, repeat=1,
+                                 skip_first=1)
+        states = [sched(i) for i in range(10)]
+        assert states == [P.ProfilerState.CLOSED] * 3 + [
+            P.ProfilerState.READY, P.ProfilerState.RECORD,
+            P.ProfilerState.RECORD, P.ProfilerState.RECORD_AND_RETURN,
+        ] + [P.ProfilerState.CLOSED] * 3
+
+    def test_repeat_forever(self):
+        sched = P.make_scheduler(closed=1, ready=0, record=1)
+        assert sched(0) == P.ProfilerState.CLOSED
+        assert sched(1) == P.ProfilerState.RECORD_AND_RETURN
+        assert sched(100) == P.ProfilerState.CLOSED
+        assert sched(101) == P.ProfilerState.RECORD_AND_RETURN
+
+    def test_bad_cycle_rejected(self):
+        with pytest.raises(ValueError):
+            P.make_scheduler(closed=0, ready=0, record=0)
+
+    def test_windowed_profiler_fires_handler(self, tmp_path):
+        sched = P.make_scheduler(closed=2, ready=1, record=3, repeat=1,
+                                 skip_first=1)
+        handler = P.export_chrome_tracing(str(tmp_path))
+        fired = []
+        prof = P.Profiler(scheduler=sched,
+                          on_trace_ready=lambda p: fired.append(
+                              handler(p)))
+        prof.start()
+        for i in range(10):
+            with P.RecordEvent('work'):
+                sum(range(100))
+            prof.step()
+        prof.stop()
+        assert len(fired) == 1
+        res = P.load_profiler_result(fired[0])
+        assert 'work' in [e['name'] for e in res['traceEvents']]
+
+
+class TestSchedulerEdgeCases:
+    def test_record_first_cycle_fires(self, tmp_path):
+        # schedule whose cycle STARTS with record: the 0-based step
+        # indexing must still consult index 0
+        fired = []
+        prof = P.Profiler(
+            scheduler=P.make_scheduler(closed=0, ready=0, record=1,
+                                       repeat=1),
+            on_trace_ready=lambda p: fired.append(1))
+        prof.start()
+        for i in range(5):
+            prof.step()
+        prof.stop()
+        assert len(fired) == 1
+
+    def test_tuple_scheduler_single_window(self):
+        fired = []
+        prof = P.Profiler(scheduler=(2, 4),
+                          on_trace_ready=lambda p: fired.append(1))
+        prof.start()
+        for i in range(20):
+            prof.step()
+        prof.stop()
+        assert len(fired) == 1  # upstream: ONE [2, 4) window
+        with pytest.raises(ValueError):
+            P.Profiler(scheduler=(5, 3))
+
+    def test_windows_export_per_window_data(self, tmp_path):
+        # repeating schedule: each window must contain only its own data
+        handler = P.export_chrome_tracing(str(tmp_path))
+        outs = []
+        prof = P.Profiler(
+            scheduler=P.make_scheduler(closed=2, ready=0, record=1),
+            on_trace_ready=lambda p: outs.append(handler(p)))
+        prof.start()
+        for i in range(6):
+            with P.RecordEvent('tick'):
+                pass
+            prof.step()
+        prof.stop()
+        assert len(outs) == 2
+        for path in outs:
+            ev = [e for e in P.load_profiler_result(path)['traceEvents']
+                  if e['name'] == 'tick']
+            assert ev and ev[0]['args']['calls'] <= 2  # not cumulative
+
+
+class TestAmpDebugging:
+    def test_double_enable_is_safe(self):
+        D = paddle.amp.debugging
+        D.enable_operator_stats_collection()
+        D.enable_operator_stats_collection()  # notebook cell re-run
+        paddle.ones([2]) + 1.0
+        assert D.collect_operator_numerical_stats()['add']['calls'] == 1
+        D.disable_operator_stats_collection()
+        # hook fully removed: later ops run clean
+        out = paddle.ones([2]) + 1.0
+        assert D.collect_operator_numerical_stats() == {}
+        np.testing.assert_allclose(out.numpy(), 2.0)
+
+    def test_operator_stats_collection(self, capsys):
+        D = paddle.amp.debugging
+        D.enable_operator_stats_collection()
+        x = paddle.randn([4, 4]).astype('bfloat16')
+        paddle.matmul(x, x)
+        stats = D.collect_operator_numerical_stats()
+        D.disable_operator_stats_collection()
+        assert stats['matmul']['calls'] == 1
+        assert stats['matmul']['dtypes'] == {'bfloat16': 1}
+        assert 'matmul' in capsys.readouterr().out
+        # collection really stopped
+        paddle.matmul(x, x)
+        assert D.collect_operator_numerical_stats() == {}
+
+    def test_tensor_checker_aborts_on_nan(self):
+        D = paddle.amp.debugging
+        D.enable_tensor_checker()
+        try:
+            with pytest.raises(Exception, match='[Nn]a[Nn]'):
+                paddle.to_tensor(
+                    np.array([1.0, np.nan], np.float32)) * 2.0
+        finally:
+            D.disable_tensor_checker()
+        # off again: nan flows without raising
+        out = paddle.to_tensor(np.array([np.nan], np.float32)) * 2.0
+        assert np.isnan(out.numpy()).all()
+
+    def test_check_numerics_one_shot(self):
+        D = paddle.amp.debugging
+        good = paddle.ones([3])
+        D.check_numerics(good, 'good_op')
+        bad = paddle.to_tensor(np.array([np.inf], np.float32))
+        with pytest.raises(Exception):
+            D.check_numerics(bad, 'bad_op')
+        # non-abort mode: returns instead of raising
+        D.check_numerics(bad, 'bad_op',
+                         debug_mode=D.DebugMode.CHECK_NAN_INF)
+
+    def test_stats_chain_with_checker(self):
+        # enabling stats while the nan checker is on must keep BOTH
+        D = paddle.amp.debugging
+        D.enable_tensor_checker()
+        D.enable_operator_stats_collection()
+        try:
+            paddle.ones([2]) + 1.0
+            stats = D.collect_operator_numerical_stats()
+            assert stats['add']['calls'] == 1
+            with pytest.raises(Exception):
+                paddle.to_tensor(np.array([np.nan], np.float32)) * 1.0
+        finally:
+            D.disable_operator_stats_collection()
+            D.disable_tensor_checker()
