@@ -44,6 +44,27 @@ SURFACE = [
     'nn.functional.hsigmoid_loss', 'linalg.matrix_exp', 'linalg.matrix_norm',
     'linalg.vector_norm', 'linalg.vecdot', 'linalg.householder_product',
     'linalg.ormqr', 'linalg.svd_lowrank', 'linalg.pca_lowrank',
+    'io.ConcatDataset', 'callbacks.ReduceLROnPlateau', 'distributed.spawn',
+    'distributed.destroy_process_group', 'vision.datasets.ImageFolder',
+    'vision.datasets.DatasetFolder', 'vision.image_load',
+    'vision.set_image_backend', 'vision.get_image_backend',
+    'vision.transforms.RandomErasing', 'vision.transforms.RandomAffine',
+    'vision.transforms.RandomPerspective', 'vision.transforms.Transpose',
+    'optimizer.lr.MultiplicativeDecay', 'optimizer.lr.LinearLR',
+    'nn.initializer.Bilinear', 'nn.initializer.set_global_initializer',
+    'incubate.autograd.jvp', 'incubate.autograd.vjp',
+    'incubate.autograd.Jacobian', 'incubate.autograd.Hessian',
+    'incubate.optimizer.LookAhead', 'incubate.optimizer.ModelAverage',
+    'incubate.nn.memory_efficient_attention', 'static.nn.fc',
+    'static.nn.batch_norm', 'static.nn.conv2d', 'static.nn.embedding',
+    'utils.try_import', 'utils.deprecated', 'utils.run_check',
+    'utils.unique_name', 'sysconfig.get_include', 'sysconfig.get_lib',
+    'is_compiled_with_rocm', 'is_compiled_with_xpu', 'get_cudnn_version',
+    'profiler.make_scheduler', 'profiler.ProfilerState',
+    'profiler.ProfilerTarget', 'profiler.export_chrome_tracing',
+    'profiler.load_profiler_result', 'amp.debugging.enable_tensor_checker',
+    'amp.debugging.enable_operator_stats_collection',
+    'distribution.Binomial', 'hub.load', 'metric.Auc',
     'set_device', 'get_device', 'CPUPlace', 'CUDAPlace', 'Model',
     # linalg
     'linalg.cholesky', 'linalg.qr', 'linalg.svd', 'linalg.inv',
