@@ -176,9 +176,9 @@ def _run_config(name, cfg, batch, seq, steps, warmup, dtype,
     }
 
 
-def _run_7b_overfit(steps=200, target=7.0):
+def _run_7b_overfit(steps=300, target=7.0):
     """Correctness signal for the 7B geometry (VERDICT r4 Weak #3 / #4):
-    ~200 AdamW steps on ONE fixed small batch must drive the loss well
+    up to 300 AdamW steps on ONE fixed small batch must drive the loss well
     under ln(32000)=10.37 — a throughput-shaped block that can't learn
     would stay pinned near random init."""
     import paddle_tpu as paddle
@@ -325,8 +325,8 @@ def _free_device_memory():
 
 
 def _run_ladder(configs):
-    """Run the first config of a ladder that fits; (name, result) or
-    (None, None) if every rung OOMs."""
+    """Run the first config of a ladder that succeeds; (name, result)
+    or (None, None) if every rung fails."""
     for name, cfg, batch, seq, steps, warmup, dtype, *rest in configs:
         try:
             print(f'# rung {name} b{batch} s{seq} {dtype} '
@@ -336,15 +336,17 @@ def _run_ladder(configs):
             print(f'# rung {name} OK: {res["step_time_s"]:.3f}s/step',
                   file=sys.stderr)
             return name, res
-        except Exception as e:
-            msg = str(e).lower()
-            if 'resource' in msg or 'memory' in msg or 'oom' in msg \
-                    or 'allocat' in msg or 'compile' in msg:
-                # OOM (or a compiler blow-up on the big config): try the
-                # next, smaller config and say so in the output
-                _free_device_memory()
-                continue
-            raise
+        except Exception:
+            # OOM, compiler blow-up, or a rung-specific failure (e.g. the
+            # host-offload path on a backend where it is untested): every
+            # rung is independent, so log the FULL traceback and fall
+            # through to the next smaller config rather than killing the
+            # whole phase
+            import traceback
+            print(f'# rung {name} failed:\n'
+                  f'{traceback.format_exc()}', file=sys.stderr)
+            _free_device_memory()
+            continue
     return None, None
 
 
@@ -379,7 +381,7 @@ def _phase_headline():
 def _phase_7b():
     name7, res7 = _run_ladder(_7b_configs())
     if res7 is None:
-        return {'llama2_7b_shape': {'error': 'all 7B-shape rungs OOMed'}}
+        return {'llama2_7b_shape': {'error': 'all 7B-shape rungs failed'}}
     return {'llama2_7b_shape': {
         'tokens_per_sec': round(res7['tokens_per_sec'], 1),
         'mfu': round(res7['mfu'], 4),
