@@ -432,7 +432,11 @@ def _run_phase_subprocess(phase, timeout_s, env_extra=None):
         if proc.returncode != 0 or not line:
             return {f'{phase}_error': f'exit {proc.returncode}'}
         return json.loads(line)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # keep the child's partial stderr: the per-rung tracebacks are
+        # exactly what diagnoses a hang
+        sys.stderr.write((e.stderr.decode() if isinstance(e.stderr, bytes)
+                          else e.stderr) or '')
         return {f'{phase}_error': 'timeout'}
     except Exception as e:
         return {f'{phase}_error': type(e).__name__}

@@ -29,6 +29,19 @@ def _is_low_precision(dtype):
     return jnp.dtype(dtype) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
 
 
+
+def _flatten_for_update(params, grads, slots):
+    """Shared path-flattening for optimizer updates (fused and offload
+    paths must derive leaf names identically): returns
+    (treedef, names, flat_params, flat_grads, flat_slots)."""
+    paths_p, treedef = _tree.tree_flatten_with_path(params)
+    names = ['.'.join(str(getattr(e, 'key', e)) for e in path)
+             for path, _ in paths_p]
+    flat_p = [p for _, p in paths_p]
+    flat_g = treedef.flatten_up_to(grads)
+    flat_s = treedef.flatten_up_to(slots)
+    return treedef, names, flat_p, flat_g, flat_s
+
 class Optimizer:
     """Base optimizer. Subclasses implement `_init_slots` and `_rule`."""
 
@@ -104,12 +117,8 @@ class Optimizer:
         if self._grad_clip is not None:
             grads = self._grad_clip.apply_pytree(grads)
         step = state['step'] + 1
-        paths_p, treedef = _tree.tree_flatten_with_path(params)
-        names = ['.'.join(str(getattr(e, 'key', e)) for e in path)
-                 for path, _ in paths_p]
-        flat_p = [p for _, p in paths_p]
-        flat_g = treedef.flatten_up_to(grads)
-        flat_s = treedef.flatten_up_to(state['slots'])
+        treedef, names, flat_p, flat_g, flat_s = _flatten_for_update(
+            params, grads, state['slots'])
         new_p, new_s = [], []
         for g, p, s, nm in zip(flat_g, flat_p, flat_s, names):
             if g is None:

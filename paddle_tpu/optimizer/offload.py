@@ -58,10 +58,10 @@ class OffloadEngine:
 
     # -- kernels ------------------------------------------------------------
     def _kernel(self, g, p, slots, nm):
-        coeff = self.opt._coeff_for(nm)
-        key = (p.shape, str(p.dtype), str(g.dtype),
-               tuple(sorted(slots.keys())),
-               float(coeff) if coeff else 0.0)
+        # nm is part of the key: the compiled closure bakes the leaf
+        # name in, and optimizers may branch on it beyond _coeff_for
+        key = (nm, p.shape, str(p.dtype), str(g.dtype),
+               tuple(sorted(slots.keys())))
         if key not in self._kernels:
             opt = self.opt
 
@@ -79,12 +79,9 @@ class OffloadEngine:
         if self.opt._grad_clip is not None:
             grads = self.opt._grad_clip.apply_pytree(grads)
         step = state['step'] + 1
-        paths_p, treedef = _tree.tree_flatten_with_path(params)
-        names = ['.'.join(str(getattr(e, 'key', e)) for e in path)
-                 for path, _ in paths_p]
-        flat_p = [p for _, p in paths_p]
-        flat_g = treedef.flatten_up_to(grads)
-        flat_s = treedef.flatten_up_to(state['slots'])
+        from . import _flatten_for_update
+        treedef, names, flat_p, flat_g, flat_s = _flatten_for_update(
+            params, grads, state['slots'])
         n = len(flat_p)
         lr = jnp.asarray(lr_value, jnp.float32)
 
