@@ -25,6 +25,8 @@ from .fleet import (DistTrainStep, DistributedStrategy, fleet,
                     shard_optimizer_state)
 from .launch import init_on_pod
 from .moe import MoELayer
+from . import sharding
+from .sharding import group_sharded_parallel, save_group_sharded_model
 from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                               RowParallelLinear, VocabParallelEmbedding,
                               shard_batch, split)

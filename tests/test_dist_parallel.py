@@ -512,3 +512,71 @@ def test_pp_llama_interleaved_vpp_matches_single_device():
     vpp = run(s)
     np.testing.assert_allclose(base, vpp, rtol=1e-3)
     assert base[-1] < base[0]
+
+
+def test_group_sharded_parallel_levels_equal_unsharded():
+    """paddle.distributed.sharding.group_sharded_parallel (upstream
+    python/paddle/distributed/sharding/group_sharded.py): all three
+    levels must train bit-identically to the unsharded baseline."""
+    from paddle_tpu.distributed import group_sharded_parallel
+    import paddle_tpu.distributed as dist
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((16, 16)).astype(np.float32)
+    y = rng.integers(0, 4, 16)
+
+    def run(level):
+        dist.destroy_process_group()
+        fleet._fleet.strategy = None
+        paddle.seed(7)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {'dp_degree': 8, 'mp_degree': 1,
+                                   'pp_degree': 1, 'sep_degree': 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        m = _Mlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        if level:
+            m, opt, _ = group_sharded_parallel(m, opt, level)
+            strategy = fleet._fleet.strategy
+        else:
+            fleet.distributed_model(m)
+        step = fleet.DistTrainStep(
+            m, lambda out, lab: F.cross_entropy(out, lab), opt,
+            strategy=strategy)
+        return [float(step(paddle.to_tensor(x),
+                           paddle.to_tensor(y)).numpy())
+                for _ in range(3)]
+
+    base = run(None)
+    assert base[-1] < base[0]
+    for level in ('os', 'os_g', 'p_g_os'):
+        np.testing.assert_allclose(base, run(level), rtol=1e-4,
+                                   err_msg=level)
+    with pytest.raises(ValueError, match='level'):
+        group_sharded_parallel(_Mlp(), paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=_Mlp().parameters()), 'bogus')
+    with pytest.raises(NotImplementedError, match='offload'):
+        group_sharded_parallel(_Mlp(), paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=_Mlp().parameters()), 'os',
+            offload=True)
+
+
+def test_save_group_sharded_model(tmp_path):
+    from paddle_tpu.distributed import (group_sharded_parallel,
+                                        save_group_sharded_model)
+    import paddle_tpu.distributed as dist
+    dist.destroy_process_group()
+    fleet._fleet.strategy = None
+    paddle.seed(1)
+    m = _Mlp()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=m.parameters())
+    m, opt, _ = group_sharded_parallel(m, opt, 'os_g')
+    save_group_sharded_model(m, str(tmp_path / 'out'), opt)
+    import os
+    assert os.path.exists(str(tmp_path / 'out' / 'model.pdparams'))
+    sd = paddle.load(str(tmp_path / 'out' / 'model.pdparams'))
+    m2 = _Mlp()
+    m2.set_state_dict(sd)
+    x = paddle.to_tensor(np.ones((2, 16), np.float32))
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-5)
