@@ -84,7 +84,18 @@ def _attach_methods():
     Tensor.__truediv__ = lambda s, o: math.divide(s, o)
     Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
     Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
     Tensor.__mod__ = lambda s, o: math.mod(s, o)
+    Tensor.__rmod__ = lambda s, o: math.mod(o, s)
+    Tensor.__divmod__ = lambda s, o: (math.floor_divide(s, o),
+                                      math.mod(s, o))
+    Tensor.__rdivmod__ = lambda s, o: (math.floor_divide(o, s),
+                                       math.mod(o, s))
+    Tensor.__pos__ = lambda s: s
+    Tensor.__lshift__ = lambda s, o: math.bitwise_left_shift(s, o)
+    Tensor.__rlshift__ = lambda s, o: math.bitwise_left_shift(o, s)
+    Tensor.__rshift__ = lambda s, o: math.bitwise_right_shift(s, o)
+    Tensor.__rrshift__ = lambda s, o: math.bitwise_right_shift(o, s)
     Tensor.__pow__ = lambda s, o: math.pow(s, o)
     Tensor.__rpow__ = lambda s, o: math.pow(o, s)
     Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)

@@ -49,6 +49,32 @@ class TestSmallOps:
             paddle.set_printoptions(precision=4)
 
 
+class TestDunders:
+    def test_reflected_and_shift_operators(self):
+        it = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        np.testing.assert_array_equal((7 % it).numpy(), [[0, 1], [1, 3]])
+        np.testing.assert_array_equal((7 // it).numpy(), [[7, 3], [2, 1]])
+        np.testing.assert_array_equal((it << 2).numpy(),
+                                      [[4, 8], [12, 16]])
+        np.testing.assert_array_equal((it >> 1).numpy(), [[0, 1], [1, 2]])
+        q, r = divmod(it, 3)
+        np.testing.assert_array_equal(q.numpy(), [[0, 0], [1, 1]])
+        np.testing.assert_array_equal(r.numpy(), [[1, 2], [0, 1]])
+        q2, r2 = divmod(7, paddle.to_tensor(np.array([1, 2, 3])))
+        np.testing.assert_array_equal(q2.numpy(), [7, 3, 2])
+        np.testing.assert_array_equal(r2.numpy(), [0, 1, 1])
+        np.testing.assert_array_equal(
+            (2 << paddle.to_tensor(np.array([1, 2]))).numpy(), [4, 8])
+        np.testing.assert_array_equal(
+            (16 >> paddle.to_tensor(np.array([1, 2]))).numpy(), [8, 4])
+        t = paddle.ones([2])
+        assert (+t) is t
+        np.testing.assert_array_equal(
+            paddle.bitwise_left_shift(
+                it, paddle.to_tensor(np.array(1))).numpy(),
+            [[2, 4], [6, 8]])
+
+
 class TestLrAndInit:
     def test_linear_lr_vs_torch(self):
         import torch
