@@ -25,7 +25,8 @@ from .common_layers import (GLU, AlphaDropout, Bilinear, CELU,
 from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
                    Conv3D, Conv3DTranspose)
 from .layer import Layer, ParamAttr
-from .loss_layers import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
+from .loss_layers import (AdaptiveLogSoftmaxWithLoss,
+                          BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
                           CrossEntropyLoss, CTCLoss, GaussianNLLLoss, HSigmoidLoss,
                           HingeEmbeddingLoss, KLDivLoss, L1Loss,
                           MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss,

@@ -1738,3 +1738,84 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     if path_table is not None:
         args += [path_table, path_code]
     return defop(f, name='hsigmoid_loss')(*args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction='mean',
+                         name=None):
+    """Combined-margin softmax CE over cosine logits (reference
+    paddle.nn.functional.margin_cross_entropy; ArcFace family): the
+    target-class logit cosθ becomes cos(m1·θ + m2) − m3 before scaling.
+    m1/m2/m3 = (1, 0.5, 0) is ArcFace, (1, 0, 0.35) CosFace."""
+    if group is not None:
+        raise NotImplementedError(
+            'class-sharded margin_cross_entropy: shard the classifier '
+            'with distributed.ParallelCrossEntropy/ColumnParallelLinear '
+            'over the mesh instead of a process group')
+
+    def f(x, y):
+        y = y.astype(jnp.int32)
+        # arccos only the gathered target column; eps-clip keeps the
+        # boundary gradient finite (d/dx arccos -> -inf at |x|=1)
+        eps = 1e-6
+        tcos = jnp.take_along_axis(x, y[:, None], axis=1)[:, 0]
+        theta = jnp.arccos(jnp.clip(tcos, -1.0 + eps, 1.0 - eps))
+        mod = jnp.cos(margin1 * theta + margin2) - margin3
+        cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        adjusted = jnp.where(cols == y[:, None], mod[:, None], x)
+        z = adjusted * scale
+        lse = jax.scipy.special.logsumexp(z, axis=1)
+        per = lse - mod * scale
+        loss = _reduce(per, reduction)
+        if return_softmax:
+            return loss, jnp.exp(z - lse[:, None])
+        return loss
+    return defop(f, name='margin_cross_entropy')(logits, label)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference
+    paddle.nn.functional.adaptive_log_softmax_with_loss; Grave et al.
+    2017): frequent classes live in the head, rare classes in projected
+    tail clusters. Returns (per-sample log-prob output, mean nll loss),
+    matching upstream's (output, loss) pair."""
+    n_clusters = len(cutoffs)  # cutoffs excludes the final vocab size
+
+    def f(x, y, hw, *rest):
+        i = 0
+        hb = None
+        if head_bias is not None:
+            hb = rest[i]; i += 1
+        tails = []
+        while i < len(rest):
+            tails.append((rest[i], rest[i + 1]))
+            i += 2
+        y = y.astype(jnp.int32)
+        head = x @ hw  # [N, cutoffs[0] + n_clusters]
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, axis=-1)
+        # head classes: direct log-prob; tail c: cluster-prob + within
+        out = jnp.where(y < cutoffs[0],
+                        jnp.take_along_axis(
+                            head_lp, jnp.minimum(y, cutoffs[0] - 1)[:, None],
+                            axis=1)[:, 0],
+                        0.0)
+        lows = [0] + list(cutoffs)
+        for c, (w1, w2) in enumerate(tails):
+            lo, hi = lows[c + 1], lows[c + 2] if c + 2 < len(lows) else None
+            in_c = (y >= lo) & ((y < hi) if hi is not None else True)
+            rel = jnp.clip(y - lo, 0, w2.shape[1] - 1)
+            tl = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)
+            cluster_lp = head_lp[:, cutoffs[0] + c]
+            within = jnp.take_along_axis(tl, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(in_c, cluster_lp + within, out)
+        return out, -jnp.mean(out)
+    args = [input, label, head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    for w1, w2 in tail_weights:
+        args += [w1, w2]
+    return defop(f, name='adaptive_log_softmax_with_loss')(*args)

@@ -241,3 +241,60 @@ class HSigmoidLoss(Layer):
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                self.bias, path_table=path_table,
                                path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax head (upstream paddle.nn.AdaptiveLogSoftmaxWithLoss;
+    Grave et al. 2017). Head covers the cutoffs[0] frequent classes plus
+    one slot per tail cluster; tail cluster c factors through a
+    in_features/div_value^(c+1) bottleneck."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (not cutoffs or cutoffs != sorted(set(cutoffs))
+                or cutoffs[-1] >= n_classes):
+            raise ValueError('cutoffs must be unique, increasing, and '
+                             '< n_classes')
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs
+        self.div_value = div_value
+        n_clusters = len(cutoffs)
+        self.head_weight = self.create_parameter(
+            (in_features, cutoffs[0] + n_clusters))
+        self.head_bias = self.create_parameter(
+            (cutoffs[0] + n_clusters,), is_bias=True) if head_bias \
+            else None
+        bounds = cutoffs + [n_classes]
+        self.tail_weights = []
+        for c in range(n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (c + 1))))
+            csz = bounds[c + 1] - bounds[c]
+            w1 = self.create_parameter((in_features, hsz))
+            w2 = self.create_parameter((hsz, csz))
+            self.add_parameter(f'tail_{c}_proj', w1)
+            self.add_parameter(f'tail_{c}_cls', w2)
+            self.tail_weights.append((w1, w2))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probability table — one pass per
+        cluster, concatenated."""
+        from .. import concat
+        head = F.linear(input, self.head_weight, self.head_bias)
+        head_lp = F.log_softmax(head, axis=-1)
+        cols = [head_lp[:, :self.cutoffs[0]]]
+        for c, (w1, w2) in enumerate(self.tail_weights):
+            tl = F.log_softmax(F.linear(F.linear(input, w1), w2), axis=-1)
+            cluster_lp = head_lp[:, self.cutoffs[0] + c].unsqueeze(-1)
+            cols.append(cluster_lp + tl)
+        return concat(cols, axis=-1)
+
+    def predict(self, input):
+        return self.log_prob(input).argmax(axis=-1)

@@ -137,6 +137,95 @@ class TestLayerWrappers:
             rtol=1.0)
 
 
+class TestAdaptiveSoftmaxAndMarginCE:
+    def _pair(self):
+        import torch
+        paddle.seed(0)
+        m = paddle.nn.AdaptiveLogSoftmaxWithLoss(
+            16, 50, [10, 30], div_value=2.0, head_bias=True)
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(
+            16, 50, [10, 30], div_value=2.0, head_bias=True)
+        with torch.no_grad():
+            tm.head.weight.copy_(torch.tensor(m.head_weight.numpy().T))
+            tm.head.bias.copy_(torch.tensor(m.head_bias.numpy()))
+            for c in range(2):
+                w1, w2 = m.tail_weights[c]
+                tm.tail[c][0].weight.copy_(torch.tensor(w1.numpy().T))
+                tm.tail[c][1].weight.copy_(torch.tensor(w2.numpy().T))
+        return m, tm
+
+    def test_adaptive_softmax_parity(self):
+        import torch
+        m, tm = self._pair()
+        x = RNG.standard_normal((12, 16)).astype(np.float32)
+        y = RNG.randint(0, 50, (12,))
+        out, loss = m(_t(x), paddle.to_tensor(y))
+        tout = tm(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(out.numpy(),
+                                   tout.output.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(tout.loss), rtol=1e-4)
+        np.testing.assert_allclose(
+            m.log_prob(_t(x)).numpy(),
+            tm.log_prob(torch.tensor(x)).detach().numpy(),
+            rtol=1e-4, atol=1e-5)
+        # log_prob rows are valid log-distributions
+        np.testing.assert_allclose(
+            np.exp(m.log_prob(_t(x)).numpy()).sum(-1), 1.0, rtol=1e-4)
+        with pytest.raises(ValueError, match='cutoffs'):
+            paddle.nn.AdaptiveLogSoftmaxWithLoss(16, 50, [30, 10])
+
+    @pytest.mark.slow
+    def test_adaptive_softmax_trains(self):
+        paddle.seed(3)
+        m = paddle.nn.AdaptiveLogSoftmaxWithLoss(8, 20, [5])
+        emb = paddle.nn.Linear(20, 8)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.05,
+            parameters=list(m.parameters()) + list(emb.parameters()))
+        ids = RNG.randint(0, 20, (64,))
+        x = np.eye(20, dtype=np.float32)[ids]
+        first = last = None
+        for i in range(60):
+            _, loss = m(emb(_t(x)), paddle.to_tensor(ids))
+            loss.backward(); opt.step(); opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+            last = float(loss.numpy())
+        assert last < first * 0.2
+
+    def test_margin_ce_degenerate_and_margin_effect(self):
+        import torch
+        cos = RNG.uniform(-0.9, 0.9, (6, 8)).astype(np.float32)
+        lab = RNG.randint(0, 8, (6,))
+        got = float(F.margin_cross_entropy(
+            _t(cos), paddle.to_tensor(lab), margin1=1.0, margin2=0.0,
+            margin3=0.0, scale=10.0).numpy())
+        ref = float(tF.cross_entropy(torch.tensor(cos * 10.0),
+                                     torch.tensor(lab)))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        # a real margin makes the task strictly harder
+        arc = float(F.margin_cross_entropy(
+            _t(cos), paddle.to_tensor(lab), margin2=0.5,
+            scale=10.0).numpy())
+        assert arc > got
+        # return_softmax hands back a distribution
+        loss, sm = F.margin_cross_entropy(
+            _t(cos), paddle.to_tensor(lab), return_softmax=True)
+        np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, rtol=1e-5)
+        # gradient stays finite when the target cosine saturates at 1.0
+        sat = np.full((2, 4), 0.1, np.float32)
+        sat[0, 1] = 1.0
+        t = _t(sat)
+        t.stop_gradient = False
+        lv = F.margin_cross_entropy(t, paddle.to_tensor(np.array([1, 2])))
+        (g,) = paddle.grad(lv, [t])
+        assert np.isfinite(g.numpy()).all()
+        with pytest.raises(NotImplementedError, match='shard'):
+            F.margin_cross_entropy(_t(cos), paddle.to_tensor(lab),
+                                   group=object())
+
+
 class TestHSigmoid:
     C, FD, N = 10, 6, 7
 
