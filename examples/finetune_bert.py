@@ -1,0 +1,44 @@
+"""BERT sequence-classification finetune (BASELINE config #2 shape).
+
+    python examples/finetune_bert.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nlp import BertConfig, BertForSequenceClassification
+
+
+def main(steps=40, n_classes=2):
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=1000, hidden_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=128, max_position_embeddings=64)
+    model = BertForSequenceClassification(cfg, num_classes=n_classes)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-4,
+                                 parameters=model.parameters())
+
+    rng = np.random.RandomState(0)
+    # synthetic "sentiment": the leading marker token decides the class
+    def make_batch(n=16):
+        ids = rng.randint(10, 1000, (n, 32))
+        labels = rng.randint(0, 2, n)
+        ids[:, 0] = np.where(labels == 1, 7, 8)
+        return ids, labels
+
+    for i in range(steps):
+        ids, labels = make_batch()
+        logits = model(paddle.to_tensor(ids))
+        loss = F.cross_entropy(logits, paddle.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if i % 10 == 0 or i == steps - 1:
+            acc = (logits.numpy().argmax(1) == labels).mean()
+            print(f'step {i:3d}  loss {float(loss.numpy()):.4f}  '
+                  f'acc {acc:.2f}')
+    return acc
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,33 @@
+"""Text generation with the KV-cache decode path: greedy, sampling,
+and beam search through GenerationMixin.
+
+    python examples/generate.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      intermediate_size=128, max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    prompt = paddle.to_tensor(np.array([[5, 17, 31]]))
+
+    for mode, kw in [
+        ('greedy', dict(decode_strategy='greedy_search')),
+        ('top-p sampling', dict(decode_strategy='sampling', top_p=0.9,
+                                temperature=0.8, seed=0)),
+        ('beam search', dict(decode_strategy='beam_search', num_beams=3)),
+    ]:
+        out = model.generate(prompt, max_new_tokens=8, **kw)
+        ids = out[0] if isinstance(out, tuple) else out
+        print(f'{mode:16s} ->', np.asarray(ids.numpy())[0].tolist())
+
+
+if __name__ == '__main__':
+    main()

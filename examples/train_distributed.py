@@ -1,0 +1,55 @@
+"""Hybrid-parallel training over a device mesh (dp x mp), the pod-scale
+path of the BASELINE GPT/Llama configs.
+
+On one host this runs over whatever chips are visible; to try the
+multi-chip schedule without hardware:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python examples/train_distributed.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+
+def main(steps=10):
+    import jax
+    n = jax.device_count()
+    dp = max(1, n // 2)
+    mp = 2 if n >= 2 else 1
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {'dp_degree': dp, 'mp_degree': mp,
+                               'pp_degree': 1, 'sep_degree': 1}
+    strategy.sharding = True          # ZeRO over dp
+    strategy.sharding_configs = {'stage': 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      intermediate_size=128, max_position_embeddings=32,
+                      tensor_parallel=(mp > 1))
+    model = LlamaForCausalLM(cfg)
+    fleet.distributed_model(model)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+    step = fleet.DistTrainStep(
+        model,
+        lambda logits, labels: F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1])),
+        opt, strategy=strategy)
+
+    rng = np.random.RandomState(0)
+    for i in range(steps):
+        ids = rng.randint(0, cfg.vocab_size, (2 * dp, 32))
+        loss = step(paddle.to_tensor(ids), paddle.to_tensor(ids))
+        print(f'step {i}  loss {float(loss.numpy()):.4f}  '
+              f'(mesh dp={dp} mp={mp})')
+    return float(loss.numpy())
+
+
+if __name__ == '__main__':
+    main()

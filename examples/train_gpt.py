@@ -1,0 +1,46 @@
+"""Minimal GPT pretraining loop (the framework's flagship path).
+
+Runs on any backend; on TPU the same script is the single-chip version
+of the BASELINE GPT-3 config — scale hidden/layers and add
+fleet.DistTrainStep for the pod version (see examples/train_distributed.py).
+
+    python examples/train_gpt.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+
+
+def main(steps=30, vocab=512, seq=64, batch=4):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=256, max_position_embeddings=seq)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+    step = TrainStep(
+        model,
+        lambda logits, labels: F.cross_entropy(
+            logits.reshape([-1, vocab]), labels.reshape([-1])),
+        opt)
+
+    rng = np.random.RandomState(0)
+    # toy corpus: next-token-predictable arithmetic sequences
+    def batch_ids():
+        start = rng.randint(0, vocab - seq, (batch, 1))
+        return (start + np.arange(seq)) % vocab
+
+    for i in range(steps):
+        ids = batch_ids()
+        loss = step(ids, ids)
+        if i % 10 == 0 or i == steps - 1:
+            print(f'step {i:3d}  loss {float(loss.numpy()):.4f}')
+    return float(loss.numpy())
+
+
+if __name__ == '__main__':
+    main()
