@@ -122,8 +122,10 @@ def _run_config(name, cfg, batch, seq, steps, warmup, dtype,
         offload=offload)
 
     def loss_fn(logits, labels):
-        return F.cross_entropy(
-            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1]))
+        # true LM objective: predict token t+1 from positions <= t
+        lg = logits[:, :-1].reshape([-1, cfg.vocab_size])
+        lb = labels[:, 1:].reshape([-1])
+        return F.cross_entropy(lg, lb)
 
     step = TrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
@@ -199,7 +201,8 @@ def _run_7b_overfit(steps=300, target=7.0):
         moment_dtype='bfloat16')
     step = TrainStep(
         model, lambda logits, labels: F.cross_entropy(
-            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1])),
+            logits[:, :-1].reshape([-1, cfg.vocab_size]),
+            labels[:, 1:].reshape([-1])),
         opt)
     ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 512))
     first = None

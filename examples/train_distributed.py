@@ -18,8 +18,8 @@ from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
 def main(steps=10):
     import jax
     n = jax.device_count()
-    dp = max(1, n // 2)
-    mp = 2 if n >= 2 else 1
+    mp = 2 if n % 2 == 0 and n >= 2 else 1
+    dp = n // mp
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {'dp_degree': dp, 'mp_degree': mp,
                                'pp_degree': 1, 'sep_degree': 1}
@@ -38,8 +38,10 @@ def main(steps=10):
                                  parameters=model.parameters())
     step = fleet.DistTrainStep(
         model,
+        # next-token objective: logits at t predict token t+1
         lambda logits, labels: F.cross_entropy(
-            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1])),
+            logits[:, :-1].reshape([-1, cfg.vocab_size]),
+            labels[:, 1:].reshape([-1])),
         opt, strategy=strategy)
 
     rng = np.random.RandomState(0)

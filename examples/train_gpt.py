@@ -14,18 +14,20 @@ from paddle_tpu.jit import TrainStep
 from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
 
 
-def main(steps=30, vocab=512, seq=64, batch=4):
+def main(steps=80, vocab=512, seq=64, batch=8):
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=vocab, hidden_size=128,
                     num_hidden_layers=2, num_attention_heads=4,
                     intermediate_size=256, max_position_embeddings=seq)
     model = GPTForCausalLM(cfg)
-    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
                                  parameters=model.parameters())
     step = TrainStep(
         model,
+        # next-token objective: logits at t predict token t+1
         lambda logits, labels: F.cross_entropy(
-            logits.reshape([-1, vocab]), labels.reshape([-1])),
+            logits[:, :-1].reshape([-1, vocab]),
+            labels[:, 1:].reshape([-1])),
         opt)
 
     rng = np.random.RandomState(0)
