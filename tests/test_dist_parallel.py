@@ -514,6 +514,7 @@ def test_pp_llama_interleaved_vpp_matches_single_device():
     assert base[-1] < base[0]
 
 
+@pytest.mark.slow
 def test_group_sharded_parallel_levels_equal_unsharded():
     """paddle.distributed.sharding.group_sharded_parallel (upstream
     python/paddle/distributed/sharding/group_sharded.py): all three

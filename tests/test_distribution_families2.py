@@ -38,6 +38,7 @@ class TestBinomial:
         np.testing.assert_allclose(ours.variance.numpy(),
                                    ref.variance.numpy(), rtol=1e-6)
 
+    @pytest.mark.slow
     def test_entropy_vs_scipy(self):
         from scipy import stats
         ours, _ = self._pair()
@@ -276,6 +277,7 @@ class TestLKJCholesky:
             ours.log_prob(_t(L.numpy())).numpy(),
             ref.log_prob(L).numpy(), rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_concentration_shifts_mass(self):
         # high concentration -> correlations near 0 (identity-ish)
         lo = D.LKJCholesky(3, 1.0).sample((256,), seed=1).numpy()
