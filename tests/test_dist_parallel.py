@@ -581,3 +581,55 @@ def test_save_group_sharded_model(tmp_path):
     m2.set_state_dict(sd)
     x = paddle.to_tensor(np.ones((2, 16), np.float32))
     np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-5)
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_ring_attention_gradients_match_full(causal):
+    """The backward through the ppermute ring (what training actually
+    uses) must match full-attention gradients, incl. the blockwise-LSE
+    rescaling terms."""
+    env.init_parallel_env((1, 1, 8, 1), ('pp', 'dp', 'sp', 'mp'))
+    rng = np.random.default_rng(5)
+    B, S, H, D = 1, 64, 4, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    w = rng.standard_normal((B, S, H, D)).astype(np.float32)  # cotangent
+
+    def loss_ring(a, b, c):
+        return jnp.sum(ring_attention(a, b, c, causal=causal)
+                       * jnp.asarray(w))
+
+    def loss_full(a, b, c):
+        return jnp.sum(_attention_xla(a, b, c, causal=causal)
+                       * jnp.asarray(w))
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip('qkv', gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5,
+                                   err_msg=f'd{name}')
+
+
+def test_ulysses_gradients_match_full():
+    env.init_parallel_env((1, 1, 8, 1), ('pp', 'dp', 'sp', 'mp'))
+    rng = np.random.default_rng(6)
+    B, S, H, D = 1, 64, 8, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    w = rng.standard_normal((B, S, H, D)).astype(np.float32)
+
+    gr = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(ulysses_attention(a, b, c, causal=True)
+                                * jnp.asarray(w)),
+        argnums=(0, 1, 2)))(q, k, v)
+    gf = jax.grad(
+        lambda a, b, c: jnp.sum(_attention_xla(a, b, c, causal=True)
+                                * jnp.asarray(w)),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip('qkv', gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5,
+                                   err_msg=f'd{name}')
