@@ -1,0 +1,52 @@
+"""Seq2seq with T5: learn a toy transduction (reverse the input
+sequence), then decode it back with the encoder-decoder generate path.
+
+    python examples/seq2seq_t5.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import T5Config, T5ForConditionalGeneration
+
+
+def main(steps=300):
+    paddle.seed(0)
+    cfg = T5Config.tiny(vocab_size=64, d_model=96, d_ff=192, num_layers=2,
+                        num_heads=4)
+    model = T5ForConditionalGeneration(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                 parameters=model.parameters())
+
+    # a FINITE dataset of 64 fixed pairs: sequence reversal on fresh
+    # random data every step needs far more capacity/steps than a demo
+    # (an equal-size torch T5 plateaus at ln(V) too); 64 fixed pairs
+    # train to ~0.9 exact-token accuracy in 300 steps
+    rng = np.random.RandomState(0)
+    data = rng.randint(2, cfg.vocab_size, (64, 8))  # ids 0/1 reserved
+
+    loss = None
+    for step in range(steps):
+        src = data[rng.randint(0, len(data), 16)]
+        tgt = src[:, ::-1].copy()
+        loss, _ = model(input_ids=src, labels=tgt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 50 == 0:
+            print(f'step {step:4d}  loss {float(loss.numpy()):.4f}')
+
+    model.eval()
+    src = data[:8]
+    tgt = src[:, ::-1]
+    out, _ = model.generate(src, max_new_tokens=src.shape[1],
+                            decode_strategy='greedy_search',
+                            eos_token_id=-1)
+    acc = float((out.numpy() == tgt).mean())
+    print(f'reverse accuracy: {acc:.3f}')
+    print('src:', src[0].tolist())
+    print('out:', out.numpy()[0].tolist())
+    return float(loss.numpy()), acc
+
+
+if __name__ == '__main__':
+    main()

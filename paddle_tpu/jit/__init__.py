@@ -81,6 +81,14 @@ def functional_call(layer: Layer, params, frozen, buffers, args, kwargs,
     Returns (output pytree of raw values, new buffer dict) — buffer
     mutations (BN running stats) are captured as outputs.
     """
+    return functional_method(layer, '__call__', params, frozen, buffers,
+                             args, kwargs, rng_key=rng_key)
+
+
+def functional_method(layer: Layer, method: str, params, frozen, buffers,
+                      args, kwargs, rng_key=None):
+    """Like functional_call but invokes an arbitrary method of the layer
+    (e.g. an encoder-decoder model's `encode` during generation)."""
     saved, bmap = _bind(layer, params, frozen, buffers)
     try:
         ctx = framework.default_generator.trace_scope(rng_key) \
@@ -88,7 +96,7 @@ def functional_call(layer: Layer, params, frozen, buffers, args, kwargs,
         with ctx, autograd.functional_scope():
             wrapped_args = _tree.tree_map(
                 lambda v: Tensor(v) if not isinstance(v, Tensor) else v, args)
-            out = layer(*wrapped_args, **kwargs)
+            out = getattr(layer, method)(*wrapped_args, **kwargs)
         out_vals = _tree.tree_map(
             lambda t: t.value if isinstance(t, Tensor) else t, out,
             is_leaf=lambda t: isinstance(t, Tensor))

@@ -11,9 +11,10 @@ from .bert import (BertConfig, BertForMaskedLM,
                    BertForSequenceClassification, BertModel)
 from .ernie import (ErnieConfig, ErnieForMaskedLM,
                     ErnieForSequenceClassification, ErnieModel)
-from .generation import GenerationMixin
+from .generation import GenerationMixin, Seq2SeqGenerationMixin
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel)
+from .t5 import T5Config, T5ForConditionalGeneration, T5Model
 from .tokenizer import (BPETokenizer, PretrainedTokenizer,
                         WhitespaceTokenizer)
 
@@ -24,6 +25,7 @@ __all__ = [
     'BertModel', 'ErnieConfig', 'ErnieForMaskedLM',
     'ErnieForSequenceClassification', 'ErnieModel', 'GenerationMixin',
     'GPTConfig', 'GPTForCausalLM', 'GPTModel', 'LlamaConfig',
-    'LlamaForCausalLM', 'LlamaModel', 'BPETokenizer',
+    'LlamaForCausalLM', 'LlamaModel', 'Seq2SeqGenerationMixin',
+    'T5Config', 'T5ForConditionalGeneration', 'T5Model', 'BPETokenizer',
     'PretrainedTokenizer', 'WhitespaceTokenizer', 'transformers',
 ]

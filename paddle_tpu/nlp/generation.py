@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import framework
-from ..jit import functional_call, functional_state
+from ..jit import functional_call, functional_method, functional_state
 from ..tensor import Tensor, to_jax
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
@@ -448,6 +448,160 @@ class GenerationMixin:
                                       min_new_tokens=int(min_new_tokens))
                 out, scores = fn(params, frozen, buffers, ids, keep, cache,
                                  key)
+        finally:
+            if was_training:
+                self.train()
+        return Tensor(out), Tensor(scores)
+
+
+class Seq2SeqGenerationMixin:
+    """Mixed into encoder-decoder models (T5). Requires the host class to
+    provide, beyond ``forward(decoder_input_ids=..., encoder_output=...,
+    encoder_cross_kv=..., attention_mask=..., cache=..., cache_offset=...,
+    use_cache=True) -> (logits, new_cache)``:
+
+    - ``encode(input_ids, attention_mask=None) -> encoder hidden``
+    - ``cross_kv(encoder_hidden) -> per-decoder-layer (k, v)``
+    - ``init_cache(batch_size, max_length, dtype) -> self-attn cache``
+
+    The whole generate is ONE XLA program: encoder forward + per-layer
+    cross-attention K/V once, then a `lax.while_loop` of cached
+    single-token decoder steps (upstream: paddlenlp generation_utils'
+    encoder-decoder path re-runs the encoder outside the loop too, but
+    grows the cache — here the cache is static-shape)."""
+
+    def _s2s_decode_jit(self, max_new_tokens: int, strategy: str,
+                        temperature: float, top_k: int, top_p: float,
+                        eos_token_id: int, pad_token_id: int,
+                        start_token_id: int, min_new_tokens: int = 0):
+        cache_key = (max_new_tokens, strategy, temperature, top_k, top_p,
+                     eos_token_id, pad_token_id, start_token_id,
+                     min_new_tokens)
+        store = self.__dict__.setdefault('_generate_jit_cache', {})
+        if cache_key in store:
+            return store[cache_key]
+
+        def decode(params, frozen, buffers, enc_ids, enc_keep, cache, key):
+            b = enc_ids.shape[0]
+            enc_h, _ = functional_method(
+                self, 'encode', params, frozen, buffers, (enc_ids,),
+                dict(attention_mask=enc_keep))
+            cross, _ = functional_method(
+                self, 'cross_kv', params, frozen, buffers, (enc_h,), {})
+
+            def processors(logits, emit_idx):
+                if min_new_tokens > 0 and eos_token_id >= 0:
+                    v = logits.shape[-1]
+                    is_eos = (jnp.arange(v) == eos_token_id)[None, :]
+                    logits = jnp.where(
+                        is_eos & (emit_idx < min_new_tokens), _NEG_INF,
+                        logits)
+                return logits
+
+            def fwd(tok, cache, slot):
+                (logits, new_cache), _ = functional_call(
+                    self, params, frozen, buffers, (),
+                    dict(decoder_input_ids=tok, encoder_output=enc_h,
+                         encoder_cross_kv=cross, attention_mask=enc_keep,
+                         cache=cache, cache_offset=slot, use_cache=True))
+                return logits, new_cache
+
+            start = jnp.full((b, 1), start_token_id, jnp.int32)
+            logits, cache = fwd(start, cache, jnp.int32(0))
+            key, sub = jax.random.split(key)
+            nxt, nxt_logp = _next_token(
+                processors(logits[:, -1], jnp.int32(0)), sub, strategy,
+                temperature, top_k, top_p)
+            out = jnp.full((b, max_new_tokens), pad_token_id, jnp.int32)
+            scores = jnp.zeros((b,), jnp.float32)
+            finished = jnp.zeros((b,), jnp.bool_)
+
+            def cond(state):
+                i = state[0]
+                finished = state[5]
+                return jnp.logical_and(i < max_new_tokens,
+                                       jnp.logical_not(jnp.all(finished)))
+
+            def body(state):
+                i, tok, tok_logp, out, cache, finished, scores, key = state
+                tok = jnp.where(finished, pad_token_id, tok)
+                out = jax.lax.dynamic_update_slice(out, tok[:, None], (0, i))
+                scores = scores + jnp.where(finished, 0.0, tok_logp)
+                newly_done = jnp.logical_or(finished, tok == eos_token_id)
+                logits, cache = fwd(tok[:, None], cache, jnp.int32(1) + i)
+                key, sub = jax.random.split(key)
+                nxt, nxt_logp = _next_token(
+                    processors(logits[:, -1], i + 1), sub, strategy,
+                    temperature, top_k, top_p)
+                return (i + 1, nxt, nxt_logp, out, cache, newly_done,
+                        scores, key)
+
+            state = (jnp.int32(0), nxt, nxt_logp, out, cache, finished,
+                     scores, key)
+            _, _, _, out, _, _, scores, _ = jax.lax.while_loop(
+                cond, body, state)
+            return out, scores
+
+        jitted = jax.jit(decode)
+        store[cache_key] = jitted
+        return jitted
+
+    def generate(self, input_ids, max_new_tokens: int = 20,
+                 max_length: Optional[int] = None,
+                 decode_strategy: str = 'greedy_search',
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 min_new_tokens: int = 0,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: Optional[int] = None,
+                 decoder_start_token_id: Optional[int] = None,
+                 use_cache: bool = True, seed: Optional[int] = None,
+                 attention_mask=None, **kwargs) -> Tuple[Tensor, Tensor]:
+        """Returns (generated ids [B, max_new_tokens], per-sequence score).
+        `input_ids` are ENCODER inputs; decoding starts from
+        decoder_start_token_id (upstream T5 convention)."""
+        if decode_strategy not in ('greedy_search', 'sampling'):
+            raise ValueError(f'unknown decode_strategy {decode_strategy!r} '
+                             '(encoder-decoder generate supports '
+                             'greedy_search and sampling)')
+        if kwargs:
+            raise TypeError(f'generate() got unexpected kwargs '
+                            f'{sorted(kwargs)}')
+        ids = to_jax(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        b, s = ids.shape
+        if max_length is not None:
+            max_new_tokens = max(int(max_length) - 1, 1)
+        if attention_mask is not None:
+            keep = to_jax(attention_mask).astype(jnp.int32)
+            if keep.ndim == 1:
+                keep = keep[None, :]
+            if keep.shape != (b, s):
+                raise ValueError(
+                    f'attention_mask shape {keep.shape} does not match '
+                    f'input_ids shape {(b, s)}')
+        else:
+            keep = jnp.ones((b, s), jnp.int32)
+        cfg = getattr(self, 'config', None)
+        if eos_token_id is None:
+            eos_token_id = getattr(cfg, 'eos_token_id', -1)
+        if pad_token_id is None:
+            pad_token_id = getattr(cfg, 'pad_token_id', 0)
+        if decoder_start_token_id is None:
+            decoder_start_token_id = getattr(cfg, 'decoder_start_token_id', 0)
+        was_training = self.training
+        self.eval()
+        try:
+            params, frozen, buffers = functional_state(self)
+            cache = self.init_cache(b, 1 + max_new_tokens)
+            key = (jax.random.PRNGKey(seed) if seed is not None
+                   else framework.next_rng_key())
+            fn = self._s2s_decode_jit(
+                int(max_new_tokens), decode_strategy, float(temperature),
+                int(top_k), float(top_p), int(eos_token_id),
+                int(pad_token_id), int(decoder_start_token_id),
+                min_new_tokens=int(min_new_tokens))
+            out, scores = fn(params, frozen, buffers, ids, keep, cache, key)
         finally:
             if was_training:
                 self.train()

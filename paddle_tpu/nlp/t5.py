@@ -1,0 +1,489 @@
+"""T5 encoder-decoder family (relative position bias, RMS layer norm,
+unscaled attention, tied embeddings with d_model**-0.5 logit scaling).
+
+Upstream analogue: PaddleNLP `paddlenlp/transformers/t5/modeling.py`
+(T5Model / T5ForConditionalGeneration). TPU-native design notes:
+- the relative-position bucket map is pure jnp (log-bucketing via
+  `jnp.where`, no data-dependent control flow) so the whole encoder and
+  the cached decode step trace once under `jax.jit`;
+- attention routes through `F.scaled_dot_product_attention` with the
+  bias passed as an additive float mask; T5 is unscaled, so q is
+  pre-multiplied by sqrt(d_kv) to cancel the SDPA 1/sqrt(d) factor;
+- decode uses the same static-slot KV cache as the decoder-only models
+  (`lax.dynamic_update_slice`), plus per-layer cross-attention K/V
+  computed ONCE from the encoder output — generation never recompiles
+  and never re-encodes.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.common_layers import Dropout, Embedding, Linear
+from ..nn.layer import Layer
+from ..nn.norm import RMSNorm
+from ..tensor import Tensor, apply_op, to_jax
+from .generation import Seq2SeqGenerationMixin, as_offset as _as_offset, \
+    update_kv_cache as _update_kv_cache
+
+_NEG = -1e9
+
+
+class T5Config:
+    model_type = 't5'
+
+    def __init__(self, vocab_size=32128, d_model=512, d_kv=64, d_ff=2048,
+                 num_layers=6, num_decoder_layers=None, num_heads=8,
+                 relative_attention_num_buckets=32,
+                 relative_attention_max_distance=128, dropout_rate=0.1,
+                 layer_norm_epsilon=1e-6, feed_forward_proj='relu',
+                 tie_word_embeddings=True, pad_token_id=0, eos_token_id=1,
+                 decoder_start_token_id=0, **kwargs):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.d_kv = d_kv
+        self.d_ff = d_ff
+        self.num_layers = num_layers
+        self.num_decoder_layers = (num_decoder_layers
+                                   if num_decoder_layers is not None
+                                   else num_layers)
+        self.num_heads = num_heads
+        self.relative_attention_num_buckets = relative_attention_num_buckets
+        self.relative_attention_max_distance = relative_attention_max_distance
+        self.dropout_rate = dropout_rate
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.feed_forward_proj = feed_forward_proj
+        self.tie_word_embeddings = tie_word_embeddings
+        self.pad_token_id = pad_token_id
+        self.eos_token_id = eos_token_id
+        self.decoder_start_token_id = decoder_start_token_id
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    @property
+    def is_gated_act(self):
+        return self.feed_forward_proj.startswith('gated-')
+
+    @property
+    def dense_act_fn(self):
+        return self.feed_forward_proj.split('-')[-1]
+
+    @classmethod
+    def t5_small(cls, **kw):
+        return cls(d_model=512, d_kv=64, d_ff=2048, num_layers=6,
+                   num_heads=8, **kw)
+
+    @classmethod
+    def t5_base(cls, **kw):
+        return cls(d_model=768, d_kv=64, d_ff=3072, num_layers=12,
+                   num_heads=12, **kw)
+
+    @classmethod
+    def t5_large(cls, **kw):
+        return cls(d_model=1024, d_kv=64, d_ff=4096, num_layers=24,
+                   num_heads=16, **kw)
+
+    @classmethod
+    def t5_v1_1_base(cls, **kw):
+        return cls(d_model=768, d_kv=64, d_ff=2048, num_layers=12,
+                   num_heads=12, feed_forward_proj='gated-gelu',
+                   tie_word_embeddings=False, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault('vocab_size', 96)
+        kw.setdefault('d_model', 64)
+        kw.setdefault('d_kv', 16)
+        kw.setdefault('d_ff', 128)
+        kw.setdefault('num_layers', 2)
+        kw.setdefault('num_heads', 4)
+        kw.setdefault('relative_attention_num_buckets', 8)
+        kw.setdefault('relative_attention_max_distance', 16)
+        kw.setdefault('dropout_rate', 0.0)
+        return cls(**kw)
+
+
+def _relative_position_bucket(rel, bidirectional, num_buckets, max_distance):
+    """T5 log-bucketed relative positions (upstream paddlenlp
+    t5/modeling.py::T5Attention._relative_position_bucket). `rel` is
+    memory_pos - query_pos, int32, any shape."""
+    ret = jnp.zeros_like(rel)
+    n = -rel
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    nf = jnp.maximum(n, 1).astype(jnp.float32)
+    val_if_large = max_exact + (
+        jnp.log(nf / max_exact) / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class T5Attention(Layer):
+    """Unscaled multi-head attention with optional learned relative
+    position bias; inner dim = num_heads * d_kv (decoupled from d_model)."""
+
+    def __init__(self, config: T5Config, has_relative_attention_bias=False,
+                 bidirectional=True):
+        super().__init__()
+        self.config = config
+        self.bidirectional = bidirectional
+        self.num_heads = config.num_heads
+        self.d_kv = config.d_kv
+        inner = config.num_heads * config.d_kv
+        self.q = Linear(config.d_model, inner, bias_attr=False)
+        self.k = Linear(config.d_model, inner, bias_attr=False)
+        self.v = Linear(config.d_model, inner, bias_attr=False)
+        self.o = Linear(inner, config.d_model, bias_attr=False)
+        self.relative_attention_bias = (
+            Embedding(config.relative_attention_num_buckets,
+                      config.num_heads)
+            if has_relative_attention_bias else None)
+
+    def compute_bias(self, query_length, key_length, query_offset=0):
+        """[1, H, Sq, Sk] additive bias. `query_offset` shifts the query
+        positions (cached decode: the single query sits at slot t)."""
+        cfg = self.config
+        ctx = query_offset + jnp.arange(query_length, dtype=jnp.int32)
+        mem = jnp.arange(key_length, dtype=jnp.int32)
+        rel = mem[None, :] - ctx[:, None]
+        bucket = _relative_position_bucket(
+            rel, self.bidirectional, cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance)
+        return apply_op(
+            lambda w: jnp.transpose(w[bucket], (2, 0, 1))[None],
+            self.relative_attention_bias.weight, _name='t5_rel_bias')
+
+    def forward(self, hidden, key_value_states=None, bias=None, cache=None,
+                cache_offset=None):
+        """bias: additive float [.., H|1, Sq|1, Sk] (position bias and/or
+        padding/causal mask), already combined by the caller.
+        cache: self-attn (k,v) static cache updated at `cache_offset`, or
+        cross-attn precomputed (k,v) used as-is (key_value_states=None
+        marks self-attention)."""
+        nh, dk = self.num_heads, self.d_kv
+
+        def split(t):
+            return apply_op(
+                lambda v: v.reshape(v.shape[0], v.shape[1], nh, dk),
+                t, _name='split_heads')
+
+        q = split(self.q(hidden))
+        # T5 attention is unscaled; SDPA divides by sqrt(d) — cancel it
+        q = apply_op(lambda v: v * math.sqrt(dk), q, _name='t5_unscale')
+        new_cache = None
+        if key_value_states is not None:        # cross-attention
+            if cache is not None:
+                kh, vh = cache                  # precomputed, static
+            else:
+                kh = split(self.k(key_value_states))
+                vh = split(self.v(key_value_states))
+        else:                                   # self-attention
+            kh = split(self.k(hidden))
+            vh = split(self.v(hidden))
+            if cache is not None:
+                slot = _as_offset(cache_offset)
+                kc, vc = _update_kv_cache(
+                    cache[0], cache[1],
+                    kh if isinstance(kh, Tensor) else Tensor(kh),
+                    vh if isinstance(vh, Tensor) else Tensor(vh), slot)
+                kh, vh = kc, vc
+                new_cache = (kc, vc)
+        out = F.scaled_dot_product_attention(q, kh, vh, attn_mask=bias)
+        out = apply_op(
+            lambda t: t.reshape(t.shape[0], t.shape[1], nh * dk),
+            out, _name='merge_heads')
+        out = self.o(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class T5DenseFF(Layer):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        act = {'relu': F.relu, 'gelu': lambda x: F.gelu(x, approximate=True),
+               'silu': F.silu}[config.dense_act_fn]
+        self.act = act
+        if config.is_gated_act:
+            self.wi_0 = Linear(config.d_model, config.d_ff, bias_attr=False)
+            self.wi_1 = Linear(config.d_model, config.d_ff, bias_attr=False)
+        else:
+            self.wi = Linear(config.d_model, config.d_ff, bias_attr=False)
+        self.wo = Linear(config.d_ff, config.d_model, bias_attr=False)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, x):
+        if self.config.is_gated_act:
+            h = self.act(self.wi_0(x)) * self.wi_1(x)
+        else:
+            h = self.act(self.wi(x))
+        return self.wo(self.dropout(h))
+
+
+class T5Block(Layer):
+    """Pre-norm residual block: ln -> sublayer -> dropout -> add.
+    Encoder: self-attn + FF. Decoder: self-attn + cross-attn + FF."""
+
+    def __init__(self, config: T5Config, is_decoder,
+                 has_relative_attention_bias=False):
+        super().__init__()
+        self.is_decoder = is_decoder
+        self.self_attn = T5Attention(
+            config, has_relative_attention_bias=has_relative_attention_bias,
+            bidirectional=not is_decoder)
+        self.self_attn_norm = RMSNorm(config.d_model,
+                                      epsilon=config.layer_norm_epsilon)
+        if is_decoder:
+            self.cross_attn = T5Attention(config, bidirectional=True)
+            self.cross_attn_norm = RMSNorm(config.d_model,
+                                           epsilon=config.layer_norm_epsilon)
+        self.ff = T5DenseFF(config)
+        self.ff_norm = RMSNorm(config.d_model,
+                               epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, hidden, self_bias=None, encoder_hidden=None,
+                cross_bias=None, cache=None, cache_offset=None,
+                cross_kv=None):
+        out = self.self_attn(self.self_attn_norm(hidden), bias=self_bias,
+                             cache=cache, cache_offset=cache_offset)
+        new_cache = None
+        if cache is not None:
+            out, new_cache = out
+        h = hidden + self.dropout(out)
+        if self.is_decoder:
+            c = self.cross_attn(self.cross_attn_norm(h),
+                                key_value_states=encoder_hidden,
+                                bias=cross_bias, cache=cross_kv)
+            h = h + self.dropout(c)
+        h = h + self.dropout(self.ff(self.ff_norm(h)))
+        if cache is not None:
+            return h, new_cache
+        return h
+
+
+def _pad_bias(mask):
+    """[B, S] keep-mask -> [B, 1, 1, S] additive 0/-1e9 float bias."""
+    return apply_op(
+        lambda m: jnp.where((m > 0)[:, None, None, :], 0.0, _NEG)
+        .astype(jnp.float32), mask, _name='t5_pad_bias')
+
+
+class T5Stack(Layer):
+    def __init__(self, config: T5Config, is_decoder):
+        super().__init__()
+        self.config = config
+        self.is_decoder = is_decoder
+        n = config.num_decoder_layers if is_decoder else config.num_layers
+        self.block = [T5Block(config, is_decoder,
+                              has_relative_attention_bias=(i == 0))
+                      for i in range(n)]
+        for i, b in enumerate(self.block):
+            self.add_sublayer(f'block.{i}', b)
+        self.final_layer_norm = RMSNorm(config.d_model,
+                                        epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, embeds, attention_mask=None, encoder_hidden=None,
+                encoder_attention_mask=None, cache=None, cache_offset=None,
+                cross_kv=None):
+        h = self.dropout(embeds)
+        s = h.shape[1]
+        if cache is not None:
+            total = cache[0][0].shape[1]
+            slot = _as_offset(cache_offset)
+            # query at slots [slot, slot+s); keys valid up to slot+row
+            bias = self.block[0].self_attn.compute_bias(
+                s, total, query_offset=slot)
+            valid = (jnp.arange(total)[None, None, None, :]
+                     <= (slot + jnp.arange(s))[None, None, :, None])
+            self_bias = apply_op(
+                lambda b: b + jnp.where(valid, 0.0, _NEG), bias,
+                _name='t5_decode_bias')
+        else:
+            bias = self.block[0].self_attn.compute_bias(s, s)
+            if self.is_decoder:
+                causal = (jnp.arange(s)[None, :]
+                          <= jnp.arange(s)[:, None])[None, None]
+                bias = apply_op(
+                    lambda b: b + jnp.where(causal, 0.0, _NEG), bias,
+                    _name='t5_causal_bias')
+            self_bias = bias
+            if attention_mask is not None:
+                self_bias = self_bias + _pad_bias(attention_mask)
+        cross_bias = None
+        if self.is_decoder and encoder_attention_mask is not None:
+            cross_bias = _pad_bias(encoder_attention_mask)
+        new_caches = []
+        for i, blk in enumerate(self.block):
+            layer_cache = None
+            if cache is not None:
+                kc, vc = cache[i]
+                layer_cache = (kc if isinstance(kc, Tensor) else Tensor(kc),
+                               vc if isinstance(vc, Tensor) else Tensor(vc))
+            out = blk(h, self_bias=self_bias, encoder_hidden=encoder_hidden,
+                      cross_bias=cross_bias, cache=layer_cache,
+                      cache_offset=cache_offset,
+                      cross_kv=None if cross_kv is None else cross_kv[i])
+            if layer_cache is not None:
+                h, c = out
+                new_caches.append(c)
+            else:
+                h = out
+        h = self.dropout(self.final_layer_norm(h))
+        if cache is not None:
+            return h, tuple(new_caches)
+        return h
+
+
+class T5PretrainedModel(Layer):
+    config_class = T5Config
+    base_model_prefix = 't5'
+
+
+class T5Model(T5PretrainedModel):
+    """Reference parity: paddlenlp T5Model (shared embedding -> encoder
+    stack -> decoder stack with cross-attention)."""
+
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        self.shared = Embedding(config.vocab_size, config.d_model)
+        self.encoder = T5Stack(config, is_decoder=False)
+        self.decoder = T5Stack(config, is_decoder=True)
+
+    def encode(self, input_ids, attention_mask=None):
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(to_jax(input_ids))
+        return self.encoder(self.shared(ids), attention_mask=attention_mask)
+
+    def decode(self, decoder_input_ids, encoder_hidden,
+               encoder_attention_mask=None, decoder_attention_mask=None,
+               cache=None, cache_offset=None, cross_kv=None):
+        ids = decoder_input_ids if isinstance(decoder_input_ids, Tensor) \
+            else Tensor(to_jax(decoder_input_ids))
+        return self.decoder(self.shared(ids),
+                            attention_mask=decoder_attention_mask,
+                            encoder_hidden=encoder_hidden,
+                            encoder_attention_mask=encoder_attention_mask,
+                            cache=cache, cache_offset=cache_offset,
+                            cross_kv=cross_kv)
+
+    def forward(self, input_ids, decoder_input_ids, attention_mask=None,
+                decoder_attention_mask=None):
+        enc = self.encode(input_ids, attention_mask=attention_mask)
+        dec_ids = decoder_input_ids \
+            if isinstance(decoder_input_ids, Tensor) \
+            else Tensor(to_jax(decoder_input_ids))
+        dec_embeds = self.shared(dec_ids)
+        return self.decoder(dec_embeds, attention_mask=decoder_attention_mask,
+                            encoder_hidden=enc,
+                            encoder_attention_mask=attention_mask), enc
+
+    def init_cache(self, batch_size, max_length, dtype=None):
+        cfg = self.config
+        dt = dtype or 'float32'
+        shape = (batch_size, int(max_length), cfg.num_heads, cfg.d_kv)
+        return tuple((jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                     for _ in range(cfg.num_decoder_layers))
+
+    def cross_kv(self, encoder_hidden):
+        """Per-decoder-layer cross-attention (K, V) from the encoder
+        output — computed once per generate() call."""
+        out = []
+        nh, dk = self.config.num_heads, self.config.d_kv
+
+        def split(t):
+            return apply_op(
+                lambda v: v.reshape(v.shape[0], v.shape[1], nh, dk),
+                t, _name='split_heads')
+        for blk in self.decoder.block:
+            out.append((split(blk.cross_attn.k(encoder_hidden)),
+                        split(blk.cross_attn.v(encoder_hidden))))
+        return tuple(out)
+
+
+class T5ForConditionalGeneration(T5PretrainedModel, Seq2SeqGenerationMixin):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        self.t5 = T5Model(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.d_model, config.vocab_size,
+                                  bias_attr=False)
+
+    def _shift_right(self, labels):
+        """labels -> decoder inputs: prepend decoder_start, drop last,
+        map ignore_index (-100) to pad (upstream _shift_right)."""
+        cfg = self.config
+
+        def f(lab):
+            lab = jnp.asarray(lab)  # host int64 -> canonical int32
+            shifted = jnp.concatenate(
+                [jnp.full((lab.shape[0], 1), cfg.decoder_start_token_id,
+                          lab.dtype), lab[:, :-1]], axis=1)
+            return jnp.where(shifted == -100, cfg.pad_token_id, shifted)
+        return apply_op(f, labels if isinstance(labels, Tensor)
+                        else Tensor(to_jax(labels)), _name='shift_right')
+
+    def _logits(self, h):
+        cfg = self.config
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        # tied head: rescale by d_model**-0.5 (upstream T5 does this only
+        # in the tied configuration)
+        w = self.t5.shared.weight
+        scale = cfg.d_model ** -0.5
+        return apply_op(lambda hv, wv: (hv * scale) @ wv.T, h, w,
+                        _name='tied_lm_head')
+
+    def forward(self, input_ids=None, decoder_input_ids=None,
+                attention_mask=None, decoder_attention_mask=None,
+                labels=None, encoder_output=None, encoder_cross_kv=None,
+                cache=None, cache_offset=None, use_cache=False):
+        if labels is not None and decoder_input_ids is None:
+            decoder_input_ids = self._shift_right(labels)
+        if encoder_output is None:
+            encoder_output = self.t5.encode(input_ids,
+                                            attention_mask=attention_mask)
+        out = self.t5.decode(decoder_input_ids, encoder_output,
+                             encoder_attention_mask=attention_mask,
+                             decoder_attention_mask=decoder_attention_mask,
+                             cache=cache, cache_offset=cache_offset,
+                             cross_kv=encoder_cross_kv)
+        if cache is not None:
+            h, new_cache = out
+        else:
+            h, new_cache = out, None
+        logits = self._logits(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                (labels if isinstance(labels, Tensor)
+                 else Tensor(to_jax(labels))).reshape([-1]))
+            return loss, logits
+        if use_cache:
+            return logits, new_cache
+        return logits
+
+    # --- Seq2SeqGenerationMixin protocol --------------------------------
+    def init_cache(self, batch_size, max_length, dtype=None):
+        return self.t5.init_cache(batch_size, max_length, dtype)
+
+    def encode(self, input_ids, attention_mask=None):
+        return self.t5.encode(input_ids, attention_mask=attention_mask)
+
+    def cross_kv(self, encoder_hidden):
+        return self.t5.cross_kv(encoder_hidden)

@@ -6,5 +6,7 @@ from .ernie import (ErnieConfig, ErnieForMaskedLM,  # noqa: F401
 from .generation import GenerationMixin  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .t5 import (T5Config, T5ForConditionalGeneration,  # noqa: F401
+                 T5Model)
 from .tokenizer import (BPETokenizer, PretrainedTokenizer,  # noqa: F401
                         WhitespaceTokenizer)
