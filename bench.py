@@ -87,17 +87,26 @@ def _7b_configs():
             num_attention_heads=32, num_key_value_heads=32,
             max_position_embeddings=4096, num_hidden_layers=layers,
             use_recompute=remat)
-    # no 24L rung: bf16 params+grads alone are 20.6 GB — past the chip's
-    # HBM no matter where the moments live; 16L (7.1+7.1 GB) is the
-    # deepest grads-in-HBM point and runs with offloaded moments
-    return [
-        ('llama2_7b_shape_16L', mk(16, True), 1, 2048, 4, 1, 'bfloat16',
-         'host'),
+    # throughput ladder: deepest config whose FULL state (params + grads
+    # + bf16 moments) lives in HBM — this is the tokens/sec-per-chip
+    # number comparable run to run
+    fast = [
         ('llama2_7b_shape_8L', mk(8, 'dots_no_batch'), 1, 4096, 6, 2,
          'bfloat16', None),
         ('llama2_7b_shape_8L', mk(8, True), 2, 2048, 6, 2, 'bfloat16',
          None),
     ]
+    # depth rung (reported separately): 16L with Adam moments
+    # host-offloaded — 2x the in-HBM depth ceiling. The moment streaming
+    # crosses the host link every step (on this rig, an RPC tunnel), so
+    # its step_time measures the offload tradeoff, not model throughput.
+    # No 24L rung: bf16 params+grads alone are 20.6 GB — past the chip's
+    # HBM no matter where the moments live.
+    deep = [
+        ('llama2_7b_shape_16L', mk(16, True), 1, 2048, 3, 1, 'bfloat16',
+         'host'),
+    ]
+    return fast, deep
 
 
 def _run_config(name, cfg, batch, seq, steps, warmup, dtype,
@@ -381,22 +390,36 @@ def _phase_headline():
     return out
 
 
+def _report_7b(res):
+    return {
+        'tokens_per_sec': round(res['tokens_per_sec'], 1),
+        'mfu': round(res['mfu'], 4),
+        'step_time_s': round(res['step_time_s'], 4),
+        'loss': round(res['loss'], 4),
+        'params_m': res['params_m'],
+        'batch': res['batch'], 'seq': res['seq'],
+        'peak_hbm_gb': res.get('peak_hbm_gb'),
+        'layers': res['layers'], 'layers_full_7b': 32,
+        'depth_reduced_to_fit_hbm': res['layers'] < 32,
+        'optimizer_state_host_offload': res['offload_optimizer'],
+    }
+
+
 def _phase_7b():
-    name7, res7 = _run_ladder(_7b_configs())
+    fast, deep = _7b_configs()
+    out = {}
+    _, res7 = _run_ladder(fast)
     if res7 is None:
-        return {'llama2_7b_shape': {'error': 'all 7B-shape rungs failed'}}
-    return {'llama2_7b_shape': {
-        'tokens_per_sec': round(res7['tokens_per_sec'], 1),
-        'mfu': round(res7['mfu'], 4),
-        'step_time_s': round(res7['step_time_s'], 4),
-        'loss': round(res7['loss'], 4),
-        'params_m': res7['params_m'],
-        'batch': res7['batch'], 'seq': res7['seq'],
-        'peak_hbm_gb': res7.get('peak_hbm_gb'),
-        'layers': res7['layers'], 'layers_full_7b': 32,
-        'depth_reduced_to_fit_hbm': res7['layers'] < 32,
-        'optimizer_state_host_offload': res7['offload_optimizer'],
-    }}
+        out['llama2_7b_shape'] = {'error': 'all 7B-shape rungs failed'}
+    else:
+        out['llama2_7b_shape'] = _report_7b(res7)
+    _free_device_memory()
+    _, res16 = _run_ladder(deep)
+    if res16 is None:
+        out['llama2_7b_deep_offload'] = {'error': '16L offload rung failed'}
+    else:
+        out['llama2_7b_deep_offload'] = _report_7b(res16)
+    return out
 
 
 def _phase_probe():

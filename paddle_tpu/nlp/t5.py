@@ -105,6 +105,14 @@ class T5Config:
         return cls(**kw)
 
 
+def _split_heads(t, num_heads, d_kv):
+    """[B, S, H*D] -> [B, S, H, D] (single definition shared by attention
+    and the precomputed cross-attention K/V path)."""
+    return apply_op(
+        lambda v: v.reshape(v.shape[0], v.shape[1], num_heads, d_kv),
+        t, _name='split_heads')
+
+
 def _relative_position_bucket(rel, bidirectional, num_buckets, max_distance):
     """T5 log-bucketed relative positions (upstream paddlenlp
     t5/modeling.py::T5Attention._relative_position_bucket). `rel` is
@@ -172,9 +180,7 @@ class T5Attention(Layer):
         nh, dk = self.num_heads, self.d_kv
 
         def split(t):
-            return apply_op(
-                lambda v: v.reshape(v.shape[0], v.shape[1], nh, dk),
-                t, _name='split_heads')
+            return _split_heads(t, nh, dk)
 
         q = split(self.q(hidden))
         # T5 attention is unscaled; SDPA divides by sqrt(d) — cancel it
@@ -402,14 +408,10 @@ class T5Model(T5PretrainedModel):
         output — computed once per generate() call."""
         out = []
         nh, dk = self.config.num_heads, self.config.d_kv
-
-        def split(t):
-            return apply_op(
-                lambda v: v.reshape(v.shape[0], v.shape[1], nh, dk),
-                t, _name='split_heads')
         for blk in self.decoder.block:
-            out.append((split(blk.cross_attn.k(encoder_hidden)),
-                        split(blk.cross_attn.v(encoder_hidden))))
+            out.append(
+                (_split_heads(blk.cross_attn.k(encoder_hidden), nh, dk),
+                 _split_heads(blk.cross_attn.v(encoder_hidden), nh, dk)))
         return tuple(out)
 
 

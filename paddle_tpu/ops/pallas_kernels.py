@@ -512,7 +512,7 @@ def _ce_fwd_kernel(lab_ref, x_ref, loss_ref, lse_ref, m_s, s_s, t_s, *,
     s_s[:, 0] = s_s[:, 0] * scale + jnp.sum(
         jnp.exp(xf - m_new[:, None]), axis=1)
     m_s[:, 0] = m_new
-    lab = lab_ref[:]  # [rows] int32
+    lab = lab_ref[:, 0]  # [rows] int32 (column-vector view, see fwd)
     hit = (cols == lab[:, None]) & inb
     t_s[:, 0] = t_s[:, 0] + jnp.sum(
         jnp.where(hit, x_ref[:].astype(jnp.float32), 0.0), axis=1)
@@ -520,8 +520,8 @@ def _ce_fwd_kernel(lab_ref, x_ref, loss_ref, lse_ref, m_s, s_s, t_s, *,
     @pl.when(j == n_vblocks - 1)
     def _fin():
         lse = m_s[:, 0] + jnp.log(s_s[:, 0])
-        lse_ref[:] = lse
-        loss_ref[:] = lse - t_s[:, 0]
+        lse_ref[:, 0] = lse
+        loss_ref[:, 0] = lse - t_s[:, 0]
 
 
 def _ce_bwd_kernel(lab_ref, g_ref, x_ref, lse_ref, dx_ref, *, block_v,
@@ -531,9 +531,9 @@ def _ce_bwd_kernel(lab_ref, g_ref, x_ref, lse_ref, dx_ref, *, block_v,
     j = pl.program_id(1)
     xf = x_ref[:].astype(jnp.float32)
     cols = jax.lax.broadcasted_iota(jnp.int32, xf.shape, 1) + j * block_v
-    p = jnp.exp(xf - lse_ref[:][:, None])
-    onehot = (cols == lab_ref[:][:, None]).astype(jnp.float32)
-    dx = (p - onehot) * g_ref[:][:, None]
+    p = jnp.exp(xf - lse_ref[:])
+    onehot = (cols == lab_ref[:]).astype(jnp.float32)
+    dx = (p - onehot) * g_ref[:]
     inb = cols < vocab
     dx_ref[:] = jnp.where(inb, dx, 0.0).astype(dx_ref.dtype)
 
@@ -554,21 +554,23 @@ def softmax_cross_entropy_fwd(logits, labels, block_rows=256,
     if vp != v:
         logits = jnp.pad(logits, ((0, 0), (0, vp - v)))
     n_vblocks = vp // block_v
+    # rank-1 operands are carried as [np_, 1] column vectors: a rank-1
+    # block would have to match XLA's rank-1 tiling ({0:T(1024)}), which
+    # conflicts with a 256-row block; a (block_rows, 1) 2-D block is
+    # layout-legal on both sides
+    col = pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0))
     loss, lse = pl.pallas_call(
         functools.partial(_ce_fwd_kernel, n_vblocks=n_vblocks,
                           block_v=block_v, vocab=v),
         grid=(np_ // block_rows, n_vblocks),
         in_specs=[
-            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+            col,
             pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
         ],
-        out_specs=[
-            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
-            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
-        ],
+        out_specs=[col, col],
         out_shape=[
-            jax.ShapeDtypeStruct((np_,), jnp.float32),
-            jax.ShapeDtypeStruct((np_,), jnp.float32),
+            jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+            jax.ShapeDtypeStruct((np_, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_rows, 1), jnp.float32),
@@ -578,8 +580,8 @@ def softmax_cross_entropy_fwd(logits, labels, block_rows=256,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'arbitrary')),
         interpret=interpret,
-    )(labels.astype(jnp.int32), logits)
-    return loss[:n], lse[:n]
+    )(labels.astype(jnp.int32).reshape(np_, 1), logits)
+    return loss.reshape(np_)[:n], lse.reshape(np_)[:n]
 
 
 def softmax_cross_entropy_bwd(logits, labels, lse, g, block_rows=256,
@@ -594,21 +596,23 @@ def softmax_cross_entropy_bwd(logits, labels, lse, g, block_rows=256,
         g = jnp.pad(g, (0, np_ - n))
     if vp != v:
         logits = jnp.pad(logits, ((0, 0), (0, vp - v)))
+    col = pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0))
     dx = pl.pallas_call(
         functools.partial(_ce_bwd_kernel, block_v=block_v, vocab=v),
         grid=(np_ // block_rows, vp // block_v),
         in_specs=[
-            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
-            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+            col,
+            col,
             pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
-            pl.BlockSpec((block_rows,), lambda i, j: (i,)),
+            col,
         ],
         out_specs=pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((np_, vp), logits.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel')),
         interpret=interpret,
-    )(labels.astype(jnp.int32), g, logits, lse)
+    )(labels.astype(jnp.int32).reshape(np_, 1), g.reshape(np_, 1),
+      logits, lse.reshape(np_, 1))
     return dx[:n, :v]
 
 
