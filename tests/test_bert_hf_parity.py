@@ -1,0 +1,112 @@
+"""paddle_tpu BERT vs HuggingFace torch BERT on copied weights:
+post-LN encoder, gelu, learned positions + token types, tanh pooler."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import BertConfig, BertModel
+
+torch = pytest.importorskip('torch')
+hf = pytest.importorskip('transformers')
+
+
+def _make_pair(seed=0):
+    paddle.seed(seed)
+    cfg = BertConfig(vocab_size=120, hidden_size=48, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=96,
+                     max_position_embeddings=64, type_vocab_size=2,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    model = BertModel(cfg).eval()
+    hc = hf.BertConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        intermediate_size=cfg.intermediate_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        type_vocab_size=cfg.type_vocab_size, hidden_act='gelu',
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=cfg.layer_norm_eps, pad_token_id=cfg.pad_token_id)
+    tm = hf.BertModel(hc).eval()
+    sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
+
+    def put(t, name, transpose=True):
+        arr = sd[name]
+        if transpose and arr.ndim == 2:
+            arr = arr.T
+        t.data.copy_(torch.tensor(arr))
+
+    e = tm.embeddings
+    put(e.word_embeddings.weight, 'embeddings.word_embeddings.weight',
+        transpose=False)
+    put(e.position_embeddings.weight,
+        'embeddings.position_embeddings.weight', transpose=False)
+    put(e.token_type_embeddings.weight,
+        'embeddings.token_type_embeddings.weight', transpose=False)
+    put(e.LayerNorm.weight, 'embeddings.layer_norm.weight', transpose=False)
+    put(e.LayerNorm.bias, 'embeddings.layer_norm.bias', transpose=False)
+    for i, blk in enumerate(tm.encoder.layer):
+        p = f'encoder.layers.{i}.'
+        put(blk.attention.self.query.weight, p + 'self_attn.q_proj.weight')
+        put(blk.attention.self.query.bias, p + 'self_attn.q_proj.bias',
+            transpose=False)
+        put(blk.attention.self.key.weight, p + 'self_attn.k_proj.weight')
+        put(blk.attention.self.key.bias, p + 'self_attn.k_proj.bias',
+            transpose=False)
+        put(blk.attention.self.value.weight, p + 'self_attn.v_proj.weight')
+        put(blk.attention.self.value.bias, p + 'self_attn.v_proj.bias',
+            transpose=False)
+        put(blk.attention.output.dense.weight, p + 'self_attn.out_proj.weight')
+        put(blk.attention.output.dense.bias, p + 'self_attn.out_proj.bias',
+            transpose=False)
+        put(blk.attention.output.LayerNorm.weight, p + 'norm1.weight',
+            transpose=False)
+        put(blk.attention.output.LayerNorm.bias, p + 'norm1.bias',
+            transpose=False)
+        put(blk.intermediate.dense.weight, p + 'linear1.weight')
+        put(blk.intermediate.dense.bias, p + 'linear1.bias',
+            transpose=False)
+        put(blk.output.dense.weight, p + 'linear2.weight')
+        put(blk.output.dense.bias, p + 'linear2.bias', transpose=False)
+        put(blk.output.LayerNorm.weight, p + 'norm2.weight',
+            transpose=False)
+        put(blk.output.LayerNorm.bias, p + 'norm2.bias', transpose=False)
+    put(tm.pooler.dense.weight, 'pooler.dense.weight')
+    put(tm.pooler.dense.bias, 'pooler.dense.bias', transpose=False)
+    return cfg, model, tm
+
+
+class TestBertHFParity:
+    def test_sequence_output_and_pooler_match_hf(self):
+        cfg, model, tm = _make_pair(seed=0)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(3, cfg.vocab_size, (2, 10))
+        tok = rng.randint(0, 2, (2, 10))
+        seq, pooled = model(ids, token_type_ids=tok)
+        with torch.no_grad():
+            ref = tm(input_ids=torch.tensor(ids),
+                     token_type_ids=torch.tensor(tok))
+        np.testing.assert_allclose(seq.numpy(),
+                                   ref.last_hidden_state.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(pooled.numpy(),
+                                   ref.pooler_output.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_padding_mask_matches_hf(self):
+        cfg, model, tm = _make_pair(seed=1)
+        rng = np.random.RandomState(1)
+        ids = rng.randint(3, cfg.vocab_size, (2, 12))
+        mask = np.ones((2, 12), np.int64)
+        mask[0, 8:] = 0
+        mask[1, 5:] = 0
+        ids = ids * mask
+        seq, _ = model(ids, attention_mask=mask)
+        with torch.no_grad():
+            ref = tm(input_ids=torch.tensor(ids),
+                     attention_mask=torch.tensor(mask)).last_hidden_state
+        # compare only the non-pad positions (pad rows attend freely in
+        # both, but numerical garbage there is irrelevant)
+        m = mask.astype(bool)
+        np.testing.assert_allclose(seq.numpy()[m], ref.numpy()[m],
+                                   rtol=2e-4, atol=2e-4)

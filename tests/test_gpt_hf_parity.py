@@ -1,0 +1,79 @@
+"""paddle_tpu GPT vs HuggingFace torch GPT-2 on copied weights: the
+architectures coincide (pre-LN, fused qkv, learned positions, tied lm
+head), and HF's Conv1D stores [in, out] exactly like this repo's Linear,
+so weights copy with no transpose."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+
+torch = pytest.importorskip('torch')
+hf = pytest.importorskip('transformers')
+
+
+def _make_pair(seed=0):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=96, hidden_size=48, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0, eos_token_id=1)
+    model = GPTForCausalLM(cfg).eval()
+    hc = hf.GPT2Config(
+        vocab_size=cfg.vocab_size, n_embd=cfg.hidden_size,
+        n_layer=cfg.num_hidden_layers, n_head=cfg.num_attention_heads,
+        n_positions=cfg.max_position_embeddings,
+        n_inner=cfg.intermediate_size,
+        activation_function='gelu',  # exact erf gelu, as this repo's F.gelu
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=cfg.layer_norm_epsilon,
+        bos_token_id=1, eos_token_id=1)
+    tm = hf.GPT2LMHeadModel(hc).eval()
+    sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
+
+    def put(t, name):
+        t.data.copy_(torch.tensor(sd[name]))
+
+    put(tm.transformer.wte.weight, 'gpt.word_embeddings.weight')
+    put(tm.transformer.wpe.weight, 'gpt.position_embeddings.weight')
+    for i, blk in enumerate(tm.transformer.h):
+        p = f'gpt.layers.{i}.'
+        put(blk.ln_1.weight, p + 'norm1.weight')
+        put(blk.ln_1.bias, p + 'norm1.bias')
+        put(blk.attn.c_attn.weight, p + 'attn.qkv_proj.weight')
+        put(blk.attn.c_attn.bias, p + 'attn.qkv_proj.bias')
+        put(blk.attn.c_proj.weight, p + 'attn.out_proj.weight')
+        put(blk.attn.c_proj.bias, p + 'attn.out_proj.bias')
+        put(blk.ln_2.weight, p + 'norm2.weight')
+        put(blk.ln_2.bias, p + 'norm2.bias')
+        put(blk.mlp.c_fc.weight, p + 'linear1.weight')
+        put(blk.mlp.c_fc.bias, p + 'linear1.bias')
+        put(blk.mlp.c_proj.weight, p + 'linear2.weight')
+        put(blk.mlp.c_proj.bias, p + 'linear2.bias')
+    put(tm.transformer.ln_f.weight, 'gpt.final_norm.weight')
+    put(tm.transformer.ln_f.bias, 'gpt.final_norm.bias')
+    return cfg, model, tm
+
+
+class TestGPTHFParity:
+    def test_logits_match_gpt2(self):
+        cfg, model, tm = _make_pair(seed=0)
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 12))
+        mine = model(ids).numpy()
+        with torch.no_grad():
+            ref = tm(input_ids=torch.tensor(ids)).logits.numpy()
+        np.testing.assert_allclose(mine, ref, rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.slow
+    def test_greedy_generate_matches_gpt2(self):
+        cfg, model, tm = _make_pair(seed=1)
+        ids = np.random.RandomState(1).randint(2, cfg.vocab_size, (2, 5))
+        out, _ = model.generate(ids, max_new_tokens=10,
+                                decode_strategy='greedy_search',
+                                eos_token_id=-1)
+        with torch.no_grad():
+            ref = tm.generate(torch.tensor(ids), max_new_tokens=10,
+                              do_sample=False, num_beams=1,
+                              eos_token_id=None, pad_token_id=0)
+        np.testing.assert_array_equal(out.numpy(),
+                                      ref[:, ids.shape[1]:].numpy())

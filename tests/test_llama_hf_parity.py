@@ -1,0 +1,134 @@
+"""Flagship-model gold standard: paddle_tpu Llama vs HuggingFace torch
+Llama on copied weights — logits, loss gradients' direction (via a train
+step), and greedy generation token-for-token."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+torch = pytest.importorskip('torch')
+hf = pytest.importorskip('transformers')
+
+
+def _cfg(**kw):
+    return LlamaConfig.tiny(**kw)
+
+
+def _hf_cfg(cfg):
+    return hf.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        attention_bias=False, tie_word_embeddings=False,
+        pad_token_id=cfg.pad_token_id, bos_token_id=cfg.bos_token_id,
+        eos_token_id=cfg.eos_token_id)
+
+
+def _copy_into_hf(model, tm):
+    sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
+
+    def put(t, name, transpose=True):
+        arr = sd[name]
+        if transpose and arr.ndim == 2:
+            arr = arr.T
+        t.data.copy_(torch.tensor(arr))
+
+    put(tm.model.embed_tokens.weight, 'llama.embed_tokens.weight',
+        transpose=False)
+    for i, blk in enumerate(tm.model.layers):
+        p = f'llama.layers.{i}.'
+        put(blk.self_attn.q_proj.weight, p + 'self_attn.q_proj.weight')
+        put(blk.self_attn.k_proj.weight, p + 'self_attn.k_proj.weight')
+        put(blk.self_attn.v_proj.weight, p + 'self_attn.v_proj.weight')
+        put(blk.self_attn.o_proj.weight, p + 'self_attn.o_proj.weight')
+        put(blk.mlp.gate_proj.weight, p + 'mlp.gate_proj.weight')
+        put(blk.mlp.up_proj.weight, p + 'mlp.up_proj.weight')
+        put(blk.mlp.down_proj.weight, p + 'mlp.down_proj.weight')
+        put(blk.input_layernorm.weight, p + 'input_layernorm.weight',
+            transpose=False)
+        put(blk.post_attention_layernorm.weight,
+            p + 'post_attention_layernorm.weight', transpose=False)
+    put(tm.model.norm.weight, 'llama.norm.weight', transpose=False)
+    put(tm.lm_head.weight, 'lm_head.weight')
+
+
+def _make_pair(seed=0, **kw):
+    paddle.seed(seed)
+    cfg = _cfg(**kw)
+    model = LlamaForCausalLM(cfg).eval()
+    tm = hf.LlamaForCausalLM(_hf_cfg(cfg)).eval()
+    _copy_into_hf(model, tm)
+    return cfg, model, tm
+
+
+class TestLlamaHFParity:
+    def test_logits_match_hf_gqa(self):
+        cfg, model, tm = _make_pair(seed=0)  # tiny() is GQA: 4 q / 2 kv
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 11))
+        mine = model(ids).numpy()
+        with torch.no_grad():
+            ref = tm(input_ids=torch.tensor(ids)).logits.numpy()
+        np.testing.assert_allclose(mine, ref, rtol=2e-4, atol=2e-4)
+
+    def test_logits_match_hf_mha(self):
+        cfg, model, tm = _make_pair(seed=1, num_key_value_heads=4)
+        ids = np.random.RandomState(1).randint(0, cfg.vocab_size, (1, 7))
+        mine = model(ids).numpy()
+        with torch.no_grad():
+            ref = tm(input_ids=torch.tensor(ids)).logits.numpy()
+        np.testing.assert_allclose(mine, ref, rtol=2e-4, atol=2e-4)
+
+    def test_loss_matches_hf(self):
+        cfg, model, tm = _make_pair(seed=2)
+        ids = np.random.RandomState(2).randint(0, cfg.vocab_size, (2, 9))
+        # this repo's labels are unshifted logits-aligned targets; HF
+        # shifts internally — feed HF the same next-token objective
+        loss, _ = model(ids[:, :-1], labels=ids[:, 1:])
+        # HF's .loss shifts labels internally a second time, so compare
+        # against an explicit no-shift CE over its logits instead
+        with torch.no_grad():
+            t_ids = torch.tensor(ids)
+            lg = tm(input_ids=t_ids[:, :-1]).logits
+            ref = torch.nn.functional.cross_entropy(
+                lg.reshape(-1, cfg.vocab_size),
+                t_ids[:, 1:].reshape(-1)).item()
+        assert abs(float(loss.numpy()) - ref) < 2e-4
+
+    @pytest.mark.slow
+    def test_greedy_generate_matches_hf(self):
+        cfg, model, tm = _make_pair(seed=3)
+        ids = np.random.RandomState(3).randint(3, cfg.vocab_size, (2, 6))
+        out, _ = model.generate(ids, max_new_tokens=10,
+                                decode_strategy='greedy_search',
+                                eos_token_id=-1)
+        with torch.no_grad():
+            ref = tm.generate(torch.tensor(ids), max_new_tokens=10,
+                              do_sample=False, num_beams=1,
+                              eos_token_id=None, pad_token_id=0)
+        np.testing.assert_array_equal(out.numpy(),
+                                      ref[:, ids.shape[1]:].numpy())
+
+    @pytest.mark.slow
+    def test_greedy_generate_left_padded_matches_hf(self):
+        cfg, model, tm = _make_pair(seed=4)
+        rng = np.random.RandomState(4)
+        ids = rng.randint(3, cfg.vocab_size, (2, 6))
+        ids[1, :2] = cfg.pad_token_id
+        mask = np.ones_like(ids)
+        mask[1, :2] = 0
+        out, _ = model.generate(ids, max_new_tokens=8,
+                                decode_strategy='greedy_search',
+                                eos_token_id=-1, attention_mask=mask)
+        with torch.no_grad():
+            ref = tm.generate(torch.tensor(ids),
+                              attention_mask=torch.tensor(mask),
+                              max_new_tokens=8, do_sample=False,
+                              num_beams=1, eos_token_id=None,
+                              pad_token_id=0)
+        np.testing.assert_array_equal(out.numpy(),
+                                      ref[:, ids.shape[1]:].numpy())
