@@ -40,7 +40,7 @@ class T5Config:
                  relative_attention_max_distance=128, dropout_rate=0.1,
                  layer_norm_epsilon=1e-6, feed_forward_proj='relu',
                  tie_word_embeddings=True, pad_token_id=0, eos_token_id=1,
-                 decoder_start_token_id=0, **kwargs):
+                 decoder_start_token_id=0, tensor_parallel=False, **kwargs):
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.d_kv = d_kv
@@ -59,6 +59,7 @@ class T5Config:
         self.pad_token_id = pad_token_id
         self.eos_token_id = eos_token_id
         self.decoder_start_token_id = decoder_start_token_id
+        self.tensor_parallel = tensor_parallel
         for k, v in kwargs.items():
             setattr(self, k, v)
 
@@ -105,6 +106,24 @@ class T5Config:
         return cls(**kw)
 
 
+def _col_linear(config, in_f, out_f):
+    """Plain Linear, or mp-column-sharded under config.tensor_parallel
+    (same wiring as llama.py; upstream: fleet's parallel layers)."""
+    if config.tensor_parallel:
+        from ..distributed.parallel_layers import ColumnParallelLinear
+        return ColumnParallelLinear(in_f, out_f, has_bias=False,
+                                    gather_output=False)
+    return Linear(in_f, out_f, bias_attr=False)
+
+
+def _row_linear(config, in_f, out_f):
+    if config.tensor_parallel:
+        from ..distributed.parallel_layers import RowParallelLinear
+        return RowParallelLinear(in_f, out_f, has_bias=False,
+                                 input_is_parallel=True)
+    return Linear(in_f, out_f, bias_attr=False)
+
+
 def _split_heads(t, num_heads, d_kv):
     """[B, S, H*D] -> [B, S, H, D] (single definition shared by attention
     and the precomputed cross-attention K/V path)."""
@@ -147,10 +166,10 @@ class T5Attention(Layer):
         self.num_heads = config.num_heads
         self.d_kv = config.d_kv
         inner = config.num_heads * config.d_kv
-        self.q = Linear(config.d_model, inner, bias_attr=False)
-        self.k = Linear(config.d_model, inner, bias_attr=False)
-        self.v = Linear(config.d_model, inner, bias_attr=False)
-        self.o = Linear(inner, config.d_model, bias_attr=False)
+        self.q = _col_linear(config, config.d_model, inner)
+        self.k = _col_linear(config, config.d_model, inner)
+        self.v = _col_linear(config, config.d_model, inner)
+        self.o = _row_linear(config, inner, config.d_model)
         self.relative_attention_bias = (
             Embedding(config.relative_attention_num_buckets,
                       config.num_heads)
@@ -221,11 +240,11 @@ class T5DenseFF(Layer):
                'silu': F.silu}[config.dense_act_fn]
         self.act = act
         if config.is_gated_act:
-            self.wi_0 = Linear(config.d_model, config.d_ff, bias_attr=False)
-            self.wi_1 = Linear(config.d_model, config.d_ff, bias_attr=False)
+            self.wi_0 = _col_linear(config, config.d_model, config.d_ff)
+            self.wi_1 = _col_linear(config, config.d_model, config.d_ff)
         else:
-            self.wi = Linear(config.d_model, config.d_ff, bias_attr=False)
-        self.wo = Linear(config.d_ff, config.d_model, bias_attr=False)
+            self.wi = _col_linear(config, config.d_model, config.d_ff)
+        self.wo = _row_linear(config, config.d_ff, config.d_model)
         self.dropout = Dropout(config.dropout_rate)
 
     def forward(self, x):
@@ -364,7 +383,12 @@ class T5Model(T5PretrainedModel):
     def __init__(self, config: T5Config):
         super().__init__()
         self.config = config
-        self.shared = Embedding(config.vocab_size, config.d_model)
+        if config.tensor_parallel:
+            from ..distributed.parallel_layers import VocabParallelEmbedding
+            self.shared = VocabParallelEmbedding(config.vocab_size,
+                                                 config.d_model)
+        else:
+            self.shared = Embedding(config.vocab_size, config.d_model)
         self.encoder = T5Stack(config, is_decoder=False)
         self.decoder = T5Stack(config, is_decoder=True)
 
