@@ -1,0 +1,104 @@
+"""paddle_tpu ERNIE vs HuggingFace torch Ernie on copied weights:
+BERT encoder plus task-type embeddings summed before the embedding
+LayerNorm (use_task_id)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import ErnieConfig, ErnieModel
+
+torch = pytest.importorskip('torch')
+hf = pytest.importorskip('transformers')
+
+
+def _make_pair(seed=0):
+    paddle.seed(seed)
+    cfg = ErnieConfig(vocab_size=120, hidden_size=48, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=96,
+                      max_position_embeddings=64, type_vocab_size=2,
+                      task_type_vocab_size=3, use_task_id=True,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model = ErnieModel(cfg).eval()
+    hc = hf.ErnieConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        intermediate_size=cfg.intermediate_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        type_vocab_size=cfg.type_vocab_size,
+        task_type_vocab_size=cfg.task_type_vocab_size, use_task_id=True,
+        hidden_act='gelu', hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        layer_norm_eps=cfg.layer_norm_eps, pad_token_id=cfg.pad_token_id)
+    tm = hf.ErnieModel(hc).eval()
+    sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
+
+    def put(t, name, transpose=True):
+        arr = sd[name]
+        if transpose and arr.ndim == 2:
+            arr = arr.T
+        t.data.copy_(torch.tensor(arr))
+
+    e = tm.embeddings
+    put(e.word_embeddings.weight, 'bert.embeddings.word_embeddings.weight',
+        transpose=False)
+    put(e.position_embeddings.weight,
+        'bert.embeddings.position_embeddings.weight', transpose=False)
+    put(e.token_type_embeddings.weight,
+        'bert.embeddings.token_type_embeddings.weight', transpose=False)
+    put(e.task_type_embeddings.weight, 'task_type_embeddings.weight',
+        transpose=False)
+    put(e.LayerNorm.weight, 'bert.embeddings.layer_norm.weight',
+        transpose=False)
+    put(e.LayerNorm.bias, 'bert.embeddings.layer_norm.bias',
+        transpose=False)
+    for i, blk in enumerate(tm.encoder.layer):
+        p = f'bert.encoder.layers.{i}.'
+        for hf_mod, mine in [
+                (blk.attention.self.query, 'self_attn.q_proj'),
+                (blk.attention.self.key, 'self_attn.k_proj'),
+                (blk.attention.self.value, 'self_attn.v_proj'),
+                (blk.attention.output.dense, 'self_attn.out_proj'),
+                (blk.intermediate.dense, 'linear1'),
+                (blk.output.dense, 'linear2')]:
+            put(hf_mod.weight, p + mine + '.weight')
+            put(hf_mod.bias, p + mine + '.bias', transpose=False)
+        put(blk.attention.output.LayerNorm.weight, p + 'norm1.weight',
+            transpose=False)
+        put(blk.attention.output.LayerNorm.bias, p + 'norm1.bias',
+            transpose=False)
+        put(blk.output.LayerNorm.weight, p + 'norm2.weight',
+            transpose=False)
+        put(blk.output.LayerNorm.bias, p + 'norm2.bias', transpose=False)
+    put(tm.pooler.dense.weight, 'bert.pooler.dense.weight')
+    put(tm.pooler.dense.bias, 'bert.pooler.dense.bias', transpose=False)
+    return cfg, model, tm
+
+
+class TestErnieHFParity:
+    def test_outputs_match_hf_with_task_ids(self):
+        cfg, model, tm = _make_pair(seed=0)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(3, cfg.vocab_size, (2, 10))
+        tok = rng.randint(0, 2, (2, 10))
+        task = rng.randint(0, 3, (2, 10))
+        seq, pooled = model(ids, token_type_ids=tok, task_type_ids=task)
+        with torch.no_grad():
+            ref = tm(input_ids=torch.tensor(ids),
+                     token_type_ids=torch.tensor(tok),
+                     task_type_ids=torch.tensor(task))
+        np.testing.assert_allclose(seq.numpy(),
+                                   ref.last_hidden_state.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(pooled.numpy(),
+                                   ref.pooler_output.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_default_task_ids_are_zero(self):
+        cfg, model, tm = _make_pair(seed=1)
+        ids = np.random.RandomState(1).randint(3, cfg.vocab_size, (1, 8))
+        seq_default, _ = model(ids)
+        seq_zero, _ = model(ids, task_type_ids=np.zeros((1, 8), np.int64))
+        np.testing.assert_allclose(seq_default.numpy(), seq_zero.numpy(),
+                                   rtol=1e-6)
