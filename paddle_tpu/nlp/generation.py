@@ -546,10 +546,110 @@ class Seq2SeqGenerationMixin:
         store[cache_key] = jitted
         return jitted
 
+    def _s2s_beam_decode_jit(self, max_new_tokens: int, num_beams: int,
+                             eos_token_id: int, pad_token_id: int,
+                             start_token_id: int, length_penalty: float):
+        """Beam search for encoder-decoder models: the encoder runs once,
+        then cross-attention K/V, the self-attn cache, and the encoder
+        mask are beam-expanded to a [B*K] batch (same one-program design
+        as the decoder-only beam)."""
+        cache_key = ('beam', max_new_tokens, num_beams, eos_token_id,
+                     pad_token_id, start_token_id, length_penalty)
+        store = self.__dict__.setdefault('_generate_jit_cache', {})
+        if cache_key in store:
+            return store[cache_key]
+        K = num_beams
+        NEG = jnp.float32(-1e9)
+
+        def decode(params, frozen, buffers, enc_ids, enc_keep, cache):
+            b = enc_ids.shape[0]
+            enc_h, _ = functional_method(
+                self, 'encode', params, frozen, buffers, (enc_ids,),
+                dict(attention_mask=enc_keep))
+            cross, _ = functional_method(
+                self, 'cross_kv', params, frozen, buffers, (enc_h,), {})
+
+            def fwd(tok, cache, cross, enc_h, enc_keep, slot):
+                (logits, new_cache), _ = functional_call(
+                    self, params, frozen, buffers, (),
+                    dict(decoder_input_ids=tok, encoder_output=enc_h,
+                         encoder_cross_kv=cross, attention_mask=enc_keep,
+                         cache=cache, cache_offset=slot, use_cache=True))
+                return logits, new_cache
+
+            start = jnp.full((b, 1), start_token_id, jnp.int32)
+            logits, cache = fwd(start, cache, cross, enc_h, enc_keep,
+                                jnp.int32(0))
+            logp0 = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1)      # [B, V]
+            v = logp0.shape[-1]
+            scores, tok = jax.lax.top_k(logp0, K)                # [B, K]
+            rep = lambda t: jnp.repeat(t, K, axis=0)
+            cache = jax.tree_util.tree_map(rep, cache)
+            cross_bk = jax.tree_util.tree_map(rep, cross)
+            enc_h_bk = rep(enc_h)
+            enc_keep_bk = rep(enc_keep)
+            out = jnp.full((b, K, max_new_tokens), pad_token_id, jnp.int32)
+            finished = jnp.zeros((b, K), jnp.bool_)
+            lengths = jnp.zeros((b, K), jnp.int32)
+
+            def cond(state):
+                i = state[0]
+                finished = state[5]
+                return jnp.logical_and(i < max_new_tokens,
+                                       jnp.logical_not(jnp.all(finished)))
+
+            def body(state):
+                (i, tok, out, cache, scores, finished, lengths) = state
+                tok = jnp.where(finished, pad_token_id, tok)     # [B, K]
+                out = jax.lax.dynamic_update_slice(
+                    out, tok[:, :, None], (0, 0, i))
+                lengths = lengths + jnp.where(finished, 0, 1)
+                finished = jnp.logical_or(finished, tok == eos_token_id)
+                logits, cache = fwd(
+                    tok.reshape(b * K, 1), cache, cross_bk, enc_h_bk,
+                    enc_keep_bk, jnp.int32(1) + i)
+                logp = jax.nn.log_softmax(
+                    logits[:, -1].astype(jnp.float32), -1).reshape(b, K, v)
+                pad_only = jnp.full((v,), NEG).at[pad_token_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], pad_only[None, None],
+                                 logp)
+                cand = scores[:, :, None] + logp                 # [B, K, V]
+                scores, flat_idx = jax.lax.top_k(
+                    cand.reshape(b, K * v), K)                   # [B, K]
+                beam_src = flat_idx // v
+                nxt = (flat_idx % v).astype(jnp.int32)
+                out = jnp.take_along_axis(out, beam_src[:, :, None], axis=1)
+                finished = jnp.take_along_axis(finished, beam_src, axis=1)
+                lengths = jnp.take_along_axis(lengths, beam_src, axis=1)
+                flat_src = (jnp.arange(b)[:, None] * K
+                            + beam_src).reshape(-1)              # [B*K]
+                cache = jax.tree_util.tree_map(
+                    lambda c: jnp.take(c, flat_src, axis=0), cache)
+                return (i + 1, nxt, out, cache, scores, finished, lengths)
+
+            state = (jnp.int32(0), tok, out, cache, scores, finished,
+                     lengths)
+            _, _, out, _, scores, _, lengths = jax.lax.while_loop(
+                cond, body, state)
+            norm = jnp.maximum(lengths, 1).astype(jnp.float32) \
+                ** jnp.float32(length_penalty)
+            best = jnp.argmax(scores / norm, axis=1)             # [B]
+            best_out = jnp.take_along_axis(
+                out, best[:, None, None], axis=1)[:, 0]          # [B, T]
+            best_score = jnp.take_along_axis(
+                scores / norm, best[:, None], axis=1)[:, 0]
+            return best_out, best_score
+
+        jitted = jax.jit(decode)
+        store[cache_key] = jitted
+        return jitted
+
     def generate(self, input_ids, max_new_tokens: int = 20,
                  max_length: Optional[int] = None,
                  decode_strategy: str = 'greedy_search',
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 num_beams: int = 1, length_penalty: float = 0.0,
                  min_new_tokens: int = 0,
                  eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None,
@@ -559,10 +659,15 @@ class Seq2SeqGenerationMixin:
         """Returns (generated ids [B, max_new_tokens], per-sequence score).
         `input_ids` are ENCODER inputs; decoding starts from
         decoder_start_token_id (upstream T5 convention)."""
-        if decode_strategy not in ('greedy_search', 'sampling'):
-            raise ValueError(f'unknown decode_strategy {decode_strategy!r} '
-                             '(encoder-decoder generate supports '
-                             'greedy_search and sampling)')
+        if decode_strategy not in ('greedy_search', 'sampling',
+                                   'beam_search'):
+            raise ValueError(f'unknown decode_strategy {decode_strategy!r}')
+        if decode_strategy == 'beam_search' and num_beams < 1:
+            raise ValueError('beam_search requires num_beams >= 1')
+        if decode_strategy == 'beam_search' and min_new_tokens > 0:
+            raise NotImplementedError(
+                'min_new_tokens is supported for greedy_search and '
+                'sampling (not beam_search)')
         if kwargs:
             raise TypeError(f'generate() got unexpected kwargs '
                             f'{sorted(kwargs)}')
@@ -594,14 +699,22 @@ class Seq2SeqGenerationMixin:
         try:
             params, frozen, buffers = functional_state(self)
             cache = self.init_cache(b, 1 + max_new_tokens)
-            key = (jax.random.PRNGKey(seed) if seed is not None
-                   else framework.next_rng_key())
-            fn = self._s2s_decode_jit(
-                int(max_new_tokens), decode_strategy, float(temperature),
-                int(top_k), float(top_p), int(eos_token_id),
-                int(pad_token_id), int(decoder_start_token_id),
-                min_new_tokens=int(min_new_tokens))
-            out, scores = fn(params, frozen, buffers, ids, keep, cache, key)
+            if decode_strategy == 'beam_search':
+                fn = self._s2s_beam_decode_jit(
+                    int(max_new_tokens), int(num_beams), int(eos_token_id),
+                    int(pad_token_id), int(decoder_start_token_id),
+                    float(length_penalty))
+                out, scores = fn(params, frozen, buffers, ids, keep, cache)
+            else:
+                key = (jax.random.PRNGKey(seed) if seed is not None
+                       else framework.next_rng_key())
+                fn = self._s2s_decode_jit(
+                    int(max_new_tokens), decode_strategy, float(temperature),
+                    int(top_k), float(top_p), int(eos_token_id),
+                    int(pad_token_id), int(decoder_start_token_id),
+                    min_new_tokens=int(min_new_tokens))
+                out, scores = fn(params, frozen, buffers, ids, keep, cache,
+                                 key)
         finally:
             if was_training:
                 self.train()

@@ -259,3 +259,93 @@ class TestT5Behavior:
         a, _ = m1.eval()(ids, dec)
         b, _ = m2.eval()(ids, dec)
         np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
+
+
+def _ref_s2s_beam(m, enc_ids, K, max_new, eos, pad, start,
+                  length_penalty=0.0):
+    """Pure-python seq2seq beam over full cache-free forwards, mirroring
+    _s2s_beam_decode_jit's state machine."""
+    NEG = np.float32(-1e9)
+
+    def logp_last(dec_seq):
+        logits = m(input_ids=enc_ids[None, :],
+                   decoder_input_ids=dec_seq[None, :]).numpy()
+        lg = logits[0, -1].astype(np.float32)
+        return lg - np.log(np.exp(lg - lg.max()).sum()) - lg.max()
+
+    lp0 = logp_last(np.array([start], np.int64))
+    V = lp0.shape[0]
+    order = np.argsort(-lp0, kind='stable')[:K]
+    scores = lp0[order].copy()
+    tok = order.astype(np.int64)
+    out = np.full((K, max_new), pad, np.int64)
+    finished = np.zeros(K, bool)
+    lengths = np.zeros(K, np.int64)
+    for i in range(max_new):
+        if finished.all():
+            break
+        tok = np.where(finished, pad, tok)
+        out[:, i] = tok
+        lengths = lengths + (~finished)
+        finished = finished | (tok == eos)
+        cand = np.full((K, V), NEG, np.float32)
+        for k in range(K):
+            if finished[k]:
+                cand[k, pad] = scores[k]
+            else:
+                seq = np.concatenate([[start], out[k, :i + 1]])
+                cand[k] = scores[k] + logp_last(seq)
+        flat = np.argsort(-cand.ravel(), kind='stable')[:K]
+        scores = cand.ravel()[flat]
+        src = flat // V
+        tok = (flat % V).astype(np.int64)
+        out, finished, lengths = out[src], finished[src], lengths[src]
+    norm = np.maximum(lengths, 1).astype(np.float32) ** length_penalty
+    best = int(np.argmax(scores / norm))
+    return out[best], float((scores / norm)[best])
+
+
+class TestT5Beam:
+    def test_beam_1_equals_greedy(self):
+        cfg = _tiny_cfg()
+        paddle.seed(20)
+        m = T5ForConditionalGeneration(cfg).eval()
+        ids = np.random.RandomState(20).randint(2, cfg.vocab_size, (2, 7))
+        greedy, _ = m.generate(ids, max_new_tokens=6,
+                               decode_strategy='greedy_search',
+                               eos_token_id=-1)
+        beam1, _ = m.generate(ids, max_new_tokens=6,
+                              decode_strategy='beam_search', num_beams=1,
+                              eos_token_id=-1)
+        np.testing.assert_array_equal(greedy.numpy(), beam1.numpy())
+
+    @pytest.mark.slow
+    def test_beam_k_matches_python_reference(self):
+        cfg = _tiny_cfg()
+        paddle.seed(21)
+        m = T5ForConditionalGeneration(cfg).eval()
+        ids = np.random.RandomState(21).randint(2, cfg.vocab_size, (5,))
+        got, got_score = m.generate(ids[None, :], max_new_tokens=4,
+                                    decode_strategy='beam_search',
+                                    num_beams=3, eos_token_id=-1)
+        want, want_score = _ref_s2s_beam(
+            m, ids, K=3, max_new=4, eos=-1, pad=cfg.pad_token_id,
+            start=cfg.decoder_start_token_id)
+        np.testing.assert_array_equal(got.numpy()[0], want)
+        np.testing.assert_allclose(float(got_score.numpy()[0]), want_score,
+                                   atol=1e-3)
+
+    @pytest.mark.slow
+    def test_beam_eos_freezes_and_pads(self):
+        cfg = _tiny_cfg()
+        paddle.seed(22)
+        m = T5ForConditionalGeneration(cfg).eval()
+        ids = np.random.RandomState(22).randint(2, cfg.vocab_size, (1, 6))
+        first, _ = m.generate(ids, max_new_tokens=1, eos_token_id=-1)
+        eos = int(first.numpy()[0, 0])
+        got, _ = m.generate(ids, max_new_tokens=5,
+                            decode_strategy='beam_search', num_beams=2,
+                            eos_token_id=eos, pad_token_id=93)
+        want, _ = _ref_s2s_beam(m, ids[0], K=2, max_new=5, eos=eos, pad=93,
+                                start=cfg.decoder_start_token_id)
+        np.testing.assert_array_equal(got.numpy()[0], want)
