@@ -62,6 +62,10 @@ def _configs(on_tpu):
         num_hidden_layers=12, num_attention_heads=16,
         num_key_value_heads=16, max_position_embeddings=4096)
     return [
+        # b4 first: the pallas CE avoids the fp32 [B*S, V] logits buffer,
+        # which is what OOMed b4 in r4 — falls through to b2 if it still
+        # doesn't fit
+        ('gpt3_1p3b', gpt3_dots, 4, 2048, 10, 2, 'bfloat16'),
         ('gpt3_1p3b', gpt3_dots, 2, 2048, 10, 2, 'bfloat16'),
         ('gpt3_1p3b', gpt3_full, 8, 2048, 10, 2, 'bfloat16'),
         ('gpt3_1p3b', gpt3_full, 4, 2048, 10, 2, 'bfloat16'),
