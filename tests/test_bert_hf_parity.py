@@ -77,6 +77,7 @@ def _make_pair(seed=0):
 
 
 class TestBertHFParity:
+    @pytest.mark.slow
     def test_sequence_output_and_pooler_match_hf(self):
         cfg, model, tm = _make_pair(seed=0)
         rng = np.random.RandomState(0)

@@ -95,6 +95,7 @@ class TestErnieHFParity:
                                    ref.pooler_output.numpy(),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_default_task_ids_are_zero(self):
         cfg, model, tm = _make_pair(seed=1)
         ids = np.random.RandomState(1).randint(3, cfg.vocab_size, (1, 8))

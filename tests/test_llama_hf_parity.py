@@ -83,6 +83,7 @@ class TestLlamaHFParity:
             ref = tm(input_ids=torch.tensor(ids)).logits.numpy()
         np.testing.assert_allclose(mine, ref, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_loss_matches_hf(self):
         cfg, model, tm = _make_pair(seed=2)
         ids = np.random.RandomState(2).randint(0, cfg.vocab_size, (2, 9))

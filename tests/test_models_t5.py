@@ -120,6 +120,7 @@ class TestT5HFParity:
                      decoder_input_ids=torch.tensor(dec)).logits.numpy()
         np.testing.assert_allclose(mine, ref, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_untied_gated_variant_matches_hf(self):
         # v1.1-style: gated-gelu FF, untied lm head
         cfg = _tiny_cfg(feed_forward_proj='gated-gelu',
@@ -134,6 +135,7 @@ class TestT5HFParity:
                      decoder_input_ids=torch.tensor(dec)).logits.numpy()
         np.testing.assert_allclose(mine, ref, rtol=3e-4, atol=3e-4)
 
+    @pytest.mark.slow
     def test_loss_and_shift_right_match_hf(self):
         cfg = _tiny_cfg()
         model, tm = _make_pair(cfg, seed=3)
@@ -200,6 +202,7 @@ class TestT5Behavior:
                               decode_strategy='sampling', top_k=8, seed=42)
         np.testing.assert_array_equal(a.numpy(), b.numpy())
 
+    @pytest.mark.slow
     def test_eos_stops_and_pads(self):
         cfg = _tiny_cfg()
         paddle.seed(7)
@@ -234,6 +237,7 @@ class TestT5Behavior:
                 first = float(loss.numpy())
         assert float(loss.numpy()) < first - 0.5
 
+    @pytest.mark.slow
     def test_label_ignore_index(self):
         cfg = _tiny_cfg()
         paddle.seed(9)
@@ -248,6 +252,7 @@ class TestT5Behavior:
         assert abs(float(loss_full.numpy())
                    - float(loss_masked.numpy())) > 1e-6
 
+    @pytest.mark.slow
     def test_t5model_state_dict_roundtrip(self):
         cfg = _tiny_cfg()
         paddle.seed(10)
