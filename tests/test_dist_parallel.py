@@ -700,3 +700,34 @@ def test_tp_t5_matches_dense():
     gt, _ = tp.generate(ids, max_new_tokens=6,
                         decode_strategy='greedy_search', eos_token_id=-1)
     np.testing.assert_array_equal(gd.numpy(), gt.numpy())
+
+
+@pytest.mark.slow
+def test_fleet_hybrid_t5_step_trains():
+    """T5 through fleet.DistTrainStep (dp2 x mp4 + ZeRO-1): tuple inputs
+    carry (encoder ids, decoder ids); the jitted hybrid step must train."""
+    from paddle_tpu.nlp import T5Config, T5ForConditionalGeneration
+    strategy = _make_strategy(dp=2, mp=4)
+    strategy.sharding = True
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(8)
+    cfg = T5Config.tiny(tensor_parallel=True)
+    model = T5ForConditionalGeneration(cfg)
+    fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=1e-3,
+                               parameters=model.parameters()))
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits.reshape([-1, cfg.vocab_size]),
+                               labels.reshape([-1]))
+
+    step = fleet.DistTrainStep(model, loss_fn, opt, strategy)
+    rng = np.random.RandomState(8)
+    src = rng.randint(2, cfg.vocab_size, (8, 10))
+    tgt = rng.randint(2, cfg.vocab_size, (8, 6))
+    dec_in = np.concatenate(
+        [np.full((8, 1), cfg.decoder_start_token_id), tgt[:, :-1]], axis=1)
+    losses = [float(step((src, dec_in), tgt).numpy()) for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
