@@ -317,6 +317,51 @@ def _bench_fused_ce():
         return {'fused_ce_bench_error': type(e).__name__}
 
 
+def _phase_decode():
+    """Serving throughput: KV-cache greedy decode on the 1.3B geometry
+    (batch 8, prompt 128, 128 new tokens) — decode tokens/sec/chip.
+    The whole decode is one XLA program (prefill + while_loop), so this
+    measures the incremental-decode path end to end."""
+    import time as _t
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() not in ('cpu',)
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=50304, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=24, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=4096)
+        batch, prompt_len, new_tokens, dtype = 8, 128, 128, 'bfloat16'
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, prompt_len, new_tokens, dtype = 2, 8, 8, 'float32'
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg).eval()
+    if dtype == 'bfloat16':
+        model.bfloat16()
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, prompt_len))
+    t_ids = paddle.to_tensor(ids)
+    kw = dict(max_new_tokens=new_tokens,
+              decode_strategy='greedy_search', eos_token_id=-1)
+    out, _ = model.generate(t_ids, **kw)          # compile + warm
+    assert out.shape == [batch, new_tokens]
+    t0 = _t.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out, _ = model.generate(t_ids, **kw)
+    float(out.numpy()[0, 0])                      # sync
+    dt = (_t.perf_counter() - t0) / reps
+    return {'decode_1p3b': {
+        'tokens_per_sec': round(batch * new_tokens / dt, 1),
+        'batch': batch, 'prompt_len': prompt_len,
+        'new_tokens': new_tokens, 'time_per_call_s': round(dt, 4),
+        'dtype': dtype}}
+
+
 def _free_device_memory():
     """Drop dead device buffers between ladder rungs: the autograd tape
     creates reference cycles, so the previous rung's params/moments wait
@@ -440,6 +485,7 @@ PHASES = {
     'overfit': lambda: {'llama2_7b_overfit': _run_7b_overfit()},
     'flash': _bench_flash_kernels,
     'fused_ce': _bench_fused_ce,
+    'decode': _phase_decode,
 }
 
 
@@ -514,6 +560,7 @@ def main():
     out.update(_run_phase_subprocess('7b', 1500, model_env))
     out.update(_run_phase_subprocess('overfit', 1200, model_env))
     out.update(_run_phase_subprocess('flash', 600))
+    out.update(_run_phase_subprocess('decode', 900, model_env))
     print(json.dumps(out))
     return 0
 
