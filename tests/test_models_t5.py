@@ -354,3 +354,25 @@ class TestT5Beam:
         want, _ = _ref_s2s_beam(m, ids[0], K=2, max_new=5, eos=eos, pad=93,
                                 start=cfg.decoder_start_token_id)
         np.testing.assert_array_equal(got.numpy()[0], want)
+
+
+class TestT5Export:
+    def test_jit_save_load_without_class(self, tmp_path):
+        """The T5 eval forward (encoder + decoder) exports to StableHLO
+        and reloads WITHOUT the Python class (jit.save/load)."""
+        from paddle_tpu import jit
+        cfg = _tiny_cfg()
+        paddle.seed(30)
+        m = T5Model(cfg).eval()
+        rng = np.random.RandomState(30)
+        ids = rng.randint(2, cfg.vocab_size, (2, 8)).astype(np.int32)
+        dec = rng.randint(2, cfg.vocab_size, (2, 5)).astype(np.int32)
+        expect, _ = m(ids, dec)
+        jit.save(m, str(tmp_path / 't5'),
+                 input_spec=[jit.InputSpec([2, 8], dtype='int32'),
+                             jit.InputSpec([2, 5], dtype='int32')])
+        translated = jit.load(str(tmp_path / 't5'))
+        got = translated(paddle.to_tensor(ids), paddle.to_tensor(dec))
+        got = got[0] if isinstance(got, (tuple, list)) else got
+        np.testing.assert_allclose(got.numpy(), expect.numpy(),
+                                   rtol=1e-5, atol=1e-5)
