@@ -40,7 +40,8 @@ class T5Config:
                  relative_attention_max_distance=128, dropout_rate=0.1,
                  layer_norm_epsilon=1e-6, feed_forward_proj='relu',
                  tie_word_embeddings=True, pad_token_id=0, eos_token_id=1,
-                 decoder_start_token_id=0, tensor_parallel=False, **kwargs):
+                 decoder_start_token_id=0, tensor_parallel=False,
+                 sequence_parallel=False, **kwargs):
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.d_kv = d_kv
@@ -60,6 +61,7 @@ class T5Config:
         self.eos_token_id = eos_token_id
         self.decoder_start_token_id = decoder_start_token_id
         self.tensor_parallel = tensor_parallel
+        self.sequence_parallel = sequence_parallel
         for k, v in kwargs.items():
             setattr(self, k, v)
 
@@ -323,6 +325,15 @@ class T5Stack(Layer):
                 encoder_attention_mask=None, cache=None, cache_offset=None,
                 cross_kv=None):
         h = self.dropout(embeds)
+        sp_pin = None
+        if self.config.sequence_parallel and cache is None:
+            # keep activations sequence-sharded over 'sp' between blocks;
+            # GSPMD gathers the sequence only where attention needs it
+            # (same design as LlamaModel.forward)
+            from jax.sharding import PartitionSpec as P
+            from ..distributed.parallel_layers import _constraint
+            sp_pin = _constraint(P('dp', 'sp', None))
+            h = sp_pin(h)
         s = h.shape[1]
         if cache is not None:
             total = cache[0][0].shape[1]
@@ -365,6 +376,8 @@ class T5Stack(Layer):
                 new_caches.append(c)
             else:
                 h = out
+            if sp_pin is not None:
+                h = sp_pin(h)
         h = self.dropout(self.final_layer_norm(h))
         if cache is not None:
             return h, tuple(new_caches)
