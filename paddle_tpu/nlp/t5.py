@@ -41,7 +41,7 @@ class T5Config:
                  layer_norm_epsilon=1e-6, feed_forward_proj='relu',
                  tie_word_embeddings=True, pad_token_id=0, eos_token_id=1,
                  decoder_start_token_id=0, tensor_parallel=False,
-                 sequence_parallel=False, **kwargs):
+                 sequence_parallel=False, use_recompute=False, **kwargs):
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.d_kv = d_kv
@@ -62,6 +62,7 @@ class T5Config:
         self.decoder_start_token_id = decoder_start_token_id
         self.tensor_parallel = tensor_parallel
         self.sequence_parallel = sequence_parallel
+        self.use_recompute = use_recompute
         for k, v in kwargs.items():
             setattr(self, k, v)
 
@@ -360,6 +361,9 @@ class T5Stack(Layer):
         cross_bias = None
         if self.is_decoder and encoder_attention_mask is not None:
             cross_bias = _pad_bias(encoder_attention_mask)
+        from .. import autograd as _ag
+        remat = (self.config.use_recompute and cache is None
+                 and _ag._state.functional)
         new_caches = []
         for i, blk in enumerate(self.block):
             layer_cache = None
@@ -367,10 +371,35 @@ class T5Stack(Layer):
                 kc, vc = cache[i]
                 layer_cache = (kc if isinstance(kc, Tensor) else Tensor(kc),
                                vc if isinstance(vc, Tensor) else Tensor(vc))
-            out = blk(h, self_bias=self_bias, encoder_hidden=encoder_hidden,
-                      cross_bias=cross_bias, cache=layer_cache,
-                      cache_offset=cache_offset,
-                      cross_kv=None if cross_kv is None else cross_kv[i])
+            if remat:
+                # trade FLOPs for HBM: rematerialize the block in backward
+                # (upstream: recompute over T5 blocks; here jax.checkpoint,
+                # same design as LlamaModel.forward)
+                import jax as _jax
+                sb = self_bias.value if isinstance(self_bias, Tensor)                     else self_bias
+                cb = cross_bias.value if isinstance(cross_bias, Tensor)                     else cross_bias
+                eh = encoder_hidden.value                     if isinstance(encoder_hidden, Tensor) else encoder_hidden
+                if eh is None:
+                    out = Tensor(_jax.checkpoint(
+                        lambda hv, b=blk: b(
+                            Tensor(hv),
+                            self_bias=None if sb is None else Tensor(sb))
+                        .value)(h.value))
+                else:
+                    out = Tensor(_jax.checkpoint(
+                        lambda hv, ev, b=blk: b(
+                            Tensor(hv),
+                            self_bias=None if sb is None else Tensor(sb),
+                            encoder_hidden=Tensor(ev),
+                            cross_bias=None if cb is None else Tensor(cb))
+                        .value)(h.value, eh))
+            else:
+                out = blk(h, self_bias=self_bias,
+                          encoder_hidden=encoder_hidden,
+                          cross_bias=cross_bias, cache=layer_cache,
+                          cache_offset=cache_offset,
+                          cross_kv=None if cross_kv is None
+                          else cross_kv[i])
             if layer_cache is not None:
                 h, c = out
                 new_caches.append(c)

@@ -376,3 +376,36 @@ class TestT5Export:
         got = got[0] if isinstance(got, (tuple, list)) else got
         np.testing.assert_allclose(got.numpy(), expect.numpy(),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestT5Recompute:
+    def test_recompute_matches_plain(self):
+        """Remat must change memory, never math: use_recompute=True
+        training losses == plain to tolerance (functional/jitted path,
+        where jax.checkpoint engages)."""
+        from paddle_tpu.jit import TrainStep
+        import paddle_tpu.nn.functional as F
+
+        def run(remat):
+            paddle.seed(31)
+            cfg = _tiny_cfg(use_recompute=remat)
+            m = T5ForConditionalGeneration(cfg)
+            opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=m.parameters())
+            rng = np.random.RandomState(31)
+            src = rng.randint(2, cfg.vocab_size, (4, 8))
+            tgt = rng.randint(2, cfg.vocab_size, (4, 6))
+            dec_in = np.concatenate(
+                [np.full((4, 1), cfg.decoder_start_token_id),
+                 tgt[:, :-1]], axis=1)
+            step = TrainStep(
+                m, lambda logits, labels: F.cross_entropy(
+                    logits.reshape([-1, cfg.vocab_size]),
+                    labels.reshape([-1])), opt)
+            return [float(step((src, dec_in), tgt).numpy())
+                    for _ in range(3)]
+
+        plain = run(False)
+        remat = run(True)
+        np.testing.assert_allclose(remat, plain, rtol=1e-5)
+        assert plain[-1] < plain[0]
