@@ -41,6 +41,14 @@ def _peak_flops(device) -> float:
     return 197e12  # assume v5e-class if unrecognized
 
 
+# the GPT-3 XL geometry shared by the headline train phase and the
+# decode phase
+GPT3_SHAPE = dict(vocab_size=50304, hidden_size=2048,
+                  intermediate_size=5504, num_hidden_layers=24,
+                  num_attention_heads=16, num_key_value_heads=16,
+                  max_position_embeddings=4096)
+
+
 def _configs(on_tpu):
     from paddle_tpu.nlp import LlamaConfig
     if not on_tpu:
@@ -51,12 +59,8 @@ def _configs(on_tpu):
     # (~2.5 GB) fit beside params+moments and MFU jumps 0.50 -> 0.64
     # vs full-block remat at batch 8 (whose extra forward is ~1/4 of
     # step flops). Full-remat rungs remain as OOM fallbacks.
-    shape = dict(vocab_size=50304, hidden_size=2048,
-                 intermediate_size=5504, num_hidden_layers=24,
-                 num_attention_heads=16, num_key_value_heads=16,
-                 max_position_embeddings=4096)
-    gpt3_dots = LlamaConfig(use_recompute='dots_no_batch', **shape)
-    gpt3_full = LlamaConfig(use_recompute=True, **shape)
+    gpt3_dots = LlamaConfig(use_recompute='dots_no_batch', **GPT3_SHAPE)
+    gpt3_full = LlamaConfig(use_recompute=True, **GPT3_SHAPE)
     m740 = LlamaConfig(
         vocab_size=32000, hidden_size=2048, intermediate_size=5504,
         num_hidden_layers=12, num_attention_heads=16,
@@ -330,10 +334,7 @@ def _phase_decode():
 
     on_tpu = jax.default_backend() not in ('cpu',)
     if on_tpu:
-        cfg = LlamaConfig(
-            vocab_size=50304, hidden_size=2048, intermediate_size=5504,
-            num_hidden_layers=24, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=4096)
+        cfg = LlamaConfig(**GPT3_SHAPE)
         batch, prompt_len, new_tokens, dtype = 8, 128, 128, 'bfloat16'
     else:
         cfg = LlamaConfig.tiny()

@@ -1,0 +1,13 @@
+"""Shared helper for the HF-weight-copy parity tests."""
+
+
+def make_put(sd, torch):
+    """Returns put(torch_param, state_dict_name, transpose=True): copies a
+    paddle_tpu weight into a torch parameter, transposing 2-D Linear
+    weights from this repo's [in, out] to torch's [out, in]."""
+    def put(t, name, transpose=True):
+        arr = sd[name]
+        if transpose and arr.ndim == 2:
+            arr = arr.T
+        t.data.copy_(torch.tensor(arr))
+    return put

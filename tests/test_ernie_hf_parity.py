@@ -10,6 +10,8 @@ from paddle_tpu.nlp import ErnieConfig, ErnieModel
 torch = pytest.importorskip('torch')
 hf = pytest.importorskip('transformers')
 
+from hf_parity_utils import make_put
+
 
 def _make_pair(seed=0):
     paddle.seed(seed)
@@ -33,12 +35,7 @@ def _make_pair(seed=0):
         layer_norm_eps=cfg.layer_norm_eps, pad_token_id=cfg.pad_token_id)
     tm = hf.ErnieModel(hc).eval()
     sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
-
-    def put(t, name, transpose=True):
-        arr = sd[name]
-        if transpose and arr.ndim == 2:
-            arr = arr.T
-        t.data.copy_(torch.tensor(arr))
+    put = make_put(sd, torch)
 
     e = tm.embeddings
     put(e.word_embeddings.weight, 'bert.embeddings.word_embeddings.weight',

@@ -10,6 +10,8 @@ from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
 torch = pytest.importorskip('torch')
 hf = pytest.importorskip('transformers')
 
+from hf_parity_utils import make_put
+
 
 def _cfg(**kw):
     return LlamaConfig.tiny(**kw)
@@ -31,12 +33,7 @@ def _hf_cfg(cfg):
 
 def _copy_into_hf(model, tm):
     sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
-
-    def put(t, name, transpose=True):
-        arr = sd[name]
-        if transpose and arr.ndim == 2:
-            arr = arr.T
-        t.data.copy_(torch.tensor(arr))
+    put = make_put(sd, torch)
 
     put(tm.model.embed_tokens.weight, 'llama.embed_tokens.weight',
         transpose=False)

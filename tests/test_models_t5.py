@@ -9,6 +9,8 @@ from paddle_tpu.nlp import T5Config, T5ForConditionalGeneration, T5Model
 torch = pytest.importorskip('torch')
 hf = pytest.importorskip('transformers')
 
+from hf_parity_utils import make_put
+
 
 def _tiny_cfg(**kw):
     return T5Config.tiny(**kw)
@@ -34,12 +36,7 @@ def _copy_into_hf(model, tm):
     explicitly; my Linear stores [in, out] so transpose to torch's
     [out, in])."""
     sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
-
-    def put(t, name, transpose=True):
-        arr = sd[name]
-        if transpose and arr.ndim == 2:
-            arr = arr.T
-        t.data.copy_(torch.tensor(arr))
+    put = make_put(sd, torch)
 
     put(tm.shared.weight, 't5.shared.weight', transpose=False)
     for side, stack in (('encoder', tm.encoder), ('decoder', tm.decoder)):
