@@ -453,6 +453,157 @@ class GenerationMixin:
                 self.train()
         return Tensor(out), Tensor(scores)
 
+    # ------------------------------------------------------------------
+    # speculative decoding (draft-and-verify; upstream analogue:
+    # PaddleNLP speculative/draft-model decoding)
+    # ------------------------------------------------------------------
+    def _spec_decode_jit(self, draft, max_new_tokens: int, k: int,
+                         eos_token_id: int, pad_token_id: int):
+        """Greedy speculative decode, batch 1: the draft model proposes k
+        tokens autoregressively; the target scores all k in ONE cached
+        forward and accepts the longest matching prefix plus its own next
+        token — output is EXACTLY plain greedy decode, in fewer target
+        passes. Stale speculative cache slots need no cleanup: the
+        slot-causal decode mask hides every slot above the query position,
+        and the next round overwrites them."""
+        cache_key = ('spec', id(draft), max_new_tokens, k, eos_token_id,
+                     pad_token_id)
+        store = self.__dict__.setdefault('_generate_jit_cache', {})
+        if cache_key in store:
+            return store[cache_key]
+
+        def fwd_of(model):
+            def fwd(params, frozen, buffers, tok, cache, pos):
+                (logits, new_cache), _ = functional_call(
+                    model, params, frozen, buffers, (tok,),
+                    dict(cache=cache, position_offset=pos, cache_offset=pos,
+                         use_cache=True))
+                return logits, new_cache
+            return fwd
+
+        fwd_t, fwd_d = fwd_of(self), fwd_of(draft)
+        pad_cap = max_new_tokens + k + 1   # out buffer with round overshoot
+
+        def decode(pt, ft, bt, pd, fd, bd, ids, cache_t, cache_d):
+            s = ids.shape[1]
+            logits, cache_t = fwd_t(pt, ft, bt, ids, cache_t, jnp.int32(0))
+            _, cache_d = fwd_d(pd, fd, bd, ids, cache_d, jnp.int32(0))
+            v = jnp.argmax(logits[0, -1]).astype(jnp.int32)  # pending token
+            out = jnp.full((pad_cap,), pad_token_id, jnp.int32)
+            out = out.at[0].set(v)   # the pending token is already decided
+            state = (jnp.int32(1), v, out, cache_t, cache_d,
+                     jnp.int32(0))  # emitted, pending, out, caches, rounds
+
+            def cond(st):
+                e, v = st[0], st[1]
+                return jnp.logical_and(e < max_new_tokens,
+                                       v != eos_token_id)
+
+            def body(st):
+                e, v, out, cache_t, cache_d, rounds = st
+                p = jnp.int32(s) + e - 1      # logical slot of `v`
+
+                # draft k tokens autoregressively from v
+                def draft_body(j, carry):
+                    cur, cache_d, drafts = carry
+                    lg, cache_d = fwd_d(pd, fd, bd, cur[None, None],
+                                        cache_d, p + j)
+                    nxt = jnp.argmax(lg[0, -1]).astype(jnp.int32)
+                    return nxt, cache_d, drafts.at[j].set(nxt)
+                _, cache_d, drafts = jax.lax.fori_loop(
+                    0, k, draft_body,
+                    (v, cache_d, jnp.zeros((k,), jnp.int32)))
+
+                # target scores [v, d_1..d_k] in one cached forward
+                block = jnp.concatenate([v[None], drafts])[None]  # [1, k+1]
+                lg, cache_t = fwd_t(pt, ft, bt, block, cache_t, p)
+                choice = jnp.argmax(lg[0], axis=-1).astype(jnp.int32)
+
+                # longest accepted draft prefix (stop acceptance at EOS:
+                # everything after an emitted EOS is discarded anyway)
+                match = (drafts == choice[:k]) & (drafts != eos_token_id)
+                a = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+                v_new = choice[a]              # target's token after prefix
+
+                # emit d_1..d_a then v_new at out[e : e+a+1]; positions
+                # past a get pad — they are untouched future slots, so
+                # the unconditional write is a no-op there
+                j = jnp.arange(k + 1)
+                draft_ext = jnp.concatenate([drafts, drafts[-1:]])
+                emit = jnp.where(j < a, draft_ext,
+                                 jnp.where(j == a, v_new, pad_token_id))
+                out = out.at[e + j].set(emit, mode='drop')
+                return (e + a + 1, v_new, out, cache_t, cache_d,
+                        rounds + 1)
+
+            e, _, out, _, _, rounds = jax.lax.while_loop(cond, body, state)
+            out = out[:max_new_tokens]
+            # blank everything after the first EOS (a round can overshoot)
+            if eos_token_id >= 0:
+                is_eos = out == eos_token_id
+                seen = jnp.cumsum(is_eos.astype(jnp.int32))
+                keep = (seen == 0) | (is_eos & (seen == 1))
+                out = jnp.where(keep, out, pad_token_id)
+            return out[None], jnp.minimum(e, max_new_tokens), rounds
+
+        jitted = jax.jit(decode)
+        store[cache_key] = jitted
+        return jitted
+
+    def speculative_generate(self, draft_model, input_ids,
+                             max_new_tokens: int = 20,
+                             num_draft_tokens: int = 4,
+                             eos_token_id: Optional[int] = None,
+                             pad_token_id: Optional[int] = None):
+        """Greedy decode accelerated by a smaller draft model (batch 1).
+        Returns (ids [1, max_new_tokens], stats dict with `rounds`,
+        `emitted`, and `acceptance_rate` = accepted drafts per proposal).
+        Output is token-identical to `generate(decode_strategy=
+        'greedy_search')` for ANY draft model."""
+        ids = to_jax(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        if ids.shape[0] != 1:
+            raise ValueError('speculative_generate is a latency '
+                             'optimization for a single stream; batch '
+                             f'size must be 1, got {ids.shape[0]}')
+        cfg = getattr(self, 'config', None)
+        if eos_token_id is None:
+            eos_token_id = getattr(cfg, 'eos_token_id', -1)
+        if pad_token_id is None:
+            pad_token_id = getattr(cfg, 'pad_token_id', 0)
+        k = int(num_draft_tokens)
+        if k < 1:
+            raise ValueError('num_draft_tokens must be >= 1')
+        was_training = self.training
+        self.eval()
+        draft_model.eval()
+        try:
+            pt, ft, bt = functional_state(self)
+            pd, fd, bd = functional_state(draft_model)
+            s = ids.shape[1]
+            total = s + max_new_tokens + k + 2
+            cache_t = self.init_cache(1, total)
+            cache_d = draft_model.init_cache(1, total)
+            fn = self._spec_decode_jit(draft_model, int(max_new_tokens),
+                                       k, int(eos_token_id),
+                                       int(pad_token_id))
+            out, emitted, rounds = fn(pt, ft, bt, pd, fd, bd, ids,
+                                      cache_t, cache_d)
+        finally:
+            if was_training:
+                self.train()
+        rounds_i = max(int(rounds), 1)
+        emitted_i = int(emitted)
+        # each round is ONE target forward that yields 1 + a tokens; the
+        # prefill token is free in both schemes, so accepted drafts total
+        # emitted - 1 - rounds
+        accepted = max(emitted_i - 1 - rounds_i, 0)
+        return Tensor(out), {
+            'rounds': rounds_i, 'emitted': emitted_i,
+            'target_forwards_saved': accepted,
+            'acceptance_rate': accepted / (rounds_i * k)}
+
 
 class Seq2SeqGenerationMixin:
     """Mixed into encoder-decoder models (T5). Requires the host class to

@@ -1,0 +1,89 @@
+"""Speculative decoding: greedy draft-and-verify must produce EXACTLY
+the plain greedy output for any draft model, while saving target
+forwards when the draft agrees."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                            LlamaForCausalLM)
+
+
+def _models():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    target = LlamaForCausalLM(cfg).eval()
+    paddle.seed(99)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1)).eval()
+    return cfg, target, draft
+
+
+class TestSpeculativeDecoding:
+    def test_independent_draft_matches_plain_greedy(self):
+        """The exactness guarantee: even a draft that almost never agrees
+        must leave the output token-identical to plain greedy."""
+        cfg, target, draft = _models()
+        ids = np.random.RandomState(0).randint(3, cfg.vocab_size, (1, 6))
+        plain, _ = target.generate(ids, max_new_tokens=12,
+                                   decode_strategy='greedy_search',
+                                   eos_token_id=-1)
+        out, stats = target.speculative_generate(
+            draft, ids, max_new_tokens=12, num_draft_tokens=4,
+            eos_token_id=-1)
+        np.testing.assert_array_equal(out.numpy(), plain.numpy())
+        assert stats['rounds'] >= 1
+
+    def test_self_draft_accepts_and_saves_forwards(self):
+        """Draft == target: every proposal is accepted, so max_new tokens
+        arrive in ~max_new/(k+1) target forwards."""
+        cfg, target, _ = _models()
+        ids = np.random.RandomState(1).randint(3, cfg.vocab_size, (1, 5))
+        plain, _ = target.generate(ids, max_new_tokens=12,
+                                   decode_strategy='greedy_search',
+                                   eos_token_id=-1)
+        out, stats = target.speculative_generate(
+            target, ids, max_new_tokens=12, num_draft_tokens=4,
+            eos_token_id=-1)
+        np.testing.assert_array_equal(out.numpy(), plain.numpy())
+        assert stats['rounds'] <= 4          # vs 12 plain forwards
+        assert stats['target_forwards_saved'] >= 6
+        assert stats['acceptance_rate'] > 0.5
+
+    @pytest.mark.slow
+    def test_eos_stops_and_pads(self):
+        cfg, target, draft = _models()
+        ids = np.random.RandomState(2).randint(3, cfg.vocab_size, (1, 5))
+        first, _ = target.generate(ids, max_new_tokens=1, eos_token_id=-1)
+        eos = int(first.numpy()[0, 0])
+        plain, _ = target.generate(ids, max_new_tokens=10,
+                                   eos_token_id=eos, pad_token_id=0)
+        out, _ = target.speculative_generate(
+            draft, ids, max_new_tokens=10, num_draft_tokens=3,
+            eos_token_id=eos, pad_token_id=0)
+        np.testing.assert_array_equal(out.numpy(), plain.numpy())
+
+    @pytest.mark.slow
+    def test_cross_family_draft(self):
+        """The draft need not share the target's family — a GPT draft
+        speculating for a Llama target still yields exact greedy."""
+        cfg, target, _ = _models()
+        paddle.seed(7)
+        draft = GPTForCausalLM(GPTConfig(
+            vocab_size=cfg.vocab_size, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, max_position_embeddings=256,
+            hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)).eval()
+        ids = np.random.RandomState(3).randint(3, cfg.vocab_size, (1, 6))
+        plain, _ = target.generate(ids, max_new_tokens=10,
+                                   decode_strategy='greedy_search',
+                                   eos_token_id=-1)
+        out, _ = target.speculative_generate(
+            draft, ids, max_new_tokens=10, num_draft_tokens=3,
+            eos_token_id=-1)
+        np.testing.assert_array_equal(out.numpy(), plain.numpy())
+
+    def test_batch_size_guard(self):
+        cfg, target, draft = _models()
+        ids = np.zeros((2, 4), np.int64)
+        with pytest.raises(ValueError):
+            target.speculative_generate(draft, ids)
