@@ -356,11 +356,50 @@ def _phase_decode():
         out, _ = model.generate(t_ids, **kw)
     float(out.numpy()[0, 0])                      # sync
     dt = (_t.perf_counter() - t0) / reps
-    return {'decode_1p3b': {
+    result = {'decode_1p3b': {
         'tokens_per_sec': round(batch * new_tokens / dt, 1),
         'batch': batch, 'prompt_len': prompt_len,
         'new_tokens': new_tokens, 'time_per_call_s': round(dt, 4),
         'dtype': dtype}}
+
+    # speculative decoding (batch-1 latency): same-width 2-layer draft.
+    # With a real distilled draft the acceptance rate, and therefore the
+    # speedup, would be far higher — this measures the machinery cost +
+    # whatever a random-init draft happens to accept.
+    try:
+        draft_cfg = type(cfg)(**{**cfg.__dict__, 'num_hidden_layers': 2})
+        paddle.seed(1)
+        draft = LlamaForCausalLM(draft_cfg).eval()
+        if dtype == 'bfloat16':
+            draft.bfloat16()
+        one = ids[:1]
+        kw1 = dict(max_new_tokens=new_tokens, num_draft_tokens=4,
+                   eos_token_id=-1)
+        kw_plain = dict(max_new_tokens=new_tokens,
+                        decode_strategy='greedy_search', eos_token_id=-1)
+        one_t = paddle.to_tensor(one)
+        model.speculative_generate(draft, one, **kw1)   # compile + warm
+        model.generate(one_t, **kw_plain)               # batch-1 compile
+        t0 = _t.perf_counter()
+        _, stats = model.speculative_generate(draft, one, **kw1)
+        spec_dt = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        model.generate(one_t, **kw_plain)
+        plain_dt = _t.perf_counter() - t0
+        result['speculative_decode'] = {
+            'tokens_per_sec': round(new_tokens / spec_dt, 1),
+            'plain_tokens_per_sec': round(new_tokens / plain_dt, 1),
+            'acceptance_rate': round(stats['acceptance_rate'], 3),
+            'rounds': stats['rounds'],
+            'draft_layers': draft_cfg.num_hidden_layers,
+            'note': 'random-init draft = worst case (acceptance ~0); '
+                    'speedup requires a distilled draft — this measures '
+                    'machinery overhead'}
+    except Exception as e:
+        print(f'# spec decode bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        result['speculative_decode'] = {'error': type(e).__name__}
+    return result
 
 
 def _free_device_memory():
