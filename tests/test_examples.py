@@ -48,3 +48,12 @@ def test_generate_example_all_strategies(capsys):
     runpy.run_path(f'{EX}/generate.py', run_name='__main__')
     out = capsys.readouterr().out
     assert 'greedy' in out and 'beam search' in out
+
+
+@pytest.mark.slow
+def test_speculative_decode_example_accepts_drafts():
+    mod = runpy.run_path(f'{EX}/speculative_decode.py')
+    stats = mod['main'](distill_steps=150)
+    # a distilled draft must agree often enough to save real forwards
+    assert stats['target_forwards_saved'] >= 5, stats
+    assert stats['acceptance_rate'] > 0.2, stats
