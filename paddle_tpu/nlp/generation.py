@@ -796,6 +796,158 @@ class Seq2SeqGenerationMixin:
         store[cache_key] = jitted
         return jitted
 
+    def _s2s_spec_decode_jit(self, draft, max_new_tokens: int, k: int,
+                             eos_token_id: int, pad_token_id: int,
+                             start_token_id: int):
+        """Greedy speculative decode for encoder-decoder models (batch 1):
+        both models encode their own encoder states once; the decode loop
+        is the decoder-only draft-and-verify algorithm with seq2seq
+        forwards. Output is EXACTLY plain greedy."""
+        cache_key = ('spec', id(draft), max_new_tokens, k, eos_token_id,
+                     pad_token_id, start_token_id)
+        store = self.__dict__.setdefault('_generate_jit_cache', {})
+        if cache_key in store:
+            return store[cache_key]
+
+        def prep(model, params, frozen, buffers, enc_ids, enc_keep):
+            enc_h, _ = functional_method(
+                model, 'encode', params, frozen, buffers, (enc_ids,),
+                dict(attention_mask=enc_keep))
+            cross, _ = functional_method(
+                model, 'cross_kv', params, frozen, buffers, (enc_h,), {})
+
+            def fwd(pfb, tok, cache, slot):
+                p, f, bu = pfb
+                (logits, new_cache), _ = functional_call(
+                    model, p, f, bu, (),
+                    dict(decoder_input_ids=tok, encoder_output=enc_h,
+                         encoder_cross_kv=cross, attention_mask=enc_keep,
+                         cache=cache, cache_offset=slot, use_cache=True))
+                return logits, new_cache
+            return fwd
+
+        pad_cap = max_new_tokens + k + 1
+
+        def decode(pt, ft, bt, pd, fd, bd, enc_ids, enc_keep, cache_t,
+                   cache_d):
+            fwd_t = prep(self, pt, ft, bt, enc_ids, enc_keep)
+            fwd_d = prep(draft, pd, fd, bd, enc_ids, enc_keep)
+            start = jnp.full((1, 1), start_token_id, jnp.int32)
+            logits, cache_t = fwd_t((pt, ft, bt), start, cache_t,
+                                    jnp.int32(0))
+            _, cache_d = fwd_d((pd, fd, bd), start, cache_d, jnp.int32(0))
+            v = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+            out = jnp.full((pad_cap,), pad_token_id, jnp.int32)
+            out = out.at[0].set(v)
+            state = (jnp.int32(1), v, out, cache_t, cache_d, jnp.int32(0))
+
+            def cond(st):
+                return jnp.logical_and(st[0] < max_new_tokens,
+                                       st[1] != eos_token_id)
+
+            def body(st):
+                e, v, out, cache_t, cache_d, rounds = st
+                # decoder slot of `v`: start token sits at 0, emitted
+                # token i at slot 1 + i
+                p = e                      # == 1 + (e - 1)
+
+                def draft_body(j, carry):
+                    cur, cache_d, drafts = carry
+                    lg, cache_d = fwd_d((pd, fd, bd), cur[None, None],
+                                        cache_d, p + j)
+                    nxt = jnp.argmax(lg[0, -1]).astype(jnp.int32)
+                    return nxt, cache_d, drafts.at[j].set(nxt)
+                _, cache_d, drafts = jax.lax.fori_loop(
+                    0, k, draft_body,
+                    (v, cache_d, jnp.zeros((k,), jnp.int32)))
+
+                block = jnp.concatenate([v[None], drafts])[None]
+                lg, cache_t = fwd_t((pt, ft, bt), block, cache_t, p)
+                choice = jnp.argmax(lg[0], axis=-1).astype(jnp.int32)
+                match = (drafts == choice[:k]) & (drafts != eos_token_id)
+                a = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+                v_new = choice[a]
+                j = jnp.arange(k + 1)
+                draft_ext = jnp.concatenate([drafts, drafts[-1:]])
+                emit = jnp.where(j < a, draft_ext,
+                                 jnp.where(j == a, v_new, pad_token_id))
+                out = out.at[e + j].set(emit, mode='drop')
+                return (e + a + 1, v_new, out, cache_t, cache_d,
+                        rounds + 1)
+
+            e, _, out, _, _, rounds = jax.lax.while_loop(cond, body, state)
+            out = out[:max_new_tokens]
+            if eos_token_id >= 0:
+                is_eos = out == eos_token_id
+                seen = jnp.cumsum(is_eos.astype(jnp.int32))
+                keep = (seen == 0) | (is_eos & (seen == 1))
+                out = jnp.where(keep, out, pad_token_id)
+            return out[None], jnp.minimum(e, max_new_tokens), rounds
+
+        jitted = jax.jit(decode)
+        store[cache_key] = jitted
+        return jitted
+
+    def speculative_generate(self, draft_model, input_ids,
+                             max_new_tokens: int = 20,
+                             num_draft_tokens: int = 4,
+                             eos_token_id: Optional[int] = None,
+                             pad_token_id: Optional[int] = None,
+                             decoder_start_token_id: Optional[int] = None,
+                             attention_mask=None):
+        """Greedy seq2seq decode accelerated by a smaller encoder-decoder
+        draft (batch 1). Both models read the same encoder inputs; output
+        is token-identical to `generate(decode_strategy='greedy_search')`
+        for ANY draft."""
+        ids = to_jax(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        if ids.shape[0] != 1:
+            raise ValueError('speculative_generate is a latency '
+                             'optimization for a single stream; batch '
+                             f'size must be 1, got {ids.shape[0]}')
+        if attention_mask is not None:
+            keep = to_jax(attention_mask).astype(jnp.int32)
+            if keep.ndim == 1:
+                keep = keep[None, :]
+        else:
+            keep = jnp.ones(ids.shape, jnp.int32)
+        cfg = getattr(self, 'config', None)
+        if eos_token_id is None:
+            eos_token_id = getattr(cfg, 'eos_token_id', -1)
+        if pad_token_id is None:
+            pad_token_id = getattr(cfg, 'pad_token_id', 0)
+        if decoder_start_token_id is None:
+            decoder_start_token_id = getattr(cfg, 'decoder_start_token_id',
+                                             0)
+        k = int(num_draft_tokens)
+        if k < 1:
+            raise ValueError('num_draft_tokens must be >= 1')
+        was_training = self.training
+        self.eval()
+        draft_model.eval()
+        try:
+            pt, ft, bt = functional_state(self)
+            pd, fd, bd = functional_state(draft_model)
+            total = 1 + max_new_tokens + k + 2
+            cache_t = self.init_cache(1, total)
+            cache_d = draft_model.init_cache(1, total)
+            fn = self._s2s_spec_decode_jit(
+                draft_model, int(max_new_tokens), k, int(eos_token_id),
+                int(pad_token_id), int(decoder_start_token_id))
+            out, emitted, rounds = fn(pt, ft, bt, pd, fd, bd, ids, keep,
+                                      cache_t, cache_d)
+        finally:
+            if was_training:
+                self.train()
+        rounds_i = max(int(rounds), 1)
+        emitted_i = int(emitted)
+        accepted = max(emitted_i - 1 - rounds_i, 0)
+        return Tensor(out), {
+            'rounds': rounds_i, 'emitted': emitted_i,
+            'target_forwards_saved': accepted,
+            'acceptance_rate': accepted / (rounds_i * k)}
+
     def generate(self, input_ids, max_new_tokens: int = 20,
                  max_length: Optional[int] = None,
                  decode_strategy: str = 'greedy_search',

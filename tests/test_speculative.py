@@ -87,3 +87,40 @@ class TestSpeculativeDecoding:
         ids = np.zeros((2, 4), np.int64)
         with pytest.raises(ValueError):
             target.speculative_generate(draft, ids)
+
+
+class TestSeq2SeqSpeculative:
+    def test_t5_independent_draft_matches_plain_greedy(self):
+        from paddle_tpu.nlp import T5Config, T5ForConditionalGeneration
+        paddle.seed(0)
+        cfg = T5Config.tiny()
+        target = T5ForConditionalGeneration(cfg).eval()
+        paddle.seed(55)
+        draft = T5ForConditionalGeneration(
+            T5Config.tiny(num_layers=1)).eval()
+        ids = np.random.RandomState(0).randint(2, cfg.vocab_size, (1, 7))
+        plain, _ = target.generate(ids, max_new_tokens=10,
+                                   decode_strategy='greedy_search',
+                                   eos_token_id=-1)
+        out, stats = target.speculative_generate(
+            draft, ids, max_new_tokens=10, num_draft_tokens=3,
+            eos_token_id=-1)
+        np.testing.assert_array_equal(out.numpy(), plain.numpy())
+        assert stats['rounds'] >= 1
+
+    @pytest.mark.slow
+    def test_t5_self_draft_accepts(self):
+        from paddle_tpu.nlp import T5Config, T5ForConditionalGeneration
+        paddle.seed(1)
+        cfg = T5Config.tiny()
+        target = T5ForConditionalGeneration(cfg).eval()
+        ids = np.random.RandomState(1).randint(2, cfg.vocab_size, (1, 6))
+        plain, _ = target.generate(ids, max_new_tokens=12,
+                                   decode_strategy='greedy_search',
+                                   eos_token_id=-1)
+        out, stats = target.speculative_generate(
+            target, ids, max_new_tokens=12, num_draft_tokens=4,
+            eos_token_id=-1)
+        np.testing.assert_array_equal(out.numpy(), plain.numpy())
+        assert stats['rounds'] <= 4
+        assert stats['target_forwards_saved'] >= 6
