@@ -141,17 +141,23 @@ class TestNativeSharder:
         serialization.save(tree, str(tmp_path / 'single.npz'))
         single_w = time.perf_counter() - t0
         t0 = time.perf_counter()
-        serialization.load(str(tmp_path / 'single.npz'),
-                           return_numpy=True)
-        single_r = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
         serialization.save_sharded(tree, str(tmp_path / 'sharded'))
         shard_w = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        back = serialization.load_sharded(str(tmp_path / 'sharded'),
-                                          return_numpy=True)
-        shard_r = time.perf_counter() - t0
+
+        # best-of-3 reads: a single shot loses to scheduler noise when
+        # the suite saturates the box's two cores
+        def best(f):
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = f()
+                times.append(time.perf_counter() - t0)
+            return min(times), out
+
+        single_r, _ = best(lambda: serialization.load(
+            str(tmp_path / 'single.npz'), return_numpy=True))
+        shard_r, back = best(lambda: serialization.load_sharded(
+            str(tmp_path / 'sharded'), return_numpy=True))
 
         np.testing.assert_array_equal(back['layer3'], tree['layer3'])
         print(f'write npz {single_w:.2f}s sharded {shard_w:.2f}s | '
