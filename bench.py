@@ -384,8 +384,9 @@ def _phase_decode():
         _, stats = model.speculative_generate(draft, one, **kw1)
         spec_dt = _t.perf_counter() - t0
         t0 = _t.perf_counter()
-        model.generate(one_t, **kw_plain)
-        plain_dt = _t.perf_counter() - t0
+        out_plain, _ = model.generate(one_t, **kw_plain)
+        float(out_plain.numpy()[0, 0])   # sync: measure execution, not
+        plain_dt = _t.perf_counter() - t0  # async dispatch
         result['speculative_decode'] = {
             'tokens_per_sec': round(new_tokens / spec_dt, 1),
             'plain_tokens_per_sec': round(new_tokens / plain_dt, 1),
@@ -400,6 +401,90 @@ def _phase_decode():
               file=sys.stderr)
         result['speculative_decode'] = {'error': type(e).__name__}
     return result
+
+
+def eager_mlp_loop(steps=20, warmup=3, batch=32, in_dim=64, hidden=128,
+                   classes=10, use_cache=True):
+    """Eager-dispatch micro-bench loop (also imported by the tier-1
+    regression test): a plain DyGraph MLP train step — forward, CE loss,
+    tape backward, eager SGD — with NO TrainStep jit, so every op rides
+    `apply_op`. Returns wall-clock rates plus the dispatch-cache counter
+    window covering only the post-warmup steps; with `use_cache` the
+    telemetry must show zero retraces there."""
+    import time as _t
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import debug as pdebug
+
+    was_enabled = pdebug.dispatch_stats()['enabled']
+    pdebug.enable_dispatch_cache(use_cache)
+    pdebug.clear_dispatch_cache()
+    try:
+        paddle.seed(0)
+        model = nn.Sequential(
+            nn.Linear(in_dim, hidden), nn.ReLU(),
+            nn.Linear(hidden, hidden), nn.ReLU(),
+            nn.Linear(hidden, classes))
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(
+            rng.standard_normal((batch, in_dim)).astype('float32'))
+        y = paddle.to_tensor(rng.randint(0, classes, (batch,)))
+
+        def one_step():
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        for _ in range(warmup):
+            loss = one_step()
+        float(loss.numpy())                  # drain warmup dispatch
+        pdebug.reset_dispatch_stats()
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            loss = one_step()
+        final_loss = float(loss.numpy())     # sync
+        dt = _t.perf_counter() - t0
+        stats = pdebug.dispatch_stats()
+        return {
+            'steps_per_sec': round(steps / dt, 1),
+            'ops_per_sec': round(stats['calls'] / dt, 1),
+            'ops_per_step': stats['calls'] // steps,
+            'loss': round(final_loss, 4),
+            'cache_enabled': use_cache,
+            'hits': stats['hits'], 'misses': stats['misses'],
+            'retraces': stats['retraces'],
+            'fallbacks': stats['fallbacks'],
+            'hit_rate': round(stats['hit_rate'], 4),
+        }
+    finally:
+        pdebug.enable_dispatch_cache(was_enabled)
+        pdebug.clear_dispatch_cache()
+
+
+def _bench_eager_dispatch():
+    """Eager dispatch fast path A/B: the same DyGraph MLP train loop with
+    the dispatch cache on vs off (per-call re-tracing), reporting ops/sec
+    and trace counts for each arm."""
+    try:
+        cached = eager_mlp_loop(steps=30, use_cache=True)
+        uncached = eager_mlp_loop(steps=30, use_cache=False)
+        speedup = (cached['steps_per_sec'] / uncached['steps_per_sec']
+                   if uncached['steps_per_sec'] else 0.0)
+        return {'eager_dispatch': {
+            'cached': cached, 'uncached': uncached,
+            'speedup': round(speedup, 2),
+            'parity': abs(cached['loss'] - uncached['loss']) < 1e-4,
+        }}
+    except Exception as e:   # never let the micro-bench kill the headline
+        print(f'# eager dispatch bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        return {'eager_dispatch': {'error': type(e).__name__}}
 
 
 def _free_device_memory():
@@ -526,6 +611,7 @@ PHASES = {
     'flash': _bench_flash_kernels,
     'fused_ce': _bench_fused_ce,
     'decode': _phase_decode,
+    'eager': _bench_eager_dispatch,
 }
 
 
@@ -584,7 +670,8 @@ def main():
         out = _run_phase_subprocess('headline', 1500)
         if 'metric' not in out:
             raise RuntimeError(f'headline phase failed: {out}')
-        print(json.dumps(out))  # CPU smoke: headline only
+        out.update(_run_phase_subprocess('eager', 600))
+        print(json.dumps(out))  # CPU smoke: headline + eager micro-bench
         return 0
     # Measure the pallas CE kernel FIRST, then let the model phases use
     # whichever CE implementation actually won on this chip — the kernel
@@ -601,6 +688,7 @@ def main():
     out.update(_run_phase_subprocess('overfit', 1200, model_env))
     out.update(_run_phase_subprocess('flash', 600))
     out.update(_run_phase_subprocess('decode', 900, model_env))
+    out.update(_run_phase_subprocess('eager', 600))
     print(json.dumps(out))
     return 0
 

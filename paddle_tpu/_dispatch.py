@@ -1,0 +1,376 @@
+"""Eager dispatch fast path: cached jitted primals + reusable VJPs.
+
+The DyGraph eager layer routes every op through ``tensor.apply_op``. The
+classic eager tax is that each call re-traces the pure jax function —
+twice when grad is enabled (``jax.vjp`` traces the forward AND builds the
+pullback) — in Python, on every invocation. This module amortizes that
+cost the way upstream Paddle's final-state DyGraph + phi op-dispatch
+cache do: key the call, trace once, replay a compiled executable.
+
+Key: ``(op name, fn identity, input treedef, tensor positions,
+tensor-leaf avals, hashable static leaves)``. "fn identity" is the
+function object itself for stable module-level ops, or (code object,
+closure values, defaults) for per-call lambdas whose captured values are
+hashable — so e.g. ``lambda x: x.astype(dt)`` keys on ``dt``, not on the
+throwaway function object. Calls that cannot be keyed (unhashable
+statics such as fresh PRNG key arrays, numpy buffers, or slice-bearing
+treedefs on py<3.12) or that fail to trace (data-dependent output
+shapes, Tensor-returning bodies) fall back to the uncached slow path and
+are counted.
+
+Cached per key:
+  - primal: ``jax.jit(canonical)`` for the no-grad path;
+  - fwd: ``jax.jit(lambda *vals: jax.vjp(canonical, *vals))`` for the
+    grad path. The pullback returned OUT of jit is a
+    ``jax.tree_util.Partial`` carrying concrete residual arrays — a
+    reusable primal+VJP pair: the forward runs as one XLA executable and
+    the tape Node gets a residual-bound vjp closure with zero Python
+    re-tracing.
+
+Telemetry: hit / miss / retrace / fallback counters, exposed through
+``paddle_tpu.debug.dispatch_stats()`` / ``dispatch_summary()`` and
+folded into ``paddle_tpu.profiler.Profiler`` summaries. A *retrace* is a
+miss whose (op, fn, treedef) signature had already been traced in the
+same flavor — i.e. a shape/dtype/static change forced re-tracing of an
+op the cache had compiled before; steady-state training should show
+zero of them after warmup.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from . import flags as _flags
+
+_tree = jax.tree_util
+
+_flags.register_flag('FLAGS_eager_dispatch_cache', True)
+
+_MAX_ENTRIES = 512
+_MAX_BLACKLIST = 4096
+
+_enabled = [bool(_flags.flag('FLAGS_eager_dispatch_cache'))]
+_cache: "collections.OrderedDict[Any, _Entry]" = collections.OrderedDict()
+_blacklist: set = set()
+_seen_flavors: set = set()
+
+
+class _Counters:
+    __slots__ = ('hits', 'misses', 'retraces', 'fallbacks', 'errors',
+                 'evictions', 'per_op')
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.hits = 0
+        self.misses = 0
+        self.retraces = 0
+        self.fallbacks = 0
+        self.errors = 0
+        self.evictions = 0
+        # name -> [hits, misses, fallbacks]
+        self.per_op: Dict[str, list] = collections.defaultdict(
+            lambda: [0, 0, 0])
+
+
+_counters = _Counters()
+
+
+def enabled() -> bool:
+    return _enabled[0]
+
+
+def enable(on: bool = True):
+    _enabled[0] = bool(on)
+    _flags.set_flags({'FLAGS_eager_dispatch_cache': bool(on)})
+
+
+def stats() -> dict:
+    c = _counters
+    calls = c.hits + c.misses + c.fallbacks
+    return {
+        'enabled': _enabled[0],
+        'hits': c.hits, 'misses': c.misses, 'retraces': c.retraces,
+        'fallbacks': c.fallbacks, 'errors': c.errors,
+        'evictions': c.evictions, 'calls': calls,
+        'hit_rate': (c.hits / calls) if calls else 0.0,
+        'cache_size': len(_cache), 'blacklist_size': len(_blacklist),
+        'per_op': {k: {'hits': v[0], 'misses': v[1], 'fallbacks': v[2]}
+                   for k, v in c.per_op.items()},
+    }
+
+
+def reset_stats():
+    _counters.reset()
+
+
+def clear():
+    """Drop every cached executable and trace record (stats survive;
+    use reset_stats() for those)."""
+    _cache.clear()
+    _blacklist.clear()
+    _seen_flavors.clear()
+
+
+# ---------------------------------------------------------------------------
+# keying
+# ---------------------------------------------------------------------------
+
+def _static_key(v):
+    """Hashable identity for one baked-in static value, or None.
+    The type rides along so 1 / 1.0 / True cannot collide into one key
+    (they hash and compare equal but trace to different programs)."""
+    try:
+        hash(v)
+    except TypeError:
+        return None
+    return (v.__class__, v)
+
+
+def _aval_key(v):
+    try:
+        return ('aval', v.shape, v.dtype, bool(getattr(v, 'weak_type',
+                                                       False)))
+    except AttributeError:
+        return ('aval', np.shape(v), np.result_type(v), True)
+
+
+def _fn_key(fn):
+    """Stable identity for the op body. Module-level fns key as
+    (code,); per-call closures key on (code, captured values); anything
+    with an unhashable capture (PRNG key arrays, numpy buffers) is
+    uncacheable."""
+    self_obj = getattr(fn, '__self__', None)
+    func = getattr(fn, '__func__', fn)
+    code = getattr(func, '__code__', None)
+    if code is None:
+        # builtin / partial / callable object: only safe keyed by identity
+        return _static_key(fn)
+    parts = [code]
+    if self_obj is not None:
+        sk = _static_key(self_obj)
+        if sk is None:
+            return None
+        parts.append(sk)
+    closure = getattr(func, '__closure__', None)
+    if closure:
+        for cell in closure:
+            try:
+                v = cell.cell_contents
+            except ValueError:   # empty cell
+                return None
+            sk = _static_key(v)
+            if sk is None:
+                return None
+            parts.append(sk)
+    for d in (getattr(func, '__defaults__', None) or ()):
+        sk = _static_key(d)
+        if sk is None:
+            return None
+        parts.append(sk)
+    kwd = getattr(func, '__kwdefaults__', None)
+    if kwd:
+        for k in sorted(kwd):
+            sk = _static_key(kwd[k])
+            if sk is None:
+                return None
+            parts.append((k, sk))
+    return tuple(parts)
+
+
+def _build_key(name, fn, treedef, leaves, t_idx, vals):
+    """(key, sig) or (None, None) when the call cannot be keyed."""
+    fk = _fn_key(fn)
+    if fk is None:
+        return None, None
+    try:
+        hash(treedef)   # aux data may hold slices (py<3.12) / arrays
+    except TypeError:
+        return None, None
+    parts = []
+    ti = 0
+    n_t = len(t_idx)
+    for i, leaf in enumerate(leaves):
+        if ti < n_t and i == t_idx[ti]:
+            parts.append(_aval_key(vals[ti]))
+            ti += 1
+        else:
+            sk = _static_key(leaf)
+            if sk is None:
+                return None, None
+            parts.append(sk)
+    sig = (name, fk, treedef)
+    return (sig, tuple(t_idx), tuple(parts)), sig
+
+
+# ---------------------------------------------------------------------------
+# cache entries
+# ---------------------------------------------------------------------------
+
+class _Entry:
+    __slots__ = ('canonical', 'primal_jit', 'fwd_jit')
+
+    def __init__(self, canonical):
+        self.canonical = canonical
+        self.primal_jit = None
+        self.fwd_jit = None
+
+    def primal(self, *tvals):
+        """Replayable primal for tape Nodes (autograd._build_pure):
+        shared across every call that hit this entry, jitted lazily so
+        eager replay hits the executable cache and traced replay
+        (jacobian/higher-order grad) reuses one cached jaxpr."""
+        j = self.primal_jit
+        if j is None:
+            j = self.primal_jit = jax.jit(self.canonical)
+        return j(*tvals)
+
+
+def _make_canonical(fn, treedef, template, t_idx):
+    """The cache-shared pure function: rebuilds fn's (args, kwargs) from
+    the recorded static leaves with the dynamic tensor values dropped
+    into their recorded slots."""
+    def canonical(*tvals):
+        ls = list(template)
+        for i, v in zip(t_idx, tvals):
+            ls[i] = v
+        a, k = _tree.tree_unflatten(treedef, ls)
+        return fn(*a, **k)
+    return canonical
+
+
+def _note_fallback(name):
+    _counters.fallbacks += 1
+    _counters.per_op[name][2] += 1
+
+
+def _guarded_vjp(raw_vjp, entry, key, vals):
+    """custom_vjp bodies whose bwd closes over trace-local values cannot
+    survive the jitted-forward / out-of-trace-pullback split (the
+    residual-passing idiom can; see nn.functional._fused_softmax_ce_xla).
+    If such a pullback leaks a tracer, permanently route the key to the
+    slow path and answer this backward from an eager re-vjp."""
+    def vjp(cotangents):
+        try:
+            return raw_vjp(cotangents)
+        except jax.errors.UnexpectedTracerError:
+            _counters.errors += 1
+            if len(_blacklist) >= _MAX_BLACKLIST:
+                _blacklist.clear()
+            try:
+                _blacklist.add(key)
+                _cache.pop(key, None)
+            except Exception:
+                pass
+            return jax.vjp(entry.canonical, *vals)[1](cotangents)
+    return vjp
+
+
+def run(fn, name, treedef, leaves, t_idx, vals, record
+        ) -> Optional[Tuple[Any, Any, Any]]:
+    """Dispatch one op through the cache.
+
+    Returns (out_pytree, vjp_fn_or_None, replay_primal_fn), or None when
+    the call must take the uncached slow path. `vals` are the raw jax
+    values (post-AMP-cast) for the Tensor leaves at `t_idx`.
+    """
+    # Inside a jit/vmap capture the values are tracers: the enclosing
+    # transform compiles the whole program once, so a per-op cache buys
+    # nothing there — and nested-pjit lowering of cached executables is
+    # not portable across jax versions. Eager values only.
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        _note_fallback(name)
+        return None
+    # key building AND lookup are guarded: PyTreeDef hashes ignore aux
+    # data, so dict/set probes can fall into aux __eq__ — and aux may
+    # hold objects with array-valued equality (e.g. _IndexBox Tensors),
+    # whose truthiness raises. Any such hazard routes to the slow path.
+    try:
+        key, sig = _build_key(name, fn, treedef, leaves, t_idx, vals)
+        if key is not None and key in _blacklist:
+            key = None
+    except Exception:
+        key = None
+    if key is None:
+        _note_fallback(name)
+        return None
+
+    try:
+        entry = _cache.get(key)
+    except Exception:
+        _note_fallback(name)
+        return None
+    fresh_entry = entry is None
+    if fresh_entry:
+        template = list(leaves)
+        for i in t_idx:
+            template[i] = None
+        entry = _Entry(_make_canonical(fn, treedef, tuple(template),
+                                       tuple(t_idx)))
+
+    flavor = 'fwd' if record else 'primal'
+    jitted = entry.fwd_jit if record else entry.primal_jit
+    building = jitted is None
+    if building:
+        _counters.misses += 1
+        _counters.per_op[name][1] += 1
+        try:   # sig holds the treedef: probing can hit aux __eq__ hazards
+            seen_key = (sig, flavor)
+            if seen_key in _seen_flavors:
+                _counters.retraces += 1
+            else:
+                _seen_flavors.add(seen_key)
+        except Exception:
+            pass
+        if record:
+            def _fwd(*tvals, _c=entry.canonical):
+                return jax.vjp(_c, *tvals)
+            jitted = jax.jit(_fwd)
+        else:
+            jitted = jax.jit(entry.canonical)
+    else:
+        _counters.hits += 1
+        _counters.per_op[name][0] += 1
+
+    try:
+        if record:
+            out, raw_vjp = jitted(*vals)
+            vjp_fn = _guarded_vjp(raw_vjp, entry, key, tuple(vals))
+        else:
+            out, vjp_fn = jitted(*vals), None
+    except Exception:
+        if not building:
+            raise   # a previously-compiled executable failed: genuine error
+        # first trace/compile of this key failed (data-dependent shapes,
+        # Tensor-returning body, ...): permanently route this key to the
+        # slow path — which re-raises any genuine user error itself
+        _counters.misses -= 1
+        _counters.per_op[name][1] -= 1
+        _counters.errors += 1
+        _note_fallback(name)
+        if len(_blacklist) >= _MAX_BLACKLIST:
+            _blacklist.clear()
+        try:
+            _blacklist.add(key)
+        except Exception:
+            pass
+        return None
+
+    if building:
+        if record:
+            entry.fwd_jit = jitted
+        else:
+            entry.primal_jit = jitted
+        if fresh_entry:
+            try:
+                _cache[key] = entry
+                if len(_cache) > _MAX_ENTRIES:
+                    _cache.popitem(last=False)
+                    _counters.evictions += 1
+            except Exception:
+                pass   # unstorable key: the result is still valid
+    return out, vjp_fn, entry.primal

@@ -101,7 +101,13 @@ class InputRef:
 
 
 class Node:
-    """One recorded op: input refs, vjp closure, replayable primal, metadata."""
+    """One recorded op: input refs, vjp closure, replayable primal, metadata.
+
+    On the cached dispatch path (paddle_tpu._dispatch) `vjp_fn` is the
+    residual-bound pullback returned out of the entry's jitted forward,
+    and `primal_fn` is the entry's shared jitted primal — so both
+    backward and tape replay (_build_pure) reuse compiled programs
+    instead of re-tracing the op body."""
 
     __slots__ = ('inputs', 'vjp_fn', 'primal_fn', 'out_avals', 'out_treedef',
                  'name', '_order')
@@ -356,7 +362,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
         _, vjp_f = jax.vjp(f, *xs)
         return vjp_f(tuple(cs))
 
-    res = apply_op(hg, *uniq, *cots, _name='grad')
+    # _cacheable=False: hg closes over the per-call replay fn `f`, so a
+    # dispatch-cache key could never repeat — it would only churn entries.
+    # The replayed Nodes' primal_fns ARE the cached per-op primals, so the
+    # trace inside jax.vjp still reuses their jaxprs.
+    res = apply_op(hg, *uniq, *cots, _name='grad', _cacheable=False)
     res = list(res) if isinstance(res, (tuple, list)) else [res]
     grads = [None if id(t) in unused_ids else res[uniq_pos[id(t)]]
              for t in inputs_l]

@@ -9,6 +9,10 @@ python/paddle/amp/debugging.py + the fleet loss-spike monitor).
   the DyGraph debugging workflow).
 - `LossSpikeDetector` — windowed z-score monitor used by hapi/fleet to
   flag divergence (upstream: loss scaling skip-counters + spike logs).
+- dispatch telemetry — `dispatch_stats()` / `dispatch_summary()` read the
+  eager dispatch cache's hit/miss/retrace/fallback counters
+  (paddle_tpu._dispatch); `enable_dispatch_cache(False)` forces every op
+  back onto the uncached slow path (A/B debugging, parity checks).
 """
 from __future__ import annotations
 
@@ -20,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import _dispatch
 from . import flags as _flags
 from .tensor import Tensor
 
@@ -88,6 +93,65 @@ def disable_check_numerics():
     from . import tensor as tmod
     _flags.set_flags({'FLAGS_check_nan_inf': False})
     tmod._numerics_hook = None
+
+
+# ---------------------------------------------------------------------------
+# eager dispatch cache telemetry (paddle_tpu._dispatch)
+# ---------------------------------------------------------------------------
+
+def dispatch_stats() -> dict:
+    """Counters for the eager dispatch fast path: hits (op served from a
+    cached executable), misses (a trace/compile happened), retraces
+    (misses whose op signature had already been compiled — shape/static
+    churn), fallbacks (unkeyable calls on the slow path), plus
+    hit_rate/cache_size and a per-op breakdown. Steady-state eager
+    training should show zero retraces after warmup."""
+    return _dispatch.stats()
+
+
+def reset_dispatch_stats():
+    _dispatch.reset_stats()
+
+
+def clear_dispatch_cache():
+    """Drop every cached executable (counters survive; pair with
+    reset_dispatch_stats() for a clean measurement window)."""
+    _dispatch.clear()
+
+
+def enable_dispatch_cache(enable: bool = True):
+    """Toggle the eager dispatch cache (FLAGS_eager_dispatch_cache).
+    Disabling routes every apply_op through the per-call jax.vjp slow
+    path — the pre-cache behavior — for A/B parity or debugging."""
+    _dispatch.enable(enable)
+
+
+def disable_dispatch_cache():
+    _dispatch.enable(False)
+
+
+def dispatch_summary(max_rows: int = 15) -> str:
+    """Human-readable dispatch-cache report (global counters + the
+    hottest ops by call count)."""
+    s = _dispatch.stats()
+    lines = [
+        'eager dispatch cache: '
+        f'{"enabled" if s["enabled"] else "DISABLED"}',
+        f'  calls {s["calls"]}  hits {s["hits"]}  misses {s["misses"]}'
+        f'  retraces {s["retraces"]}  fallbacks {s["fallbacks"]}'
+        f'  hit_rate {s["hit_rate"]:.1%}',
+        f'  cache_size {s["cache_size"]}  evictions {s["evictions"]}'
+        f'  errors {s["errors"]}',
+    ]
+    per = sorted(s['per_op'].items(),
+                 key=lambda kv: -(kv[1]['hits'] + kv[1]['misses']
+                                  + kv[1]['fallbacks']))
+    if per:
+        lines.append(f'  {"op":<28}{"hits":>8}{"misses":>8}{"fallbacks":>10}')
+        for name, row in per[:max_rows]:
+            lines.append(f'  {name or "<unnamed>":<28}{row["hits"]:>8}'
+                         f'{row["misses"]:>8}{row["fallbacks"]:>10}')
+    return '\n'.join(lines)
 
 
 class LossSpikeDetector:

@@ -1,0 +1,21 @@
+"""jax version-compatibility shims for the distributed layer.
+
+`shard_map` moved from jax.experimental to the jax namespace, and its
+replication-check kwarg was renamed check_rep -> check_vma along the
+way. Every distributed module imports the symbol from here so the rest
+of the code can use the modern spelling on either jax.
+"""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+    _LEGACY = False
+except ImportError:   # older jax: pre-promotion location + old kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _LEGACY = True
+
+
+def shard_map(f, **kwargs):
+    if _LEGACY and 'check_vma' in kwargs:
+        kwargs['check_rep'] = kwargs.pop('check_vma')
+    return _shard_map(f, **kwargs)

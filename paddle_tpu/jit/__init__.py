@@ -17,6 +17,9 @@ import functools
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
+# submodule import: jax.export is not an attribute of the jax module
+# object on older jax (0.4.x), but the submodule itself is importable
+from jax import export as _jax_export
 import jax.numpy as jnp
 import numpy as np
 
@@ -420,15 +423,15 @@ def save(layer, path, input_spec=None, **config):
             if has_sym:
                 # one shared scope so symbols across args can relate
                 if scope is None:
-                    scope = jax.export.SymbolicScope()
-                shape = jax.export.symbolic_shape(', '.join(dims),
+                    scope = _jax_export.SymbolicScope()
+                shape = _jax_export.symbolic_shape(', '.join(dims),
                                                   scope=scope)
             else:
                 shape = tuple(int(d) for d in dims)
             arg_specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
         abstract = lambda tree: _tree.tree_map(
             lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), tree)
-        exported = jax.export.export(
+        exported = _jax_export.export(
             jax.jit(infer_fn), platforms=_export_platforms())(
             abstract(params), abstract(frozen), abstract(buffers),
             *arg_specs)
@@ -475,7 +478,7 @@ def load(path, layer=None):
             f'{hlo_path} not found: this artifact predates program '
             f'serialization — pass the layer instance to restore into')
     with open(hlo_path, 'rb') as f:
-        exported = jax.export.deserialize(bytearray(f.read()))
+        exported = _jax_export.deserialize(bytearray(f.read()))
     params, frozen, buffers = {}, {}, {}
     manifest = {}
     try:

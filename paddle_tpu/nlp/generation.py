@@ -544,7 +544,9 @@ class GenerationMixin:
                 seen = jnp.cumsum(is_eos.astype(jnp.int32))
                 keep = (seen == 0) | (is_eos & (seen == 1))
                 out = jnp.where(keep, out, pad_token_id)
-            return out[None], jnp.minimum(e, max_new_tokens), rounds
+            # e stays UNCLAMPED: acceptance stats must count final-round
+            # overshoot drafts; the host clamps the emitted-token count
+            return out[None], e, rounds
 
         jitted = jax.jit(decode)
         store[cache_key] = jitted
@@ -576,6 +578,7 @@ class GenerationMixin:
         if k < 1:
             raise ValueError('num_draft_tokens must be >= 1')
         was_training = self.training
+        draft_was_training = draft_model.training
         self.eval()
         draft_model.eval()
         try:
@@ -593,12 +596,17 @@ class GenerationMixin:
         finally:
             if was_training:
                 self.train()
+            if draft_was_training:
+                draft_model.train()
         rounds_i = max(int(rounds), 1)
-        emitted_i = int(emitted)
         # each round is ONE target forward that yields 1 + a tokens; the
         # prefill token is free in both schemes, so accepted drafts total
-        # emitted - 1 - rounds
-        accepted = max(emitted_i - 1 - rounds_i, 0)
+        # emitted - 1 - rounds. Use the UNCLAMPED emitted count: a final
+        # round can overshoot max_new_tokens, and those accepted drafts
+        # still measure draft quality / forwards actually saved.
+        e_raw = int(emitted)
+        emitted_i = min(e_raw, max_new_tokens)
+        accepted = max(e_raw - 1 - rounds_i, 0)
         return Tensor(out), {
             'rounds': rounds_i, 'emitted': emitted_i,
             'target_forwards_saved': accepted,
@@ -882,7 +890,9 @@ class Seq2SeqGenerationMixin:
                 seen = jnp.cumsum(is_eos.astype(jnp.int32))
                 keep = (seen == 0) | (is_eos & (seen == 1))
                 out = jnp.where(keep, out, pad_token_id)
-            return out[None], jnp.minimum(e, max_new_tokens), rounds
+            # e stays UNCLAMPED: acceptance stats must count final-round
+            # overshoot drafts; the host clamps the emitted-token count
+            return out[None], e, rounds
 
         jitted = jax.jit(decode)
         store[cache_key] = jitted
@@ -924,6 +934,7 @@ class Seq2SeqGenerationMixin:
         if k < 1:
             raise ValueError('num_draft_tokens must be >= 1')
         was_training = self.training
+        draft_was_training = draft_model.training
         self.eval()
         draft_model.eval()
         try:
@@ -940,9 +951,14 @@ class Seq2SeqGenerationMixin:
         finally:
             if was_training:
                 self.train()
+            if draft_was_training:
+                draft_model.train()
         rounds_i = max(int(rounds), 1)
-        emitted_i = int(emitted)
-        accepted = max(emitted_i - 1 - rounds_i, 0)
+        # unclamped emitted count: final-round overshoot drafts still
+        # count as accepted (see the decoder-only mixin)
+        e_raw = int(emitted)
+        emitted_i = min(e_raw, max_new_tokens)
+        accepted = max(e_raw - 1 - rounds_i, 0)
         return Tensor(out), {
             'rounds': rounds_i, 'emitted': emitted_i,
             'target_forwards_saved': accepted,

@@ -170,11 +170,15 @@ class LlamaAttention(Layer):
             lambda v_: v_.reshape(v_.shape[0], v_.shape[1], nkv, hd),
             self.v_proj(hidden), _name='split_heads')
 
-        def rope_q(qv):
-            pos = _offset_grid(offset, qv.shape[1])
+        # offset rides as an op INPUT (int tensor), not a closure capture:
+        # a captured jax scalar would make every rope call uncacheable in
+        # the eager dispatch cache
+        def rope_q(qv, off):
+            pos = _offset_grid(off, qv.shape[1])
             return _rope(qv, pos, theta)
-        q = apply_op(rope_q, q, _name='rope')
-        k = apply_op(rope_q, k, _name='rope')
+        off_t = offset if isinstance(offset, Tensor) else Tensor(offset)
+        q = apply_op(rope_q, q, off_t, _name='rope')
+        k = apply_op(rope_q, k, off_t, _name='rope')
 
         if cache is None:
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,

@@ -122,7 +122,8 @@ class AlphaDropout(Layer):
             a = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
             b = -a * alpha_p * (1 - q)
             return a * jnp.where(keep, v, alpha_p) + b
-        return apply_op(f, x, _name='alpha_dropout')
+        # _cacheable=False: f closes over a fresh PRNG key array every call
+        return apply_op(f, x, _name='alpha_dropout', _cacheable=False)
 
 
 class Flatten(Layer):

@@ -221,7 +221,8 @@ def dropout(x, p=0.5, axis=None, training=True, mode='upscale_in_train',
         if mode == 'upscale_in_train':
             return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
         return jnp.where(keep, v, jnp.zeros((), v.dtype))
-    return defop(f, name='dropout')(x)
+    # cacheable=False: f closes over a fresh PRNG key array every call
+    return defop(f, name='dropout', cacheable=False)(x)
 
 
 def dropout2d(x, p=0.5, training=True, data_format='NCHW', name=None):
@@ -1029,6 +1030,10 @@ def _fused_softmax_ce_xla(logits2d, safe_labels, valid):
     own so the bench races the pallas kernel against the ACTUAL
     fallback implementation, not a strawman)."""
 
+    # labels/valid ride the RESIDUALS, never the bwd closure: a closure
+    # would capture trace-local tracers, which breaks any caller that
+    # jits the vjp-forward and invokes the pullback outside the trace
+    # (the eager dispatch cache's reusable-VJP split does exactly that)
     @jax.custom_vjp
     def ce(x):
         return _ce_fwd(x)[0]
@@ -1038,15 +1043,16 @@ def _fused_softmax_ce_xla(logits2d, safe_labels, valid):
         m = jnp.max(xf, axis=-1)
         lse = m + jnp.log(jnp.sum(jnp.exp(xf - m[:, None]), axis=-1))
         tgt = jnp.take_along_axis(xf, safe_labels[:, None], 1)[:, 0]
-        return jnp.where(valid, lse - tgt, 0.0), (x, lse)
+        return jnp.where(valid, lse - tgt, 0.0), (x, lse, safe_labels,
+                                                  valid)
 
     def _ce_bwd(res, g):
-        x, lse = res
+        x, lse, labels_r, valid_r = res
         xf = x.astype(jnp.float32)
         cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
         p = jnp.exp(xf - lse[:, None])
-        onehot = (cols == safe_labels[:, None]).astype(jnp.float32)
-        dx = (p - onehot) * jnp.where(valid, g, 0.0)[:, None]
+        onehot = (cols == labels_r[:, None]).astype(jnp.float32)
+        dx = (p - onehot) * jnp.where(valid_r, g, 0.0)[:, None]
         return (dx.astype(x.dtype),)
 
     ce.defvjp(_ce_fwd, _ce_bwd)

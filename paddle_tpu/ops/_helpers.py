@@ -6,17 +6,24 @@ import functools
 from ..tensor import Tensor, apply_op, to_jax
 
 
-def defop(fn=None, *, name=None):
+def defop(fn=None, *, name=None, cacheable=True):
     """Decorator: `fn` is written against raw jax values; the wrapper accepts
     Tensors anywhere, routes through apply_op (autograd tape), and tolerates
-    the reference API's trailing `name=` kwarg."""
+    the reference API's trailing `name=` kwarg.
+
+    `cacheable=False` opts the op out of the eager dispatch cache
+    (paddle_tpu._dispatch) — use it for bodies that close over fresh
+    per-call state (PRNG key arrays, host buffers): such calls could
+    never key stably and would only pay hashing cost before falling
+    back anyway."""
     def deco(f):
         opname = name or f.__name__
 
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
             kwargs.pop('name', None)
-            return apply_op(f, *args, _name=opname, **kwargs)
+            return apply_op(f, *args, _name=opname, _cacheable=cacheable,
+                            **kwargs)
         wrapper.__wrapped_jax__ = f
         return wrapper
     return deco(fn) if fn is not None else deco

@@ -23,6 +23,10 @@ def _norm_shape(shape, in_shape):
 def reshape(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = [int(v) for v in np.asarray(shape.value)]
+    # hashable tuple (Tensor dims concretized here, as before): the op
+    # body then keys stably in the eager dispatch cache
+    shape = tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
+                  for s in shape)
     return defop(lambda v: v.reshape(_norm_shape(shape, v.shape)),
                  name='reshape')(x)
 

@@ -26,6 +26,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed upstream: TPUCompilerParams (old) -> CompilerParams (new)
+_CompilerParams = getattr(pltpu, 'CompilerParams',
+                          getattr(pltpu, 'TPUCompilerParams', None))
+
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -577,7 +581,7 @@ def softmax_cross_entropy_fwd(logits, labels, block_rows=256,
             pltpu.VMEM((block_rows, 1), jnp.float32),
             pltpu.VMEM((block_rows, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'arbitrary')),
         interpret=interpret,
     )(labels.astype(jnp.int32).reshape(np_, 1), logits)
@@ -608,7 +612,7 @@ def softmax_cross_entropy_bwd(logits, labels, lse, g, block_rows=256,
         ],
         out_specs=pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((np_, vp), logits.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel')),
         interpret=interpret,
     )(labels.astype(jnp.int32).reshape(np_, 1), g.reshape(np_, 1),

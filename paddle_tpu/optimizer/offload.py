@@ -28,12 +28,26 @@ _tree = jax.tree_util
 
 def _host_sharding(device=None):
     device = device or jax.devices()[0]
-    return SingleDeviceSharding(device, memory_kind='pinned_host')
+    # TPU devices address host RAM as 'pinned_host'; the CPU backend
+    # exposes it as 'unpinned_host' — take whichever this device has
+    try:
+        kinds = {m.kind for m in device.addressable_memories()}
+    except Exception:
+        kinds = ()
+    kind = 'pinned_host' if 'pinned_host' in kinds else 'unpinned_host'
+    return SingleDeviceSharding(device, memory_kind=kind)
 
 
 def _device_sharding(device=None):
     device = device or jax.devices()[0]
-    return SingleDeviceSharding(device, memory_kind='device')
+    # 'device' (HBM) on accelerators; the CPU backend has no separate
+    # device memory — use its default kind so offload degrades to a
+    # correct (if pointless) host<->host stream there
+    try:
+        kind = device.default_memory().kind
+    except Exception:
+        kind = 'device'
+    return SingleDeviceSharding(device, memory_kind=kind)
 
 
 class OffloadEngine:

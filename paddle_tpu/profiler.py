@@ -5,6 +5,11 @@ TPU-native: device-side tracing delegates to the XLA/jax profiler
 timing is a lightweight in-process aggregator around `RecordEvent`
 regions. `profile(dir)` is the one-liner; `Profiler` mirrors the
 reference's start/stop/step object API.
+
+Eager dispatch telemetry: every profile window also snapshots the
+dispatch cache's hit/miss/retrace/fallback counters
+(paddle_tpu._dispatch) so `summary()`/`export()` report how much of the
+profiled region ran through cached executables vs Python re-tracing.
 """
 from __future__ import annotations
 
@@ -17,6 +22,23 @@ import time
 from typing import Dict, List, Optional
 
 import jax
+
+from . import _dispatch
+
+
+_DISPATCH_KEYS = ('hits', 'misses', 'retraces', 'fallbacks', 'calls')
+
+
+def _dispatch_snapshot() -> Dict[str, int]:
+    s = _dispatch.stats()
+    return {k: s[k] for k in _DISPATCH_KEYS}
+
+
+def _dispatch_delta(since: Optional[Dict[str, int]]) -> Dict[str, int]:
+    now = _dispatch_snapshot()
+    if since is None:
+        return now
+    return {k: now[k] - since.get(k, 0) for k in _DISPATCH_KEYS}
 
 
 class _HostTimer(threading.local):
@@ -89,11 +111,18 @@ class Profiler:
         self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._window_open = False
+        self._dispatch_start: Optional[Dict[str, int]] = None
+
+    def dispatch_stats(self) -> Dict[str, int]:
+        """Dispatch-cache counter deltas since start() (hits / misses /
+        retraces / fallbacks / calls within the profiled region)."""
+        return _dispatch_delta(self._dispatch_start)
 
     def start(self):
         _host.active = True
         _host.totals.clear()
         _host.counts.clear()
+        self._dispatch_start = _dispatch_snapshot()
         if self._scheduler is not None and self._scheduler(0) in (
                 ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
             self._window_open = True
@@ -158,6 +187,13 @@ class Profiler:
             avg = sum(self._step_times) / len(self._step_times)
             lines.append(f'steps: {self._step_count}, avg step '
                          f'{avg * 1e3:.2f} ms')
+        d = self.dispatch_stats()
+        if d['calls']:
+            rate = d['hits'] / d['calls']
+            lines.append(
+                f'eager dispatch: {d["calls"]} ops, {rate:.1%} cache hits'
+                f' ({d["misses"]} misses, {d["retraces"]} retraces, '
+                f'{d["fallbacks"]} fallbacks)')
         s = '\n'.join(lines)
         return s
 
@@ -166,7 +202,8 @@ class Profiler:
             json.dump({'regions': {k: {'total_s': v,
                                        'calls': _host.counts[k]}
                                    for k, v in _host.totals.items()},
-                       'step_times': self._step_times}, f)
+                       'step_times': self._step_times,
+                       'dispatch': self.dispatch_stats()}, f)
 
 
 @contextlib.contextmanager

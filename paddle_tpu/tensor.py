@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import autograd, framework
+from . import _dispatch
 from .dtype import convert_dtype, dtype_name
 
 # set by paddle_tpu.amp at import: (raw_vals, op_name) -> raw_vals,
@@ -363,12 +364,20 @@ def _restore_tensors_in_index(idx, kids):
 # ---------------------------------------------------------------------------
 
 
-def apply_op(fn: Callable, *args, _name: str = '', **kwargs):
+def apply_op(fn: Callable, *args, _name: str = '', _cacheable: bool = True,
+             **kwargs):
     """Run pure jax `fn` over (args, kwargs), unwrapping Tensors.
 
     Records a tape Node (with a forward-time jax.vjp) iff grad is enabled and
     some Tensor input requires grad. Returns Tensor-wrapped outputs mirroring
     fn's output pytree.
+
+    Fast path: keyable calls (see paddle_tpu._dispatch) run through the
+    dispatch cache — a jitted primal when no grad is needed, a jitted
+    residual-returning forward whose reusable pullback feeds the tape
+    when grad is on — so steady-state eager training stops re-tracing.
+    `_cacheable=False` opts a call out (bodies that close over fresh
+    arrays / per-call functions would only churn the cache).
     """
     leaves, treedef = _tree.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
@@ -377,30 +386,50 @@ def apply_op(fn: Callable, *args, _name: str = '', **kwargs):
     if _amp_cast_hook is not None:
         vals = _amp_cast_hook(vals, _name)
 
-    def pure(*vs):
-        # Rebuild args with raw jax values in Tensor slots; fn receives raw
-        # values wherever Tensors were passed.
-        ls = list(leaves)
-        for i, v in zip(t_idx, vs):
-            ls[i] = v
-        a, k = _tree.tree_unflatten(treedef, ls)
-        return fn(*a, **k)
-
     record = autograd.is_grad_enabled() and any(
         not t.stop_gradient for t in tensors)
 
-    if record:
-        out, vjp_fn = jax.vjp(pure, *vals)
+    primal_fn = None
+    cached = None
+    if _cacheable and t_idx and _dispatch.enabled():
+        # key off the post-AMP-cast values: the cast is a pure function
+        # of (op name, input dtypes, amp state) applied before dispatch,
+        # so the cached executable composes with auto_cast unchanged
+        cached = _dispatch.run(fn, _name, treedef, leaves, t_idx, vals,
+                               record)
+    elif t_idx:
+        # disabled cache or explicit _cacheable=False opt-out: still a
+        # slow-path dispatch, so it shows up in the telemetry
+        _dispatch._note_fallback(_name)
+
+    if cached is not None:
+        out, vjp_fn, primal_fn = cached
     else:
-        out = pure(*vals)
+        def pure(*vs):
+            # Rebuild args with raw jax values in Tensor slots; fn receives
+            # raw values wherever Tensors were passed.
+            ls = list(leaves)
+            for i, v in zip(t_idx, vs):
+                ls[i] = v
+            a, k = _tree.tree_unflatten(treedef, ls)
+            return fn(*a, **k)
+
+        primal_fn = pure
+        if record:
+            out, vjp_fn = jax.vjp(pure, *vals)
+        else:
+            out = pure(*vals)
 
     out_leaves, out_td = _tree.tree_flatten(out)
     node = None
     if record:
         # Snapshot inputs (InputRef) so later in-place rebinds of the live
-        # Tensors can't sever or re-key the recorded graph.
+        # Tensors can't sever or re-key the recorded graph. On the cached
+        # path primal_fn is the entry's shared jitted primal, so tape
+        # replay (paddle.grad create_graph / jacobian) also skips
+        # re-tracing.
         node = autograd.Node(
-            [autograd.InputRef(t) for t in tensors], vjp_fn, pure,
+            [autograd.InputRef(t) for t in tensors], vjp_fn, primal_fn,
             [(tuple(np.shape(l)), jnp.dtype(getattr(l, 'dtype', np.result_type(l))))
              for l in out_leaves],
             out_td, name=_name)

@@ -1,0 +1,302 @@
+"""Eager dispatch fast path (paddle_tpu._dispatch): cached jitted
+primals + reusable VJPs behind tensor.apply_op, with hit/miss/retrace/
+fallback telemetry. Covers steady-state trace bounds, slow-vs-cached
+numerical parity (grad / no-grad / in-place rebind / AMP), fallback
+correctness for uncacheable ops, and the tier-1 zero-retrace regression
+gate over the bench micro-loop."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import _dispatch, debug
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache():
+    """Each test gets a fresh, enabled cache and clean counters."""
+    debug.enable_dispatch_cache(True)
+    debug.clear_dispatch_cache()
+    debug.reset_dispatch_stats()
+    yield
+    debug.enable_dispatch_cache(True)
+    debug.clear_dispatch_cache()
+    debug.reset_dispatch_stats()
+
+
+def _mlp_and_data(classes=4):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, classes))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 16)).astype('float32'))
+    y = paddle.to_tensor(rng.randint(0, classes, (8,)))
+    return m, opt, x, y
+
+
+def _train(m, opt, x, y, steps):
+    losses = []
+    for _ in range(steps):
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+class TestSteadyState:
+    def test_three_step_loop_is_all_hits_after_warmup(self):
+        m, opt, x, y = _mlp_and_data()
+        _train(m, opt, x, y, 2)          # warmup traces every op once
+        debug.reset_dispatch_stats()
+        _train(m, opt, x, y, 3)
+        s = debug.dispatch_stats()
+        assert s['misses'] == 0, s
+        assert s['retraces'] == 0, s
+        assert s['fallbacks'] == 0, s
+        assert s['hits'] > 0
+        assert s['hit_rate'] >= 0.9      # acceptance bar: >= 90 %
+
+    def test_warmup_traces_are_bounded_not_per_step(self):
+        m, opt, x, y = _mlp_and_data()
+        _train(m, opt, x, y, 1)
+        first = debug.dispatch_stats()['misses']
+        _train(m, opt, x, y, 4)
+        s = debug.dispatch_stats()
+        # 5 steps re-run the same ops: total traces stay at step-1 count
+        assert s['misses'] == first
+        assert first > 0
+
+    def test_shape_change_counts_as_retrace(self):
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        b = paddle.to_tensor(np.ones((4, 4), np.float32))
+        (a + b).numpy()
+        (a + b).numpy()
+        c = paddle.to_tensor(np.ones((2, 8), np.float32))
+        d = paddle.to_tensor(np.ones((2, 8), np.float32))
+        (c + d).numpy()                  # same op, new avals
+        s = debug.dispatch_stats()
+        assert s['retraces'] == 1
+        assert s['hits'] >= 1
+
+
+class TestParity:
+    def _both(self, fn):
+        """Run fn() with the cache on and off; return both results."""
+        debug.enable_dispatch_cache(True)
+        debug.clear_dispatch_cache()
+        on = fn()
+        debug.enable_dispatch_cache(False)
+        off = fn()
+        debug.enable_dispatch_cache(True)
+        return on, off
+
+    def test_train_loop_parity_grad(self):
+        def run():
+            m, opt, x, y = _mlp_and_data()
+            return _train(m, opt, x, y, 4)
+        on, off = self._both(run)
+        np.testing.assert_allclose(on, off, rtol=1e-6, atol=1e-7)
+
+    def test_no_grad_parity(self):
+        def run():
+            m, _, x, _ = _mlp_and_data()
+            with paddle.no_grad():
+                return m(x).numpy()
+        on, off = self._both(run)
+        np.testing.assert_allclose(on, off, rtol=1e-6, atol=1e-7)
+
+    def test_grad_values_parity(self):
+        def run():
+            paddle.seed(0)
+            w = paddle.to_tensor(
+                np.arange(12, dtype=np.float32).reshape(3, 4) / 10.0,
+                stop_gradient=False)
+            x = paddle.to_tensor(np.ones((4, 2), np.float32))
+            loss = paddle.matmul(w, x).sum()
+            loss.backward()
+            return w.grad.numpy()
+        on, off = self._both(run)
+        np.testing.assert_allclose(on, off)
+
+    def test_inplace_rebind_parity(self):
+        def run():
+            a = paddle.to_tensor(
+                np.arange(6, dtype=np.float32).reshape(2, 3),
+                stop_gradient=False)
+            b = a * 2.0
+            a[0] = 99.0              # rebinds `a` AFTER b recorded it
+            c = (b * a).sum()
+            c.backward()
+            return float(c.numpy()), a.grad.numpy()
+        (c_on, g_on), (c_off, g_off) = self._both(run)
+        assert c_on == c_off
+        np.testing.assert_allclose(g_on, g_off)
+
+    def test_amp_parity_and_composition(self):
+        def run():
+            paddle.seed(0)
+            w = paddle.to_tensor(
+                np.random.RandomState(0).standard_normal(
+                    (8, 8)).astype('float32'), stop_gradient=False)
+            x = paddle.to_tensor(
+                np.random.RandomState(1).standard_normal(
+                    (8, 8)).astype('float32'))
+            with paddle.amp.auto_cast():
+                out = paddle.matmul(w, x)      # white-list: bf16 compute
+                loss = out.astype('float32').sum()
+            loss.backward()
+            return out.numpy(), w.grad.numpy()
+        (o_on, g_on), (o_off, g_off) = self._both(run)
+        np.testing.assert_allclose(o_on, o_off)
+        np.testing.assert_allclose(g_on, g_off)
+
+    def test_amp_cached_op_keys_on_cast_dtype(self):
+        w = paddle.to_tensor(np.ones((4, 4), np.float32))
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        plain = paddle.matmul(w, x)
+        assert plain.dtype == np.float32
+        with paddle.amp.auto_cast():
+            amped = paddle.matmul(w, x)
+        # same op + shapes, different post-cast avals: distinct cache
+        # entries, so the cached plain-path executable is NOT reused
+        assert str(amped.dtype) == 'bfloat16'
+
+
+class TestCachedAutogradMachinery:
+    def test_grad_path_reuses_vjp_without_retracing(self):
+        w = paddle.to_tensor(np.ones((3, 3), np.float32),
+                             stop_gradient=False)
+        x = paddle.to_tensor(np.full((3, 3), 2.0, np.float32))
+        for _ in range(2):               # warmup: fwd flavor traced
+            loss = paddle.matmul(w, x).sum()
+            loss.backward()
+            w.clear_grad()
+        debug.reset_dispatch_stats()
+        loss = paddle.matmul(w, x).sum()
+        loss.backward()
+        s = debug.dispatch_stats()
+        assert s['misses'] == 0 and s['retraces'] == 0
+        # d(sum(W @ x))/dW_ij = sum_k x_jk = 2.0 * 3
+        np.testing.assert_allclose(w.grad.numpy(), np.full((3, 3), 6.0))
+
+    def test_higher_order_grad_through_cached_nodes(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (g1,) = paddle.grad([y], [x], create_graph=True)
+        (g2,) = paddle.grad([g1], [x])
+        np.testing.assert_allclose(g1.numpy(), [27.0])   # 3x^2
+        np.testing.assert_allclose(g2.numpy(), [18.0])   # 6x
+
+    def test_retain_graph_double_backward(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])  # 4 + 4
+
+
+class TestFallbacks:
+    def test_dropout_falls_back_and_stays_random(self):
+        x = paddle.to_tensor(np.ones((64, 64), np.float32))
+        a = F.dropout(x, 0.5, training=True).numpy()
+        b = F.dropout(x, 0.5, training=True).numpy()
+        s = debug.dispatch_stats()
+        assert s['per_op']['dropout']['fallbacks'] == 2
+        assert s['per_op']['dropout']['hits'] == 0
+        # the fallback matters: a cached executable would freeze the mask
+        assert not np.array_equal(a, b)
+
+    def test_boolean_mask_getitem_falls_back_correctly(self):
+        x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], np.float32))
+        out = x[x > 0]                    # data-dependent output shape
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        out2 = x[x > 2]
+        np.testing.assert_allclose(out2.numpy(), [4.0])
+
+    def test_astype_lambda_keys_on_closure_dtype(self):
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        assert str(x.astype('float16').dtype) == 'float16'
+        debug.reset_dispatch_stats()
+        assert str(x.astype('float16').dtype) == 'float16'   # hit
+        assert str(x.astype('int32').dtype) == 'int32'       # new dt: miss
+        s = debug.dispatch_stats()
+        assert s['per_op']['astype']['hits'] == 1
+        assert s['per_op']['astype']['misses'] == 1
+
+    def test_scalar_type_does_not_collide(self):
+        # 1 / 1.0 / True hash equal; the key must still separate them
+        x = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+        a = (x + 1).numpy()
+        b = (x + 1.0).numpy()
+        c = (x + True).numpy()
+        np.testing.assert_allclose(a, [3.0, 3.0, 3.0])
+        np.testing.assert_allclose(b, [3.0, 3.0, 3.0])
+        np.testing.assert_allclose(c, [3.0, 3.0, 3.0])
+
+    def test_disable_enable_roundtrip(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        debug.enable_dispatch_cache(False)
+        (x + x).numpy()
+        s = debug.dispatch_stats()
+        assert not s['enabled'] and s['hits'] == 0
+        debug.enable_dispatch_cache(True)
+        (x + x).numpy()
+        (x + x).numpy()
+        assert debug.dispatch_stats()['hits'] >= 1
+
+
+class TestTelemetrySurfaces:
+    def test_dispatch_summary_renders(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        (x + x).numpy()
+        txt = debug.dispatch_summary()
+        assert 'eager dispatch cache' in txt
+        assert 'hit_rate' in txt
+
+    def test_flag_mirrors_toggle(self):
+        debug.enable_dispatch_cache(False)
+        assert paddle.get_flags('FLAGS_eager_dispatch_cache')[
+            'FLAGS_eager_dispatch_cache'] is False
+        debug.enable_dispatch_cache(True)
+        assert paddle.get_flags('FLAGS_eager_dispatch_cache')[
+            'FLAGS_eager_dispatch_cache'] is True
+
+    def test_profiler_reports_dispatch_window(self, tmp_path):
+        m, opt, x, y = _mlp_and_data()
+        _train(m, opt, x, y, 2)           # warm the cache pre-profile
+        prof = paddle.profiler.Profiler(timer_only=True)
+        prof.start()
+        _train(m, opt, x, y, 2)
+        prof.stop()
+        d = prof.dispatch_stats()
+        assert d['calls'] > 0
+        assert d['hits'] == d['calls']    # fully warmed window
+        assert 'eager dispatch' in prof.summary()
+        out = str(tmp_path / 'prof.json')
+        prof.export(out)
+        import json
+        assert json.load(open(out))['dispatch']['calls'] == d['calls']
+
+
+class TestTier1Regression:
+    def test_eager_micro_bench_records_zero_retraces_after_warmup(self):
+        """Tier-1 gate for dispatch-cache regressions: the bench.py eager
+        micro-loop must be a pure cache-hit stream after warmup. Counter
+        assertion only — no wall-clock, no flakiness."""
+        import bench
+        res = bench.eager_mlp_loop(steps=3, warmup=2, use_cache=True)
+        assert res['retraces'] == 0, res
+        assert res['misses'] == 0, res
+        assert res['fallbacks'] == 0, res
+        assert res['hit_rate'] >= 0.9, res

@@ -76,7 +76,10 @@ class TestOffloadParity:
                   for v in [s]]
         assert leaves, 'no slot arrays'
         kinds = {getattr(v.sharding, 'memory_kind', None) for v in leaves}
-        assert kinds == {'pinned_host'}, kinds
+        # pinned_host on TPU; the CPU backend names its (only) host
+        # memory unpinned_host — ask the engine's own host sharding
+        from paddle_tpu.optimizer.offload import _host_sharding
+        assert kinds == {_host_sharding().memory_kind}, kinds
 
     def test_invalid_offload_value_rejected(self):
         with pytest.raises(ValueError):
