@@ -404,21 +404,29 @@ def _phase_decode():
 
 
 def eager_mlp_loop(steps=20, warmup=3, batch=32, in_dim=64, hidden=128,
-                   classes=10, use_cache=True):
+                   classes=10, use_cache=True, instrument=False):
     """Eager-dispatch micro-bench loop (also imported by the tier-1
     regression test): a plain DyGraph MLP train step — forward, CE loss,
     tape backward, eager SGD — with NO TrainStep jit, so every op rides
     `apply_op`. Returns wall-clock rates plus the dispatch-cache counter
     window covering only the post-warmup steps; with `use_cache` the
-    telemetry must show zero retraces there."""
+    telemetry must show zero retraces there.
+
+    `instrument=True` runs the SAME loop with the observability layer
+    active per step — a span around the step body plus StepTelemetry
+    updates — for the obs-overhead A/B (`bench.py obs` phase and the
+    tier-1 <3% overhead guard)."""
     import time as _t
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
     from paddle_tpu import debug as pdebug
+    from paddle_tpu import observability as obs
 
     was_enabled = pdebug.dispatch_stats()['enabled']
+    obs_was_enabled = obs.enabled()
+    obs.enable(instrument)
     pdebug.enable_dispatch_cache(use_cache)
     pdebug.clear_dispatch_cache()
     try:
@@ -441,13 +449,25 @@ def eager_mlp_loop(steps=20, warmup=3, batch=32, in_dim=64, hidden=128,
             opt.clear_grad()
             return loss
 
+        telemetry = obs.StepTelemetry(memory_every=10) if instrument \
+            else None
+
         for _ in range(warmup):
             loss = one_step()
         float(loss.numpy())                  # drain warmup dispatch
         pdebug.reset_dispatch_stats()
         t0 = _t.perf_counter()
-        for _ in range(steps):
-            loss = one_step()
+        if telemetry is not None:
+            # instrumented arm: span + per-step telemetry (loss is NOT
+            # synced per step — the A/B measures instrumentation cost,
+            # not a forced device round-trip)
+            for _ in range(steps):
+                with obs.span('bench.eager_step'):
+                    loss = one_step()
+                telemetry.step(tokens=batch)
+        else:
+            for _ in range(steps):
+                loss = one_step()
         final_loss = float(loss.numpy())     # sync
         dt = _t.perf_counter() - t0
         stats = pdebug.dispatch_stats()
@@ -465,6 +485,39 @@ def eager_mlp_loop(steps=20, warmup=3, batch=32, in_dim=64, hidden=128,
     finally:
         pdebug.enable_dispatch_cache(was_enabled)
         pdebug.clear_dispatch_cache()
+        obs.enable(obs_was_enabled)
+
+
+def obs_overhead_ab(steps=30, trials=3):
+    """A/B the eager MLP loop with observability instrumentation on vs
+    off (also imported by the tier-1 overhead guard). Takes the best
+    steps/sec of `trials` alternating runs per arm — min-noise on a
+    shared CPU — and reports the on/off overhead ratio."""
+    best_on = best_off = 0.0
+    for _ in range(trials):
+        off = eager_mlp_loop(steps=steps, instrument=False)
+        on = eager_mlp_loop(steps=steps, instrument=True)
+        best_off = max(best_off, off['steps_per_sec'])
+        best_on = max(best_on, on['steps_per_sec'])
+    overhead = best_off / best_on - 1 if best_on else float('inf')
+    return {
+        'instrumented_steps_per_sec': best_on,
+        'plain_steps_per_sec': best_off,
+        'overhead_ratio': round(best_off / best_on, 4) if best_on else 0.0,
+        'overhead_pct': round(overhead * 100, 2),
+    }
+
+
+def _phase_obs():
+    """Observability overhead phase: instrumentation on vs off on the
+    eager hot path; the JSON carries the measured ratio (the tier-1
+    guard pins it under 3% on CPU)."""
+    try:
+        return {'obs_overhead': obs_overhead_ab()}
+    except Exception as e:
+        print(f'# obs bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        return {'obs_overhead': {'error': type(e).__name__}}
 
 
 def _bench_eager_dispatch():
@@ -612,6 +665,7 @@ PHASES = {
     'fused_ce': _bench_fused_ce,
     'decode': _phase_decode,
     'eager': _bench_eager_dispatch,
+    'obs': _phase_obs,
 }
 
 
@@ -671,7 +725,8 @@ def main():
         if 'metric' not in out:
             raise RuntimeError(f'headline phase failed: {out}')
         out.update(_run_phase_subprocess('eager', 600))
-        print(json.dumps(out))  # CPU smoke: headline + eager micro-bench
+        out.update(_run_phase_subprocess('obs', 600))
+        print(json.dumps(out))  # CPU smoke: headline + eager/obs benches
         return 0
     # Measure the pallas CE kernel FIRST, then let the model phases use
     # whichever CE implementation actually won on this chip — the kernel
@@ -689,6 +744,7 @@ def main():
     out.update(_run_phase_subprocess('flash', 600))
     out.update(_run_phase_subprocess('decode', 900, model_env))
     out.update(_run_phase_subprocess('eager', 600))
+    out.update(_run_phase_subprocess('obs', 600))
     print(json.dumps(out))
     return 0
 

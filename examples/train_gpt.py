@@ -10,6 +10,7 @@ import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
+from paddle_tpu import debug, observability
 from paddle_tpu.jit import TrainStep
 from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
 
@@ -36,11 +37,18 @@ def main(steps=80, vocab=512, seq=64, batch=8):
         start = rng.randint(0, vocab - seq, (batch, 1))
         return (start + np.arange(seq)) % vocab
 
+    # per-step telemetry into the shared observability registry:
+    # steps/sec, tokens/sec, loss, device-memory watermark
+    telemetry = observability.StepTelemetry()
     for i in range(steps):
         ids = batch_ids()
         loss = step(ids, ids)
+        telemetry.step(loss=float(loss.numpy()), tokens=batch * seq)
         if i % 10 == 0 or i == steps - 1:
             print(f'step {i:3d}  loss {float(loss.numpy()):.4f}')
+    # one call reports dispatch hit-rate, jit compiles, comm/offload
+    # bytes, throughput, and memory — all from the single registry
+    print(debug.observability_summary())
     return float(loss.numpy())
 
 

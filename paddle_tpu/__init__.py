@@ -30,6 +30,7 @@ from . import vision
 from . import amp
 from . import utils
 from . import io
+from . import observability
 from . import profiler
 from . import debug
 from . import metric

@@ -13,6 +13,10 @@ python/paddle/amp/debugging.py + the fleet loss-spike monitor).
   eager dispatch cache's hit/miss/retrace/fallback counters
   (paddle_tpu._dispatch); `enable_dispatch_cache(False)` forces every op
   back onto the uncached slow path (A/B debugging, parity checks).
+- `observability_summary()` — the one-call report over the shared
+  observability registry: dispatch hit-rate, jit compile count/seconds,
+  per-axis collective calls + bytes, offload transfer bytes, step/token
+  throughput, memory watermark, and host-span timings.
 """
 from __future__ import annotations
 
@@ -26,6 +30,7 @@ import numpy as np
 
 from . import _dispatch
 from . import flags as _flags
+from . import observability as _obs
 from .tensor import Tensor
 
 
@@ -154,9 +159,78 @@ def dispatch_summary(max_rows: int = 15) -> str:
     return '\n'.join(lines)
 
 
+def observability_summary(max_rows: int = 10) -> str:
+    """One report over the single shared observability registry: where
+    this process's time, bytes, and compiles went (upstream: stitched
+    together by hand from paddle.profiler output + fleet worker logs).
+
+    Sections always print (zeros included) so tooling can grep fields:
+    dispatch hit-rate, jit compile count + seconds, per-(op, axis)
+    collective calls/bytes, offload H2D/D2H transfer bytes, step/token
+    throughput + last loss, device-memory watermark, and the hottest
+    host spans (RecordEvent regions + subsystem spans).
+    """
+    reg = _obs.get_registry()
+    snap = reg.snapshot()   # runs collectors (dispatch mirror) first
+    ds = _dispatch.stats()
+    lines = [f'observability summary (process {snap["process_index"]})',
+             f'  dispatch: {ds["calls"]} calls  '
+             f'hit_rate {ds["hit_rate"]:.1%}  ({ds["misses"]} misses, '
+             f'{ds["retraces"]} retraces, {ds["fallbacks"]} fallbacks, '
+             f'cache_size {ds["cache_size"]})',
+             f'  jit: {int(reg.value("paddle_jit_compiles_total"))} '
+             f'compiles  '
+             f'{reg.value("paddle_jit_compile_seconds_total"):.3f} s '
+             f'compile time  cache entries: '
+             f'{_jit_cache_entries(reg)}']
+    comm = _obs.collective_totals(reg)
+    lines.append(f'  collectives: {int(comm["calls"])} calls  '
+                 f'{int(comm["bytes"])} bytes')
+    for (op, axis), row in sorted(comm['per_op'].items())[:max_rows]:
+        lines.append(f'    {op:<16} axis={axis:<6} '
+                     f'{int(row["calls"]):>6} calls {int(row["bytes"]):>12} '
+                     f'bytes')
+    lines.append(
+        f'  offload: '
+        f'{int(reg.value("paddle_offload_h2d_bytes_total"))} H2D bytes  '
+        f'{int(reg.value("paddle_offload_d2h_bytes_total"))} D2H bytes')
+    lines.append(
+        f'  steps: {int(reg.value("paddle_steps_total"))} total  '
+        f'{reg.value("paddle_steps_per_sec"):.2f} steps/s  '
+        f'{reg.value("paddle_tokens_per_sec"):.1f} tokens/s  '
+        f'loss {reg.value("paddle_loss_last"):.4f}')
+    lines.append(
+        f'  memory: watermark '
+        f'{reg.value("paddle_memory_watermark_bytes") / 2**20:.1f} MiB')
+    spans = reg.get('paddle_span_seconds')
+    rows = []
+    if spans is not None:
+        rows = sorted(spans._children.items(),
+                      key=lambda kv: -kv[1].sum)[:max_rows]
+    lines.append(f'  host spans: {len(rows)} region(s), '
+                 f'event log {len(_obs.get_event_log())} events')
+    for key, child in rows:
+        avg_ms = child.sum / child.count * 1e3 if child.count else 0.0
+        lines.append(f'    {key[0]:<32} {child.count:>6} calls '
+                     f'{child.sum:>10.4f} s  avg {avg_ms:>8.2f} ms')
+    return '\n'.join(lines)
+
+
+def _jit_cache_entries(reg) -> int:
+    fam = reg.get('paddle_jit_cache_entries')
+    if fam is None:
+        return 0
+    return int(sum(c.value for c in fam._children.values()))
+
+
 class LossSpikeDetector:
     """Windowed spike detector: flags a step whose loss exceeds
-    mean + k*std of the trailing window, or is non-finite."""
+    mean + k*std of the trailing window, or is non-finite.
+
+    Flagged values are EXCLUDED from the trailing window — a spike (or a
+    level shift that registers as one) must not inflate its own baseline
+    mean/std, which would mask every subsequent spike. Each flagged step
+    also emits a `loss_spike` event into the observability EventLog."""
 
     def __init__(self, window: int = 20, threshold_sigma: float = 6.0,
                  min_steps: int = 5):
@@ -166,12 +240,17 @@ class LossSpikeDetector:
         self.spikes: List[int] = []
         self._step = 0
 
+    def _note_spike(self, value: float):
+        self.spikes.append(self._step)
+        _obs.emit('loss_spike', step=self._step, loss=value,
+                  window=len(self.window))
+
     def update(self, loss: float) -> bool:
         """Returns True if this step is a spike."""
         v = float(loss)
         self._step += 1
         if not math.isfinite(v):
-            self.spikes.append(self._step)
+            self._note_spike(v)
             return True
         spiked = False
         if len(self.window) >= self.min_steps:
@@ -181,6 +260,7 @@ class LossSpikeDetector:
             std = math.sqrt(var)
             if v > mean + self.k * max(std, 1e-12):
                 spiked = True
-                self.spikes.append(self._step)
-        self.window.append(v)
+                self._note_spike(v)
+        if not spiked:
+            self.window.append(v)
         return spiked

@@ -31,8 +31,30 @@ from jax import lax
 from ._compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import observability as _obs
 from ..tensor import Tensor
 from . import env
+
+
+def _note_collective(op: str, axis: str, v):
+    """Count one eager collective into the shared registry: per-(op,
+    axis) call and payload-byte counters (the host-side comm ledger a
+    fleet debug session reads next to the device trace). No-op while
+    observability is disabled."""
+    if not _obs.enabled():
+        return
+    try:
+        nbytes = int(np.prod(np.shape(v))) * np.dtype(v.dtype).itemsize
+    except Exception:
+        nbytes = 0
+    reg = _obs.get_registry()
+    labels = dict(op=op, axis=axis)
+    reg.counter('paddle_collective_calls_total',
+                'eager collective invocations',
+                ('op', 'axis')).labels(**labels).inc()
+    reg.counter('paddle_collective_bytes_total',
+                'eager collective payload bytes',
+                ('op', 'axis')).labels(**labels).inc(nbytes)
 
 
 class ReduceOp:
@@ -179,6 +201,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Sum (etc.) over ranks: out[r] = reduce_r' in[r']. In-place."""
     axis = _axis_of(group)
     v, mesh, spec = _stacked_shard(_val(tensor), axis)
+    _note_collective('all_reduce', axis, v)
     out = _all_reduce_fn(axis, op, v.ndim, mesh)(v)
     if isinstance(tensor, Tensor):
         tensor._data = out
@@ -194,6 +217,7 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
         tensor, tensor_list = tensor_list, None
     ax = _axis_of(group)
     v, mesh, spec = _stacked_shard(_val(tensor), ax)
+    _note_collective('all_gather', ax, v)
     out = jax.device_put(v, NamedSharding(mesh, P()))  # all-gather = replicate
     if tensor_list is not None:
         tensor_list.clear()
@@ -209,6 +233,7 @@ def reduce_scatter(output=None, input=None, op=ReduceOp.SUM, group=None,
         input, output = output, None
     ax = _axis_of(group)
     v, mesh, spec = _stacked_shard(_val(input), ax)
+    _note_collective('reduce_scatter', ax, v)
     out = _coll_fn('reduce_scatter', ax, v.ndim, mesh)(v)
     if output is not None and isinstance(output, Tensor):
         output._data = out
@@ -221,6 +246,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     """out[r] = in[src] for all r. In-place."""
     ax = _axis_of(group)
     v, mesh, spec = _stacked_shard(_val(tensor), ax)
+    _note_collective('broadcast', ax, v)
     out = _coll_fn('broadcast', ax, v.ndim, mesh, extra=src)(v)
     if isinstance(tensor, Tensor):
         tensor._data = out
@@ -234,6 +260,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     leaves non-dst buffers unspecified; we keep them unchanged)."""
     ax = _axis_of(group)
     v, mesh, spec = _stacked_shard(_val(tensor), ax)
+    _note_collective('reduce', ax, v)
     reduced = _all_reduce_fn(ax, op, v.ndim, mesh)(v)
     idx = jnp.arange(v.shape[0]).reshape((-1,) + (1,) * (v.ndim - 1))
     out = jnp.where(idx == dst, reduced, v)
@@ -254,6 +281,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         stacked = _val(tensor)
     mesh = env.get_mesh()
     spec = P(ax, *([None] * (stacked.ndim - 1)))
+    _note_collective('scatter', ax, stacked)
     out = jax.device_put(stacked, NamedSharding(mesh, spec))
     if isinstance(tensor, Tensor):
         tensor._data = out if tensor_list is None else out
@@ -274,6 +302,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     else:
         v = _val(in_tensor_list)
     v, mesh, spec = _stacked_shard(v, ax)
+    _note_collective('alltoall', ax, v)
     out = _coll_fn('alltoall', ax, v.ndim, mesh)(v)
     if isinstance(out_tensor_list, list):
         out_tensor_list.clear()
@@ -343,6 +372,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
     t, dst, g = _pending_sends.pop(i)
     ax = _axis_of(g if g is not None else group)
     v, mesh, spec = _stacked_shard(_val(t), ax)
+    _note_collective('send_recv', ax, v)
     out = _coll_fn('ppermute', ax, v.ndim, mesh, extra=((src, dst),))(v)
     if isinstance(tensor, Tensor):
         # only dst's slice is defined; others zero (ppermute semantics)
@@ -392,6 +422,7 @@ def batch_isend_irecv(p2p_op_list):
     outs = []
     for o in sends:
         v, mesh, spec = _stacked_shard(_val(o.tensor), ax)
+        _note_collective('batch_p2p', ax, v)
         outs.append(_coll_fn('ppermute', ax, v.ndim, mesh, extra=perm)(v))
     for o, out in zip(recvs, outs):
         if isinstance(o.tensor, Tensor):
@@ -406,6 +437,7 @@ def barrier(group=None):
     token = jnp.zeros((mesh.size,), jnp.int32)
     ax = mesh.axis_names[0] if len(mesh.axis_names) == 1 else None
     if ax is not None:
+        _note_collective('barrier', ax, token)
         token = _all_reduce_fn(ax, ReduceOp.SUM, 1, mesh)(
             jax.device_put(token, NamedSharding(mesh, P(ax))))
     jax.block_until_ready(token)
@@ -423,6 +455,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     slices."""
     ax = _axis_of(group)
     v, mesh, spec = _stacked_shard(_val(tensor), ax)
+    _note_collective('gather', ax, v)
     out = jax.device_put(v, NamedSharding(mesh, P()))
     if gather_list is not None:
         gather_list.clear()

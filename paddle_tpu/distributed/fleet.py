@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import framework
+from .. import observability as _obs
 from ..jit import TrainStep, functional_call, functional_state
 from ..nn.layer import Layer
 from ..tensor import Tensor
@@ -136,6 +137,18 @@ class _Fleet:
         env.set_mesh(mesh)
         self._hcg = HybridCommunicateGroup(mesh)
         self.initialized = True
+        if _obs.enabled():
+            # record the topology so a registry snapshot identifies the
+            # mesh this host is driving (and tags it with process_index)
+            reg = _obs.get_registry()
+            for ax, size in mesh.shape.items():
+                reg.gauge('paddle_fleet_mesh_axis_size',
+                          'hybrid mesh axis sizes',
+                          ('axis',)).labels(axis=ax).set(size)
+            reg.gauge('paddle_fleet_process_count',
+                      'participating host processes').set(
+                          jax.process_count())
+            _obs.emit('fleet_init', mesh=dict(mesh.shape))
         return self
 
     def get_hybrid_communicate_group(self):
@@ -580,8 +593,22 @@ class DistTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         batch = (shard_batch(inputs, mesh=self.mesh),
                  shard_batch(labels, mesh=self.mesh))
-        loss, new_params, self._opt_state, new_bufs = self._jitted(
-            params, self._opt_state, buffers, frozen, key, lr, batch)
+        if _obs.enabled():
+            # per-step comm ledger: inside the jitted step GSPMD owns the
+            # collectives, so the host-side view counts the dp-sharded
+            # batch bytes entering the mesh each step
+            batch_bytes = sum(
+                int(np.prod(np.shape(v))) * np.dtype(v.dtype).itemsize
+                for v in _tree.tree_leaves(batch))
+            reg = _obs.get_registry()
+            reg.counter('paddle_fleet_steps_total',
+                        'DistTrainStep invocations').inc()
+            reg.counter('paddle_fleet_batch_bytes_total',
+                        'bytes of batch data sharded onto the mesh').inc(
+                            batch_bytes)
+        with _obs.span('fleet.dist_train_step', step=self._n_calls - 1):
+            loss, new_params, self._opt_state, new_bufs = self._jitted(
+                params, self._opt_state, buffers, frozen, key, lr, batch)
         pmap = dict(self.layer.named_parameters())
         for n, v in new_params.items():
             pmap[n]._data = v

@@ -52,6 +52,26 @@ def recompute(function, *args, **kwargs):
     return Tensor(out)
 
 
+def gather_registry(group=None, registry=None):
+    """Gather every host's observability-registry snapshot over the
+    existing collectives and merge them into one fleet view (upstream
+    analogue: fleet workers pushing per-rank metrics to the PS/ETCD
+    master).
+
+    Each snapshot is tagged with its host's process_index;
+    `observability.merge_snapshots` dedupes by that tag (a
+    single-controller all_gather_object returns world-size copies of
+    the one local snapshot), sums counters/histograms across distinct
+    hosts, and takes the max of gauges (fleet-wide watermarks).
+    """
+    from .. import observability as obs
+    from . import collective
+    snap = (registry or obs.get_registry()).snapshot()
+    snaps: list = []
+    collective.all_gather_object(snaps, snap, group=group)
+    return obs.merge_snapshots(snaps)
+
+
 def global_scatter(x, local_count, global_count, group=None):
     raise NotImplementedError(
         'global_scatter/global_gather are the reference MoE dispatch '

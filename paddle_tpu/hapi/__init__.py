@@ -22,12 +22,12 @@ from ..nn.layer import Layer
 from ..tensor import Tensor
 from . import callbacks as callbacks_mod
 from .callbacks import (Callback, CallbackList, EarlyStopping,
-                        ReduceLROnPlateau,
+                        ReduceLROnPlateau, MetricsLoggerCallback,
                         LRSchedulerCallback, ModelCheckpoint, ProgBarLogger,
                         VisualDL)
 
 __all__ = ['Model', 'Callback', 'EarlyStopping', 'LRSchedulerCallback',
-           'ReduceLROnPlateau',
+           'ReduceLROnPlateau', 'MetricsLoggerCallback',
            'ModelCheckpoint', 'ProgBarLogger', 'VisualDL', 'callbacks_mod']
 
 

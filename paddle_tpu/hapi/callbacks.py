@@ -236,6 +236,64 @@ class VisualDL(Callback):
             self._writer.close()
 
 
+class MetricsLoggerCallback(Callback):
+    """Streams per-step train metrics into the shared observability
+    registry via StepTelemetry (steps/sec, tokens/sec, last loss,
+    device-memory watermark) and flags divergence with a
+    debug.LossSpikeDetector whose hits land in the EventLog as
+    `loss_spike` events.
+
+    `tokens_per_batch` sets the token increment per optimizer step (e.g.
+    batch_size * seq_len for an LM); when None only step rates are
+    tracked. `log_dir` additionally appends registry JSONL exports every
+    `export_freq` steps for plain-file tailing.
+    """
+
+    def __init__(self, tokens_per_batch: Optional[int] = None,
+                 log_dir: Optional[str] = None, export_freq: int = 100,
+                 spike_window: int = 20):
+        super().__init__()
+        self.tokens_per_batch = tokens_per_batch
+        self.log_dir = log_dir
+        self.export_freq = max(int(export_freq), 1)
+        self._spike_window = spike_window
+        self._telemetry = None
+        self._spikes = None
+        self._n = 0
+
+    @property
+    def telemetry(self):
+        if self._telemetry is None:
+            from .. import observability as obs
+            self._telemetry = obs.StepTelemetry()
+        return self._telemetry
+
+    def on_train_begin(self, logs=None):
+        from ..debug import LossSpikeDetector
+        self._spikes = LossSpikeDetector(window=self._spike_window)
+        self.telemetry
+
+    def on_train_batch_end(self, step, logs=None):
+        loss = (logs or {}).get('loss')
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0] if loss else None
+        self.telemetry.step(loss=loss, tokens=self.tokens_per_batch)
+        if loss is not None and self._spikes is not None:
+            self._spikes.update(loss)
+        self._n += 1
+        if self.log_dir and self._n % self.export_freq == 0:
+            self._export()
+
+    def on_train_end(self, logs=None):
+        if self.log_dir:
+            self._export()
+
+    def _export(self):
+        from .. import observability as obs
+        os.makedirs(self.log_dir, exist_ok=True)
+        obs.to_jsonl(path=os.path.join(self.log_dir, 'metrics.jsonl'))
+
+
 # upstream name parity: paddle.callbacks.LRScheduler
 # (python/paddle/hapi/callbacks.py exposes the class under this name)
 LRScheduler = LRSchedulerCallback

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import autograd, framework
+from .. import observability as _obs
 from ..nn.layer import Layer
 from ..tensor import Tensor
 
@@ -170,6 +171,10 @@ class StaticLayer:
                     is_leaf=lambda t: isinstance(t, Tensor))
         f = jax.jit(fn)
         self._jit_cache[key] = f
+        # executable-cache telemetry: compile count/seconds ride the
+        # jax.monitoring listeners (observability.telemetry); the
+        # python-side cache growth is recorded here
+        _obs.note_jit_cache_entry('to_static')
         return f
 
     def __call__(self, *args, **kwargs):
@@ -233,6 +238,7 @@ class TrainStep:
 
         def loss_and_grads(params, buffers, frozen, key, batch):
             self.compile_count += 1  # python-level: counts traces, not runs
+            _obs.note_jit_cache_entry('train_step')  # one entry per trace
 
             def loss_of(pv):
                 inputs, labels = batch

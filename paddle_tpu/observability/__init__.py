@@ -1,0 +1,43 @@
+"""paddle_tpu.observability — unified metrics + tracing layer.
+
+One process-wide `MetricsRegistry` (labeled Counter/Gauge/Histogram),
+one bounded `EventLog` of real-timestamped spans/events, and three
+exporters (Prometheus text, JSONL, chrome-trace). Every subsystem
+reports here — eager dispatch cache (via a scrape-time collector), jit
+compiles (jax.monitoring listeners), eager collectives (per-axis
+call/byte counters), optimizer host-offload (H2D/D2H bytes), and hapi
+train loops (StepTelemetry) — so `debug.observability_summary()` or a
+single export answers "where did this step's time, bytes, and compiles
+go". Upstream Paddle scatters these across paddle.profiler,
+FLAGS_check_nan_inf, and per-worker fleet logs; MegaScale
+(arXiv:2402.15627) is the reference for why one substrate matters at
+pod scale.
+
+Multi-host: every exported sample is tagged with the host's
+process_index; `distributed.fleet_utils.gather_registry()` merges
+per-host snapshots over the existing collectives.
+"""
+from __future__ import annotations
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      DEFAULT_BUCKETS, enable, enabled, disable,
+                      get_registry, merge_snapshots)
+from .events import EventLog, Span, emit, get_event_log, span
+from .exporters import (read_jsonl, to_chrome_trace, to_jsonl,
+                        to_prometheus_text)
+from .telemetry import (StepTelemetry, collective_totals,
+                        device_memory_bytes, install,
+                        note_jit_cache_entry)
+
+__all__ = [
+    'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'DEFAULT_BUCKETS',
+    'enable', 'enabled', 'disable', 'get_registry', 'merge_snapshots',
+    'EventLog', 'Span', 'emit', 'get_event_log', 'span',
+    'read_jsonl', 'to_chrome_trace', 'to_jsonl', 'to_prometheus_text',
+    'StepTelemetry', 'collective_totals', 'device_memory_bytes',
+    'install', 'note_jit_cache_entry',
+]
+
+# register the jax.monitoring listeners + dispatch collector once at
+# import; all hooks are no-ops while observability is disabled
+install()

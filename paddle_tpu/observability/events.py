@@ -1,0 +1,154 @@
+"""Structured event log + span tracing with real timestamps.
+
+Upstream analogue: paddle.profiler's RecordEvent host regions and the
+fleet loss-spike logs — here unified as one bounded in-process
+`EventLog` of JSON-able events carrying *actual* begin timestamps and
+durations (not fabricated running sums), so the chrome-trace export is a
+true timeline and JSONL tailing works for long fleet runs.
+
+`span(name, **attrs)` is the tracing API every subsystem uses: a context
+manager that records perf_counter begin/end, nesting depth, and thread
+id into the event log and a `paddle_span_seconds{name}` histogram in the
+metrics registry. `emit(name, **attrs)` records an instant event (e.g.
+`loss_spike` from debug.LossSpikeDetector).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+
+# one process-wide clock origin so event timestamps from every thread /
+# subsystem land on a single comparable timeline
+_EPOCH = time.perf_counter()
+
+
+def _now() -> float:
+    return time.perf_counter() - _EPOCH
+
+
+class EventLog:
+    """Bounded, thread-safe ring of structured events (oldest dropped)."""
+
+    def __init__(self, capacity: int = 4096):
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def append(self, event: Dict[str, Any]):
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(event)
+
+    def emit(self, name: str, **attrs):
+        """Record an instant (zero-duration) event at the current time."""
+        if not _metrics.enabled():
+            return
+        self.append({'name': name, 'ph': 'i', 'ts': _now(),
+                     'tid': threading.get_ident(), 'attrs': attrs})
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    def __len__(self):
+        return len(self._events)
+
+    def to_jsonl(self, path: Optional[str] = None) -> str:
+        text = '\n'.join(json.dumps(e) for e in self.events())
+        if text:
+            text += '\n'
+        if path is not None:
+            with open(path, 'w') as f:
+                f.write(text)
+        return text
+
+    def to_chrome_trace(self, path: Optional[str] = None) -> Dict[str, Any]:
+        from .exporters import to_chrome_trace
+        return to_chrome_trace(self, path)
+
+
+_default_log = EventLog()
+
+
+def get_event_log() -> EventLog:
+    return _default_log
+
+
+def emit(name: str, **attrs):
+    _default_log.emit(name, **attrs)
+
+
+class _SpanState(threading.local):
+    def __init__(self):
+        self.depth = 0
+
+
+_span_state = _SpanState()
+
+
+class Span:
+    """Timed region recorded into the EventLog + span histogram. Nestable;
+    usable as a context manager or via explicit begin()/end()."""
+
+    __slots__ = ('name', 'attrs', '_t0', '_log', '_active')
+
+    def __init__(self, name: str, _log: Optional[EventLog] = None, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self._log = _log or _default_log
+        self._t0 = 0.0
+        self._active = False
+
+    def begin(self) -> 'Span':
+        self._active = _metrics.enabled()
+        if self._active:
+            _span_state.depth += 1
+            self._t0 = _now()
+        return self
+
+    def end(self):
+        if not self._active:
+            return
+        self._active = False
+        dur = _now() - self._t0
+        depth = _span_state.depth
+        _span_state.depth -= 1
+        ev = {'name': self.name, 'ph': 'X', 'ts': self._t0, 'dur': dur,
+              'tid': threading.get_ident(), 'depth': depth}
+        if self.attrs:
+            ev['attrs'] = self.attrs
+        self._log.append(ev)
+        _metrics.get_registry().histogram(
+            'paddle_span_seconds', 'span(name) wall time',
+            ('name',)).labels(name=self.name).observe(dur)
+
+    def __enter__(self) -> 'Span':
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def span(name: str, **attrs) -> Span:
+    """`with span('fleet.dist_train_step', step=i): ...` — records a real
+    begin/end timestamped event and a duration histogram sample."""
+    return Span(name, **attrs)

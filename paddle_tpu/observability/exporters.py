@@ -1,0 +1,129 @@
+"""Exporters: Prometheus text, JSONL, chrome://tracing JSON.
+
+One registry + one event log, three standard surfaces: a Prometheus
+scrape body for fleet dashboards, JSONL lines for plain-file tailing
+(the same format utils.logging.SummaryWriter writes), and a
+chrome-trace with TRUE per-event begin timestamps and durations
+(consumable by Perfetto/chrome://tracing next to the device-side trace
+jax.profiler writes).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+
+def _fmt_labels(labels: Dict[str, str], extra: Dict[str, str]) -> str:
+    all_labels = {**labels, **extra}
+    if not all_labels:
+        return ''
+    body = ','.join(f'{k}="{_escape(str(v))}"'
+                    for k, v in sorted(all_labels.items()))
+    return '{' + body + '}'
+
+
+def _escape(v: str) -> str:
+    return v.replace('\\', '\\\\').replace('"', '\\"').replace('\n', '\\n')
+
+
+def _num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def to_prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Prometheus exposition format (text/plain; version 0.0.4). Every
+    sample carries a `process` label with the host's process index so
+    multi-host scrapes aggregate cleanly."""
+    registry = registry or get_registry()
+    snap = registry.snapshot()
+    proc = {'process': str(snap['process_index'])}
+    lines = []
+    for m in snap['metrics']:
+        name = m['name']
+        lines.append(f'# HELP {name} {_escape(m["help"])}')
+        lines.append(f'# TYPE {name} {m["type"]}')
+        for s in m['samples']:
+            if m['type'] == 'histogram':
+                for bound, count in s['buckets'].items():
+                    lines.append(
+                        f'{name}_bucket'
+                        f'{_fmt_labels(s["labels"], {**proc, "le": bound})}'
+                        f' {count}')
+                lines.append(f'{name}_sum{_fmt_labels(s["labels"], proc)}'
+                             f' {_num(s["sum"])}')
+                lines.append(f'{name}_count{_fmt_labels(s["labels"], proc)}'
+                             f' {s["count"]}')
+            else:
+                lines.append(f'{name}{_fmt_labels(s["labels"], proc)}'
+                             f' {_num(s["value"])}')
+    return '\n'.join(lines) + '\n'
+
+
+def to_jsonl(registry: Optional[MetricsRegistry] = None,
+             path: Optional[str] = None) -> str:
+    """One JSON line per sample: {name, type, labels, process, value |
+    sum/count/buckets} — the plain-file surface per-host fleet logs use."""
+    registry = registry or get_registry()
+    snap = registry.snapshot()
+    lines = []
+    for m in snap['metrics']:
+        for s in m['samples']:
+            rec = {'name': m['name'], 'type': m['type'],
+                   'labels': s['labels'],
+                   'process': snap['process_index']}
+            if m['type'] == 'histogram':
+                rec.update(sum=s['sum'], count=s['count'],
+                           buckets=s['buckets'])
+            else:
+                rec['value'] = s['value']
+            lines.append(json.dumps(rec))
+    text = '\n'.join(lines)
+    if text:
+        text += '\n'
+    if path is not None:
+        with open(path, 'w') as f:
+            f.write(text)
+    return text
+
+
+def read_jsonl(text_or_path: str):
+    """Parse a to_jsonl export back into records (path or raw text)."""
+    if '\n' not in text_or_path and not text_or_path.lstrip().startswith(
+            '{'):
+        with open(text_or_path) as f:
+            text = f.read()
+    else:
+        text = text_or_path
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def to_chrome_trace(event_log=None, path: Optional[str] = None
+                    ) -> Dict[str, Any]:
+    """chrome://tracing JSON built from the EventLog's REAL timestamps:
+    each span becomes a complete ('X') event at its actual begin time
+    with its actual duration; instant events ('i') keep their timestamp.
+    Timestamps are microseconds on the process-wide span clock."""
+    from .events import get_event_log
+    event_log = event_log or get_event_log()
+    trace_events = []
+    for e in event_log.events():
+        out = {'name': e['name'], 'ph': e.get('ph', 'X'), 'pid': 0,
+               'tid': e.get('tid', 0), 'ts': int(e['ts'] * 1e6)}
+        if out['ph'] == 'X':
+            out['dur'] = int(e.get('dur', 0.0) * 1e6)
+        if out['ph'] == 'i':
+            out['s'] = 't'   # instant scope: thread
+        args = dict(e.get('attrs') or {})
+        if 'depth' in e:
+            args['depth'] = e['depth']
+        if args:
+            out['args'] = args
+        trace_events.append(out)
+    doc = {'traceEvents': trace_events, 'displayTimeUnit': 'ms'}
+    if path is not None:
+        with open(path, 'w') as f:
+            json.dump(doc, f)
+    return doc

@@ -1,0 +1,385 @@
+"""Thread-safe metrics registry: labeled Counter / Gauge / Histogram.
+
+Upstream Paddle scatters runtime telemetry across paddle.profiler
+summaries, FLAGS_check_nan_inf prints, and fleet's per-worker logs. The
+TPU-native framework centralizes all of it in ONE process-wide
+`MetricsRegistry` that every subsystem reports into (dispatch cache, jit
+compiles, collectives, optimizer offload, hapi step telemetry), so a
+single snapshot/export answers "where did this step's time, bytes, and
+compiles go" — the MegaScale-style observability substrate
+(arXiv:2402.15627) the ROADMAP's pod-scale north star assumes.
+
+Design rules:
+- Hot paths never pay for observability: per-op counters (the eager
+  dispatch cache) stay raw ints in their own module and flow into the
+  registry through *collectors* — callbacks run at snapshot/export time,
+  not per event. Direct metric writes are reserved for per-step /
+  per-collective / per-compile frequency events.
+- Metric families are create-or-get by name (idempotent), children are
+  create-or-get by label values, and every mutation takes one registry
+  RLock — cheap at the rates we write, safe under DataLoader workers.
+- `snapshot()` is plain data (JSON-able) and carries the host's
+  process_index so multi-host fleets can gather and merge registries
+  over the existing collectives (fleet_utils.gather_registry).
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .. import flags as _flags
+
+_flags.register_flag('FLAGS_observability', True)
+
+_enabled = [bool(_flags.flag('FLAGS_observability'))]
+
+
+def enabled() -> bool:
+    """Fast global gate consulted by instrumented call sites."""
+    return _enabled[0]
+
+
+def enable(on: bool = True):
+    """Toggle direct metric writes (spans, step telemetry, collective /
+    offload / compile counters). Collectors still report at snapshot
+    time — they read state that exists anyway."""
+    _enabled[0] = bool(on)
+    _flags.set_flags({'FLAGS_observability': bool(on)})
+
+
+def disable():
+    enable(False)
+
+
+# latency-shaped default buckets (seconds), Prometheus-style
+DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0,
+                   5.0, 10.0, 60.0)
+
+
+def _label_key(labelnames: Sequence[str], labels: Dict[str, Any]
+               ) -> Tuple[str, ...]:
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f'expected labels {tuple(labelnames)}, got {tuple(labels)}')
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+class Counter:
+    """Monotonically increasing value (one child of a family)."""
+
+    __slots__ = ('_family', '_labels', 'value')
+
+    def __init__(self, family, labels: Tuple[str, ...]):
+        self._family = family
+        self._labels = labels
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        if amount < 0:
+            raise ValueError(f'counters only go up; inc({amount})')
+        with self._family._registry._lock:
+            self.value += amount
+        return self
+
+
+class Gauge:
+    """Point-in-time value (one child of a family)."""
+
+    __slots__ = ('_family', '_labels', 'value')
+
+    def __init__(self, family, labels: Tuple[str, ...]):
+        self._family = family
+        self._labels = labels
+        self.value = 0.0
+
+    def set(self, value: float):
+        with self._family._registry._lock:
+            self.value = float(value)
+        return self
+
+    def inc(self, amount: float = 1.0):
+        with self._family._registry._lock:
+            self.value += amount
+        return self
+
+    def dec(self, amount: float = 1.0):
+        return self.inc(-amount)
+
+    def set_to_max(self, value: float):
+        """Watermark update: keep the max of the current and new value."""
+        with self._family._registry._lock:
+            if value > self.value:
+                self.value = float(value)
+        return self
+
+
+class Histogram:
+    """Cumulative-bucket distribution (one child of a family)."""
+
+    __slots__ = ('_family', '_labels', 'bucket_counts', 'sum', 'count')
+
+    def __init__(self, family, labels: Tuple[str, ...]):
+        self._family = family
+        self._labels = labels
+        self.bucket_counts = [0] * (len(family.buckets) + 1)  # +inf last
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float):
+        v = float(value)
+        with self._family._registry._lock:
+            self.bucket_counts[bisect.bisect_left(
+                self._family.buckets, v)] += 1
+            self.sum += v
+            self.count += 1
+        return self
+
+
+_CHILD_TYPES = {'counter': Counter, 'gauge': Gauge, 'histogram': Histogram}
+
+
+class _Family:
+    """One named metric: a set of children keyed by label values. With no
+    labelnames the family proxies its single child, so
+    `reg.counter('x').inc()` works without a labels() hop."""
+
+    def __init__(self, registry: 'MetricsRegistry', name: str, typ: str,
+                 help: str, labelnames: Tuple[str, ...],
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self._registry = registry
+        self.name = name
+        self.type = typ
+        self.help = help
+        self.labelnames = labelnames
+        if typ == 'histogram':
+            self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._children: Dict[Tuple[str, ...], Any] = {}
+        if not labelnames:
+            self._children[()] = _CHILD_TYPES[typ](self, ())
+
+    def labels(self, **labels):
+        key = _label_key(self.labelnames, labels)
+        with self._registry._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = _CHILD_TYPES[self.type](
+                    self, key)
+        return child
+
+    def _sole(self):
+        if self.labelnames:
+            raise ValueError(
+                f'{self.name} is labeled {self.labelnames}; use .labels()')
+        return self._children[()]
+
+    # unlabeled convenience proxies
+    def inc(self, amount: float = 1.0):
+        return self._sole().inc(amount)
+
+    def set(self, value: float):
+        return self._sole().set(value)
+
+    def dec(self, amount: float = 1.0):
+        return self._sole().dec(amount)
+
+    def set_to_max(self, value: float):
+        return self._sole().set_to_max(value)
+
+    def observe(self, value: float):
+        return self._sole().observe(value)
+
+    @property
+    def value(self):
+        return self._sole().value
+
+    @property
+    def count(self):
+        return self._sole().count
+
+    @property
+    def sum(self):
+        return self._sole().sum
+
+
+class MetricsRegistry:
+    def __init__(self, process_index: Optional[int] = None):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+        self._collectors: List[Callable[['MetricsRegistry'], None]] = []
+        self._process_index = process_index
+        self._in_collect = False
+
+    # -- family constructors (create-or-get, conflict-checked) --------------
+    def _family(self, name, typ, help, labelnames, buckets=None):
+        labelnames = tuple(labelnames)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(
+                    self, name, typ, help, labelnames, buckets)
+            elif fam.type != typ or fam.labelnames != labelnames:
+                raise ValueError(
+                    f'metric {name!r} already registered as {fam.type}'
+                    f'{fam.labelnames}; asked for {typ}{labelnames}')
+            return fam
+
+    def counter(self, name, help: str = '', labelnames: Sequence[str] = ()):
+        return self._family(name, 'counter', help, labelnames)
+
+    def gauge(self, name, help: str = '', labelnames: Sequence[str] = ()):
+        return self._family(name, 'gauge', help, labelnames)
+
+    def histogram(self, name, help: str = '',
+                  labelnames: Sequence[str] = (), buckets=None):
+        return self._family(name, 'histogram', help, labelnames, buckets)
+
+    # -- collectors ----------------------------------------------------------
+    def register_collector(self, fn: Callable[['MetricsRegistry'], None]):
+        """`fn(registry)` runs at snapshot/export time to sync state that
+        is kept outside the registry (e.g. the dispatch cache's raw
+        counters) into registry metrics — the zero-hot-path-cost path."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+        return fn
+
+    def _collect(self):
+        with self._lock:
+            if self._in_collect:   # a collector snapshotting would recurse
+                return
+            self._in_collect = True
+            try:
+                for fn in list(self._collectors):
+                    try:
+                        fn(self)
+                    except Exception:
+                        pass   # a broken collector must not kill a scrape
+            finally:
+                self._in_collect = False
+
+    # -- introspection -------------------------------------------------------
+    def process_index(self) -> int:
+        if self._process_index is not None:
+            return self._process_index
+        try:
+            import jax
+            return int(jax.process_index())
+        except Exception:
+            return 0
+
+    def get(self, name) -> Optional[_Family]:
+        return self._families.get(name)
+
+    def value(self, name, default=0.0, **labels) -> float:
+        """Read one sample's current value (counters/gauges); collectors
+        are NOT run — pair with snapshot() for collected reads."""
+        fam = self._families.get(name)
+        if fam is None:
+            return default
+        key = _label_key(fam.labelnames, labels) if labels else ()
+        child = fam._children.get(key)
+        return default if child is None else child.value
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Collected, JSON-able view of every metric."""
+        self._collect()
+        with self._lock:
+            metrics = []
+            for fam in self._families.values():
+                samples = []
+                for key, child in fam._children.items():
+                    labels = dict(zip(fam.labelnames, key))
+                    if fam.type == 'histogram':
+                        samples.append({
+                            'labels': labels, 'sum': child.sum,
+                            'count': child.count,
+                            'buckets': dict(zip(
+                                [str(b) for b in fam.buckets] + ['+Inf'],
+                                _cumulate(child.bucket_counts)))})
+                    else:
+                        samples.append({'labels': labels,
+                                        'value': child.value})
+                entry = {'name': fam.name, 'type': fam.type,
+                         'help': fam.help, 'samples': samples}
+                if fam.type == 'histogram':
+                    entry['bucket_bounds'] = list(fam.buckets)
+                metrics.append(entry)
+            return {'process_index': self.process_index(),
+                    'metrics': metrics}
+
+    def reset(self):
+        """Zero every value (families and children survive) — opens a
+        clean measurement window without re-plumbing instrument sites."""
+        with self._lock:
+            for fam in self._families.values():
+                for child in fam._children.values():
+                    if fam.type == 'histogram':
+                        child.bucket_counts = [0] * len(child.bucket_counts)
+                        child.sum = 0.0
+                        child.count = 0
+                    else:
+                        child.value = 0.0
+
+    # exporters live in observability.exporters; bound here for ergonomics
+    def to_prometheus_text(self) -> str:
+        from .exporters import to_prometheus_text
+        return to_prometheus_text(self)
+
+    def to_jsonl(self, path: Optional[str] = None) -> str:
+        from .exporters import to_jsonl
+        return to_jsonl(self, path)
+
+
+def _cumulate(bucket_counts: List[int]) -> List[int]:
+    out, acc = [], 0
+    for c in bucket_counts:
+        acc += c
+        out.append(acc)
+    return out
+
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-host registry snapshots into one fleet view.
+
+    Snapshots are deduped by process_index first (all_gather_object on a
+    single-controller mesh returns world-size copies of the one local
+    snapshot — merging those must not multiply counters). Counters and
+    histogram sums/counts add across hosts; gauges take the max (the
+    fleet-wide watermark reading).
+    """
+    by_proc: Dict[int, Dict[str, Any]] = {}
+    for s in snapshots:
+        by_proc.setdefault(int(s.get('process_index', 0)), s)
+    merged: Dict[str, Dict[str, Any]] = {}
+    for snap in by_proc.values():
+        for m in snap.get('metrics', []):
+            tgt = merged.setdefault(m['name'], {
+                'name': m['name'], 'type': m['type'], 'help': m['help'],
+                'samples': {}})
+            for s in m['samples']:
+                key = tuple(sorted(s['labels'].items()))
+                cur = tgt['samples'].get(key)
+                if cur is None:
+                    tgt['samples'][key] = {k: (dict(v) if isinstance(v, dict)
+                                               else v)
+                                           for k, v in s.items()}
+                elif m['type'] == 'counter':
+                    cur['value'] += s['value']
+                elif m['type'] == 'gauge':
+                    cur['value'] = max(cur['value'], s['value'])
+                else:
+                    cur['sum'] += s['sum']
+                    cur['count'] += s['count']
+                    for b, c in s['buckets'].items():
+                        cur['buckets'][b] = cur['buckets'].get(b, 0) + c
+    return {'processes': sorted(by_proc),
+            'metrics': [{**m, 'samples': list(m['samples'].values())}
+                        for m in merged.values()]}

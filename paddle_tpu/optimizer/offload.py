@@ -23,7 +23,24 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import SingleDeviceSharding
 
+from .. import observability as _obs
+
 _tree = jax.tree_util
+
+
+def _slot_bytes(slots: Dict[str, Any]) -> int:
+    return sum(int(v.size) * v.dtype.itemsize for v in slots.values())
+
+
+def _note_transfer(direction: str, nbytes: int):
+    """H2D/D2H ledger for the streamed optimizer slots — the number that
+    tells you whether offload's PCIe traffic is hiding under compute or
+    dominating the step. No-op while observability is disabled."""
+    if not _obs.enabled() or not nbytes:
+        return
+    _obs.get_registry().counter(
+        f'paddle_offload_{direction}_bytes_total',
+        f'optimizer-slot {direction.upper()} transfer bytes').inc(nbytes)
 
 
 def _host_sharding(device=None):
@@ -105,6 +122,7 @@ class OffloadEngine:
             if flat_g[i] is not None:
                 staged[i] = {k: jax.device_put(v, self._dev)
                              for k, v in flat_s[i].items()}
+                _note_transfer('h2d', _slot_bytes(flat_s[i]))
         if n:
             fetch(0)
         new_p, new_s = [], []
@@ -122,6 +140,7 @@ class OffloadEngine:
             new_p.append(np_)
             new_s.append({k: jax.device_put(v, self._host)
                           for k, v in ns_.items()})
+            _note_transfer('d2h', _slot_bytes(new_s[-1]))
         return (_tree.tree_unflatten(treedef, new_p),
                 {'step': step,
                  'slots': _tree.tree_unflatten(treedef, new_s)})

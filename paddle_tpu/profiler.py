@@ -10,6 +10,12 @@ Eager dispatch telemetry: every profile window also snapshots the
 dispatch cache's hit/miss/retrace/fallback counters
 (paddle_tpu._dispatch) so `summary()`/`export()` report how much of the
 profiled region ran through cached executables vs Python re-tracing.
+
+Observability: `RecordEvent` regions record REAL begin timestamps and
+durations (per event, not a per-name running sum), feed the shared
+observability EventLog/registry, and `summary()`/`export()` fold in the
+registry's jit-compile, collective-bytes, and memory-watermark metrics
+— the profiler and `debug.observability_summary()` read one substrate.
 """
 from __future__ import annotations
 
@@ -19,11 +25,12 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 
 from . import _dispatch
+from . import observability as _obs
 
 
 _DISPATCH_KEYS = ('hits', 'misses', 'retraces', 'fallbacks', 'calls')
@@ -46,22 +53,37 @@ class _HostTimer(threading.local):
         self.stack: List = []
         self.totals: Dict[str, float] = collections.defaultdict(float)
         self.counts: Dict[str, int] = collections.defaultdict(int)
+        # per-event records with REAL begin timestamps:
+        # (name, begin_perf_counter_s, duration_s)
+        self.events: List[Tuple[str, float, float]] = []
         self.active = False
 
 
 _host = _HostTimer()
 
 
+def _host_reset():
+    _host.totals.clear()
+    _host.counts.clear()
+    _host.events.clear()
+
+
 class RecordEvent:
     """Named host region, nestable; shows up in summary() and, when a jax
-    trace is active, as a TraceAnnotation on the device timeline."""
+    trace is active, as a TraceAnnotation on the device timeline. Each
+    occurrence records its actual begin timestamp and duration (exported
+    verbatim by export_chrome_tracing) and, when observability is
+    enabled, lands in the shared EventLog + span histogram too."""
 
     def __init__(self, name: str):
         self.name = name
         self._jax_ctx = None
         self._t0 = 0.0
+        self._span = None
 
     def begin(self):
+        if _obs.enabled():
+            self._span = _obs.span(self.name).begin()
         self._t0 = time.perf_counter()
         try:
             self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
@@ -75,9 +97,13 @@ class RecordEvent:
         if _host.active:
             _host.totals[self.name] += dt
             _host.counts[self.name] += 1
+            _host.events.append((self.name, self._t0, dt))
         if self._jax_ctx is not None:
             self._jax_ctx.__exit__(None, None, None)
             self._jax_ctx = None
+        if self._span is not None:
+            self._span.end()
+            self._span = None
 
     def __enter__(self):
         return self.begin()
@@ -120,8 +146,7 @@ class Profiler:
 
     def start(self):
         _host.active = True
-        _host.totals.clear()
-        _host.counts.clear()
+        _host_reset()
         self._dispatch_start = _dispatch_snapshot()
         if self._scheduler is not None and self._scheduler(0) in (
                 ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
@@ -158,10 +183,16 @@ class Profiler:
                 self._window_open = True
                 # a window exports ITS steps only: reset the host
                 # aggregates when it opens
-                _host.totals.clear()
-                _host.counts.clear()
+                _host_reset()
 
     def stop(self):
+        # a scheduler window still open at stop() owns real data (e.g. a
+        # RECORD phase the loop exited mid-cycle): flush it to
+        # on_trace_ready before deactivating, instead of dropping it
+        if self._window_open:
+            self._window_open = False
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
         _host.active = False
         if self._tracing:
             try:
@@ -194,6 +225,21 @@ class Profiler:
                 f'eager dispatch: {d["calls"]} ops, {rate:.1%} cache hits'
                 f' ({d["misses"]} misses, {d["retraces"]} retraces, '
                 f'{d["fallbacks"]} fallbacks)')
+        # shared observability registry: compile time / comm bytes /
+        # memory watermark recorded by the instrumented runtime
+        reg = _obs.get_registry()
+        compiles = reg.value('paddle_jit_compiles_total')
+        if compiles:
+            lines.append(
+                f'jit: {int(compiles)} XLA compiles, '
+                f'{reg.value("paddle_jit_compile_seconds_total"):.3f} s')
+        comm = _obs.collective_totals(reg)
+        if comm['calls']:
+            lines.append(f'collectives: {int(comm["calls"])} calls, '
+                         f'{int(comm["bytes"])} bytes')
+        mem = reg.value('paddle_memory_watermark_bytes')
+        if mem:
+            lines.append(f'memory watermark: {mem / 2**20:.1f} MiB')
         s = '\n'.join(lines)
         return s
 
@@ -203,7 +249,8 @@ class Profiler:
                                        'calls': _host.counts[k]}
                                    for k, v in _host.totals.items()},
                        'step_times': self._step_times,
-                       'dispatch': self.dispatch_stats()}, f)
+                       'dispatch': self.dispatch_stats(),
+                       'observability': _obs.get_registry().snapshot()}, f)
 
 
 @contextlib.contextmanager
@@ -263,20 +310,25 @@ def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
 
 def export_chrome_tracing(dir_name: str, worker_name: str = None):
     """on_trace_ready factory writing chrome://tracing JSON of the host
-    regions (upstream paddle.profiler.export_chrome_tracing). Device
-    timelines ride the jax perfetto trace in `trace_dir`."""
+    regions (upstream paddle.profiler.export_chrome_tracing). Each
+    RecordEvent occurrence is emitted at its REAL begin timestamp with
+    its real duration — a true timeline, not name-aggregated events at
+    fabricated back-to-back offsets. Device timelines ride the jax
+    perfetto trace in `trace_dir`."""
     def handler(prof: 'Profiler'):
         os.makedirs(dir_name, exist_ok=True)
         events = []
-        t = 0.0
-        for name, total in _host.totals.items():
+        counts: Dict[str, int] = collections.defaultdict(int)
+        window = sorted(_host.events, key=lambda e: e[1])
+        origin = window[0][1] if window else 0.0
+        for name, t0, dur in window:
+            counts[name] += 1
             events.append({
                 'name': name, 'ph': 'X', 'pid': 0,
                 'tid': worker_name or 'host',
-                'ts': int(t * 1e6), 'dur': int(total * 1e6),
-                'args': {'calls': _host.counts[name]},
+                'ts': int((t0 - origin) * 1e6), 'dur': int(dur * 1e6),
+                'args': {'calls': counts[name]},
             })
-            t += total
         path = os.path.join(
             dir_name, f'paddle_tpu_trace_{prof._step_count}.json')
         with open(path, 'w') as f:
