@@ -404,7 +404,8 @@ def _phase_decode():
 
 
 def eager_mlp_loop(steps=20, warmup=3, batch=32, in_dim=64, hidden=128,
-                   classes=10, use_cache=True, instrument=False):
+                   classes=10, use_cache=True, instrument=False,
+                   resilience=False):
     """Eager-dispatch micro-bench loop (also imported by the tier-1
     regression test): a plain DyGraph MLP train step — forward, CE loss,
     tape backward, eager SGD — with NO TrainStep jit, so every op rides
@@ -415,7 +416,12 @@ def eager_mlp_loop(steps=20, warmup=3, batch=32, in_dim=64, hidden=128,
     `instrument=True` runs the SAME loop with the observability layer
     active per step — a span around the step body plus StepTelemetry
     updates — for the obs-overhead A/B (`bench.py obs` phase and the
-    tier-1 <3% overhead guard)."""
+    tier-1 <3% overhead guard).
+
+    `resilience=True` instead routes every step through a
+    FaultTolerantStep wrapper (per-step loss finiteness + spike check,
+    host snapshot every 10 steps) for the resilience-overhead A/B
+    (`bench.py resilience` phase and its tier-1 <3% guard)."""
     import time as _t
 
     import paddle_tpu as paddle
@@ -452,12 +458,36 @@ def eager_mlp_loop(steps=20, warmup=3, batch=32, in_dim=64, hidden=128,
         telemetry = obs.StepTelemetry(memory_every=10) if instrument \
             else None
 
+        ft = None
+        if resilience:
+            import jax.numpy as jnp
+            from paddle_tpu import resilience as res
+
+            def snap():
+                return {n: np.asarray(p.value)
+                        for n, p in model.named_parameters()}
+
+            def rest(s):
+                pm = dict(model.named_parameters())
+                for n, v in s.items():
+                    pm[n]._data = jnp.asarray(v)
+                    pm[n]._node = None
+            ft = res.FaultTolerantStep(
+                lambda: one_step(), snapshot_fn=snap, restore_fn=rest,
+                snapshot_interval=10)
+
         for _ in range(warmup):
             loss = one_step()
         float(loss.numpy())                  # drain warmup dispatch
         pdebug.reset_dispatch_stats()
         t0 = _t.perf_counter()
-        if telemetry is not None:
+        if ft is not None:
+            # resilience arm: the wrapper syncs the loss each step (the
+            # finiteness check needs the value on host) — that sync IS
+            # part of the fault-tolerance cost being measured
+            for _ in range(steps):
+                loss = ft()
+        elif telemetry is not None:
             # instrumented arm: span + per-step telemetry (loss is NOT
             # synced per step — the A/B measures instrumentation cost,
             # not a forced device round-trip)
@@ -518,6 +548,37 @@ def _phase_obs():
         print(f'# obs bench failed: {type(e).__name__}: {e}',
               file=sys.stderr)
         return {'obs_overhead': {'error': type(e).__name__}}
+
+
+def resilience_overhead_ab(steps=30, trials=3):
+    """A/B the eager MLP loop through a FaultTolerantStep wrapper vs
+    plain (also imported by the tier-1 overhead guard). Same best-of-N
+    protocol as obs_overhead_ab."""
+    best_on = best_off = 0.0
+    for _ in range(trials):
+        off = eager_mlp_loop(steps=steps, resilience=False)
+        on = eager_mlp_loop(steps=steps, resilience=True)
+        best_off = max(best_off, off['steps_per_sec'])
+        best_on = max(best_on, on['steps_per_sec'])
+    overhead = best_off / best_on - 1 if best_on else float('inf')
+    return {
+        'ft_steps_per_sec': best_on,
+        'plain_steps_per_sec': best_off,
+        'overhead_ratio': round(best_off / best_on, 4) if best_on else 0.0,
+        'overhead_pct': round(overhead * 100, 2),
+    }
+
+
+def _phase_resilience():
+    """Fault-tolerance overhead phase: FaultTolerantStep wrapper on vs
+    off on the eager hot path (mirrors the obs phase; tier-1 guards the
+    ratio under 3% on CPU)."""
+    try:
+        return {'resilience_overhead': resilience_overhead_ab()}
+    except Exception as e:
+        print(f'# resilience bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        return {'resilience_overhead': {'error': type(e).__name__}}
 
 
 def _bench_eager_dispatch():
@@ -666,6 +727,7 @@ PHASES = {
     'decode': _phase_decode,
     'eager': _bench_eager_dispatch,
     'obs': _phase_obs,
+    'resilience': _phase_resilience,
 }
 
 
@@ -726,6 +788,7 @@ def main():
             raise RuntimeError(f'headline phase failed: {out}')
         out.update(_run_phase_subprocess('eager', 600))
         out.update(_run_phase_subprocess('obs', 600))
+        out.update(_run_phase_subprocess('resilience', 600))
         print(json.dumps(out))  # CPU smoke: headline + eager/obs benches
         return 0
     # Measure the pallas CE kernel FIRST, then let the model phases use
@@ -745,6 +808,7 @@ def main():
     out.update(_run_phase_subprocess('decode', 900, model_env))
     out.update(_run_phase_subprocess('eager', 600))
     out.update(_run_phase_subprocess('obs', 600))
+    out.update(_run_phase_subprocess('resilience', 600))
     print(json.dumps(out))
     return 0
 

@@ -4,18 +4,28 @@ Runs on any backend; on TPU the same script is the single-chip version
 of the BASELINE GPT-3 config — scale hidden/layers and add
 fleet.DistTrainStep for the pod version (see examples/train_distributed.py).
 
-    python examples/train_gpt.py
+Fault tolerance is on by default: the step rides a FaultTolerantStep
+(NaN/spike rollback + skip), SIGTERM/SIGINT force a final checkpoint,
+and `--resume auto` continues from the latest committed step:
+
+    python examples/train_gpt.py --ckpt-dir /tmp/gpt_ckpt
+    # ... preempted ...
+    python examples/train_gpt.py --ckpt-dir /tmp/gpt_ckpt --resume auto
 """
+import argparse
+
 import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
-from paddle_tpu import debug, observability
+from paddle_tpu import debug, observability, resilience
 from paddle_tpu.jit import TrainStep
 from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.utils.checkpoint import CheckpointManager
 
 
-def main(steps=80, vocab=512, seq=64, batch=8):
+def main(steps=80, vocab=512, seq=64, batch=8, ckpt_dir=None, resume=None,
+         ckpt_interval=20):
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=vocab, hidden_size=128,
                     num_hidden_layers=2, num_attention_heads=4,
@@ -23,34 +33,77 @@ def main(steps=80, vocab=512, seq=64, batch=8):
     model = GPTForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-3,
                                  parameters=model.parameters())
-    step = TrainStep(
+    raw_step = TrainStep(
         model,
         # next-token objective: logits at t predict token t+1
         lambda logits, labels: F.cross_entropy(
             logits[:, :-1].reshape([-1, vocab]),
             labels[:, 1:].reshape([-1])),
         opt)
+    # NaN/spike steps roll back and the batch is skipped; transient PjRt
+    # errors are retried with backoff
+    step = resilience.FaultTolerantStep(
+        raw_step, retry_policy=resilience.RetryPolicy())
 
-    rng = np.random.RandomState(0)
-    # toy corpus: next-token-predictable arithmetic sequences
-    def batch_ids():
-        start = rng.randint(0, vocab - seq, (batch, 1))
-        return (start + np.arange(seq)) % vocab
+    mgr = None
+    start = 0
+    if ckpt_dir:
+        mgr = CheckpointManager(ckpt_dir, backend='npz',
+                                save_interval_steps=ckpt_interval)
+        if resume == 'auto' and mgr.latest_step() is not None:
+            tree = mgr.restore()
+            model.set_state_dict(tree['model'])
+            raw_step._opt_state = tree['opt']
+            raw_step._n_calls = int(np.asarray(tree['n_calls']))
+            start = int(np.asarray(tree['step']))
+            print(f'resumed from step {start}')
+
+    def save(i, force=False):
+        if mgr is None:
+            return
+        mgr.save(i, {'model': dict(model.state_dict()),
+                     'opt': raw_step._opt_state,
+                     'n_calls': raw_step._n_calls, 'step': i}, force=force)
+
+    # toy corpus: next-token-predictable arithmetic sequences; keyed by
+    # step index so a resumed run replays the identical batch stream
+    def batch_ids(i):
+        r = np.random.RandomState(i)
+        start_tok = r.randint(0, vocab - seq, (batch, 1))
+        return (start_tok + np.arange(seq)) % vocab
 
     # per-step telemetry into the shared observability registry:
     # steps/sec, tokens/sec, loss, device-memory watermark
     telemetry = observability.StepTelemetry()
-    for i in range(steps):
-        ids = batch_ids()
-        loss = step(ids, ids)
-        telemetry.step(loss=float(loss.numpy()), tokens=batch * seq)
-        if i % 10 == 0 or i == steps - 1:
-            print(f'step {i:3d}  loss {float(loss.numpy()):.4f}')
+    loss = None
+    with resilience.PreemptionHandler() as preempt:
+        for i in range(start, steps):
+            ids = batch_ids(i)
+            loss = step(ids, ids)
+            telemetry.step(loss=float(loss.numpy()), tokens=batch * seq)
+            if not step.last_step_skipped:
+                save(i + 1)
+            if i % 10 == 0 or i == steps - 1:
+                print(f'step {i:3d}  loss {float(loss.numpy()):.4f}')
+            if preempt.requested:
+                save(i + 1, force=True)
+                print(f'preempted at step {i}: checkpoint forced, '
+                      f'exiting cleanly')
+                break
     # one call reports dispatch hit-rate, jit compiles, comm/offload
-    # bytes, throughput, and memory — all from the single registry
+    # bytes, throughput, memory — and now resilience/checkpoint activity
     print(debug.observability_summary())
-    return float(loss.numpy())
+    return float(loss.numpy()) if loss is not None else float('nan')
 
 
 if __name__ == '__main__':
-    main()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument('--steps', type=int, default=80)
+    p.add_argument('--ckpt-dir', default=None,
+                   help='directory for step-indexed training checkpoints')
+    p.add_argument('--resume', choices=['auto'], default=None,
+                   help="'auto': continue from the latest committed step")
+    p.add_argument('--ckpt-interval', type=int, default=20)
+    args = p.parse_args()
+    main(steps=args.steps, ckpt_dir=args.ckpt_dir, resume=args.resume,
+         ckpt_interval=args.ckpt_interval)

@@ -33,6 +33,7 @@ from . import io
 from . import observability
 from . import profiler
 from . import debug
+from . import resilience
 from . import metric
 from . import hapi
 from .hapi import Model

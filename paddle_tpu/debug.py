@@ -202,6 +202,22 @@ def observability_summary(max_rows: int = 10) -> str:
     lines.append(
         f'  memory: watermark '
         f'{reg.value("paddle_memory_watermark_bytes") / 2**20:.1f} MiB')
+    lines.append(
+        f'  resilience: {int(_labeled_total(reg, "paddle_resilience_retries_total"))} '
+        f'retries  '
+        f'{int(reg.value("paddle_resilience_rollbacks_total"))} rollbacks  '
+        f'{int(reg.value("paddle_resilience_skipped_batches_total"))} '
+        f'skipped batches  '
+        f'{int(reg.value("paddle_resilience_preempt_saves_total"))} '
+        f'preempt saves  '
+        f'{int(reg.value("paddle_resilience_hangs_total"))} hangs')
+    lines.append(
+        f'  checkpoints: '
+        f'{int(reg.value("paddle_checkpoint_saves_total"))} saves '
+        f'({int(reg.value("paddle_checkpoint_save_bytes_total"))} bytes)  '
+        f'{int(reg.value("paddle_checkpoint_restores_total"))} restores '
+        f'({int(reg.value("paddle_checkpoint_restore_bytes_total"))} '
+        f'bytes)')
     spans = reg.get('paddle_span_seconds')
     rows = []
     if spans is not None:
@@ -221,6 +237,14 @@ def _jit_cache_entries(reg) -> int:
     if fam is None:
         return 0
     return int(sum(c.value for c in fam._children.values()))
+
+
+def _labeled_total(reg, name: str) -> float:
+    """Sum a labeled counter family across all label values."""
+    fam = reg.get(name)
+    if fam is None:
+        return 0.0
+    return sum(c.value for c in fam._children.values())
 
 
 class LossSpikeDetector:

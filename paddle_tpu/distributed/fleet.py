@@ -301,7 +301,8 @@ class DistTrainStep:
     """
 
     def __init__(self, layer: Layer, loss_fn, optimizer,
-                 strategy: Optional[DistributedStrategy] = None):
+                 strategy: Optional[DistributedStrategy] = None,
+                 retry_policy=None):
         self.layer = layer
         self.loss_fn = loss_fn
         self.optimizer = optimizer._inner \
@@ -310,6 +311,10 @@ class DistTrainStep:
         self.mesh = env.get_mesh()
         self._opt_state = None
         self._n_calls = 0
+        # transient PjRt/collective failures (link flaps, neighbour HBM
+        # pressure) are retried with backoff rather than killing the run;
+        # None = fail fast (the pre-resilience behavior)
+        self.retry_policy = retry_policy
         st = self.strategy
         dp = self.mesh.shape.get('dp', 1)
         self._dp = dp
@@ -591,8 +596,15 @@ class DistTrainStep:
                                  self._n_calls)
         self._n_calls += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        batch = (shard_batch(inputs, mesh=self.mesh),
-                 shard_batch(labels, mesh=self.mesh))
+        if self.retry_policy is not None:
+            from ..resilience.retry import call_with_retry
+            batch = call_with_retry(
+                lambda: (shard_batch(inputs, mesh=self.mesh),
+                         shard_batch(labels, mesh=self.mesh)),
+                policy=self.retry_policy, site='device_transfer')
+        else:
+            batch = (shard_batch(inputs, mesh=self.mesh),
+                     shard_batch(labels, mesh=self.mesh))
         if _obs.enabled():
             # per-step comm ledger: inside the jitted step GSPMD owns the
             # collectives, so the host-side view counts the dp-sharded
@@ -607,8 +619,17 @@ class DistTrainStep:
                         'bytes of batch data sharded onto the mesh').inc(
                             batch_bytes)
         with _obs.span('fleet.dist_train_step', step=self._n_calls - 1):
-            loss, new_params, self._opt_state, new_bufs = self._jitted(
-                params, self._opt_state, buffers, frozen, key, lr, batch)
+            if self.retry_policy is not None:
+                from ..resilience.retry import call_with_retry
+                loss, new_params, self._opt_state, new_bufs = \
+                    call_with_retry(
+                        self._jitted, params, self._opt_state, buffers,
+                        frozen, key, lr, batch,
+                        policy=self.retry_policy, site='dist_step')
+            else:
+                loss, new_params, self._opt_state, new_bufs = self._jitted(
+                    params, self._opt_state, buffers, frozen, key, lr,
+                    batch)
         pmap = dict(self.layer.named_parameters())
         for n, v in new_params.items():
             pmap[n]._data = v

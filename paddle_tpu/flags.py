@@ -22,6 +22,14 @@ _FLAGS: Dict[str, Any] = {
     'FLAGS_fraction_of_gpu_memory_to_use': 0.92,
     'FLAGS_allocator_strategy': 'auto_growth',
     'FLAGS_eager_delete_tensor_gb': 0.0,
+    # fault tolerance (consumed by paddle_tpu.resilience)
+    'FLAGS_resilience': True,          # master gate for FT instrumentation
+    'FLAGS_ft_max_retries': 3,         # transient-error retry budget
+    'FLAGS_ft_retry_base_delay': 0.1,  # first backoff sleep (seconds)
+    'FLAGS_ft_retry_max_delay': 30.0,  # backoff cap (seconds)
+    'FLAGS_ft_skip_budget': 10,        # bad steps a run may drop
+    'FLAGS_ft_snapshot_interval': 1,   # steps between rollback snapshots
+    'FLAGS_ft_step_deadline_s': 0.0,   # watchdog deadline; 0 = disabled
     # misc parity flags
     'FLAGS_use_mkldnn': False,
     'FLAGS_paddle_num_threads': 1,

@@ -72,6 +72,7 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self._train_step: Optional[TrainStep] = None
+        self._ft_step = None  # FaultTolerantStep wrapper, set by fit()
         self.stop_training = False
 
     # -- setup --------------------------------------------------------------
@@ -132,7 +133,8 @@ class Model:
 
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
-        step = self._ensure_step()
+        step = self._ft_step if self._ft_step is not None \
+            else self._ensure_step()
         ins = tuple(_to_list(inputs)) if isinstance(inputs, (list, tuple)) \
             else (inputs,)
         with self._amp_ctx():
@@ -161,14 +163,65 @@ class Model:
         return out
 
     # -- loops --------------------------------------------------------------
+    def _save_train_ckpt(self, mgr, it, loader, force=False):
+        """Step-indexed training checkpoint: model + jit opt-state + RNG
+        counter + global step, with the dataloader cursor riding the
+        committed sidecar so resume replays the exact remaining batches."""
+        st = self._ensure_step()
+        tree = {'model': dict(self.network.state_dict()),
+                'opt': st._opt_state,
+                'n_calls': st._n_calls,
+                'step': it}
+        return mgr.save(it, tree, force=force,
+                        dataloader=loader
+                        if hasattr(loader, 'state_dict') else None)
+
+    def _restore_train_ckpt(self, mgr, step, loader):
+        """Inverse of _save_train_ckpt; returns the restored global step."""
+        from ..resilience.step import _to_device
+        cursor_loader = loader if hasattr(loader, 'set_state_dict') else None
+        tree = mgr.restore(step, dataloader=cursor_loader)
+        self.network.set_state_dict(tree['model'])
+        st = self._ensure_step()
+        opt = tree.get('opt')
+        st._opt_state = _to_device(opt) if opt is not None else None
+        st._n_calls = int(np.asarray(tree.get('n_calls', 0)))
+        return int(np.asarray(tree.get('step', 0)))
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            ckpt_dir=None, ckpt_interval=1, resume=None,
+            fault_tolerance=None, step_timeout=None,
+            handle_preemption=None):
+        """Train the prepared model.
+
+        Fault-tolerance knobs (all off by default):
+          ckpt_dir: directory (or a CheckpointManager) for step-indexed
+            training checkpoints every `ckpt_interval` optimizer steps,
+            each committed with the dataloader cursor.
+          resume: 'auto' restores the latest committed step from
+            ckpt_dir (fresh run if none exist); an int restores that
+            exact step. Restores params, opt-state, RNG counter, global
+            step, and the mid-epoch dataloader cursor — the resumed loss
+            trajectory is bit-exact vs. an uninterrupted run.
+          fault_tolerance: True (defaults) or a dict of
+            resilience.FaultTolerantStep kwargs — NaN/Inf and loss-spike
+            steps roll back to the last snapshot and the batch is
+            skipped, within a bounded skip budget.
+          step_timeout: seconds before a step is declared hang-suspected
+            (resilience.StepWatchdog; emits `hang_suspected`).
+          handle_preemption: install SIGTERM/SIGINT handlers that force
+            a synchronous checkpoint and exit the loop cleanly (defaults
+            to True when ckpt_dir is set).
+        """
         if accumulate_grad_batches != 1:
             raise NotImplementedError(
                 'accumulate_grad_batches > 1 is not implemented yet; '
                 'raise the batch size or use fleet gradient_merge')
+        from .. import observability as _obs
+        from .. import resilience as _res
         loader = _as_loader(train_data, batch_size, shuffle, num_workers,
                             drop_last)
         eval_loader = _as_loader(eval_data, batch_size, False, num_workers,
@@ -183,34 +236,104 @@ class Model:
         cblist.set_params({'epochs': epochs, 'verbose': verbose,
                            'metrics': ['loss'] + [m.name()
                                                   for m in self._metrics]})
+        # ---- resilience plumbing ----------------------------------------
+        mgr = None
+        if ckpt_dir is not None:
+            from ..utils.checkpoint import CheckpointManager
+            if isinstance(ckpt_dir, CheckpointManager):
+                mgr = ckpt_dir
+            else:
+                # npz container: structure-exact round-trips (tuples,
+                # ints, None) for the jit opt-state pytree
+                mgr = CheckpointManager(
+                    ckpt_dir, backend='npz',
+                    save_interval_steps=max(1, int(ckpt_interval)))
+        if resume not in (None, False) and mgr is None:
+            raise ValueError("fit(resume=...) requires ckpt_dir")
+        it_count = 0
+        start_epoch = 0
+        if resume not in (None, False):
+            target = mgr.latest_step() if resume == 'auto' else int(resume)
+            if target is not None:   # 'auto' on an empty dir = fresh run
+                it_count = self._restore_train_ckpt(mgr, target, loader)
+                if hasattr(loader, 'state_dict'):
+                    start_epoch = int(loader.state_dict()['epoch'])
+        if fault_tolerance:
+            ft_cfg = dict(fault_tolerance) \
+                if isinstance(fault_tolerance, dict) else {}
+            self._ft_step = _res.FaultTolerantStep(self._ensure_step(),
+                                                   **ft_cfg)
+        wd = _res.StepWatchdog(step_timeout) if step_timeout else None
+        if handle_preemption is None:
+            handle_preemption = mgr is not None
+        preempt = _res.PreemptionHandler().install() \
+            if handle_preemption else None
+
         self.stop_training = False
         cblist.on_train_begin()
         history = {'loss': []}
-        it_count = 0
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            cblist.on_epoch_begin(epoch)
-            self.network.train()
-            epoch_logs: Dict[str, Any] = {}
-            for step, batch in enumerate(loader):
-                cblist.on_train_batch_begin(step)
-                ins, lab = _split_batch(batch)
-                loss = self.train_batch(list(ins), lab)
-                logs = {'loss': loss[0]}
-                epoch_logs.update(logs)
-                cblist.on_train_batch_end(step, logs)
-                history['loss'].append(loss[0])
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
-                    self.stop_training = True
+        epoch_logs: Dict[str, Any] = {}
+        try:
+            for epoch in range(start_epoch, epochs):
+                if self.stop_training:
                     break
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self._run_eval(eval_loader, cblist)
-                epoch_logs.update({f'eval_{k}': v
-                                   for k, v in eval_logs.items()})
-            cblist.on_epoch_end(epoch, epoch_logs)
-        cblist.on_train_end(epoch_logs if epochs else {})
+                cblist.on_epoch_begin(epoch)
+                self.network.train()
+                epoch_logs = {}
+                for step, batch in enumerate(loader):
+                    cblist.on_train_batch_begin(step)
+                    ins, lab = _split_batch(batch)
+                    if wd is not None:
+                        with wd.watch():
+                            loss = self.train_batch(list(ins), lab)
+                    else:
+                        loss = self.train_batch(list(ins), lab)
+                    skipped = self._ft_step is not None \
+                        and self._ft_step.last_step_skipped
+                    logs = {'loss': loss[0]}
+                    cblist.on_train_batch_end(step, logs)
+                    if not skipped:
+                        epoch_logs.update(logs)
+                        history['loss'].append(loss[0])
+                        it_count += 1
+                        if mgr is not None and mgr.should_save(it_count):
+                            self._save_train_ckpt(mgr, it_count, loader)
+                    if preempt is not None and preempt.requested:
+                        # eviction grace window: one forced synchronous
+                        # checkpoint (dataloader cursor included), then
+                        # leave the loop cleanly
+                        if mgr is not None:
+                            self._save_train_ckpt(mgr, it_count, loader,
+                                                  force=True)
+                        if _obs.enabled():
+                            _obs.get_registry().counter(
+                                'paddle_resilience_preempt_saves_total',
+                                'forced checkpoints on preemption '
+                                'signals').inc()
+                            _obs.emit('preempt_save', step=it_count,
+                                      saved=mgr is not None)
+                        self.stop_training = True
+                    if num_iters is not None and it_count >= num_iters:
+                        self.stop_training = True
+                    if self.stop_training:
+                        break
+                if self.stop_training and preempt is not None \
+                        and preempt.requested:
+                    break   # skip eval: the grace window is for saving
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self._run_eval(eval_loader, cblist)
+                    epoch_logs.update({f'eval_{k}': v
+                                       for k, v in eval_logs.items()})
+                cblist.on_epoch_end(epoch, epoch_logs)
+            cblist.on_train_end(epoch_logs if epochs else {})
+            if self._ft_step is not None:
+                history['resilience'] = self._ft_step.stats()
+        finally:
+            if preempt is not None:
+                preempt.uninstall()
+            if wd is not None:
+                wd.stop()
+            self._ft_step = None
         return history
 
     def _run_eval(self, loader, cblist=None):

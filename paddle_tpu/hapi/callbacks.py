@@ -1,9 +1,11 @@
 """hapi callbacks (upstream: python/paddle/hapi/callbacks.py)."""
 from __future__ import annotations
 
+import math
 import os
 import sys
 import time
+import warnings
 from typing import Dict, List, Optional
 
 
@@ -171,6 +173,8 @@ class EarlyStopping(Callback):
         self.stopped = False
         self.wait = 0
         self.best = None
+        self._warned_nan = False
+        self._warned_missing = False
 
     def _better(self, cur, best):
         if best is None:
@@ -182,8 +186,25 @@ class EarlyStopping(Callback):
     def on_eval_end(self, logs=None):
         cur = _extract_metric(logs, self.monitor)
         if cur is None:
+            if not self._warned_missing:
+                self._warned_missing = True
+                warnings.warn(
+                    f'EarlyStopping: monitored metric {self.monitor!r} is '
+                    f'missing from eval logs; callback is inactive')
             return
-        if self._better(cur, self.best):
+        # a NaN metric must never become `best` (NaN compares false
+        # against everything, so every later value would look like "no
+        # improvement"); treat the NaN step itself as no improvement
+        if math.isnan(cur):
+            if not self._warned_nan:
+                self._warned_nan = True
+                warnings.warn(
+                    f'EarlyStopping: monitored metric {self.monitor!r} is '
+                    f'NaN; treating as no improvement')
+            improved = False
+        else:
+            improved = self._better(cur, self.best)
+        if improved:
             self.best = cur
             self.wait = 0
         else:
@@ -323,6 +344,8 @@ class ReduceLROnPlateau(Callback):
         self.wait = 0
         self.cooldown_counter = 0
         self._eval_seen_this_epoch = False
+        self._warned_nan = False
+        self._warned_missing = False
 
     def _better(self, cur):
         if self.best is None:
@@ -334,11 +357,25 @@ class ReduceLROnPlateau(Callback):
     def _on_metric(self, logs):
         cur = _extract_metric(logs, self.monitor)
         if cur is None:
+            if not self._warned_missing:
+                self._warned_missing = True
+                warnings.warn(
+                    f'ReduceLROnPlateau: monitored metric '
+                    f'{self.monitor!r} is missing from logs; callback is '
+                    f'inactive')
             return
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
             self.wait = 0
-        if self._better(cur):
+        # NaN must not poison `best` (see EarlyStopping): it counts as a
+        # plateau step but is never stored
+        if math.isnan(cur):
+            if not self._warned_nan:
+                self._warned_nan = True
+                warnings.warn(
+                    f'ReduceLROnPlateau: monitored metric '
+                    f'{self.monitor!r} is NaN; treating as no improvement')
+        elif self._better(cur):
             self.best = cur
             self.wait = 0
             return

@@ -1,0 +1,39 @@
+"""paddle_tpu.resilience — fault-tolerant training.
+
+Pod-scale runs die constantly: preemptions, transient PjRt/compile
+errors, and occasional NaN/loss-spike steps. This subsystem is the layer
+that turns those from run-enders into logged events:
+
+- `retry` / `RetryPolicy` / `is_transient` — transient-error retry with
+  exponential backoff + jitter and an error classifier, applied to
+  checkpoint I/O, collective-wrapped steps, and device transfers.
+- `FaultTolerantStep` — snapshots params/opt-state/rng every step
+  window, detects NaN/Inf or `LossSpikeDetector` anomalies, rolls back
+  and skips the offending batch within a bounded skip budget
+  (PaLM-style skip-the-bad-step).
+- `PreemptionHandler` — SIGTERM/SIGINT → forced synchronous checkpoint
+  (with the dataloader cursor) + clean exit; pairs with
+  `Model.fit(resume='auto')`.
+- `StepWatchdog` — configurable step deadline; emits `hang_suspected`
+  with the last-known span before the configured abort action.
+
+Everything reports into the shared observability registry
+(`paddle_resilience_*` counters: retries, rollbacks, skipped_batches,
+preempt_saves, hangs) so `debug.observability_summary()` shows recovery
+activity next to throughput and comm ledgers.
+"""
+from __future__ import annotations
+
+from .retry import (FatalError, RetryPolicy, TransientError,
+                    call_with_retry, is_transient, register_transient,
+                    retry)
+from .step import FaultTolerantStep, SkipBudgetExhausted
+from .preemption import PreemptionHandler
+from .watchdog import StepWatchdog
+
+__all__ = [
+    'FatalError', 'RetryPolicy', 'TransientError', 'call_with_retry',
+    'is_transient', 'register_transient', 'retry',
+    'FaultTolerantStep', 'SkipBudgetExhausted',
+    'PreemptionHandler', 'StepWatchdog',
+]

@@ -1,0 +1,182 @@
+"""Transient-error retry: classifier + exponential backoff with jitter.
+
+Real pod runs shed a steady drizzle of *transient* failures — PjRt RPC
+drops, RESOURCE_EXHAUSTED from a neighbour's temporary HBM/host-RAM
+pressure, compile-service timeouts — that a production trainer must
+absorb without operator involvement, while *fatal* errors (shape
+mismatches, assertion failures, real OOM loops) must still surface
+immediately. This module is the one place that judgment lives:
+
+- `is_transient(exc)` — the error classifier. Type-based first
+  (ConnectionError/TimeoutError/`TransientError`), then message-based
+  against the PjRt/absl status vocabulary (RESOURCE_EXHAUSTED,
+  UNAVAILABLE, DEADLINE_EXCEEDED, ...). Extendable at runtime via
+  `register_transient` (deployment-specific storage clients, fault
+  injection in tests).
+- `RetryPolicy` — max_retries / exponential backoff / jitter knobs,
+  defaulting from the FLAGS_ft_* registry.
+- `retry(policy, site)` decorator and `call_with_retry(fn, ...)` — the
+  wrappers applied to checkpoint I/O, collective-wrapped train steps,
+  and device transfers. Every retry increments
+  `paddle_resilience_retries_total{site}` and emits a `retry` event so
+  `debug.observability_summary()` shows recovery activity.
+"""
+from __future__ import annotations
+
+import functools
+import random
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+from .. import flags as _flags
+from .. import observability as _obs
+
+
+class TransientError(RuntimeError):
+    """Marker exception that is always classified as retryable (used by
+    tests and as a base class for custom transient failures)."""
+
+
+class FatalError(RuntimeError):
+    """Marker exception that is never retried, even if its message
+    matches a transient pattern."""
+
+
+# absl/PjRt status vocabulary + the usual socket-level suspects. Matched
+# case-insensitively against "TypeName: message".
+_TRANSIENT_MARKERS: Tuple[str, ...] = (
+    'resource_exhausted',
+    'resource exhausted',
+    'deadline_exceeded',
+    'deadline exceeded',
+    'unavailable',
+    'aborted',
+    'cancelled',
+    'data_loss',
+    'connection reset',
+    'connection refused',
+    'connection closed',
+    'broken pipe',
+    'temporarily unavailable',
+    'try again',
+    'socket closed',
+    'transport closed',
+    'compile timeout',
+    'compilation timed out',
+    'preempted',
+)
+
+_transient_types: Tuple[Type[BaseException], ...] = (
+    TransientError, ConnectionError, TimeoutError, InterruptedError,
+)
+
+
+def register_transient(exc_type: Type[BaseException]):
+    """Teach the classifier a new retryable exception type (e.g. a cloud
+    storage client's throttling error, or a test's injected fault)."""
+    global _transient_types
+    if exc_type not in _transient_types:
+        _transient_types = _transient_types + (exc_type,)
+    return exc_type
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True if `exc` looks like a failure that a bounded retry can
+    outlive. Fatal-by-construction errors (FatalError, KeyboardInterrupt,
+    programming errors) are never transient."""
+    if isinstance(exc, FatalError):
+        return False
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit,
+                        AssertionError, TypeError, ValueError, KeyError,
+                        AttributeError, NotImplementedError)):
+        return False
+    if isinstance(exc, _transient_types):
+        return True
+    msg = f'{type(exc).__name__}: {exc}'.lower()
+    return any(marker in msg for marker in _TRANSIENT_MARKERS)
+
+
+class RetryPolicy:
+    """Exponential backoff with +/- jitter over a transient classifier.
+
+    max_retries counts *re*-attempts: max_retries=3 means up to 4 calls.
+    delay(attempt) = min(base * multiplier**attempt, max_delay), scaled
+    by a uniform factor in [1 - jitter, 1 + jitter] so a fleet of hosts
+    retrying the same shared service doesn't stampede in lockstep.
+    Defaults come from the FLAGS_ft_* registry; `classify` overrides the
+    transient/fatal judgment per call site.
+    """
+
+    def __init__(self, max_retries: Optional[int] = None,
+                 base_delay: Optional[float] = None,
+                 max_delay: Optional[float] = None,
+                 multiplier: float = 2.0, jitter: float = 0.25,
+                 classify: Optional[Callable[[BaseException], bool]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_retries = int(_flags.flag('FLAGS_ft_max_retries')
+                               if max_retries is None else max_retries)
+        self.base_delay = float(_flags.flag('FLAGS_ft_retry_base_delay')
+                                if base_delay is None else base_delay)
+        self.max_delay = float(_flags.flag('FLAGS_ft_retry_max_delay')
+                               if max_delay is None else max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.classify = classify or is_transient
+        self.sleep = sleep
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before re-attempt number `attempt` (0-based)."""
+        d = min(self.base_delay * self.multiplier ** attempt,
+                self.max_delay)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        return max(d, 0.0)
+
+    def retryable(self, exc: BaseException) -> bool:
+        return self.classify(exc)
+
+
+def _note_retry(site: str, attempt: int, exc: BaseException, delay: float):
+    if not _obs.enabled():
+        return
+    _obs.get_registry().counter(
+        'paddle_resilience_retries_total',
+        'transient-error retries by call site',
+        ('site',)).labels(site=site).inc()
+    _obs.emit('retry', site=site, attempt=attempt,
+              error=type(exc).__name__, delay_s=round(delay, 4))
+
+
+def call_with_retry(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
+                    site: str = 'generic', **kwargs) -> Any:
+    """Run `fn(*args, **kwargs)`, re-attempting transient failures per
+    `policy`. Fatal errors and exhausted budgets re-raise the original
+    exception unchanged."""
+    policy = policy or RetryPolicy()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as exc:
+            if attempt >= policy.max_retries or not policy.retryable(exc):
+                raise
+            d = policy.delay(attempt)
+            _note_retry(site, attempt, exc, d)
+            if d > 0:
+                policy.sleep(d)
+            attempt += 1
+
+
+def retry(policy: Optional[RetryPolicy] = None, site: Optional[str] = None):
+    """Decorator form: `@retry(RetryPolicy(max_retries=5), site='io')`.
+    Also usable bare (`@retry()`) with flag-default policy; `site`
+    defaults to the function name for counter labeling."""
+    def deco(fn):
+        label = site or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return call_with_retry(fn, *args, policy=policy, site=label,
+                                   **kwargs)
+        return wrapper
+    return deco

@@ -1,0 +1,194 @@
+"""FaultTolerantStep — rollback + skip-the-bad-batch around a train step.
+
+Large-model practice (PaLM's skip-the-bad-step restarts) treats an
+occasional NaN/Inf or loss-spike step as data to be dropped, not a run
+to be killed: restore the last known-good state, skip the offending
+batch, keep going — up to a bounded skip budget, past which something is
+structurally wrong and the run must fail loudly.
+
+The wrapper works over any step object shaped like `jit.TrainStep` /
+`fleet.DistTrainStep` (callable(inputs, labels) -> loss, with `.layer`,
+`._opt_state`, `._n_calls`), or over a bare callable given explicit
+`snapshot_fn`/`restore_fn`. Snapshots are host-side numpy copies of
+params/buffers/opt-state plus the step's RNG counter, taken every
+`snapshot_interval` good steps — so a rollback replays from at most
+`snapshot_interval - 1` steps back, and with the default interval of 1
+the replay is exactly "this batch never happened".
+
+Reports into the shared observability registry:
+`paddle_resilience_rollbacks_total`,
+`paddle_resilience_skipped_batches_total`, plus `bad_step` events.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags as _flags
+from .. import observability as _obs
+from .retry import RetryPolicy, call_with_retry
+
+_tree = jax.tree_util
+
+
+class SkipBudgetExhausted(RuntimeError):
+    """More bad steps than the skip budget allows — the failure is not
+    an isolated batch; stop instead of silently dropping the dataset."""
+
+
+def _to_host(tree):
+    return _tree.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, 'shape') else x, tree)
+
+
+def _to_device(tree):
+    return _tree.tree_map(
+        lambda x: jnp.asarray(x) if hasattr(x, 'shape') else x, tree)
+
+
+class FaultTolerantStep:
+    """Wrap a train step with snapshot / bad-step rollback / retry.
+
+    Args:
+        step: the underlying step — `TrainStep`, `DistTrainStep`, or any
+            callable. Step-shaped objects get automatic snapshot/restore
+            of `(layer params+buffers, _opt_state, _n_calls)`.
+        skip_budget: total bad steps the run may drop before
+            `SkipBudgetExhausted` (default FLAGS_ft_skip_budget).
+        snapshot_interval: good steps between host snapshots (default
+            FLAGS_ft_snapshot_interval; 1 = snapshot before every step).
+        spike_window / spike_sigma / spike_min_steps: LossSpikeDetector
+            config; `check_spikes=False` reduces detection to NaN/Inf.
+        retry_policy: RetryPolicy for transient *errors raised by* the
+            step (PjRt hiccups); None disables retry.
+        watchdog: an armed `StepWatchdog` whose watch() brackets each
+            step call; None disables.
+    """
+
+    def __init__(self, step: Callable, *, skip_budget: Optional[int] = None,
+                 snapshot_interval: Optional[int] = None,
+                 spike_window: int = 20, spike_sigma: float = 6.0,
+                 spike_min_steps: int = 5, check_spikes: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 watchdog=None,
+                 snapshot_fn: Optional[Callable[[], Any]] = None,
+                 restore_fn: Optional[Callable[[Any], None]] = None):
+        self.step = step
+        self.skip_budget = int(_flags.flag('FLAGS_ft_skip_budget')
+                               if skip_budget is None else skip_budget)
+        self.snapshot_interval = max(1, int(
+            _flags.flag('FLAGS_ft_snapshot_interval')
+            if snapshot_interval is None else snapshot_interval))
+        self.retry_policy = retry_policy
+        self.watchdog = watchdog
+        self._snapshot_fn = snapshot_fn
+        self._restore_fn = restore_fn
+        if snapshot_fn is None and not hasattr(step, 'layer'):
+            raise TypeError(
+                f'{type(step).__name__} is not step-shaped (no .layer); '
+                f'pass explicit snapshot_fn/restore_fn')
+        self._spikes = None
+        if check_spikes:
+            from ..debug import LossSpikeDetector
+            self._spikes = LossSpikeDetector(
+                window=spike_window, threshold_sigma=spike_sigma,
+                min_steps=spike_min_steps)
+        self._snapshot = None
+        self._since_snapshot = 0
+        self.rollbacks = 0
+        self.skipped_batches = 0
+        self.good_steps = 0
+        self.last_step_skipped = False
+
+    # -- state capture ------------------------------------------------------
+    def _capture(self):
+        if self._snapshot_fn is not None:
+            return self._snapshot_fn()
+        layer = self.step.layer
+        return {
+            'params': {n: np.asarray(p.value)
+                       for n, p in layer.named_parameters()},
+            'buffers': {n: np.asarray(b.value)
+                        for n, b in layer.named_buffers()},
+            'opt': _to_host(getattr(self.step, '_opt_state', None)),
+            'n_calls': int(getattr(self.step, '_n_calls', 0)),
+        }
+
+    def _restore(self, snap):
+        if self._restore_fn is not None:
+            self._restore_fn(snap)
+            return
+        layer = self.step.layer
+        pmap = dict(layer.named_parameters())
+        for n, v in snap['params'].items():
+            pmap[n]._data = jnp.asarray(v)
+            pmap[n]._node = None
+        bmap = dict(layer.named_buffers())
+        for n, v in snap['buffers'].items():
+            bmap[n]._data = jnp.asarray(v)
+        if hasattr(self.step, '_opt_state'):
+            self.step._opt_state = _to_device(snap['opt'])
+        if hasattr(self.step, '_n_calls'):
+            self.step._n_calls = snap['n_calls']
+
+    # -- the wrapped step ---------------------------------------------------
+    def _run(self, *args, **kwargs):
+        ctx = self.watchdog.watch() if self.watchdog is not None else None
+        if ctx is None:
+            return self.step(*args, **kwargs)
+        with ctx:
+            return self.step(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        self.last_step_skipped = False
+        if self._snapshot is None \
+                or self._since_snapshot >= self.snapshot_interval:
+            self._snapshot = self._capture()
+            self._since_snapshot = 0
+        if self.retry_policy is not None:
+            loss = call_with_retry(self._run, *args,
+                                   policy=self.retry_policy,
+                                   site='train_step', **kwargs)
+        else:
+            loss = self._run(*args, **kwargs)
+        lv = float(loss.numpy()) if hasattr(loss, 'numpy') else float(
+            np.asarray(loss))
+        bad = self._spikes.update(lv) if self._spikes is not None \
+            else not math.isfinite(lv)
+        if bad:
+            self.rollbacks += 1
+            self.skipped_batches += 1
+            if _obs.enabled():
+                reg = _obs.get_registry()
+                reg.counter('paddle_resilience_rollbacks_total',
+                            'bad-step rollbacks to the last snapshot').inc()
+                reg.counter('paddle_resilience_skipped_batches_total',
+                            'batches dropped by bad-step handling').inc()
+                _obs.emit('bad_step', loss=lv,
+                          skipped=self.skipped_batches,
+                          budget=self.skip_budget)
+            self._restore(self._snapshot)
+            self.last_step_skipped = True
+            if self.skipped_batches > self.skip_budget:
+                raise SkipBudgetExhausted(
+                    f'{self.skipped_batches} bad steps exceed the skip '
+                    f'budget of {self.skip_budget} (last loss {lv})')
+        else:
+            self.good_steps += 1
+            self._since_snapshot += 1
+        return loss
+
+    def stats(self) -> Dict[str, Any]:
+        return {'good_steps': self.good_steps,
+                'rollbacks': self.rollbacks,
+                'skipped_batches': self.skipped_batches,
+                'skip_budget': self.skip_budget,
+                'snapshot_interval': self.snapshot_interval}
+
+    # look like the wrapped step (Model.fit pokes at ._opt_state etc.)
+    def __getattr__(self, name):
+        return getattr(self.step, name)

@@ -20,9 +20,21 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from .. import observability as _obs
 from .. import serialization
+from ..resilience.retry import RetryPolicy, call_with_retry
 
 _STEP_RE = re.compile(r'^step_(\d+)$')
+
+
+def _tree_bytes(tree: Any) -> int:
+    """Payload size of a host pytree (array leaves only)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, 'nbytes', None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
 
 
 def _try_orbax():
@@ -42,7 +54,8 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 5,
                  save_interval_steps: int = 1, async_save: bool = False,
-                 backend: Optional[str] = None):
+                 backend: Optional[str] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = max_to_keep
@@ -61,6 +74,10 @@ class CheckpointManager:
         else:
             self.backend = 'orbax' if self._ocp is not None else 'npz'
         self._pending: Optional[threading.Thread] = None
+        # transient I/O failures (flaky NFS/GCS mounts) are retried with
+        # backoff before a save/restore is declared dead
+        self._retry_policy = retry_policy or RetryPolicy()
+        self._writer_exc: Optional[BaseException] = None
 
     # -- bookkeeping --------------------------------------------------------
     def _step_dir(self, step: int) -> str:
@@ -90,7 +107,10 @@ class CheckpointManager:
             else (np.asarray(x) if hasattr(x, 'shape') or isinstance(
                 x, (int, float)) else x), tree)
 
-    def _write(self, step: int, host_tree: Any, cursor=None):
+    def _write_once(self, step: int, host_tree: Any, cursor=None):
+        """One write attempt: tmp dir → serialize → commit marker →
+        atomic rename. Re-entrant (the tmp dir is recreated), so the
+        retry wrapper can safely re-run it after a transient failure."""
         d = self._step_dir(step)
         tmp = d + '.tmp'
         if os.path.exists(tmp):
@@ -115,6 +135,19 @@ class CheckpointManager:
         os.replace(tmp, d)
         self._gc()
 
+    def _write(self, step: int, host_tree: Any, cursor=None):
+        nbytes = _tree_bytes(host_tree)
+        with _obs.span('checkpoint_save', step=step, bytes=nbytes):
+            call_with_retry(self._write_once, step, host_tree, cursor,
+                            policy=self._retry_policy,
+                            site='checkpoint_save')
+        if _obs.enabled():
+            reg = _obs.get_registry()
+            reg.counter('paddle_checkpoint_saves_total',
+                        'committed checkpoint saves').inc()
+            reg.counter('paddle_checkpoint_save_bytes_total',
+                        'checkpoint payload bytes written').inc(nbytes)
+
     def save(self, step: int, tree: Any, force: bool = False,
              dataloader: Any = None):
         """Snapshot `tree` at `step`. Respects save_interval unless forced.
@@ -134,9 +167,17 @@ class CheckpointManager:
         # thread would tear the checkpoint across steps
         host_tree = self._to_host(tree)
         if self.async_save:
+            # the writer thread must not swallow failures: capture the
+            # exception and re-raise it from wait_until_finished() / the
+            # next save() — a silently-lost checkpoint surfaces only at
+            # restore time, which is exactly when it's too late
+            def _write_capturing():
+                try:
+                    self._write(step, host_tree, cursor)
+                except BaseException as e:
+                    self._writer_exc = e
             self._pending = threading.Thread(
-                target=self._write, args=(step, host_tree, cursor),
-                daemon=True)
+                target=_write_capturing, daemon=True)
             self._pending.start()
         else:
             self._write(step, host_tree, cursor)
@@ -165,6 +206,20 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(
                 f'no committed checkpoints under {self.directory}')
+        with _obs.span('checkpoint_restore', step=step):
+            tree = call_with_retry(self._read_tree, step, template,
+                                   policy=self._retry_policy,
+                                   site='checkpoint_restore')
+        if _obs.enabled():
+            reg = _obs.get_registry()
+            reg.counter('paddle_checkpoint_restores_total',
+                        'checkpoint restores').inc()
+            reg.counter('paddle_checkpoint_restore_bytes_total',
+                        'checkpoint payload bytes read').inc(
+                            _tree_bytes(tree))
+        return tree
+
+    def _read_tree(self, step: int, template: Any = None) -> Any:
         d = self._step_dir(step)
         with open(os.path.join(d, '_COMMITTED')) as f:
             meta = json.load(f)
@@ -190,6 +245,11 @@ class CheckpointManager:
         if self._pending is not None:
             self._pending.join()
             self._pending = None
+        if self._writer_exc is not None:
+            exc, self._writer_exc = self._writer_exc, None
+            raise RuntimeError(
+                'async checkpoint write failed (checkpoint NOT '
+                'committed)') from exc
 
     def _gc(self):
         steps = self.all_steps()

@@ -1,0 +1,76 @@
+"""Fault-injection harness for the resilience test suite.
+
+`FaultInjector` wraps a callable (or patches an attribute on a class /
+module / instance __dict__) so that the Nth call fails with a chosen
+exception class — or has its *result* transformed (e.g. into a NaN
+loss) — for `repeat` consecutive calls, then behaves normally again.
+This is how the tests simulate transient I/O errors, bad steps, and
+flaky device transfers without any real flaky infrastructure.
+
+Usage:
+    inj = FaultInjector(nth=3, exc=TransientError('synthetic blip'))
+    flaky = inj.wrap(real_fn)           # call-through wrapper
+
+    with FaultInjector(nth=2, exc=OSError('I/O')).patch(
+            serialization, 'save'):     # module/class attribute patch
+        ...
+
+    with FaultInjector(nth=5, mutate=lambda r: nan_like(r)).patch(
+            TrainStep, '__call__'):     # Nth step returns a NaN loss
+        ...
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional
+
+
+class FaultInjector:
+    """Fail (or mutate the result of) the Nth..Nth+repeat-1 calls.
+
+    Args:
+        nth: 1-based call index at which the fault window opens.
+        exc: exception *instance or class* to raise inside the window.
+        mutate: instead of raising, transform the wrapped callable's
+            return value (mutually exclusive with `exc`).
+        repeat: how many consecutive calls the window covers.
+    """
+
+    def __init__(self, nth: int = 1, exc: Optional[Any] = None,
+                 mutate: Optional[Callable[[Any], Any]] = None,
+                 repeat: int = 1):
+        if (exc is None) == (mutate is None):
+            raise ValueError('pass exactly one of exc= or mutate=')
+        self.nth = int(nth)
+        self.exc = exc
+        self.mutate = mutate
+        self.repeat = int(repeat)
+        self.calls = 0
+        self.fired = 0
+
+    def _in_window(self) -> bool:
+        return self.nth <= self.calls < self.nth + self.repeat
+
+    def wrap(self, fn: Callable) -> Callable:
+        def wrapper(*args, **kwargs):
+            self.calls += 1
+            if self._in_window():
+                self.fired += 1
+                if self.exc is not None:
+                    raise self.exc if isinstance(self.exc, BaseException) \
+                        else self.exc()
+                return self.mutate(fn(*args, **kwargs))
+            return fn(*args, **kwargs)
+        return wrapper
+
+    @contextlib.contextmanager
+    def patch(self, owner: Any, name: str):
+        """Temporarily replace `owner.name` with the faulting wrapper.
+        Works on modules, classes (including dunder methods looked up on
+        the type, e.g. __call__), and plain objects."""
+        original = getattr(owner, name)
+        setattr(owner, name, self.wrap(original))
+        try:
+            yield self
+        finally:
+            setattr(owner, name, original)

@@ -1,0 +1,631 @@
+"""Fault-tolerant training tests: retry/classifier, bad-step rollback,
+preemption save + bit-exact resume, watchdog, checkpoint instrumentation,
+and the async-writer error satellite (ISSUE 3 acceptance criteria)."""
+import math
+import os
+import signal
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+from paddle_tpu import resilience as res
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.utils.checkpoint import CheckpointManager
+
+from fault_injection import FaultInjector
+
+
+def _reg():
+    return obs.get_registry()
+
+
+def _retries_total():
+    fam = _reg().get('paddle_resilience_retries_total')
+    return sum(c.value for c in fam._children.values()) if fam else 0.0
+
+
+# ---------------------------------------------------------------------------
+# retry / classifier
+# ---------------------------------------------------------------------------
+
+class TestClassifier:
+    def test_marker_types(self):
+        assert res.is_transient(res.TransientError('x'))
+        assert res.is_transient(ConnectionResetError('peer gone'))
+        assert res.is_transient(TimeoutError('t'))
+        assert not res.is_transient(res.FatalError('RESOURCE_EXHAUSTED'))
+        assert not res.is_transient(ValueError('bad shape'))
+        assert not res.is_transient(AssertionError('UNAVAILABLE'))
+
+    def test_pjrt_status_vocabulary(self):
+        assert res.is_transient(RuntimeError(
+            'RESOURCE_EXHAUSTED: Out of memory allocating scratch'))
+        assert res.is_transient(RuntimeError(
+            'DEADLINE_EXCEEDED: compile timeout'))
+        assert res.is_transient(RuntimeError('UNAVAILABLE: socket closed'))
+        assert not res.is_transient(RuntimeError(
+            'INVALID_ARGUMENT: rank mismatch'))
+
+    def test_register_transient(self):
+        class StorageThrottled(Exception):
+            pass
+        assert not res.is_transient(StorageThrottled('slow down'))
+        res.register_transient(StorageThrottled)
+        assert res.is_transient(StorageThrottled('slow down'))
+
+
+class TestRetry:
+    def _policy(self, **kw):
+        kw.setdefault('base_delay', 0.0)
+        kw.setdefault('sleep', lambda d: None)
+        return res.RetryPolicy(**kw)
+
+    def test_retries_transient_then_succeeds(self):
+        inj = FaultInjector(nth=1, exc=res.TransientError('blip'), repeat=2)
+        fn = inj.wrap(lambda: 'ok')
+        out = res.call_with_retry(fn, policy=self._policy(max_retries=3),
+                                  site='t1')
+        assert out == 'ok' and inj.calls == 3
+
+    def test_fatal_raises_immediately(self):
+        inj = FaultInjector(nth=1, exc=ValueError('bad'), repeat=9)
+        fn = inj.wrap(lambda: 'ok')
+        with pytest.raises(ValueError):
+            res.call_with_retry(fn, policy=self._policy(max_retries=5))
+        assert inj.calls == 1
+
+    def test_budget_exhausted_reraises(self):
+        inj = FaultInjector(nth=1, exc=res.TransientError('dead'),
+                            repeat=99)
+        fn = inj.wrap(lambda: 'ok')
+        with pytest.raises(res.TransientError):
+            res.call_with_retry(fn, policy=self._policy(max_retries=2))
+        assert inj.calls == 3  # 1 try + 2 retries
+
+    def test_backoff_grows_and_caps(self):
+        p = res.RetryPolicy(base_delay=0.1, max_delay=0.5, jitter=0.0)
+        assert p.delay(0) == pytest.approx(0.1)
+        assert p.delay(1) == pytest.approx(0.2)
+        assert p.delay(10) == pytest.approx(0.5)  # capped
+
+    def test_jitter_bounded(self):
+        p = res.RetryPolicy(base_delay=1.0, jitter=0.25)
+        for a in range(50):
+            assert 0.75 <= p.delay(0) <= 1.25
+
+    def test_decorator_counts_into_registry(self):
+        before = _retries_total()
+        calls = {'n': 0}
+
+        @res.retry(policy=self._policy(max_retries=3), site='deco_test')
+        def flaky():
+            calls['n'] += 1
+            if calls['n'] < 3:
+                raise res.TransientError('blip')
+            return 7
+
+        assert flaky() == 7
+        assert _retries_total() == before + 2
+
+
+# ---------------------------------------------------------------------------
+# FaultTolerantStep
+# ---------------------------------------------------------------------------
+
+def _mk_trainstep(seed=0, lr=0.05):
+    paddle.seed(seed)
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=lr,
+                               parameters=m.parameters())
+    step = TrainStep(m, lambda out, lab: ((out - lab) ** 2).mean(), opt)
+    return m, step
+
+
+def _nan_loss(_loss):
+    from paddle_tpu.tensor import Tensor
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(float('nan'), jnp.float32))
+
+
+class TestFaultTolerantStep:
+    def test_nan_step_rolls_back_and_skips(self):
+        m, step = _mk_trainstep()
+        ft = res.FaultTolerantStep(step, skip_budget=3, check_spikes=False)
+        x = np.random.RandomState(0).randn(8, 4).astype('float32')
+        y = np.random.RandomState(1).randn(8, 2).astype('float32')
+        ft(x, y)
+        w_before = np.asarray(m.weight.value).copy()
+        n_before = step._n_calls
+        with FaultInjector(nth=1, mutate=_nan_loss).patch(
+                TrainStep, '__call__'):
+            loss = ft(x, y)
+        assert math.isnan(float(loss.numpy()))
+        assert ft.last_step_skipped and ft.skipped_batches == 1
+        # params and RNG counter restored to the pre-step snapshot
+        np.testing.assert_array_equal(np.asarray(m.weight.value), w_before)
+        assert step._n_calls == n_before
+        # a good step after the rollback trains normally
+        ft(x, y)
+        assert ft.good_steps == 2
+        assert not np.array_equal(np.asarray(m.weight.value), w_before)
+
+    def test_rollback_replays_identically(self):
+        # the defining property: a rolled-back bad step must leave NO
+        # trace — same state, same RNG key stream as if it never ran
+        x = np.random.RandomState(0).randn(8, 4).astype('float32')
+        y = np.random.RandomState(1).randn(8, 2).astype('float32')
+
+        m1, s1 = _mk_trainstep()
+        plain = [float(s1(x, y).numpy()) for _ in range(4)]
+
+        m2, s2 = _mk_trainstep()
+        ft = res.FaultTolerantStep(s2, skip_budget=2, check_spikes=False)
+        got = [float(ft(x, y).numpy()) for _ in range(2)]
+        with FaultInjector(nth=1, mutate=_nan_loss).patch(
+                TrainStep, '__call__'):
+            ft(x, y)  # bad step, rolled back
+        got += [float(ft(x, y).numpy()) for _ in range(2)]
+        assert got == plain
+
+    def test_skip_budget_exhausted_raises(self):
+        m, step = _mk_trainstep()
+        ft = res.FaultTolerantStep(step, skip_budget=1, check_spikes=False)
+        x = np.zeros((4, 4), 'float32')
+        y = np.zeros((4, 2), 'float32')
+        with FaultInjector(nth=1, mutate=_nan_loss, repeat=99).patch(
+                TrainStep, '__call__'):
+            ft(x, y)  # first bad step: within budget
+            with pytest.raises(res.SkipBudgetExhausted):
+                ft(x, y)
+
+    def test_spike_detection_rolls_back(self):
+        m, step = _mk_trainstep()
+        ft = res.FaultTolerantStep(step, skip_budget=5, spike_sigma=4.0,
+                                   spike_min_steps=3)
+        x = np.random.RandomState(0).randn(8, 4).astype('float32')
+        y = np.random.RandomState(1).randn(8, 2).astype('float32')
+        for _ in range(5):
+            ft(x, y)
+
+        def _spike(_loss):
+            from paddle_tpu.tensor import Tensor
+            import jax.numpy as jnp
+            return Tensor(jnp.float32(1e9))
+        with FaultInjector(nth=1, mutate=_spike).patch(
+                TrainStep, '__call__'):
+            ft(x, y)
+        assert ft.skipped_batches == 1
+
+    def test_counters_land_in_registry(self):
+        before = _reg().value('paddle_resilience_rollbacks_total')
+        m, step = _mk_trainstep()
+        ft = res.FaultTolerantStep(step, skip_budget=3, check_spikes=False)
+        x = np.zeros((4, 4), 'float32')
+        y = np.zeros((4, 2), 'float32')
+        ft(x, y)
+        with FaultInjector(nth=1, mutate=_nan_loss).patch(
+                TrainStep, '__call__'):
+            ft(x, y)
+        assert _reg().value('paddle_resilience_rollbacks_total') \
+            == before + 1
+        names = [e['name'] for e in obs.get_event_log().events()]
+        assert 'bad_step' in names
+
+    def test_transient_step_error_is_retried(self):
+        m, step = _mk_trainstep()
+        policy = res.RetryPolicy(max_retries=2, base_delay=0.0,
+                                 sleep=lambda d: None)
+        ft = res.FaultTolerantStep(step, retry_policy=policy,
+                                   check_spikes=False)
+        x = np.zeros((4, 4), 'float32')
+        y = np.zeros((4, 2), 'float32')
+        before = _retries_total()
+        with FaultInjector(nth=1, exc=res.TransientError('pjrt blip')) \
+                .patch(TrainStep, '__call__'):
+            loss = ft(x, y)
+        assert math.isfinite(float(loss.numpy()))
+        assert _retries_total() == before + 1
+
+    def test_non_step_shaped_requires_snapshot_fns(self):
+        with pytest.raises(TypeError, match='step-shaped'):
+            res.FaultTolerantStep(lambda: 0.0)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_fires_on_overrun_with_last_span(self):
+        import time
+        before = _reg().value('paddle_resilience_hangs_total')
+        with obs.span('pre_hang_marker'):
+            pass
+        wd = res.StepWatchdog(deadline_s=0.05, poll_interval=0.01)
+        try:
+            with wd.watch():
+                time.sleep(0.2)
+        finally:
+            wd.stop()
+        assert wd.fired == 1
+        assert _reg().value('paddle_resilience_hangs_total') == before + 1
+        evs = [e for e in obs.get_event_log().events()
+               if e['name'] == 'hang_suspected']
+        assert evs and evs[-1]['attrs']['elapsed_s'] >= 0.05
+        assert 'last_span' in evs[-1]['attrs']
+
+    def test_does_not_fire_within_deadline(self):
+        wd = res.StepWatchdog(deadline_s=5.0, poll_interval=0.01)
+        try:
+            for _ in range(3):
+                with wd.watch():
+                    pass
+        finally:
+            wd.stop()
+        assert wd.fired == 0
+
+    def test_disabled_by_zero_deadline(self):
+        wd = res.StepWatchdog(deadline_s=0.0)
+        assert not wd.enabled
+        with wd.watch():
+            pass
+        assert wd._thread is None
+
+    def test_on_hang_callable(self):
+        import time
+        seen = []
+        wd = res.StepWatchdog(deadline_s=0.03, poll_interval=0.01,
+                              on_hang=seen.append)
+        try:
+            with wd.watch():
+                time.sleep(0.15)
+        finally:
+            wd.stop()
+        assert seen and seen[0] >= 0.03
+
+
+# ---------------------------------------------------------------------------
+# preemption handler
+# ---------------------------------------------------------------------------
+
+class TestPreemptionHandler:
+    def test_sigterm_sets_flag_no_kill(self):
+        with res.PreemptionHandler() as h:
+            assert not h.requested
+            signal.raise_signal(signal.SIGTERM)
+            assert h.requested and h.signum == signal.SIGTERM
+
+    def test_handlers_restored_on_exit(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        with res.PreemptionHandler():
+            assert signal.getsignal(signal.SIGTERM) != prev
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+    def test_manual_request_and_reset(self):
+        h = res.PreemptionHandler()
+        h.request()
+        assert h.requested
+        h.reset()
+        assert not h.requested
+
+    def test_callback_invoked(self):
+        seen = []
+        with res.PreemptionHandler(callback=seen.append) as h:
+            h.request()
+        assert seen == [signal.SIGTERM]
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager satellites: async errors, retry, spans/bytes
+# ---------------------------------------------------------------------------
+
+class TestCheckpointResilience:
+    def test_async_writer_error_reraised(self, tmp_path):
+        ck = CheckpointManager(str(tmp_path / 'ck'), backend='npz',
+                               async_save=True,
+                               retry_policy=res.RetryPolicy(
+                                   max_retries=0, base_delay=0.0))
+        from paddle_tpu import serialization
+        with FaultInjector(nth=1, exc=res.FatalError('disk gone'),
+                           repeat=99).patch(serialization, 'save'):
+            ck.save(1, {'w': np.ones(4)})
+            with pytest.raises(RuntimeError, match='NOT committed'):
+                ck.wait_until_finished()
+        # failure is reported once, then cleared
+        ck.wait_until_finished()
+        assert ck.all_steps() == []
+
+    def test_async_writer_error_reraised_from_next_save(self, tmp_path):
+        ck = CheckpointManager(str(tmp_path / 'ck'), backend='npz',
+                               async_save=True,
+                               retry_policy=res.RetryPolicy(
+                                   max_retries=0, base_delay=0.0))
+        from paddle_tpu import serialization
+        with FaultInjector(nth=1, exc=res.FatalError('disk gone')).patch(
+                serialization, 'save'):
+            ck.save(1, {'w': np.ones(4)})
+            ck._pending.join()
+            with pytest.raises(RuntimeError, match='NOT committed'):
+                ck.save(2, {'w': np.ones(4)})
+
+    def test_transient_io_error_retried(self, tmp_path):
+        before = _retries_total()
+        ck = CheckpointManager(str(tmp_path / 'ck'), backend='npz',
+                               retry_policy=res.RetryPolicy(
+                                   max_retries=3, base_delay=0.0,
+                                   sleep=lambda d: None))
+        from paddle_tpu import serialization
+        with FaultInjector(nth=1, exc=res.TransientError('nfs blip')) \
+                .patch(serialization, 'save'):
+            ck.save(1, {'w': np.arange(8.0)})
+        assert ck.all_steps() == [1]
+        np.testing.assert_array_equal(ck.restore()['w'], np.arange(8.0))
+        assert _retries_total() == before + 1
+
+    def test_save_restore_spans_and_bytes(self, tmp_path):
+        reg = _reg()
+        saves0 = reg.value('paddle_checkpoint_saves_total')
+        sbytes0 = reg.value('paddle_checkpoint_save_bytes_total')
+        restores0 = reg.value('paddle_checkpoint_restores_total')
+        ck = CheckpointManager(str(tmp_path / 'ck'), backend='npz')
+        payload = {'w': np.ones((32, 32), np.float32)}  # 4096 bytes
+        ck.save(1, payload)
+        ck.restore()
+        assert reg.value('paddle_checkpoint_saves_total') == saves0 + 1
+        assert reg.value('paddle_checkpoint_save_bytes_total') \
+            >= sbytes0 + 32 * 32 * 4
+        assert reg.value('paddle_checkpoint_restores_total') \
+            == restores0 + 1
+        names = [e['name'] for e in obs.get_event_log().events()]
+        assert 'checkpoint_save' in names and 'checkpoint_restore' in names
+
+    def test_summary_mentions_resilience(self):
+        from paddle_tpu import debug
+        s = debug.observability_summary()
+        assert 'resilience:' in s and 'checkpoints:' in s
+
+
+# ---------------------------------------------------------------------------
+# callback NaN robustness satellites
+# ---------------------------------------------------------------------------
+
+class TestCallbackNaNRobustness:
+    def test_early_stopping_nan_not_stored_as_best(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        es = EarlyStopping(monitor='loss', patience=2, mode='min')
+        es.on_eval_end({'loss': 1.0})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            es.on_eval_end({'loss': float('nan')})
+            es.on_eval_end({'loss': float('nan')})
+        assert es.best == 1.0  # NaN never became best
+        assert es.wait == 2
+        assert sum('NaN' in str(x.message) for x in w) == 1  # warn once
+        es.on_eval_end({'loss': 0.5})  # recovery still recognized
+        assert es.best == 0.5 and es.wait == 0
+
+    def test_early_stopping_nan_first_eval(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        es = EarlyStopping(monitor='loss', patience=0, mode='min')
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter('always')
+            es.on_eval_end({'loss': float('nan')})
+        assert es.best is None
+        es.on_eval_end({'loss': 2.0})
+        assert es.best == 2.0
+
+    def test_early_stopping_missing_metric_warns_once(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        es = EarlyStopping(monitor='acc')
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            es.on_eval_end({'loss': 1.0})
+            es.on_eval_end({'loss': 0.9})
+        assert sum('missing' in str(x.message) for x in w) == 1
+        assert es.wait == 0 and not es.stopped
+
+    def test_reduce_lr_nan_robust(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        class FakeOpt:
+            def __init__(self):
+                self.lr = 1.0
+
+            def get_lr(self):
+                return self.lr
+
+            def set_lr(self, v):
+                self.lr = v
+
+        class FakeModel:
+            pass
+        fm = FakeModel()
+        fm._optimizer = FakeOpt()
+        rp = ReduceLROnPlateau(monitor='loss', factor=0.5, patience=2,
+                               mode='min', verbose=0)
+        rp.set_model(fm)
+        rp.on_eval_end({'loss': 1.0})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            for _ in range(2):
+                rp.on_eval_end({'loss': float('nan')})
+        assert rp.best == 1.0  # NaN not stored
+        assert fm._optimizer.lr == 0.5  # plateau of NaNs reduced the LR
+        assert sum('NaN' in str(x.message) for x in w) == 1
+
+
+# ---------------------------------------------------------------------------
+# Model.fit integration: kill-and-resume bit-exact, NaN skip, preemption
+# ---------------------------------------------------------------------------
+
+def _make_model(n=48, in_dim=4, out_dim=2, lr=0.05):
+    paddle.seed(7)
+    net = nn.Linear(in_dim, out_dim)
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=lr,
+                                        parameters=net.parameters()),
+        loss=lambda out, lab: ((out - lab) ** 2).mean())
+    rng = np.random.RandomState(3)
+    x = rng.randn(n, in_dim).astype('float32')
+    y = rng.randn(n, out_dim).astype('float32')
+    ds = TensorDataset([x, y])
+    return model, ds
+
+
+class _RaiseSignalAt(paddle.callbacks.Callback):
+    """Simulate a mid-epoch preemption: deliver SIGTERM from inside the
+    step loop after the Nth batch."""
+
+    def __init__(self, at):
+        super().__init__()
+        self.at = at
+        self._n = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._n += 1
+        if self._n == self.at:
+            signal.raise_signal(signal.SIGTERM)
+
+
+class TestFitKillAndResume:
+    def test_preempt_then_resume_bit_exact(self, tmp_path):
+        # uninterrupted baseline: 2 epochs of 12 batches
+        model_a, ds_a = _make_model()
+        full = model_a.fit(ds_a, batch_size=4, epochs=2, shuffle=True,
+                           verbose=0)['loss']
+        assert len(full) == 24
+
+        # interrupted run: SIGTERM lands after batch 7 (mid-epoch 0)
+        ck = str(tmp_path / 'ck')
+        model_b, ds_b = _make_model()
+        prev_handler = signal.getsignal(signal.SIGTERM)
+        part = model_b.fit(ds_b, batch_size=4, epochs=2, shuffle=True,
+                           verbose=0, ckpt_dir=ck, ckpt_interval=1,
+                           callbacks=[_RaiseSignalAt(7)])['loss']
+        assert len(part) == 7
+        # SIGTERM handler restored after fit
+        assert signal.getsignal(signal.SIGTERM) == prev_handler
+
+        # "new process": fresh model restores the latest committed step
+        model_c, ds_c = _make_model()
+        rest = model_c.fit(ds_c, batch_size=4, epochs=2, shuffle=True,
+                           verbose=0, ckpt_dir=ck, resume='auto')['loss']
+        assert len(rest) == 24 - 7
+        np.testing.assert_array_equal(np.asarray(part + rest),
+                                      np.asarray(full))
+        # preempt-save counter moved
+        assert _reg().value(
+            'paddle_resilience_preempt_saves_total') >= 1
+
+    def test_resume_auto_fresh_dir_is_fresh_run(self, tmp_path):
+        model, ds = _make_model()
+        hist = model.fit(ds, batch_size=4, epochs=1, verbose=0,
+                         ckpt_dir=str(tmp_path / 'empty'), resume='auto')
+        assert len(hist['loss']) == 12
+
+    def test_resume_requires_ckpt_dir(self):
+        model, ds = _make_model()
+        with pytest.raises(ValueError, match='ckpt_dir'):
+            model.fit(ds, batch_size=4, epochs=1, verbose=0, resume='auto')
+
+    def test_nan_step_skipped_within_budget(self, tmp_path):
+        model, ds = _make_model()
+        with FaultInjector(nth=5, mutate=_nan_loss).patch(
+                TrainStep, '__call__'):
+            hist = model.fit(ds, batch_size=4, epochs=1, verbose=0,
+                             fault_tolerance={'skip_budget': 2,
+                                              'check_spikes': False})
+        # 12 batches, 1 dropped: 11 good optimizer steps, no NaN in history
+        assert len(hist['loss']) == 11
+        assert all(math.isfinite(v) for v in hist['loss'])
+        assert hist['resilience']['skipped_batches'] == 1
+        assert hist['resilience']['good_steps'] == 11
+
+    def test_fit_full_fault_gauntlet(self, tmp_path):
+        """Acceptance: one training run suffering (a) a transient
+        checkpoint I/O error, (b) an injected NaN step, and (c) a
+        SIGTERM preemption — completes with the right step counts and
+        matching paddle_resilience_* counters, then resumes bit-exact."""
+        from paddle_tpu import serialization
+        reg = _reg()
+        rollbacks0 = reg.value('paddle_resilience_rollbacks_total')
+        preempts0 = reg.value('paddle_resilience_preempt_saves_total')
+        retries0 = _retries_total()
+
+        model_a, ds_a = _make_model()
+        full = model_a.fit(ds_a, batch_size=4, epochs=2, shuffle=True,
+                           verbose=0)['loss']
+
+        ck = str(tmp_path / 'ck')
+        model_b, ds_b = _make_model()
+        io_fault = FaultInjector(nth=3, exc=res.TransientError('nfs blip'))
+        nan_fault = FaultInjector(nth=6, mutate=_nan_loss)
+        with io_fault.patch(serialization, 'save'), \
+                nan_fault.patch(TrainStep, '__call__'):
+            part = model_b.fit(
+                ds_b, batch_size=4, epochs=2, shuffle=True, verbose=0,
+                ckpt_dir=ck, ckpt_interval=1,
+                fault_tolerance={'skip_budget': 2, 'check_spikes': False},
+                callbacks=[_RaiseSignalAt(10)])['loss']
+        assert io_fault.fired == 1 and nan_fault.fired == 1
+        # 10 batches consumed, 1 dropped to the NaN step -> 9 good steps
+        assert len(part) == 9
+        assert reg.value('paddle_resilience_rollbacks_total') \
+            == rollbacks0 + 1
+        assert reg.value('paddle_resilience_preempt_saves_total') \
+            == preempts0 + 1
+        assert _retries_total() >= retries0 + 1
+
+        # resume replays the rest INCLUDING the batch the NaN step
+        # dropped upstream of the optimizer (it was consumed, so the
+        # baseline index stream just continues)
+        model_c, ds_c = _make_model()
+        rest = model_c.fit(ds_c, batch_size=4, epochs=2, shuffle=True,
+                           verbose=0, ckpt_dir=ck, resume='auto')['loss']
+        assert len(part) + len(rest) == 24 - 1  # exactly one batch lost
+        # the resumed trajectory continues bit-exact from the restored
+        # state: compare against a no-fault baseline that also skips
+        # batch 6 of epoch 0
+        model_d, ds_d = _make_model()
+        with FaultInjector(nth=6, mutate=_nan_loss).patch(
+                TrainStep, '__call__'):
+            ref = model_d.fit(
+                ds_d, batch_size=4, epochs=2, shuffle=True, verbose=0,
+                fault_tolerance={'skip_budget': 2,
+                                 'check_spikes': False})['loss']
+        np.testing.assert_array_equal(np.asarray(part + rest),
+                                      np.asarray(ref))
+
+    def test_watchdog_in_fit(self):
+        model, ds = _make_model()
+        hist = model.fit(ds, batch_size=4, epochs=1, verbose=0,
+                         step_timeout=30.0)
+        assert len(hist['loss']) == 12  # no hang: trains normally
+
+
+# ---------------------------------------------------------------------------
+# tier-1 overhead guard (mirrors the PR-2 obs guard)
+# ---------------------------------------------------------------------------
+
+def test_resilience_overhead_under_3pct():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'bench', os.path.join(os.path.dirname(__file__), '..', 'bench.py'))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    # shared-CPU noise: accept the first trial under the bar, retry up
+    # to 3 times — the wrapper's true cost is a float() sync plus a
+    # 26k-param host snapshot every 10 steps
+    res_ab = None
+    for _ in range(3):
+        res_ab = bench.resilience_overhead_ab(steps=30, trials=3)
+        if res_ab['overhead_pct'] < 3.0:
+            break
+    assert res_ab['overhead_pct'] < 3.0, res_ab
