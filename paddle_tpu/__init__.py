@@ -34,6 +34,7 @@ from . import observability
 from . import profiler
 from . import debug
 from . import resilience
+from . import serving
 from . import metric
 from . import hapi
 from .hapi import Model

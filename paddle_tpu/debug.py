@@ -167,8 +167,9 @@ def observability_summary(max_rows: int = 10) -> str:
     Sections always print (zeros included) so tooling can grep fields:
     dispatch hit-rate, jit compile count + seconds, per-(op, axis)
     collective calls/bytes, offload H2D/D2H transfer bytes, step/token
-    throughput + last loss, device-memory watermark, and the hottest
-    host spans (RecordEvent regions + subsystem spans).
+    throughput + last loss, device-memory watermark, serving engine
+    traffic (requests/queue/slots/TTFT/TPOT), and the hottest host
+    spans (RecordEvent regions + subsystem spans).
     """
     reg = _obs.get_registry()
     snap = reg.snapshot()   # runs collectors (dispatch mirror) first
@@ -218,6 +219,25 @@ def observability_summary(max_rows: int = 10) -> str:
         f'{int(reg.value("paddle_checkpoint_restores_total"))} restores '
         f'({int(reg.value("paddle_checkpoint_restore_bytes_total"))} '
         f'bytes)')
+    lines.append(
+        f'  serving: '
+        f'{int(reg.value("paddle_serving_requests_total", status="submitted"))} '
+        f'requests '
+        f'({int(reg.value("paddle_serving_requests_total", status="completed"))} '
+        f'done, '
+        f'{int(reg.value("paddle_serving_requests_total", status="failed"))} '
+        f'failed)  queue {int(reg.value("paddle_serving_queue_depth"))}  '
+        f'slots {int(reg.value("paddle_serving_active_slots"))}'
+        f'/{int(reg.value("paddle_serving_slots"))}  '
+        f'{int(reg.value("paddle_serving_tokens_total"))} tokens')
+    lines.append(
+        f'    ttft avg {_hist_avg_ms(reg, "paddle_serving_ttft_seconds"):.2f} '
+        f'ms  tpot avg '
+        f'{_hist_avg_ms(reg, "paddle_serving_tpot_seconds"):.2f} ms  '
+        f'{int(_labeled_total(reg, "paddle_serving_prefills_total"))} '
+        f'prefills  '
+        f'{int(reg.value("paddle_serving_decode_steps_total"))} decode '
+        f'steps')
     spans = reg.get('paddle_span_seconds')
     rows = []
     if spans is not None:
@@ -245,6 +265,17 @@ def _labeled_total(reg, name: str) -> float:
     if fam is None:
         return 0.0
     return sum(c.value for c in fam._children.values())
+
+
+def _hist_avg_ms(reg, name: str) -> float:
+    """Mean of an unlabeled histogram family, in milliseconds."""
+    fam = reg.get(name)
+    if fam is None:
+        return 0.0
+    child = fam._children.get(())
+    if child is None or not child.count:
+        return 0.0
+    return child.sum / child.count * 1e3
 
 
 class LossSpikeDetector:

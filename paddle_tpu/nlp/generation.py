@@ -11,6 +11,7 @@ early exit when every sequence has emitted EOS.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -21,6 +22,9 @@ from ..jit import functional_call, functional_method, functional_state
 from ..tensor import Tensor, to_jax
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+# warn-once latch for the prompt-already-at-max_length case (tests reset it)
+_warned_max_length = [False]
 
 
 def as_offset(position_offset):
@@ -46,13 +50,21 @@ def offset_grid(offset, s):
 def update_kv_cache(k_cache, v_cache, k, v, offset):
     """Write new K/V blocks into the static decode cache at `offset`.
     All args are Tensors; [B, L, H_kv, D] caches, [B, S, H_kv, D] updates.
-    Returns (k_cache, v_cache) Tensors. Shared by every causal-LM family
-    so decode-cache semantics can never diverge between models."""
+    `offset` is a scalar slot shared by the whole batch, or a [B] array of
+    per-row slots (the serving engine's slot pool, where every sequence
+    decodes at its own position). Returns (k_cache, v_cache) Tensors.
+    Shared by every causal-LM family so decode-cache semantics can never
+    diverge between models."""
     from ..tensor import apply_op as _apply
+    off = offset.value if isinstance(offset, Tensor) else offset
 
     def upd(c, new):
-        return jax.lax.dynamic_update_slice(c, new.astype(c.dtype),
-                                            (0, offset, 0, 0))
+        new = new.astype(c.dtype)
+        if jnp.ndim(off) >= 1:
+            return jax.vmap(
+                lambda cr, nr, o: jax.lax.dynamic_update_slice(
+                    cr, nr, (o, 0, 0)))(c, new, jnp.asarray(off, jnp.int32))
+        return jax.lax.dynamic_update_slice(c, new, (0, off, 0, 0))
     return (_apply(upd, k_cache, k, _name='cache_update'),
             _apply(upd, v_cache, v, _name='cache_update'))
 
@@ -97,10 +109,12 @@ def _process_logits(logits, temperature, top_k, top_p):
         logits = logits / jnp.maximum(temperature, 1e-6)
     v = logits.shape[-1]
     if top_k and 0 < top_k < v:
-        kth = jnp.sort(logits, axis=-1)[:, v - top_k][:, None]
+        # lax.top_k touches k values instead of sorting the whole vocab
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, _NEG_INF, logits)
     if top_p and top_p < 1.0:
-        srt = jnp.sort(logits, axis=-1)[:, ::-1]
+        # full descending sort via top_k(v) — one primitive for both paths
+        srt = jax.lax.top_k(logits, v)[0]
         probs = jax.nn.softmax(srt, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # keep tokens until cumulative prob exceeds top_p (always keep top-1)
@@ -121,6 +135,25 @@ def _next_token(logits, key, strategy, temperature, top_k, top_p):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     tok_logp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
     return tok, tok_logp
+
+
+def cached_forward(model, params, frozen, buffers):
+    """The one cached-decode forward contract: returns
+    ``fwd(tok, cache, pos_offset, slot, mask) -> (logits, new_cache)``
+    running `model` functionally with the given state bound in. Shared by
+    the greedy/sampling and beam decode loops below AND by the serving
+    engine's slot-pooled decode step (paddle_tpu.serving.engine), so the
+    decode-step semantics (position origin, cache slot, mask override)
+    can never diverge between the batch and continuous-batching paths.
+    `pos_offset`/`slot` may be scalars or per-row [B] arrays."""
+    def fwd(tok, cache, pos_offset, slot, mask):
+        (logits, new_cache), _ = functional_call(
+            model, params, frozen, buffers, (tok,),
+            dict(cache=cache, position_offset=pos_offset,
+                 cache_offset=slot, attention_mask=mask,
+                 use_cache=True))
+        return logits, new_cache
+    return fwd
 
 
 class GenerationMixin:
@@ -181,13 +214,7 @@ class GenerationMixin:
             else:
                 seen0 = jnp.zeros((b, 1), bool)  # unused placeholder
 
-            def fwd(tok, cache, pos_offset, slot, mask):
-                (logits, new_cache), _ = functional_call(
-                    self, params, frozen, buffers, (tok,),
-                    dict(cache=cache, position_offset=pos_offset,
-                         cache_offset=slot, attention_mask=mask,
-                         use_cache=True))
-                return logits, new_cache
+            fwd = cached_forward(self, params, frozen, buffers)
 
             if padded:
                 # left-padded prompts: per-sequence logical origin
@@ -273,14 +300,7 @@ class GenerationMixin:
         def decode(params, frozen, buffers, ids, keep, cache):
             b, s = ids.shape
             total = s + max_new_tokens
-
-            def fwd(tok, cache, pos_offset, slot, mask):
-                (logits, new_cache), _ = functional_call(
-                    self, params, frozen, buffers, (tok,),
-                    dict(cache=cache, position_offset=pos_offset,
-                         cache_offset=slot, attention_mask=mask,
-                         use_cache=True))
-                return logits, new_cache
+            fwd = cached_forward(self, params, frozen, buffers)
 
             if padded:
                 offsets = jnp.sum(keep, axis=1).astype(jnp.int32) - s  # [B]
@@ -403,7 +423,20 @@ class GenerationMixin:
         else:
             keep = jnp.ones((b, s), bool)
         if max_length is not None:
-            max_new_tokens = max(int(max_length) - s, 1)
+            max_new_tokens = int(max_length) - s
+            if max_new_tokens <= 0:
+                # upstream semantics: a prompt that already meets/exceeds
+                # max_length gets NO new tokens (the old behavior silently
+                # clamped to 1 and decoded past the requested total length)
+                if not _warned_max_length[0]:
+                    _warned_max_length[0] = True
+                    warnings.warn(
+                        f'generate(): prompt length {s} already meets '
+                        f'max_length={int(max_length)}; returning 0 new '
+                        f'tokens. Use max_new_tokens= to request a budget '
+                        f'beyond the prompt.', UserWarning, stacklevel=2)
+                return (Tensor(jnp.zeros((b, 0), jnp.int32)),
+                        Tensor(jnp.zeros((b,), jnp.float32)))
         if min_length is not None:  # upstream name: total-length minimum
             min_new_tokens = max(int(min_length) - s, min_new_tokens)
         if decode_strategy == 'beam_search' and (

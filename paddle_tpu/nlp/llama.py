@@ -152,7 +152,8 @@ class LlamaAttention(Layer):
                 cache=None, cache_offset=None):
         cfg = self.config
         offset = _as_offset(position_offset)
-        # cache_offset = SLOT index in the static cache (always scalar);
+        # cache_offset = SLOT index in the static cache (scalar, or [B]
+        # per-row slots for the serving engine's slot pool);
         # position_offset = LOGICAL position for RoPE (scalar or [B] for
         # left-padded prompts). They coincide for unpadded prompts.
         slot = _as_offset(cache_offset) if cache_offset is not None \

@@ -1,0 +1,38 @@
+"""paddle_tpu.serving — continuous-batching inference engine.
+
+The batch-synchronous `generate()` path admits a whole batch together
+and every sequence waits for the slowest one. This subsystem serves
+heavy mixed-length traffic instead: an `InferenceEngine` owning a
+preallocated fixed-slot KV-cache pool (`kv_pool.SlotPool`, N slots x
+max_length with length-bucketed prefill), an iteration-level FCFS
+scheduler (`scheduler.FCFSScheduler`) that admits and retires requests
+BETWEEN decode steps (Orca, OSDI'22; pooled-cache management after
+vLLM/PagedAttention, SOSP'23 — fixed slots instead of paged blocks
+because TPU programs want static shapes), and ONE compiled decode step
+carrying per-slot positions, active mask, and sampling params as
+arrays. Greedy outputs are token-for-token identical to `generate()`;
+everything reports into the shared observability registry
+(`paddle_serving_*`), and host<->device transfers ride the resilience
+retry layer with request-level (not engine-level) failure.
+
+    from paddle_tpu.serving import InferenceEngine, SamplingParams
+
+    eng = InferenceEngine(model, num_slots=8, max_length=256)
+    h = eng.submit(prompt_ids, SamplingParams(max_new_tokens=32))
+    for tok in h.stream():
+        ...                       # per-token, as slots advance
+    hs = eng.generate_many(prompts)   # continuous-batched batch API
+"""
+from __future__ import annotations
+
+from .api import (FAILED, FINISHED, GREEDY, QUEUED, RUNNING, SAMPLING,
+                  RequestHandle, SamplingParams)
+from .engine import InferenceEngine, sample_rows
+from .kv_pool import SlotPool, default_buckets
+from .scheduler import FCFSScheduler
+
+__all__ = [
+    'FAILED', 'FINISHED', 'GREEDY', 'QUEUED', 'RUNNING', 'SAMPLING',
+    'RequestHandle', 'SamplingParams', 'InferenceEngine', 'sample_rows',
+    'SlotPool', 'default_buckets', 'FCFSScheduler',
+]

@@ -1,0 +1,479 @@
+"""Continuous-batching inference engine over a slot-pooled KV cache.
+
+`GenerationMixin.generate()` is batch-synchronous: the whole batch is
+admitted together, decodes in lock-step, and every sequence waits for
+the slowest one. This engine is the iteration-level alternative (Orca,
+Yu et al. OSDI'22): a fixed pool of KV slots (kv_pool.SlotPool), an
+FCFS scheduler that admits queued requests into freed slots BETWEEN
+decode steps (scheduler.FCFSScheduler), and ONE compiled decode step
+that advances every occupied slot a block of tokens at a time with
+per-slot position offsets, an active-slot mask, and per-slot sampling
+params carried as arrays — so heterogeneous requests (different prompt
+lengths, token budgets, temperatures, eos ids) share a single XLA
+program and admission/retirement never recompiles anything.
+
+Compiled-program inventory (asserted by the zero-recompile tests):
+- one decode-block step (shapes fixed by num_slots/max_length/block),
+- one prefill program per length bucket (right-padded prompts; pad KV
+  lands above the live position where the slot-causal mask hides it
+  until the slot's own decode overwrites it — the stale-slot argument
+  speculative decoding already relies on),
+- the slot-pool writer.
+
+Greedy requests take the raw argmax exactly like `generate()`, so their
+outputs are token-for-token identical to a per-request generate() call
+(the bench.py `serving` phase guards this bit-for-bit).
+
+Resilience: host<->device transfers ride `resilience.call_with_retry`
+(transient blips retried with backoff); any prefill/transfer failure is
+a REQUEST-level error — the handle turns FAILED, the slot frees, and
+the engine keeps serving everyone else.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _obs
+from ..jit import functional_state
+from ..nlp.generation import _NEG_INF, cached_forward
+from ..resilience import RetryPolicy, call_with_retry
+from ..tensor import Tensor
+from .api import GREEDY, RUNNING, RequestHandle, SamplingParams
+from .kv_pool import SlotPool
+from .scheduler import FCFSScheduler
+
+# occupancy is a ratio; the latency-shaped default buckets are wrong here
+_OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+def _to_device(x):
+    """Host->device staging of prompts (module-level so fault-injection
+    tests can patch it; production call sites wrap it in retry)."""
+    return jnp.asarray(x)
+
+
+def _from_device(x):
+    """Device->host fetch of sampled tokens (patchable, see _to_device)."""
+    return np.asarray(x)
+
+
+def sample_rows(logits, temp, topk, topp, greedy, keys, steps):
+    """Vectorized per-row sampling over a [N, V] logits slab with PER-ROW
+    params (arrays, not static config — one compiled program serves every
+    request mix). Greedy rows take the raw argmax — bit-identical to
+    `_next_token`'s greedy path — so a greedy request's tokens never
+    depend on its batch neighbours. Sampling rows apply temperature, then
+    top-k, then top-p (the `_process_logits` order) and draw
+    categorically with their own folded key."""
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def do_sample(_):
+        scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+        # per-row top-k: k <= 0 or >= v disables (mirrors _process_logits)
+        srt = jax.lax.top_k(scaled, v)[0]                   # descending
+        k_eff = jnp.where((topk > 0) & (topk < v), topk,
+                          v).astype(jnp.int32)
+        kth = jnp.take_along_axis(srt, k_eff[:, None] - 1, axis=-1)
+        x = jnp.where(scaled < kth, _NEG_INF, scaled)
+        # per-row top-p over the already-top-k-filtered slab
+        srt_p = jax.lax.top_k(x, v)[0]
+        probs = jax.nn.softmax(srt_p, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum((cum - probs) < topp[:, None], axis=-1) - 1
+        cutoff = jnp.take_along_axis(
+            srt_p, jnp.clip(cutoff_idx, 0, v - 1)[:, None], axis=-1)
+        x = jnp.where((topp[:, None] < 1.0) & (x < cutoff), _NEG_INF, x)
+        keys_f = jax.vmap(jax.random.fold_in)(keys, steps)
+        return jax.vmap(jax.random.categorical)(keys_f,
+                                                x).astype(jnp.int32)
+
+    # all-greedy batches (the common serving mix) skip the two full-vocab
+    # sorts + RNG entirely — lax.cond picks the branch at RUN time, so
+    # the mix can change step to step without recompiling
+    sampled = jax.lax.cond(jnp.all(greedy), lambda _: greedy_tok,
+                           do_sample, None)
+    return jnp.where(greedy, greedy_tok, sampled)
+
+
+class InferenceEngine:
+    """Single-host continuous-batching engine around one causal-LM.
+
+    Args:
+        model: any `GenerationMixin` model honoring the `init_cache` /
+            cached-forward contract (weights are snapshotted at
+            construction). Put the model in eval() yourself if it holds
+            dropout state; the engine forces eval.
+        num_slots: KV slots = max concurrently decoding requests.
+        max_length: per-slot cache length; every request needs
+            prompt_len + max_new_tokens <= max_length.
+        decode_block: tokens decoded per compiled step (device-side
+            lax.scan). Larger blocks amortize host dispatch; a request
+            finishing mid-block wastes at most block-1 sub-steps.
+        buckets: prefill length buckets (default: powers of two).
+        max_prefill_tokens: per-iteration prefill budget (scheduler).
+        eos_token_id: default eos (-1 = never); per-request params win.
+        retry_policy: resilience.RetryPolicy for host<->device
+            transfers (default: flag-configured policy).
+
+    Not thread-safe: one engine is one event loop; drive it with
+    `step()`, `run()`, `stream()`, or `generate_many()`.
+    """
+
+    def __init__(self, model, num_slots: int = 8, max_length: int = 256,
+                 decode_block: int = 4,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_prefill_tokens: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 dtype=None, retry_policy: Optional[RetryPolicy] = None):
+        cfg = getattr(model, 'config', None)
+        max_pos = getattr(cfg, 'max_position_embeddings', None)
+        if max_pos is not None and max_length > max_pos:
+            raise ValueError(
+                f'max_length {max_length} exceeds the model\'s '
+                f'max_position_embeddings {max_pos}')
+        if decode_block < 1:
+            raise ValueError('decode_block must be >= 1')
+        model.eval()
+        self.model = model
+        self._params, self._frozen, self._buffers = functional_state(model)
+        self.eos_token_id = int(
+            getattr(cfg, 'eos_token_id', -1) if eos_token_id is None
+            else eos_token_id)
+        self.decode_block = int(decode_block)
+        self.pool = SlotPool(model, num_slots, max_length, dtype, buckets)
+        self.scheduler = FCFSScheduler(max_prefill_tokens)
+        self._retry = retry_policy or RetryPolicy()
+
+        n = self.pool.num_slots
+        # per-slot decode state + sampling params, host-authoritative
+        # (tiny arrays re-staged every step; the KV pool stays on device)
+        self._tok = np.zeros(n, np.int32)       # pending (last emitted)
+        self._pos = np.zeros(n, np.int32)       # its cache slot/position
+        self._steps = np.zeros(n, np.int32)     # per-request sample index
+        self._active = np.zeros(n, bool)
+        self._temp = np.ones(n, np.float32)
+        self._topk = np.zeros(n, np.int32)
+        self._topp = np.ones(n, np.float32)
+        self._greedy = np.ones(n, bool)
+        self._keys = np.zeros((n, 2), np.uint32)
+        self._slot_req: dict = {}               # slot -> RequestHandle
+
+        self._trace_counts = collections.Counter()
+        self._counts = collections.Counter()
+        self._decode_jit = jax.jit(self._decode_block_fn)
+        self._prefill_jit = jax.jit(self._prefill_fn)  # 1 trace per bucket
+        self._init_metrics()
+
+    # ------------------------------------------------------------------
+    # metrics
+    # ------------------------------------------------------------------
+    def _init_metrics(self):
+        reg = _obs.get_registry()
+        self._m_requests = reg.counter(
+            'paddle_serving_requests_total',
+            'serving requests by lifecycle event', ('status',))
+        self._m_tokens = reg.counter(
+            'paddle_serving_tokens_total', 'generated tokens')
+        self._m_prefills = reg.counter(
+            'paddle_serving_prefills_total', 'prefills by length bucket',
+            ('bucket',))
+        self._m_prefill_tokens = reg.counter(
+            'paddle_serving_prefill_tokens_total',
+            'real (unpadded) prompt tokens prefilled')
+        self._m_decode_steps = reg.counter(
+            'paddle_serving_decode_steps_total',
+            'single-token decode sub-steps executed')
+        self._m_rounds = reg.counter(
+            'paddle_serving_decode_rounds_total',
+            'compiled decode-block invocations')
+        self._m_slots = reg.gauge(
+            'paddle_serving_slots', 'KV slot capacity')
+        self._m_active = reg.gauge(
+            'paddle_serving_active_slots', 'slots currently decoding')
+        self._m_occupancy = reg.histogram(
+            'paddle_serving_slot_occupancy',
+            'occupied-slot fraction per decode round',
+            buckets=_OCCUPANCY_BUCKETS)
+        self._m_ttft = reg.histogram(
+            'paddle_serving_ttft_seconds',
+            'submit -> first token latency')
+        self._m_tpot = reg.histogram(
+            'paddle_serving_tpot_seconds',
+            'mean inter-token latency per finished request')
+        if _obs.enabled():
+            self._m_slots.set(self.pool.num_slots)
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _decode_block_fn(self, params, frozen, buffers, pool, tok, pos,
+                         steps, active, temp, topk, topp, greedy, keys):
+        """One compiled program: `decode_block` single-token steps over
+        ALL slots (lax.scan), per-slot positions/masks/sampling."""
+        self._trace_counts['decode_step'] += 1   # python-level trace count
+        fwd = cached_forward(self.model, params, frozen, buffers)
+        max_len = self.pool.max_length
+        k_slot = jnp.arange(max_len, dtype=jnp.int32)
+
+        def sub(carry, _):
+            tok, pos, steps, pool = carry
+            # pending token writes its KV at slot `pos` and attends to
+            # every slot <= pos; freed/stale rows above are masked out
+            mask = (k_slot[None, :] <= pos[:, None])[:, None, None, :]
+            logits, pool = fwd(tok[:, None], pool, pos, pos, mask)
+            nxt = sample_rows(logits[:, -1], temp, topk, topp, greedy,
+                              keys, steps)
+            nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+            pos = jnp.minimum(pos + 1, jnp.int32(max_len - 1))
+            return (nxt, pos, steps + 1, pool), nxt
+
+        (tok, pos, steps, pool), toks = jax.lax.scan(
+            sub, (tok, pos, steps, pool), None, length=self.decode_block)
+        return jnp.transpose(toks), pool         # [num_slots, block]
+
+    def _prefill_fn(self, params, frozen, buffers, pool, slot, ids):
+        """Prefill ONE request (batch-1, right-padded to its bucket) and
+        scatter the resulting KV slab into the pool row `slot`. KV-only
+        and fully async: no logits leave the device — the request's
+        FIRST token falls out of the next decode block, which re-forwards
+        the last prompt token at position s-1 (an identical overwrite of
+        its KV slot) and samples from the same last-position logits the
+        prefill computed. One compile per bucket (ids.shape), everything
+        else traced."""
+        self._trace_counts[f'prefill_{ids.shape[1]}'] += 1
+        fwd = cached_forward(self.model, params, frozen, buffers)
+        slab = jax.tree_util.tree_map(
+            lambda c: jnp.zeros((1,) + c.shape[1:], c.dtype), pool)
+        _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
+        return jax.tree_util.tree_map(
+            lambda c, s: jax.lax.dynamic_update_slice(
+                c, s.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1)),
+            pool, slab)
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _normalize_prompt(prompt) -> List[int]:
+        if isinstance(prompt, Tensor):
+            prompt = prompt.numpy()
+        arr = np.asarray(prompt)
+        if arr.ndim == 2 and arr.shape[0] == 1:
+            arr = arr[0]
+        if arr.ndim != 1 or arr.size < 1:
+            raise ValueError(
+                f'prompt must be a non-empty 1-D token sequence, got '
+                f'shape {arr.shape}')
+        return [int(t) for t in arr]
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               **kwargs) -> RequestHandle:
+        """Queue one request; returns its live handle. Validation errors
+        raise HERE (caller bug); runtime failures mark the handle
+        FAILED instead."""
+        if params is None:
+            params = SamplingParams(**kwargs)
+        elif kwargs:
+            raise TypeError('pass params= or keyword sampling args, '
+                            'not both')
+        toks = self._normalize_prompt(prompt)
+        self.pool.bucket_for(len(toks))   # raises when no bucket fits
+        if len(toks) + params.max_new_tokens > self.pool.max_length:
+            raise ValueError(
+                f'prompt ({len(toks)}) + max_new_tokens '
+                f'({params.max_new_tokens}) exceeds the slot length '
+                f'({self.pool.max_length})')
+        h = RequestHandle(toks, params, engine=self)
+        h._eos = int(self.eos_token_id if params.eos_token_id is None
+                     else params.eos_token_id)
+        self._counts['submitted'] += 1
+        if _obs.enabled():
+            self._m_requests.labels(status='submitted').inc()
+        self.scheduler.submit(h)
+        return h
+
+    # ------------------------------------------------------------------
+    # the iteration loop
+    # ------------------------------------------------------------------
+    @property
+    def has_work(self) -> bool:
+        return bool(self._slot_req) or self.scheduler.queue_depth > 0
+
+    def step(self) -> int:
+        """ONE scheduler iteration: admit queued requests into free
+        slots, then advance every occupied slot one decode block.
+        Returns the number of requests that progressed."""
+        self._admit()
+        if not self._slot_req:
+            return 0
+        toks_dev, new_pool = self._decode_jit(
+            self._params, self._frozen, self._buffers, self.pool.cache,
+            self._tok, self._pos, self._steps, self._active, self._temp,
+            self._topk, self._topp, self._greedy, self._keys)
+        self.pool.cache = new_pool
+        toks = call_with_retry(_from_device, toks_dev,
+                               policy=self._retry, site='serving.d2h')
+        now = time.perf_counter()
+        n = len(self._slot_req)
+        self._counts['decode_rounds'] += 1
+        self._counts['decode_steps'] += self.decode_block
+        if _obs.enabled():
+            self._m_rounds.inc()
+            self._m_decode_steps.inc(self.decode_block)
+            self._m_occupancy.observe(self.pool.occupancy)
+            self._m_tokens.inc(0)   # ensure the family exists even idle
+        for slot, h in list(self._slot_req.items()):
+            done = False
+            emitted = 0
+            first = not h.tokens
+            for j in range(self.decode_block):
+                t = int(toks[slot, j])
+                h._emit(t, now)
+                emitted += 1
+                if (len(h.tokens) >= h.params.max_new_tokens
+                        or t == h._eos):
+                    done = True
+                    break
+            self._counts['tokens'] += emitted
+            if _obs.enabled():
+                self._m_tokens.inc(emitted)
+                if first:
+                    self._m_ttft.observe(h.ttft)
+            if done:
+                self._retire(slot, h, now)
+            else:
+                self._tok[slot] = toks[slot, self.decode_block - 1]
+                self._pos[slot] += self.decode_block
+                self._steps[slot] += self.decode_block
+        return n
+
+    def run(self) -> int:
+        """Drive until queue and slots drain; returns decode rounds."""
+        rounds = 0
+        while self.has_work:
+            self.step()
+            rounds += 1
+        return rounds
+
+    def stream(self, handle: RequestHandle):
+        """Per-token iterator for one request (see RequestHandle.stream)."""
+        return handle.stream()
+
+    def generate_many(self, prompts, params=None) -> List[RequestHandle]:
+        """Submit a batch of prompts and drain the engine — the
+        continuous-batching replacement for a sequential `generate()`
+        loop on mixed-length workloads. `params` is one SamplingParams
+        for all, or a per-prompt sequence."""
+        if params is None or isinstance(params, SamplingParams):
+            params = [params or SamplingParams()] * len(prompts)
+        if len(params) != len(prompts):
+            raise ValueError('one SamplingParams per prompt')
+        handles = [self.submit(p, sp) for p, sp in zip(prompts, params)]
+        self.run()
+        return handles
+
+    # ------------------------------------------------------------------
+    # admission / retirement
+    # ------------------------------------------------------------------
+    def _admit(self):
+        for h in self.scheduler.admissible(self.pool.free_count,
+                                           self.pool.bucket_for):
+            slot = self.pool.alloc()
+            try:
+                self._prefill_into(slot, h)
+            except Exception as exc:
+                # REQUEST-level failure: free the slot, fail the handle,
+                # keep the engine serving everyone else
+                self.pool.free(slot)
+                h._fail(exc)
+                self._counts['failed'] += 1
+                if _obs.enabled():
+                    self._m_requests.labels(status='failed').inc()
+                    _obs.emit('serving_request_failed',
+                              request_id=h.request_id,
+                              error=type(exc).__name__)
+        if _obs.enabled():
+            self._m_active.set(self.pool.used_count)
+
+    def _prefill_into(self, slot: int, h: RequestHandle):
+        p = h.params
+        s = len(h.prompt_tokens)
+        bucket = self.pool.bucket_for(s)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :s] = h.prompt_tokens
+        ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
+                                  site='serving.h2d')
+        greedy = p.strategy == GREEDY
+        key = (np.zeros(2, np.uint32) if greedy else np.asarray(
+            jax.random.PRNGKey(h.request_id if p.seed is None
+                               else p.seed), np.uint32))
+        self.pool.cache = self._prefill_jit(
+            self._params, self._frozen, self._buffers, self.pool.cache,
+            jnp.int32(slot), ids_dev)
+        h.status = RUNNING
+        self._counts['prefills'] += 1
+        self._counts['prefill_tokens'] += s
+        if _obs.enabled():
+            self._m_prefills.labels(bucket=bucket).inc()
+            self._m_prefill_tokens.inc(s)
+        # pending = the LAST prompt token at position s-1: the next decode
+        # block re-forwards it (identical KV overwrite) and its sampled
+        # output is the request's first generated token
+        self._tok[slot] = h.prompt_tokens[-1]
+        self._pos[slot] = s - 1
+        self._steps[slot] = 0
+        self._active[slot] = True
+        self._temp[slot] = p.temperature
+        self._topk[slot] = p.top_k
+        self._topp[slot] = p.top_p
+        self._greedy[slot] = greedy
+        self._keys[slot] = key
+        self._slot_req[slot] = h
+
+    def _retire(self, slot: int, h: RequestHandle, now: float):
+        h._finish(now)
+        del self._slot_req[slot]
+        self._active[slot] = False
+        self.pool.free(slot)
+        self._counts['completed'] += 1
+        if _obs.enabled():
+            self._m_requests.labels(status='completed').inc()
+            self._m_active.set(self.pool.used_count)
+            tpot = h.tpot
+            if tpot is not None:
+                self._m_tpot.observe(tpot)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Host-side counters + compile-trace counts (the zero-recompile
+        assertions read `traces`: after warmup it must stop growing
+        across admissions)."""
+        return {
+            'submitted': self._counts['submitted'],
+            'completed': self._counts['completed'],
+            'failed': self._counts['failed'],
+            'tokens': self._counts['tokens'],
+            'prefills': self._counts['prefills'],
+            'prefill_tokens': self._counts['prefill_tokens'],
+            'decode_rounds': self._counts['decode_rounds'],
+            'decode_steps': self._counts['decode_steps'],
+            'queue_depth': self.scheduler.queue_depth,
+            'active_slots': self.pool.used_count,
+            'traces': dict(self._trace_counts),
+            'pool': self.pool.stats(),
+        }
+
+    def reset_stats(self):
+        """Zero the host-side counters (trace counts survive — they
+        track compiles, which persist in the jit caches)."""
+        self._counts.clear()

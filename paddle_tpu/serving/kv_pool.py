@@ -1,0 +1,127 @@
+"""Slot-pooled KV cache: N fixed slots x max_length, allocated ONCE.
+
+vLLM's PagedAttention (Kwon et al. SOSP'23) pools KV memory in small
+blocks behind an address-translation step; on TPU the same "requests
+share one preallocated cache" idea wants STATIC shapes, so the pool here
+is the coarser fixed-slot variant: one [num_slots, max_length, H_kv, D]
+cache per layer (exactly the model's own `init_cache` layout with the
+batch dim reinterpreted as slots). A slot is the unit of admission:
+alloc on prefill, free on retirement, and the decode step runs over ALL
+slots every iteration with per-slot positions — freed slots are simply
+masked until a new request overwrites them, so admission never
+recompiles anything.
+
+Prefill shapes are length-bucketed: a prompt of length s runs at the
+smallest bucket >= s (right-padded; pad KV lands above the live
+position, where the slot-causal decode mask hides it until the slot's
+own decode overwrites it — the same stale-slot argument as speculative
+decoding). Buckets bound the number of prefill compilations to
+O(len(buckets)), not O(distinct prompt lengths).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def default_buckets(max_length: int, smallest: int = 8) -> Tuple[int, ...]:
+    """Powers of two from `smallest` up to max_length (max_length always
+    included so every admissible prompt has a bucket)."""
+    out: List[int] = []
+    b = smallest
+    while b < max_length:
+        out.append(b)
+        b *= 2
+    out.append(max_length)
+    return tuple(out)
+
+
+class SlotPool:
+    """Owns the pooled cache pytree + the slot free list.
+
+    The cache is whatever `model.init_cache(num_slots, max_length)`
+    returns (per-layer (K, V) pairs for every causal-LM family here), so
+    the pool works for any model honoring the init_cache contract.
+    """
+
+    def __init__(self, model, num_slots: int, max_length: int,
+                 dtype=None, buckets: Optional[Sequence[int]] = None):
+        if num_slots < 1:
+            raise ValueError('num_slots must be >= 1')
+        if max_length < 2:
+            raise ValueError('max_length must be >= 2')
+        self.num_slots = int(num_slots)
+        self.max_length = int(max_length)
+        self.cache = model.init_cache(self.num_slots, self.max_length,
+                                      dtype)
+        self.buckets = tuple(sorted(set(
+            int(b) for b in (buckets or default_buckets(self.max_length))
+            if int(b) <= self.max_length)))
+        if not self.buckets:
+            raise ValueError('no prefill bucket <= max_length')
+        self._free = sorted(range(self.num_slots), reverse=True)
+        self._write_traces = 0
+        self._write_jit = jax.jit(self._write_fn)
+
+    # -- slot lifecycle ----------------------------------------------------
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.used_count / self.num_slots
+
+    def alloc(self) -> int:
+        """Claim the lowest free slot index; raises when full (the
+        scheduler checks free_count before admitting)."""
+        if not self._free:
+            raise RuntimeError('slot pool exhausted')
+        return self._free.pop()
+
+    def free(self, slot: int):
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f'slot {slot} out of range')
+        if slot in self._free:
+            raise ValueError(f'slot {slot} is already free')
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+
+    # -- prefill bucketing -------------------------------------------------
+    def bucket_for(self, length: int) -> int:
+        """Smallest bucket >= length; ValueError past the largest."""
+        for b in self.buckets:
+            if b >= length:
+                return b
+        raise ValueError(
+            f'prompt length {length} exceeds the largest prefill bucket '
+            f'{self.buckets[-1]} (max_length {self.max_length})')
+
+    # -- pooled-cache writes -----------------------------------------------
+    def _write_fn(self, pool, slab, slot):
+        # one compile total: `slot` is traced, shapes are static
+        self._write_traces += 1
+        return jax.tree_util.tree_map(
+            lambda c, s: jax.lax.dynamic_update_slice(
+                c, s.astype(c.dtype),
+                (slot,) + (0,) * (c.ndim - 1)),
+            pool, slab)
+
+    def write_slot(self, slot: int, slab):
+        """Scatter a batch-1 prefill cache (leaves [1, max_length, ...])
+        into the pool's row `slot` — the hand-off from prefill to the
+        pooled decode step."""
+        self.cache = self._write_jit(self.cache, slab,
+                                     jnp.int32(slot))
+
+    def stats(self) -> dict:
+        return {'num_slots': self.num_slots, 'max_length': self.max_length,
+                'used': self.used_count, 'free': self.free_count,
+                'buckets': list(self.buckets),
+                'write_traces': self._write_traces}

@@ -1,0 +1,80 @@
+"""Iteration-level FCFS scheduler (Orca-style continuous batching).
+
+The engine calls `admissible()` between decode steps; the scheduler
+hands back the queue head(s) that fit the currently free slots, under a
+per-iteration prefill token budget so a burst of long prompts cannot
+starve the decode of already-running requests (the prefill/decode
+interleave knob). Admission is strictly FCFS — the head request is never
+overtaken by a shorter one behind it (no starvation), and the FIRST
+admission of an iteration ignores the budget so a single over-budget
+prompt still makes progress.
+
+Queue depth is exported as `paddle_serving_queue_depth` on every
+mutation, so the gauge is live even between scrapes.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Deque, List, Optional
+
+from .. import observability as _obs
+from .api import RequestHandle
+
+
+class FCFSScheduler:
+    """FCFS request queue + iteration-level admission policy.
+
+    `max_prefill_tokens` caps the summed BUCKETED prompt lengths admitted
+    in one scheduling iteration (0/None = unbounded). Bucketed — not raw
+    — lengths, because the bucket is what the prefill actually computes.
+    """
+
+    def __init__(self, max_prefill_tokens: Optional[int] = None):
+        self.max_prefill_tokens = (int(max_prefill_tokens)
+                                   if max_prefill_tokens else 0)
+        self._queue: Deque[RequestHandle] = collections.deque()
+        self._gauge = None
+        if _obs.enabled():
+            self._gauge = _obs.get_registry().gauge(
+                'paddle_serving_queue_depth',
+                'requests waiting for a slot')
+            self._gauge.set(0)
+
+    def _note_depth(self):
+        if self._gauge is not None:
+            self._gauge.set(len(self._queue))
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, handle: RequestHandle):
+        self._queue.append(handle)
+        self._note_depth()
+
+    def cancel(self, handle: RequestHandle) -> bool:
+        """Drop a still-queued request; False if it already left the
+        queue (running requests retire through the engine)."""
+        try:
+            self._queue.remove(handle)
+        except ValueError:
+            return False
+        self._note_depth()
+        return True
+
+    def admissible(self, free_slots: int,
+                   bucket_for: Callable[[int], int]) -> List[RequestHandle]:
+        """Pop the FCFS prefix that fits `free_slots` and the prefill
+        token budget this iteration."""
+        admitted: List[RequestHandle] = []
+        budget = self.max_prefill_tokens
+        while self._queue and free_slots > 0:
+            cost = bucket_for(len(self._queue[0].prompt_tokens))
+            if admitted and self.max_prefill_tokens and cost > budget:
+                break   # budget spent; head waits for the next iteration
+            admitted.append(self._queue.popleft())
+            free_slots -= 1
+            budget -= cost
+        if admitted:
+            self._note_depth()
+        return admitted

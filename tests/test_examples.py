@@ -51,6 +51,16 @@ def test_generate_example_all_strategies(capsys):
 
 
 @pytest.mark.slow
+def test_serve_gpt_example_serves_all_requests(capsys):
+    mod = runpy.run_path(f'{EX}/serve_gpt.py')
+    handles = mod['main'](num_requests=6)
+    assert all(h.status == 'FINISHED' for h in handles)
+    assert all(h.tokens for h in handles)
+    out = capsys.readouterr().out
+    assert 'streaming request 0' in out and 'serving:' in out
+
+
+@pytest.mark.slow
 def test_speculative_decode_example_accepts_drafts():
     mod = runpy.run_path(f'{EX}/speculative_decode.py')
     stats = mod['main'](distill_steps=150)

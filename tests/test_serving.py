@@ -1,0 +1,455 @@
+"""paddle_tpu.serving — continuous-batching engine over the slot pool.
+
+Covers the ISSUE-4 acceptance surface: mixed-length greedy parity vs
+per-request generate() (token for token), mid-flight admission into
+freed slots with ZERO recompiles (python trace counters + the
+jax.monitoring compile counter), eos retirement freeing slots,
+per-request sampling params, request-level fault isolation, streaming,
+scheduler FCFS/budget behavior, the kv-pool primitives, metrics, and
+the two generation.py satellites (lax.top_k logits parity, max_length
+clamp semantics).
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import debug, observability as obs
+from paddle_tpu.nlp import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                            LlamaForCausalLM)
+from paddle_tpu.nlp import generation
+from paddle_tpu.resilience import FatalError, RetryPolicy, TransientError
+from paddle_tpu.serving import (FAILED, FINISHED, FCFSScheduler,
+                                InferenceEngine, RequestHandle,
+                                SamplingParams, SlotPool, default_buckets)
+from paddle_tpu.serving import engine as engine_mod
+
+from fault_injection import FaultInjector
+
+NO_EOS = -1
+_NO_SLEEP = RetryPolicy(base_delay=0.0, sleep=lambda d: None)
+
+
+@pytest.fixture(scope='module')
+def gpt():
+    paddle.seed(7)
+    return GPTForCausalLM(GPTConfig.tiny()).eval()
+
+
+def _prompts(lens, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, (s,)).tolist() for s in lens]
+
+
+def _ref_generate(model, prompt, max_new, eos=NO_EOS):
+    out, _ = model.generate(
+        paddle.to_tensor(np.array([prompt])), max_new_tokens=max_new,
+        decode_strategy='greedy_search', eos_token_id=eos)
+    return out.numpy()[0].tolist()
+
+
+def _trim_at_eos(tokens, eos):
+    if eos in tokens:
+        return tokens[:tokens.index(eos) + 1]
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# satellite: _process_logits via lax.top_k — parity with the old sort path
+# ---------------------------------------------------------------------------
+
+def _old_process_logits(logits, temperature, top_k, top_p):
+    """The pre-lax.top_k implementation (full jnp.sort), verbatim."""
+    neg = float(jnp.finfo(jnp.float32).min)
+    logits = logits.astype(jnp.float32)
+    if temperature != 1.0:
+        logits = logits / jnp.maximum(temperature, 1e-6)
+    v = logits.shape[-1]
+    if top_k and 0 < top_k < v:
+        kth = jnp.sort(logits, axis=-1)[:, v - top_k][:, None]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p and top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1) - 1
+        cutoff = jnp.take_along_axis(srt, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return logits
+
+
+@pytest.mark.parametrize('temp,top_k,top_p', [
+    (1.0, 5, 1.0), (0.7, 12, 1.0), (1.0, 0, 0.9), (1.3, 8, 0.75),
+    (1.0, 1, 1.0), (1.0, 64, 0.5), (2.0, 63, 0.99),
+])
+def test_process_logits_topk_lax_parity(temp, top_k, top_p):
+    rng = np.random.RandomState(3)
+    logits = rng.standard_normal((4, 64)).astype(np.float32)
+    logits[0, :8] = logits[0, 8]          # duplicated values (sort ties)
+    new = generation._process_logits(jnp.asarray(logits), temp, top_k,
+                                     top_p)
+    old = _old_process_logits(jnp.asarray(logits), temp, top_k, top_p)
+    np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+
+
+# ---------------------------------------------------------------------------
+# satellite: generate(max_length=) no longer decodes past the prompt
+# ---------------------------------------------------------------------------
+
+def test_max_length_met_warns_once_and_returns_empty(gpt):
+    generation._warned_max_length[0] = False
+    ids = paddle.to_tensor(np.array([[3, 5, 7, 9, 11]]))
+    with pytest.warns(UserWarning, match='already meets max_length'):
+        out, scores = gpt.generate(ids, max_length=4)
+    assert tuple(out.shape) == (1, 0)
+    assert tuple(scores.shape) == (1,)
+    with warnings.catch_warnings():
+        warnings.simplefilter('error')    # second call: warn ONCE only
+        out, _ = gpt.generate(ids, max_length=5)
+    assert tuple(out.shape) == (1, 0)
+
+
+def test_max_length_budget_still_decodes_to_total_length(gpt):
+    ids = paddle.to_tensor(np.array([[3, 5, 7, 9, 11]]))
+    out, _ = gpt.generate(ids, max_length=9, eos_token_id=NO_EOS)
+    assert tuple(out.shape) == (1, 4)     # 9 total - 5 prompt
+    ref = _ref_generate(gpt, [3, 5, 7, 9, 11], 4)
+    assert out.numpy()[0].tolist() == ref
+
+
+# ---------------------------------------------------------------------------
+# kv_pool
+# ---------------------------------------------------------------------------
+
+def test_default_buckets_cover_max_length():
+    assert default_buckets(64) == (8, 16, 32, 64)
+    assert default_buckets(48) == (8, 16, 32, 48)
+
+
+def test_slot_pool_alloc_free_cycle(gpt):
+    pool = SlotPool(gpt, num_slots=3, max_length=32)
+    slots = [pool.alloc() for _ in range(3)]
+    assert slots == [0, 1, 2] and pool.free_count == 0
+    with pytest.raises(RuntimeError):
+        pool.alloc()
+    pool.free(1)
+    assert pool.alloc() == 1              # lowest free slot reused
+    with pytest.raises(ValueError):
+        pool.free(99)
+    pool.free(0)
+    with pytest.raises(ValueError):
+        pool.free(0)                      # double free
+
+
+def test_slot_pool_bucket_for(gpt):
+    pool = SlotPool(gpt, num_slots=2, max_length=64)
+    assert pool.bucket_for(3) == 8
+    assert pool.bucket_for(8) == 8
+    assert pool.bucket_for(9) == 16
+    assert pool.bucket_for(64) == 64
+    with pytest.raises(ValueError):
+        pool.bucket_for(65)
+
+
+def test_slot_pool_write_slot_scatters_one_row(gpt):
+    pool = SlotPool(gpt, num_slots=3, max_length=16)
+    slab = jax.tree_util.tree_map(
+        lambda c: jnp.ones((1,) + c.shape[1:], c.dtype),
+        gpt.init_cache(1, 16))
+    pool.write_slot(1, slab)
+    k0 = np.asarray(pool.cache[0][0])
+    assert (k0[1] == 1).all() and (k0[0] == 0).all() and (k0[2] == 0).all()
+    assert pool.stats()['write_traces'] == 1
+    pool.write_slot(2, slab)              # second write: no retrace
+    assert pool.stats()['write_traces'] == 1
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+def _handle(prompt_len, max_new=4):
+    return RequestHandle(list(range(1, prompt_len + 1)),
+                         SamplingParams(max_new_tokens=max_new))
+
+
+def test_scheduler_fcfs_order_and_slot_limit():
+    sched = FCFSScheduler()
+    hs = [_handle(4) for _ in range(5)]
+    for h in hs:
+        sched.submit(h)
+    got = sched.admissible(3, bucket_for=lambda n: n)
+    assert got == hs[:3]                  # strict FCFS prefix
+    assert sched.queue_depth == 2
+    assert sched.admissible(0, bucket_for=lambda n: n) == []
+    assert sched.admissible(5, bucket_for=lambda n: n) == hs[3:]
+
+
+def test_scheduler_prefill_token_budget():
+    sched = FCFSScheduler(max_prefill_tokens=10)
+    hs = [_handle(8), _handle(8), _handle(8)]
+    for h in hs:
+        sched.submit(h)
+    # first admission always proceeds (progress guarantee); the second
+    # would blow the 10-token budget and waits
+    assert sched.admissible(3, bucket_for=lambda n: n) == hs[:1]
+    assert sched.admissible(3, bucket_for=lambda n: n) == hs[1:2]
+
+
+def test_scheduler_cancel_and_queue_gauge():
+    sched = FCFSScheduler()
+    h1, h2 = _handle(4), _handle(4)
+    sched.submit(h1)
+    sched.submit(h2)
+    assert obs.get_registry().value('paddle_serving_queue_depth') == 2
+    assert sched.cancel(h1)
+    assert not sched.cancel(h1)
+    assert sched.admissible(2, bucket_for=lambda n: n) == [h2]
+    assert obs.get_registry().value('paddle_serving_queue_depth') == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: greedy parity, slot reuse, recompiles
+# ---------------------------------------------------------------------------
+
+def test_engine_mixed_length_greedy_matches_generate(gpt):
+    prompts = _prompts([3, 9, 5, 14, 7, 11])
+    news = [6, 9, 4, 12, 8, 5]
+    eng = InferenceEngine(gpt, num_slots=3, max_length=64, decode_block=4)
+    handles = eng.generate_many(
+        prompts, [SamplingParams(max_new_tokens=n, eos_token_id=NO_EOS)
+                  for n in news])
+    for h, p, n in zip(handles, prompts, news):
+        assert h.status == FINISHED
+        assert h.tokens == _ref_generate(gpt, p, n), \
+            f'request {h.request_id} diverged from generate()'
+    st = eng.stats()
+    assert st['completed'] == 6 and st['failed'] == 0
+    assert eng.pool.free_count == 3       # every slot returned
+
+
+def test_engine_llama_per_row_cache_offsets():
+    # the llama family shares update_kv_cache: per-row slots must work
+    # for RoPE models too (rope offsets already support [B])
+    paddle.seed(11)
+    model = LlamaForCausalLM(LlamaConfig.tiny()).eval()
+    prompts = _prompts([4, 9])
+    eng = InferenceEngine(model, num_slots=2, max_length=32,
+                          decode_block=2)
+    hs = eng.generate_many(
+        prompts, [SamplingParams(max_new_tokens=5, eos_token_id=NO_EOS)
+                  for _ in prompts])
+    for h, p in zip(hs, prompts):
+        assert h.tokens == _ref_generate(model, p, 5)
+
+
+def test_midflight_admission_reuses_slot_with_zero_recompiles(gpt):
+    eng = InferenceEngine(gpt, num_slots=2, max_length=64, decode_block=2)
+    # warmup wave: compiles the decode block + the touched buckets
+    eng.generate_many(
+        _prompts([3, 9, 6], seed=1),
+        [SamplingParams(max_new_tokens=4, eos_token_id=NO_EOS)] * 3)
+    traces = dict(eng.stats()['traces'])
+    assert traces['decode_step'] == 1
+    compiles_before = obs.get_registry().value('paddle_jit_compiles_total')
+
+    # second wave, same buckets, more requests than slots: every
+    # admission lands in a freed slot and NOTHING recompiles
+    hs = eng.generate_many(
+        _prompts([4, 8, 5, 16, 7], seed=2),
+        [SamplingParams(max_new_tokens=6, eos_token_id=NO_EOS)] * 5)
+    assert all(h.status == FINISHED for h in hs)
+    assert eng.stats()['traces'] == traces, 'admission retraced a program'
+    assert obs.get_registry().value('paddle_jit_compiles_total') \
+        == compiles_before, 'admission triggered an XLA compile'
+    # with 2 slots and 5 requests, slots were necessarily reused
+    assert eng.stats()['prefills'] == 8
+    assert eng.pool.free_count == 2
+
+
+def test_eos_retirement_frees_slot_and_matches_generate(gpt):
+    prompt = _prompts([6], seed=5)[0]
+    ref = _ref_generate(gpt, prompt, 10)
+    eos = ref[2]                          # force an early eos hit
+    expected = _trim_at_eos(ref, eos)
+    eng = InferenceEngine(gpt, num_slots=2, max_length=64, decode_block=4)
+    h = eng.submit(prompt, SamplingParams(max_new_tokens=10,
+                                          eos_token_id=eos))
+    eng.run()
+    assert h.status == FINISHED
+    assert h.tokens == expected
+    assert h.tokens[-1] == eos
+    assert eng.pool.free_count == 2       # retirement freed the slot
+
+
+# ---------------------------------------------------------------------------
+# engine: per-request sampling params
+# ---------------------------------------------------------------------------
+
+def test_per_request_sampling_params_honored(gpt):
+    eng = InferenceEngine(gpt, num_slots=4, max_length=64, decode_block=4)
+    prompt = _prompts([5], seed=9)[0]
+    sp = dict(max_new_tokens=8, strategy='sampling', temperature=1.5,
+              top_k=30, top_p=0.9, eos_token_id=NO_EOS)
+    h1 = eng.submit(prompt, SamplingParams(seed=123, **sp))
+    h2 = eng.submit(prompt, SamplingParams(seed=123, **sp))
+    h3 = eng.submit(prompt, SamplingParams(
+        max_new_tokens=8, strategy='sampling', top_k=1,
+        eos_token_id=NO_EOS, seed=5))
+    h4 = eng.submit(prompt, SamplingParams(max_new_tokens=8,
+                                           eos_token_id=NO_EOS))
+    eng.run()
+    assert h1.tokens == h2.tokens         # same seed => same tokens
+    assert h3.tokens == h4.tokens         # top_k=1 degenerates to greedy
+    assert h4.tokens == _ref_generate(gpt, prompt, 8)
+
+
+def test_greedy_request_unaffected_by_sampling_neighbours(gpt):
+    prompt = _prompts([7], seed=13)[0]
+    ref = _ref_generate(gpt, prompt, 8)
+    eng = InferenceEngine(gpt, num_slots=4, max_length=64, decode_block=4)
+    hs = eng.generate_many(
+        [prompt, prompt, prompt],
+        [SamplingParams(max_new_tokens=8, eos_token_id=NO_EOS),
+         SamplingParams(max_new_tokens=8, strategy='sampling',
+                        temperature=2.0, seed=1, eos_token_id=NO_EOS),
+         SamplingParams(max_new_tokens=8, strategy='sampling',
+                        temperature=2.0, seed=2, eos_token_id=NO_EOS)])
+    assert hs[0].tokens == ref            # bit-identical despite neighbours
+
+
+# ---------------------------------------------------------------------------
+# engine: streaming + convenience API
+# ---------------------------------------------------------------------------
+
+def test_stream_yields_tokens_incrementally(gpt):
+    eng = InferenceEngine(gpt, num_slots=2, max_length=64, decode_block=2)
+    prompt = _prompts([4], seed=3)[0]
+    h = eng.submit(prompt, SamplingParams(max_new_tokens=7,
+                                          eos_token_id=NO_EOS))
+    seen = []
+    for tok in h.stream():
+        seen.append(tok)
+    assert seen == h.tokens == _ref_generate(gpt, prompt, 7)
+    assert h.done and h.ttft is not None and h.ttft >= 0
+
+
+def test_result_blocks_until_done(gpt):
+    eng = InferenceEngine(gpt, num_slots=1, max_length=64, decode_block=4)
+    hs = [eng.submit(p, SamplingParams(max_new_tokens=4,
+                                       eos_token_id=NO_EOS))
+          for p in _prompts([3, 5], seed=4)]
+    assert hs[1].result() == _ref_generate(gpt, hs[1].prompt_tokens, 4)
+    assert hs[0].done                     # draining served everyone
+
+
+def test_submit_validation_errors(gpt):
+    eng = InferenceEngine(gpt, num_slots=2, max_length=32)
+    with pytest.raises(ValueError):
+        eng.submit([])                    # empty prompt
+    with pytest.raises(ValueError):
+        eng.submit(list(range(40)))       # no bucket fits
+    with pytest.raises(ValueError):      # prompt + budget > slot length
+        eng.submit(list(range(20)), SamplingParams(max_new_tokens=20))
+    with pytest.raises(ValueError):
+        SamplingParams(strategy='beam_search')
+    with pytest.raises(ValueError):
+        InferenceEngine(gpt, max_length=4096)   # > max_position_embeddings
+    with pytest.raises(ValueError):
+        eng.generate_many([[1, 2]], [SamplingParams(), SamplingParams()])
+
+
+# ---------------------------------------------------------------------------
+# engine: resilience — request-level failure, engine survives
+# ---------------------------------------------------------------------------
+
+def test_fatal_transfer_failure_fails_only_that_request(gpt):
+    eng = InferenceEngine(gpt, num_slots=2, max_length=64, decode_block=2,
+                          retry_policy=_NO_SLEEP)
+    prompts = _prompts([4, 6, 5], seed=6)
+    sp = SamplingParams(max_new_tokens=4, eos_token_id=NO_EOS)
+    inj = FaultInjector(nth=2, exc=FatalError('injected device loss'))
+    with inj.patch(engine_mod, '_to_device'):
+        hs = [eng.submit(p, sp) for p in prompts]
+        eng.run()
+    assert [h.status for h in hs] == [FINISHED, FAILED, FINISHED]
+    assert isinstance(hs[1].error, FatalError)
+    assert hs[0].tokens == _ref_generate(gpt, prompts[0], 4)
+    assert eng.pool.free_count == 2       # the failed slot was freed
+    with pytest.raises(FatalError):
+        list(hs[1].stream())              # stream surfaces the error
+    # the engine keeps serving new requests afterwards
+    h = eng.submit(prompts[1], sp)
+    eng.run()
+    assert h.status == FINISHED
+    assert h.tokens == _ref_generate(gpt, prompts[1], 4)
+
+
+def test_transient_transfer_failure_is_retried(gpt):
+    eng = InferenceEngine(gpt, num_slots=1, max_length=64,
+                          retry_policy=_NO_SLEEP)
+    reg = obs.get_registry()
+    retries_before = reg.value('paddle_resilience_retries_total',
+                               site='serving.h2d')
+    inj = FaultInjector(nth=1, exc=TransientError('blip'), repeat=2)
+    with inj.patch(engine_mod, '_to_device'):
+        h = eng.submit(_prompts([5], seed=8)[0],
+                       SamplingParams(max_new_tokens=3,
+                                      eos_token_id=NO_EOS))
+        eng.run()
+    assert h.status == FINISHED           # retried through the blips
+    assert inj.calls == 3
+    assert reg.value('paddle_resilience_retries_total',
+                     site='serving.h2d') == retries_before + 2
+
+
+# ---------------------------------------------------------------------------
+# observability wiring
+# ---------------------------------------------------------------------------
+
+def test_serving_metrics_and_summary(gpt):
+    reg = obs.get_registry()
+    before_sub = reg.value('paddle_serving_requests_total',
+                           status='submitted')
+    before_done = reg.value('paddle_serving_requests_total',
+                            status='completed')
+    ttft_fam = reg.get('paddle_serving_ttft_seconds')
+    before_ttft = ttft_fam._children[()].count if ttft_fam else 0
+    occ_fam = reg.get('paddle_serving_slot_occupancy')
+    before_occ = occ_fam._children[()].count if occ_fam else 0
+    eng = InferenceEngine(gpt, num_slots=2, max_length=64)
+    hs = eng.generate_many(
+        _prompts([3, 11, 6], seed=10),
+        [SamplingParams(max_new_tokens=4, eos_token_id=NO_EOS)] * 3)
+    assert reg.value('paddle_serving_requests_total',
+                     status='submitted') == before_sub + 3
+    assert reg.value('paddle_serving_requests_total',
+                     status='completed') == before_done + 3
+    ttft = reg.get('paddle_serving_ttft_seconds')._children[()]
+    assert ttft.count == before_ttft + 3
+    assert reg.value('paddle_serving_active_slots') == 0
+    assert reg.value('paddle_serving_tokens_total') >= 12
+    occ = reg.get('paddle_serving_slot_occupancy')._children[()]
+    assert occ.count - before_occ == eng.stats()['decode_rounds'] > 0
+    text = debug.observability_summary()
+    assert 'serving:' in text and 'ttft avg' in text
+    assert sum(len(h.tokens) for h in hs) == 12
+
+
+# ---------------------------------------------------------------------------
+# tier-1 bench guard: bit-identical outputs + zero recompiles + speedup
+# ---------------------------------------------------------------------------
+
+def test_bench_serving_guard():
+    import bench
+    res = bench.serving_ab(num_requests=8, num_slots=4, trials=1)
+    assert res['parity'], 'engine greedy outputs diverged from generate()'
+    assert res['recompiles_after_warmup'] == 0, \
+        'continuous batching recompiled after warmup'
+    # the >= 1.5x bar is asserted on the full bench trace; here just
+    # sanity-check both arms actually ran
+    assert res['engine_tokens_per_sec'] > 0
+    assert res['sequential_tokens_per_sec'] > 0
