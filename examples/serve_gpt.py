@@ -3,17 +3,30 @@ mixed-length burst of requests against the tiny GPT, stream one of them
 token by token, and print the engine's serving telemetry.
 
     python examples/serve_gpt.py
+
+Live introspection: `--metrics-port 8000` serves the HTTP observability
+endpoint while the engine decodes — /metrics (Prometheus, incl. the
+paddle_serving_* family), /healthz (decode-round liveness), /trace
+(queue/prefill/decode spans with per-request trace ids), /programs
+(decode block + per-bucket prefill FLOPs/bytes attribution):
+
+    python examples/serve_gpt.py --metrics-port 8000
 """
+import argparse
+
 import numpy as np
 
 import paddle_tpu as paddle
-from paddle_tpu import debug
+from paddle_tpu import debug, observability
 from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
 from paddle_tpu.serving import InferenceEngine, SamplingParams
 
 
-def main(num_requests=10):
+def main(num_requests=10, metrics_port=None):
     paddle.seed(0)
+    if metrics_port is not None:
+        server = observability.start_server(metrics_port)
+        print(f'observability endpoint at {server.url}')
     model = GPTForCausalLM(GPTConfig.tiny()).eval()
 
     # one engine = one slot pool + scheduler; 4 slots serve the whole
@@ -55,4 +68,10 @@ def main(num_requests=10):
 
 
 if __name__ == '__main__':
-    main()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument('--num-requests', type=int, default=10)
+    p.add_argument('--metrics-port', type=int, default=None,
+                   help='serve the HTTP observability endpoint on this '
+                        'port while decoding')
+    args = p.parse_args()
+    main(num_requests=args.num_requests, metrics_port=args.metrics_port)

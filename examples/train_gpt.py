@@ -11,6 +11,14 @@ and `--resume auto` continues from the latest committed step:
     python examples/train_gpt.py --ckpt-dir /tmp/gpt_ckpt
     # ... preempted ...
     python examples/train_gpt.py --ckpt-dir /tmp/gpt_ckpt --resume auto
+
+Live introspection: `--metrics-port 8000` serves /metrics (Prometheus),
+/healthz (hang-aware liveness), /summary, /events, /trace, and
+/programs (per-program XLA cost attribution) from a daemon thread while
+the loop trains:
+
+    python examples/train_gpt.py --metrics-port 8000 &
+    curl localhost:8000/healthz; curl localhost:8000/metrics
 """
 import argparse
 
@@ -25,8 +33,13 @@ from paddle_tpu.utils.checkpoint import CheckpointManager
 
 
 def main(steps=80, vocab=512, seq=64, batch=8, ckpt_dir=None, resume=None,
-         ckpt_interval=20):
+         ckpt_interval=20, metrics_port=None):
     paddle.seed(0)
+    server = None
+    if metrics_port is not None:
+        server = observability.start_server(metrics_port)
+        print(f'observability endpoint at {server.url} '
+              f'(/metrics /healthz /summary /events /trace /programs)')
     cfg = GPTConfig(vocab_size=vocab, hidden_size=128,
                     num_hidden_layers=2, num_attention_heads=4,
                     intermediate_size=256, max_position_embeddings=seq)
@@ -104,6 +117,10 @@ if __name__ == '__main__':
     p.add_argument('--resume', choices=['auto'], default=None,
                    help="'auto': continue from the latest committed step")
     p.add_argument('--ckpt-interval', type=int, default=20)
+    p.add_argument('--metrics-port', type=int, default=None,
+                   help='serve the HTTP observability endpoint '
+                        '(/metrics /healthz /summary /events /trace '
+                        '/programs) on this port while training')
     args = p.parse_args()
     main(steps=args.steps, ckpt_dir=args.ckpt_dir, resume=args.resume,
-         ckpt_interval=args.ckpt_interval)
+         ckpt_interval=args.ckpt_interval, metrics_port=args.metrics_port)

@@ -38,6 +38,7 @@ zero of them after warmup.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -248,6 +249,17 @@ def _note_fallback(name):
     _counters.per_op[name][2] += 1
 
 
+def _note_program_compile(name, seconds):
+    """Cold-path-only hook into the observability ProgramCatalog: one
+    cache entry was traced+compiled (the building call's wall time).
+    Invocation counts mirror at scrape time; the hit path pays nothing."""
+    try:
+        from .observability.cost import note_dispatch_compile
+        note_dispatch_compile(name, seconds)
+    except Exception:
+        pass   # observability is optional here
+
+
 def _guarded_vjp(raw_vjp, entry, key, vals):
     """custom_vjp bodies whose bwd closes over trace-local values cannot
     survive the jitted-forward / out-of-trace-pullback split (the
@@ -336,6 +348,7 @@ def run(fn, name, treedef, leaves, t_idx, vals, record
         _counters.hits += 1
         _counters.per_op[name][0] += 1
 
+    t_build = time.perf_counter() if building else 0.0
     try:
         if record:
             out, raw_vjp = jitted(*vals)
@@ -361,6 +374,7 @@ def run(fn, name, treedef, leaves, t_idx, vals, record
         return None
 
     if building:
+        _note_program_compile(name, time.perf_counter() - t_build)
         if record:
             entry.fwd_jit = jitted
         else:

@@ -159,7 +159,96 @@ def dispatch_summary(max_rows: int = 15) -> str:
     return '\n'.join(lines)
 
 
-def observability_summary(max_rows: int = 10) -> str:
+def _observability_data(max_rows: int = 10) -> dict:
+    """The machine-readable structure behind observability_summary():
+    one JSON-able dict per section, read off the same registry snapshot
+    the text report formats."""
+    reg = _obs.get_registry()
+    snap = reg.snapshot()   # runs collectors (dispatch mirror) first
+    ds = _dispatch.stats()
+    comm = _obs.collective_totals(reg)
+    spans = reg.get('paddle_span_seconds')
+    span_rows = []
+    if spans is not None:
+        for key, child in sorted(spans._children.items(),
+                                 key=lambda kv: -kv[1].sum)[:max_rows]:
+            span_rows.append({
+                'name': key[0], 'calls': child.count,
+                'total_s': child.sum,
+                'avg_ms': (child.sum / child.count * 1e3
+                           if child.count else 0.0)})
+    log = _obs.get_event_log()
+    return {
+        'process_index': snap['process_index'],
+        'dispatch': {
+            'calls': ds['calls'], 'hit_rate': ds['hit_rate'],
+            'misses': ds['misses'], 'retraces': ds['retraces'],
+            'fallbacks': ds['fallbacks'], 'cache_size': ds['cache_size']},
+        'jit': {
+            'compiles': int(reg.value('paddle_jit_compiles_total')),
+            'compile_seconds': reg.value(
+                'paddle_jit_compile_seconds_total'),
+            'cache_entries': _jit_cache_entries(reg)},
+        'collectives': {
+            'calls': int(comm['calls']), 'bytes': int(comm['bytes']),
+            'per_op': [{'op': op, 'axis': axis,
+                        'calls': int(row['calls']),
+                        'bytes': int(row['bytes'])}
+                       for (op, axis), row
+                       in sorted(comm['per_op'].items())[:max_rows]]},
+        'offload': {
+            'h2d_bytes': int(reg.value('paddle_offload_h2d_bytes_total')),
+            'd2h_bytes': int(reg.value('paddle_offload_d2h_bytes_total'))},
+        'steps': {
+            'total': int(reg.value('paddle_steps_total')),
+            'steps_per_sec': reg.value('paddle_steps_per_sec'),
+            'tokens_per_sec': reg.value('paddle_tokens_per_sec'),
+            'loss_last': reg.value('paddle_loss_last')},
+        'memory': {
+            'watermark_bytes': reg.value('paddle_memory_watermark_bytes')},
+        'resilience': {
+            'retries': int(_labeled_total(
+                reg, 'paddle_resilience_retries_total')),
+            'rollbacks': int(reg.value(
+                'paddle_resilience_rollbacks_total')),
+            'skipped_batches': int(reg.value(
+                'paddle_resilience_skipped_batches_total')),
+            'preempt_saves': int(reg.value(
+                'paddle_resilience_preempt_saves_total')),
+            'hangs': int(reg.value('paddle_resilience_hangs_total'))},
+        'checkpoints': {
+            'saves': int(reg.value('paddle_checkpoint_saves_total')),
+            'save_bytes': int(reg.value(
+                'paddle_checkpoint_save_bytes_total')),
+            'restores': int(reg.value('paddle_checkpoint_restores_total')),
+            'restore_bytes': int(reg.value(
+                'paddle_checkpoint_restore_bytes_total'))},
+        'serving': {
+            'submitted': int(reg.value('paddle_serving_requests_total',
+                                       status='submitted')),
+            'completed': int(reg.value('paddle_serving_requests_total',
+                                       status='completed')),
+            'failed': int(reg.value('paddle_serving_requests_total',
+                                    status='failed')),
+            'queue_depth': int(reg.value('paddle_serving_queue_depth')),
+            'active_slots': int(reg.value('paddle_serving_active_slots')),
+            'slots': int(reg.value('paddle_serving_slots')),
+            'tokens': int(reg.value('paddle_serving_tokens_total')),
+            'ttft_avg_ms': _hist_avg_ms(reg, 'paddle_serving_ttft_seconds'),
+            'tpot_avg_ms': _hist_avg_ms(reg, 'paddle_serving_tpot_seconds'),
+            'prefills': int(_labeled_total(
+                reg, 'paddle_serving_prefills_total')),
+            'decode_steps': int(reg.value(
+                'paddle_serving_decode_steps_total'))},
+        'programs': _obs.program_catalog().top_programs(n=max_rows),
+        'spans': span_rows,
+        'events': {'logged': len(log), 'dropped': log.dropped,
+                   'flight_dumps': int(_labeled_total(
+                       reg, 'paddle_flight_dumps_total'))},
+    }
+
+
+def observability_summary(max_rows: int = 10, as_dict: bool = False):
     """One report over the single shared observability registry: where
     this process's time, bytes, and compiles went (upstream: stitched
     together by hand from paddle.profiler output + fleet worker logs).
@@ -168,87 +257,84 @@ def observability_summary(max_rows: int = 10) -> str:
     dispatch hit-rate, jit compile count + seconds, per-(op, axis)
     collective calls/bytes, offload H2D/D2H transfer bytes, step/token
     throughput + last loss, device-memory watermark, serving engine
-    traffic (requests/queue/slots/TTFT/TPOT), and the hottest host
-    spans (RecordEvent regions + subsystem spans).
+    traffic (requests/queue/slots/TTFT/TPOT), per-program XLA cost
+    attribution (ProgramCatalog), and the hottest host spans.
+
+    `as_dict=True` returns the machine-readable structure backing the
+    text (the /summary?format=json payload); both views are rendered
+    from the SAME snapshot so their headline counters always agree.
     """
-    reg = _obs.get_registry()
-    snap = reg.snapshot()   # runs collectors (dispatch mirror) first
-    ds = _dispatch.stats()
-    lines = [f'observability summary (process {snap["process_index"]})',
+    d = _observability_data(max_rows)
+    if as_dict:
+        return d
+    ds, jit = d['dispatch'], d['jit']
+    lines = [f'observability summary (process {d["process_index"]})',
              f'  dispatch: {ds["calls"]} calls  '
              f'hit_rate {ds["hit_rate"]:.1%}  ({ds["misses"]} misses, '
              f'{ds["retraces"]} retraces, {ds["fallbacks"]} fallbacks, '
              f'cache_size {ds["cache_size"]})',
-             f'  jit: {int(reg.value("paddle_jit_compiles_total"))} '
-             f'compiles  '
-             f'{reg.value("paddle_jit_compile_seconds_total"):.3f} s '
-             f'compile time  cache entries: '
-             f'{_jit_cache_entries(reg)}']
-    comm = _obs.collective_totals(reg)
-    lines.append(f'  collectives: {int(comm["calls"])} calls  '
-                 f'{int(comm["bytes"])} bytes')
-    for (op, axis), row in sorted(comm['per_op'].items())[:max_rows]:
-        lines.append(f'    {op:<16} axis={axis:<6} '
-                     f'{int(row["calls"]):>6} calls {int(row["bytes"]):>12} '
+             f'  jit: {jit["compiles"]} compiles  '
+             f'{jit["compile_seconds"]:.3f} s '
+             f'compile time  cache entries: {jit["cache_entries"]}']
+    comm = d['collectives']
+    lines.append(f'  collectives: {comm["calls"]} calls  '
+                 f'{comm["bytes"]} bytes')
+    for row in comm['per_op']:
+        lines.append(f'    {row["op"]:<16} axis={row["axis"]:<6} '
+                     f'{row["calls"]:>6} calls {row["bytes"]:>12} '
                      f'bytes')
     lines.append(
-        f'  offload: '
-        f'{int(reg.value("paddle_offload_h2d_bytes_total"))} H2D bytes  '
-        f'{int(reg.value("paddle_offload_d2h_bytes_total"))} D2H bytes')
+        f'  offload: {d["offload"]["h2d_bytes"]} H2D bytes  '
+        f'{d["offload"]["d2h_bytes"]} D2H bytes')
+    st = d['steps']
     lines.append(
-        f'  steps: {int(reg.value("paddle_steps_total"))} total  '
-        f'{reg.value("paddle_steps_per_sec"):.2f} steps/s  '
-        f'{reg.value("paddle_tokens_per_sec"):.1f} tokens/s  '
-        f'loss {reg.value("paddle_loss_last"):.4f}')
+        f'  steps: {st["total"]} total  '
+        f'{st["steps_per_sec"]:.2f} steps/s  '
+        f'{st["tokens_per_sec"]:.1f} tokens/s  '
+        f'loss {st["loss_last"]:.4f}')
     lines.append(
         f'  memory: watermark '
-        f'{reg.value("paddle_memory_watermark_bytes") / 2**20:.1f} MiB')
+        f'{d["memory"]["watermark_bytes"] / 2**20:.1f} MiB')
+    rs = d['resilience']
     lines.append(
-        f'  resilience: {int(_labeled_total(reg, "paddle_resilience_retries_total"))} '
-        f'retries  '
-        f'{int(reg.value("paddle_resilience_rollbacks_total"))} rollbacks  '
-        f'{int(reg.value("paddle_resilience_skipped_batches_total"))} '
-        f'skipped batches  '
-        f'{int(reg.value("paddle_resilience_preempt_saves_total"))} '
-        f'preempt saves  '
-        f'{int(reg.value("paddle_resilience_hangs_total"))} hangs')
+        f'  resilience: {rs["retries"]} retries  '
+        f'{rs["rollbacks"]} rollbacks  '
+        f'{rs["skipped_batches"]} skipped batches  '
+        f'{rs["preempt_saves"]} preempt saves  '
+        f'{rs["hangs"]} hangs')
+    ck = d['checkpoints']
     lines.append(
-        f'  checkpoints: '
-        f'{int(reg.value("paddle_checkpoint_saves_total"))} saves '
-        f'({int(reg.value("paddle_checkpoint_save_bytes_total"))} bytes)  '
-        f'{int(reg.value("paddle_checkpoint_restores_total"))} restores '
-        f'({int(reg.value("paddle_checkpoint_restore_bytes_total"))} '
-        f'bytes)')
+        f'  checkpoints: {ck["saves"]} saves ({ck["save_bytes"]} bytes)  '
+        f'{ck["restores"]} restores ({ck["restore_bytes"]} bytes)')
+    sv = d['serving']
     lines.append(
-        f'  serving: '
-        f'{int(reg.value("paddle_serving_requests_total", status="submitted"))} '
-        f'requests '
-        f'({int(reg.value("paddle_serving_requests_total", status="completed"))} '
-        f'done, '
-        f'{int(reg.value("paddle_serving_requests_total", status="failed"))} '
-        f'failed)  queue {int(reg.value("paddle_serving_queue_depth"))}  '
-        f'slots {int(reg.value("paddle_serving_active_slots"))}'
-        f'/{int(reg.value("paddle_serving_slots"))}  '
-        f'{int(reg.value("paddle_serving_tokens_total"))} tokens')
+        f'  serving: {sv["submitted"]} requests '
+        f'({sv["completed"]} done, {sv["failed"]} failed)  '
+        f'queue {sv["queue_depth"]}  '
+        f'slots {sv["active_slots"]}/{sv["slots"]}  '
+        f'{sv["tokens"]} tokens')
     lines.append(
-        f'    ttft avg {_hist_avg_ms(reg, "paddle_serving_ttft_seconds"):.2f} '
-        f'ms  tpot avg '
-        f'{_hist_avg_ms(reg, "paddle_serving_tpot_seconds"):.2f} ms  '
-        f'{int(_labeled_total(reg, "paddle_serving_prefills_total"))} '
-        f'prefills  '
-        f'{int(reg.value("paddle_serving_decode_steps_total"))} decode '
-        f'steps')
-    spans = reg.get('paddle_span_seconds')
-    rows = []
-    if spans is not None:
-        rows = sorted(spans._children.items(),
-                      key=lambda kv: -kv[1].sum)[:max_rows]
-    lines.append(f'  host spans: {len(rows)} region(s), '
-                 f'event log {len(_obs.get_event_log())} events')
-    for key, child in rows:
-        avg_ms = child.sum / child.count * 1e3 if child.count else 0.0
-        lines.append(f'    {key[0]:<32} {child.count:>6} calls '
-                     f'{child.sum:>10.4f} s  avg {avg_ms:>8.2f} ms')
+        f'    ttft avg {sv["ttft_avg_ms"]:.2f} ms  '
+        f'tpot avg {sv["tpot_avg_ms"]:.2f} ms  '
+        f'{sv["prefills"]} prefills  '
+        f'{sv["decode_steps"]} decode steps')
+    lines.append(f'  programs: {len(d["programs"])} tracked '
+                 f'(top by host time)')
+    for p in d['programs']:
+        lines.append(
+            f'    {p["name"][:31]:<32} {p["invocations"]:>6} calls '
+            f'{p["host_seconds"]:>9.3f} s  '
+            f'{p["flops"] / 1e9:>9.3f} GFLOP  '
+            f'{p["bytes_accessed"] / 1e9:>8.3f} GB  '
+            f'peak {p["peak_memory_bytes"] / 2**20:>8.1f} MiB')
+    lines.append(f'  host spans: {len(d["spans"])} region(s), '
+                 f'event log {d["events"]["logged"]} events '
+                 f'({d["events"]["dropped"]} dropped, '
+                 f'{d["events"]["flight_dumps"]} flight dumps)')
+    for row in d['spans']:
+        lines.append(f'    {row["name"]:<32} {row["calls"]:>6} calls '
+                     f'{row["total_s"]:>10.4f} s  avg '
+                     f'{row["avg_ms"]:>8.2f} ms')
     return '\n'.join(lines)
 
 

@@ -267,17 +267,23 @@ class MetricsLoggerCallback(Callback):
     `tokens_per_batch` sets the token increment per optimizer step (e.g.
     batch_size * seq_len for an LM); when None only step rates are
     tracked. `log_dir` additionally appends registry JSONL exports every
-    `export_freq` steps for plain-file tailing.
+    `export_freq` steps for plain-file tailing. `metrics_port` starts
+    the HTTP observability endpoint (observability.start_server) on
+    train begin, so a hapi `fit()` is scrapeable from outside the
+    process (/metrics, /healthz, /summary, /events, /trace, /programs).
     """
 
     def __init__(self, tokens_per_batch: Optional[int] = None,
                  log_dir: Optional[str] = None, export_freq: int = 100,
-                 spike_window: int = 20):
+                 spike_window: int = 20,
+                 metrics_port: Optional[int] = None):
         super().__init__()
         self.tokens_per_batch = tokens_per_batch
         self.log_dir = log_dir
         self.export_freq = max(int(export_freq), 1)
         self._spike_window = spike_window
+        self.metrics_port = metrics_port
+        self.server = None
         self._telemetry = None
         self._spikes = None
         self._n = 0
@@ -293,6 +299,9 @@ class MetricsLoggerCallback(Callback):
         from ..debug import LossSpikeDetector
         self._spikes = LossSpikeDetector(window=self._spike_window)
         self.telemetry
+        if self.metrics_port is not None and self.server is None:
+            from .. import observability as obs
+            self.server = obs.start_server(self.metrics_port)
 
     def on_train_batch_end(self, step, logs=None):
         loss = (logs or {}).get('loss')

@@ -169,7 +169,10 @@ class StaticLayer:
                 return _tree.tree_map(
                     lambda t: t.value if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
-        f = jax.jit(fn)
+        target_name = getattr(self._target, '__name__',
+                              type(self._target).__name__)
+        f = _obs.program_catalog().wrap_jit(
+            jax.jit(fn), name=f'to_static:{target_name}', kind='to_static')
         self._jit_cache[key] = f
         # executable-cache telemetry: compile count/seconds ride the
         # jax.monitoring listeners (observability.telemetry); the
@@ -272,10 +275,15 @@ class TrainStep:
             # per-leaf through optimizer.offload.OffloadEngine
             from ..optimizer.offload import OffloadEngine
 
-            self._jitted_grads = jax.jit(loss_and_grads,
-                                         donate_argnums=(1,))
+            self._jitted_grads = _obs.program_catalog().wrap_jit(
+                jax.jit(loss_and_grads, donate_argnums=(1,)),
+                name='train_step_grads', kind='train')
             self._engine = OffloadEngine(optimizer)
-        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        # enrolled in the ProgramCatalog: the one AOT compile serves the
+        # traffic AND yields cost/memory analysis for top_programs()
+        self._jitted = _obs.program_catalog().wrap_jit(
+            jax.jit(step_fn, donate_argnums=(0, 1, 2)),
+            name='train_step', kind='train')
 
     @staticmethod
     def _as_batch(inputs, labels):

@@ -28,6 +28,13 @@ from .exporters import (read_jsonl, to_chrome_trace, to_jsonl,
 from .telemetry import (StepTelemetry, collective_totals,
                         device_memory_bytes, install,
                         note_jit_cache_entry)
+from .cost import (CatalogedJit, ProgramCatalog, ProgramRecord,
+                   get_catalog as program_catalog)
+from .flight import FlightRecorder, get_flight_recorder
+from .server import (ObservabilityServer, health, note_progress,
+                     start_server)
+from . import cost as _cost
+from . import flight as _flight
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'DEFAULT_BUCKETS',
@@ -36,8 +43,15 @@ __all__ = [
     'read_jsonl', 'to_chrome_trace', 'to_jsonl', 'to_prometheus_text',
     'StepTelemetry', 'collective_totals', 'device_memory_bytes',
     'install', 'note_jit_cache_entry',
+    'CatalogedJit', 'ProgramCatalog', 'ProgramRecord', 'program_catalog',
+    'FlightRecorder', 'get_flight_recorder',
+    'ObservabilityServer', 'health', 'note_progress', 'start_server',
 ]
 
 # register the jax.monitoring listeners + dispatch collector once at
 # import; all hooks are no-ops while observability is disabled
 install()
+# program-catalog collector (paddle_program_* mirror) + the always-on
+# flight recorder's anomaly listener on the default event log
+_cost.install()
+_flight.install()

@@ -1,0 +1,347 @@
+"""Per-program XLA cost attribution: the ProgramCatalog.
+
+Every compiled executable this framework creates — the jitted train
+step, to_static programs, the serving engine's decode block and
+per-bucket prefills, the eager dispatch cache's per-op entries — already
+carries free introspection data XLA computes at compile time
+(`compiled.cost_analysis()` FLOPs / bytes accessed,
+`compiled.memory_analysis()` peak HBM) that we previously threw away.
+The catalog records it per *named program* together with compile time,
+cumulative invocation count, and host wall time, so
+`top_programs()` answers "which programs is this step/decode round
+actually spending its time and FLOPs in" — train step vs. decode block
+vs. prefill buckets — without a profiler attached.
+
+Zero extra compiles by construction: `wrap_jit` compiles a jitted
+callable ONCE through the AOT path (`lower().compile()`) per input
+signature and then invokes the captured `Compiled` object directly, so
+the cost/memory analyses are read off the very executable that serves
+the traffic — the catalog never compiles anything the program would not
+have compiled anyway (guarded by the serving zero-recompile tests over
+`paddle_jit_compiles_total`).
+
+Hot paths never pay: the eager dispatch cache reports only from its
+cold miss path (`note_dispatch_compile`) and its per-op invocation
+counts are mirrored at scrape time by a registry collector, exactly
+like the `paddle_dispatch_*` metrics.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from . import metrics as _metrics
+
+
+class ProgramRecord:
+    """One named compiled program's cumulative accounting."""
+
+    __slots__ = ('name', 'kind', 'compile_count', 'compile_seconds',
+                 'invocations', 'host_seconds', 'flops', 'bytes_accessed',
+                 'peak_memory_bytes', 'argument_bytes', 'output_bytes',
+                 'temp_bytes', 'analyzed', 'note')
+
+    def __init__(self, name: str, kind: str = 'jit'):
+        self.name = name
+        self.kind = kind
+        self.compile_count = 0
+        self.compile_seconds = 0.0
+        self.invocations = 0
+        self.host_seconds = 0.0
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.peak_memory_bytes = 0
+        self.argument_bytes = 0
+        self.output_bytes = 0
+        self.temp_bytes = 0
+        self.analyzed = False
+        self.note = ''
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            'name': self.name, 'kind': self.kind,
+            'compile_count': self.compile_count,
+            'compile_seconds': self.compile_seconds,
+            'invocations': self.invocations,
+            'host_seconds': self.host_seconds,
+            'flops': self.flops, 'bytes_accessed': self.bytes_accessed,
+            'peak_memory_bytes': self.peak_memory_bytes,
+            'argument_bytes': self.argument_bytes,
+            'output_bytes': self.output_bytes,
+            'temp_bytes': self.temp_bytes,
+            'analyzed': self.analyzed, 'note': self.note,
+        }
+
+
+def _read_analysis(compiled, record: ProgramRecord):
+    """Fill a record from a jax `Compiled` object's free introspection.
+    Cumulative across signatures: a program recompiled at a second
+    shape (to_static buckets) keeps the LARGEST figures — the report
+    attributes the expensive variant."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            record.flops = max(record.flops, float(ca.get('flops', 0.0)))
+            record.bytes_accessed = max(
+                record.bytes_accessed, float(ca.get('bytes accessed', 0.0)))
+            record.analyzed = True
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg = int(getattr(ma, 'argument_size_in_bytes', 0) or 0)
+            out = int(getattr(ma, 'output_size_in_bytes', 0) or 0)
+            tmp = int(getattr(ma, 'temp_size_in_bytes', 0) or 0)
+            alias = int(getattr(ma, 'alias_size_in_bytes', 0) or 0)
+            peak = int(getattr(ma, 'peak_memory_in_bytes', 0) or 0)
+            if not peak:
+                # CPU/older backends report no live peak: the resident
+                # footprint bound is args + temps + outputs - aliased
+                peak = max(arg + tmp + out - alias, 0)
+            record.peak_memory_bytes = max(record.peak_memory_bytes, peak)
+            record.argument_bytes = max(record.argument_bytes, arg)
+            record.output_bytes = max(record.output_bytes, out)
+            record.temp_bytes = max(record.temp_bytes, tmp)
+    except Exception:
+        pass
+
+
+class CatalogedJit:
+    """A jax.jit'd callable enrolled in the catalog.
+
+    First call per input signature compiles through the AOT path
+    (`fn.lower(*args).compile()`) — the SAME one backend compile the
+    plain call would have cost — keeps the `Compiled` executable, and
+    reads its cost/memory analyses into the program record. Subsequent
+    calls invoke the captured executable directly and account
+    invocations + host wall time. Any AOT failure (exotic backend,
+    unhashable signature) falls back to the plain jitted call for that
+    signature; the record then carries counts without analysis.
+    """
+
+    def __init__(self, catalog: 'ProgramCatalog', fn, name: Optional[str]
+                 = None, name_fn: Optional[Callable] = None,
+                 kind: str = 'jit'):
+        if name is None and name_fn is None:
+            raise ValueError('CatalogedJit needs name= or name_fn=')
+        self._catalog = catalog
+        self._fn = fn
+        self._name = name
+        self._name_fn = name_fn
+        self._kind = kind
+        self._entries: Dict[Any, Any] = {}   # sig -> (record, callable)
+
+    def _signature(self, args):
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        sig = []
+        for leaf in leaves:
+            dt = getattr(leaf, 'dtype', None)
+            if dt is not None:
+                sig.append((tuple(getattr(leaf, 'shape', ())), str(dt),
+                            bool(getattr(leaf, 'weak_type', False))))
+            else:
+                sig.append(('py', type(leaf)))
+        key = (treedef, tuple(sig))
+        hash(key)
+        return key
+
+    def _build(self, key, args):
+        if self._name is not None:
+            name = self._name
+        else:
+            try:
+                name = self._name_fn(args)
+            except Exception:
+                name = f'{self._kind}:unnamed'   # naming must never fail a call
+        record = self._catalog.record(name, kind=self._kind)
+        call = self._fn
+        if key is not None:
+            t0 = time.perf_counter()
+            try:
+                compiled = self._fn.lower(*args).compile()
+                dt = time.perf_counter() - t0
+                with self._catalog._lock:
+                    record.compile_count += 1
+                    record.compile_seconds += dt
+                _read_analysis(compiled, record)
+                call = compiled
+            except Exception:
+                # AOT path unavailable here: serve through the plain
+                # jitted call — counts still accumulate, analysis stays
+                # empty and the report marks it
+                record.note = 'aot_unavailable'
+            self._entries[key] = (record, call)
+        return record, call
+
+    def __call__(self, *args):
+        try:
+            key = self._signature(args)
+        except Exception:
+            key = None
+        entry = self._entries.get(key) if key is not None else None
+        t0 = time.perf_counter()
+        if entry is None:
+            record, call = self._build(key, args)
+        else:
+            record, call = entry
+        out = call(*args)
+        dt = time.perf_counter() - t0
+        with self._catalog._lock:
+            record.invocations += 1
+            record.host_seconds += dt
+        return out
+
+    # the wrapped object still answers AOT introspection (TrainStep's
+    # memory_analysis does `self._jitted.lower(...)`); the lowering
+    # cache makes that free after the wrapper's own compile
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+class ProgramCatalog:
+    """Registry of every named compiled program in the process."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._records: Dict[str, ProgramRecord] = {}
+
+    # -- enrollment ---------------------------------------------------------
+    def record(self, name: str, kind: str = 'jit') -> ProgramRecord:
+        with self._lock:
+            rec = self._records.get(name)
+            if rec is None:
+                rec = self._records[name] = ProgramRecord(name, kind)
+            return rec
+
+    def wrap_jit(self, fn, name: Optional[str] = None,
+                 name_fn: Optional[Callable] = None,
+                 kind: str = 'jit') -> CatalogedJit:
+        """Enroll a jax.jit'd callable; returns the drop-in wrapper."""
+        return CatalogedJit(self, fn, name=name, name_fn=name_fn, kind=kind)
+
+    def note_invocation(self, name: str, seconds: float = 0.0, n: int = 1,
+                        kind: str = 'jit'):
+        rec = self.record(name, kind)
+        with self._lock:
+            rec.invocations += n
+            rec.host_seconds += seconds
+        return rec
+
+    def note_compile(self, name: str, seconds: float, kind: str = 'jit'):
+        rec = self.record(name, kind)
+        with self._lock:
+            rec.compile_count += 1
+            rec.compile_seconds += seconds
+        return rec
+
+    # -- dispatch-cache mirror ----------------------------------------------
+    def _sync_dispatch(self):
+        """Mirror the eager dispatch cache's per-op call counts into
+        `eager:{op}` records (compile times arrive from the cache's own
+        cold miss path via `note_dispatch_compile`). Mirrors, not
+        accumulates — runs at report/scrape time only."""
+        try:
+            from .. import _dispatch
+            per_op = _dispatch.stats()['per_op']
+        except Exception:
+            return
+        with self._lock:
+            for op, row in per_op.items():
+                rec = self.record(f'eager:{op}', kind='dispatch')
+                rec.invocations = row['hits'] + row['misses']
+
+    # -- reporting ----------------------------------------------------------
+    def records(self) -> List[ProgramRecord]:
+        with self._lock:
+            return list(self._records.values())
+
+    def top_programs(self, n: int = 10, sort_by: str = 'host_seconds',
+                     kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        """The attribution report: programs ranked by `sort_by`
+        ('host_seconds', 'flops', 'bytes_accessed', 'invocations',
+        'compile_seconds'). Pure dict reads — never compiles."""
+        self._sync_dispatch()
+        rows = [r.as_dict() for r in self.records()
+                if kind is None or r.kind == kind]
+        rows.sort(key=lambda r: (-r.get(sort_by, 0.0), r['name']))
+        return rows[:n]
+
+    def snapshot(self) -> Dict[str, Any]:
+        self._sync_dispatch()
+        return {'programs': [r.as_dict() for r in self.records()]}
+
+    def report(self, max_rows: int = 12) -> str:
+        """Human-readable program-attribution table."""
+        rows = self.top_programs(n=max_rows)
+        lines = [f'program catalog: {len(self.records())} program(s)',
+                 f'  {"program":<28}{"kind":<10}{"calls":>8}'
+                 f'{"host s":>10}{"compile s":>10}{"GFLOPs":>10}'
+                 f'{"GB moved":>10}{"peak MiB":>10}']
+        for r in rows:
+            lines.append(
+                f'  {r["name"][:27]:<28}{r["kind"]:<10}'
+                f'{r["invocations"]:>8}'
+                f'{r["host_seconds"]:>10.3f}'
+                f'{r["compile_seconds"]:>10.3f}'
+                f'{r["flops"] / 1e9:>10.3f}'
+                f'{r["bytes_accessed"] / 1e9:>10.3f}'
+                f'{r["peak_memory_bytes"] / 2**20:>10.1f}')
+        return '\n'.join(lines)
+
+    def reset(self):
+        with self._lock:
+            self._records.clear()
+
+
+_catalog = ProgramCatalog()
+
+
+def get_catalog() -> ProgramCatalog:
+    return _catalog
+
+
+def note_dispatch_compile(op_name: str, seconds: float):
+    """Cold-path hook for paddle_tpu._dispatch: one cache entry was
+    traced+compiled (the building call's wall time)."""
+    _catalog.note_compile(f'eager:{op_name}', seconds, kind='dispatch')
+
+
+def _program_collector(reg: '_metrics.MetricsRegistry'):
+    """Scrape-time mirror of the catalog into `paddle_program_*`
+    metrics (mirror, not accumulate — same contract as the dispatch
+    collector)."""
+    cat = _catalog
+    cat._sync_dispatch()
+    inv = reg.counter('paddle_program_invocations_total',
+                      'compiled-program invocations', ('program',))
+    host = reg.counter('paddle_program_host_seconds_total',
+                       'host wall seconds inside compiled programs',
+                       ('program',))
+    comp = reg.counter('paddle_program_compile_seconds_total',
+                       'seconds compiling each program', ('program',))
+    flops = reg.gauge('paddle_program_flops',
+                      'XLA cost_analysis FLOPs per invocation',
+                      ('program',))
+    byts = reg.gauge('paddle_program_bytes_accessed',
+                     'XLA cost_analysis bytes accessed per invocation',
+                     ('program',))
+    peak = reg.gauge('paddle_program_peak_memory_bytes',
+                     'XLA memory_analysis peak bytes', ('program',))
+    for r in cat.records():
+        inv.labels(program=r.name).value = float(r.invocations)
+        host.labels(program=r.name).value = float(r.host_seconds)
+        comp.labels(program=r.name).value = float(r.compile_seconds)
+        flops.labels(program=r.name).set(r.flops)
+        byts.labels(program=r.name).set(r.bytes_accessed)
+        peak.labels(program=r.name).set(r.peak_memory_bytes)
+
+
+def install(registry: Optional['_metrics.MetricsRegistry'] = None):
+    """Idempotent: register the scrape-time program collector."""
+    (registry or _metrics.get_registry()).register_collector(
+        _program_collector)

@@ -38,6 +38,7 @@ class EventLog:
         self._events: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._dropped = 0
+        self._listeners: List = []
 
     @property
     def capacity(self) -> int:
@@ -52,6 +53,23 @@ class EventLog:
             if len(self._events) == self._events.maxlen:
                 self._dropped += 1
             self._events.append(event)
+        # listeners run OUTSIDE the lock (a listener may read the log,
+        # e.g. the flight recorder dumping on an anomaly event)
+        for fn in list(self._listeners):
+            try:
+                fn(event)
+            except Exception:
+                pass   # a broken listener must not break emit sites
+
+    def add_listener(self, fn):
+        """`fn(event)` runs after every append (anomaly triggers)."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+        return fn
+
+    def remove_listener(self, fn):
+        if fn in self._listeners:
+            self._listeners.remove(fn)
 
     def emit(self, name: str, **attrs):
         """Record an instant (zero-duration) event at the current time."""
@@ -91,6 +109,15 @@ _default_log = EventLog()
 
 def get_event_log() -> EventLog:
     return _default_log
+
+
+@_metrics.get_registry().register_collector
+def _dropped_collector(reg):
+    """Scrape-time mirror: events silently aged out of the bounded ring
+    are visible on /metrics, so trace truncation is never a surprise."""
+    fam = reg.counter('paddle_events_dropped_total',
+                      'events dropped by the bounded EventLog')
+    fam._sole().value = float(_default_log.dropped)   # mirror
 
 
 def emit(name: str, **attrs):

@@ -25,11 +25,22 @@ def _fmt_labels(labels: Dict[str, str], extra: Dict[str, str]) -> str:
 
 
 def _escape(v: str) -> str:
+    """Label-value escaping: backslash, double-quote, line feed."""
     return v.replace('\\', '\\\\').replace('"', '\\"').replace('\n', '\\n')
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping per the exposition format: ONLY backslash and
+    line feed — escaping quotes here would corrupt the help text."""
+    return v.replace('\\', '\\\\').replace('\n', '\\n')
 
 
 def _num(v: float) -> str:
     f = float(v)
+    if f != f:
+        return 'NaN'
+    if f in (float('inf'), float('-inf')):
+        return '+Inf' if f > 0 else '-Inf'
     return str(int(f)) if f == int(f) else repr(f)
 
 
@@ -43,7 +54,7 @@ def to_prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     lines = []
     for m in snap['metrics']:
         name = m['name']
-        lines.append(f'# HELP {name} {_escape(m["help"])}')
+        lines.append(f'# HELP {name} {_escape_help(m["help"])}')
         lines.append(f'# TYPE {name} {m["type"]}')
         for s in m['samples']:
             if m['type'] == 'histogram':

@@ -25,6 +25,7 @@ Design rules:
 from __future__ import annotations
 
 import bisect
+import math
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -128,6 +129,15 @@ class Histogram:
 
     def observe(self, value: float):
         v = float(value)
+        if not math.isfinite(v):
+            # a single NaN observation would poison `sum` forever (e.g.
+            # a NaN loss observed before the FT rollback drops the
+            # batch); drop it but keep the drop itself visible
+            self._family._registry.counter(
+                'paddle_metrics_nonfinite_dropped_total',
+                'non-finite histogram observations dropped',
+                ('metric',)).labels(metric=self._family.name).inc()
+            return self
         with self._family._registry._lock:
             self.bucket_counts[bisect.bisect_left(
                 self._family.buckets, v)] += 1

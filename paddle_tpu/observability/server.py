@@ -1,0 +1,209 @@
+"""HTTP observability endpoint: scrape a live trainer/server from outside.
+
+PR 2's metrics/event core was a pull-from-Python library — nothing could
+look at a running process without code on the inside. This is the
+standard production answer: a stdlib `http.server` daemon thread (no new
+dependencies) serving the shared registry and event log the way every
+fleet scraper expects:
+
+  /metrics   Prometheus text (the registry, collectors included)
+  /healthz   process liveness: 200 while steps/decodes make progress,
+             503 JSON while any armed watchdog suspects a hang
+  /summary   debug.observability_summary() (?format=json for the dict)
+  /events    JSONL tail of the event log (?n=200)
+  /trace     chrome://tracing JSON of the event log
+  /programs  ProgramCatalog report (?format=json for top_programs())
+
+`start_server(port)` is wired into examples/train_gpt.py and
+examples/serve_gpt.py via `--metrics-port`; port 0 binds an ephemeral
+port (tests). Handlers only READ shared state under the registry lock,
+so scrapes are safe concurrent with training/decoding threads.
+
+This module also owns the process's *liveness* state: instrumented
+loops call `note_progress(kind)` per step/decode round (StepTelemetry
+and the serving engine do this), and the resilience watchdog flips
+`note_hang` / `clear_hang` around a suspected hang — /healthz is the
+external view of both.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+# -- liveness state (written by instrumented loops + the watchdog) ----------
+_live_lock = threading.Lock()
+_progress: Dict[str, float] = {}        # kind -> monotonic ts of last beat
+_hangs: Dict[int, Dict[str, Any]] = {}  # watchdog id -> hang info
+_START = time.monotonic()
+
+
+def note_progress(kind: str = 'step'):
+    """Heartbeat: an instrumented loop completed one unit of `kind`
+    ('step', 'decode', ...). Cheap enough to call every step."""
+    with _live_lock:
+        _progress[kind] = time.monotonic()
+
+
+def note_hang(key: int, info: Optional[Dict[str, Any]] = None):
+    """A watchdog suspects the step under `key` is hung; /healthz goes
+    non-200 until `clear_hang(key)` (the step finally returning)."""
+    with _live_lock:
+        _hangs[key] = dict(info or {})
+
+
+def clear_hang(key: int):
+    with _live_lock:
+        _hangs.pop(key, None)
+
+
+def hang_suspected() -> bool:
+    return bool(_hangs)
+
+
+def health() -> Dict[str, Any]:
+    """The /healthz body: liveness + watchdog state + seconds since the
+    last step/decode heartbeat."""
+    import os
+    now = time.monotonic()
+    with _live_lock:
+        since = {k: round(now - t, 3) for k, t in _progress.items()}
+        hangs = [dict(v) for v in _hangs.values()]
+    return {
+        'status': 'hang_suspected' if hangs else 'ok',
+        'pid': os.getpid(),
+        'uptime_s': round(now - _START, 3),
+        'seconds_since_progress': since,
+        'hangs': hangs,
+    }
+
+
+# -- the endpoint ------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    # one handler instance per request (ThreadingHTTPServer)
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, fmt, *args):   # scrapes must not spam stdout
+        pass
+
+    def _send(self, body: str, content_type: str = 'text/plain',
+              status: int = 200):
+        data = body.encode('utf-8')
+        self.send_response(status)
+        self.send_header('Content-Type', f'{content_type}; charset=utf-8')
+        self.send_header('Content-Length', str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _query(self) -> Dict[str, str]:
+        return {k: v[-1] for k, v in
+                parse_qs(urlparse(self.path).query).items()}
+
+    def do_GET(self):
+        route = urlparse(self.path).path.rstrip('/') or '/'
+        try:
+            handler = {
+                '/': self._index, '/metrics': self._metrics,
+                '/healthz': self._healthz, '/summary': self._summary,
+                '/events': self._events, '/trace': self._trace,
+                '/programs': self._programs,
+            }.get(route)
+            if handler is None:
+                self._send(f'unknown route {route}\n', status=404)
+            else:
+                handler()
+        except BrokenPipeError:
+            pass   # scraper went away mid-response
+        except Exception as exc:   # a broken section must not kill scraping
+            self._send(f'{type(exc).__name__}: {exc}\n', status=500)
+
+    def _index(self):
+        self._send('paddle_tpu observability: /metrics /healthz /summary '
+                   '/events /trace /programs\n')
+
+    def _metrics(self):
+        from .exporters import to_prometheus_text
+        self._send(to_prometheus_text(),
+                   content_type='text/plain; version=0.0.4')
+
+    def _healthz(self):
+        body = health()
+        self._send(json.dumps(body, indent=1) + '\n',
+                   content_type='application/json',
+                   status=200 if body['status'] == 'ok' else 503)
+
+    def _summary(self):
+        from .. import debug
+        if self._query().get('format') == 'json':
+            self._send(json.dumps(debug.observability_summary(as_dict=True))
+                       + '\n', content_type='application/json')
+        else:
+            self._send(debug.observability_summary() + '\n')
+
+    def _events(self):
+        from .events import get_event_log
+        try:
+            n = int(self._query().get('n', 200))
+        except ValueError:
+            n = 200
+        events = get_event_log().events()[-max(n, 0):]
+        self._send(''.join(json.dumps(e) + '\n' for e in events),
+                   content_type='application/jsonl')
+
+    def _trace(self):
+        from .exporters import to_chrome_trace
+        self._send(json.dumps(to_chrome_trace()),
+                   content_type='application/json')
+
+    def _programs(self):
+        from .cost import get_catalog
+        cat = get_catalog()
+        if self._query().get('format') == 'json':
+            self._send(json.dumps({'programs': cat.top_programs(n=50)})
+                       + '\n', content_type='application/json')
+        else:
+            self._send(cat.report() + '\n')
+
+
+class ObservabilityServer:
+    """A bound, running endpoint; `stop()` to shut down (daemon threads
+    die with the process otherwise — safe for long trainers)."""
+
+    def __init__(self, port: int = 0, host: str = '0.0.0.0'):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f'paddle-obs-server:{self.port}', daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host = '127.0.0.1' if self.host == '0.0.0.0' else self.host
+        return f'http://{host}:{self.port}'
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2.0)
+
+    def __repr__(self):
+        return f'ObservabilityServer(url={self.url!r})'
+
+
+_servers = []
+
+
+def start_server(port: int = 0, host: str = '0.0.0.0'
+                 ) -> ObservabilityServer:
+    """Start the observability endpoint on a daemon thread; returns the
+    running server (`.port` carries the bound port when port=0)."""
+    srv = ObservabilityServer(port=port, host=host)
+    _servers.append(srv)
+    return srv

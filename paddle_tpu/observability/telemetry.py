@@ -153,6 +153,8 @@ class StepTelemetry:
     def step(self, loss=None, tokens: Optional[int] = None):
         if not _metrics.enabled():
             return self
+        from . import flight as _flight
+        from .server import note_progress
         now = time.perf_counter()
         self._times.append(now)
         self._n += 1
@@ -175,7 +177,14 @@ class StepTelemetry:
                     tok = sum(list(self._tok_hist)[-n:])
                     self._tps.set(tok / dt)
         if self._n % self._memory_every == 0:
-            self._mem.set_to_max(device_memory_bytes())
+            mem = device_memory_bytes()
+            self._mem.set_to_max(mem)
+            _flight.get_flight_recorder().record_memory(mem)
+        # liveness heartbeat (/healthz) + flight-recorder ring sample
+        note_progress('step')
+        _flight.get_flight_recorder().record_step(
+            loss=self._loss.value if loss is not None else None,
+            tokens_per_sec=self._tps.value, step=self._n)
         return self
 
     def update_memory_watermark(self):

@@ -174,6 +174,11 @@ class FaultTolerantStep:
             self._restore(self._snapshot)
             self.last_step_skipped = True
             if self.skipped_batches > self.skip_budget:
+                # flight-recorder trigger: the postmortem bundle is on
+                # disk BEFORE the run dies on the raise below
+                _obs.emit('skip_budget_exhausted', loss=lv,
+                          skipped=self.skipped_batches,
+                          budget=self.skip_budget)
                 raise SkipBudgetExhausted(
                     f'{self.skipped_batches} bad steps exceed the skip '
                     f'budget of {self.skip_budget} (last loss {lv})')

@@ -78,7 +78,12 @@ class StepWatchdog:
 
     def disarm(self):
         with self._lock:
+            fired = self._fired_this_arm
             self._armed_at = None
+        if fired:
+            # the step finally came back: /healthz recovers to 200
+            from ..observability.server import clear_hang
+            clear_hang(id(self))
 
     @contextlib.contextmanager
     def watch(self):
@@ -114,6 +119,12 @@ class StepWatchdog:
 
     def _fire(self, elapsed: float):
         self.fired += 1
+        # /healthz goes 503 until the hung step returns (disarm); the
+        # hang_suspected event below also triggers a flight-recorder dump
+        from ..observability.server import note_hang
+        note_hang(id(self), {'elapsed_s': round(elapsed, 3),
+                             'deadline_s': self.deadline,
+                             'last_span': self._last_span()})
         if _obs.enabled():
             _obs.get_registry().counter(
                 'paddle_resilience_hangs_total',

@@ -91,6 +91,17 @@ class RequestHandle:
         self._t_submit = time.perf_counter()
         self._t_first: Optional[float] = None
         self._t_done: Optional[float] = None
+        # `request_id` doubles as the trace id: the engine threads it
+        # through the serving.queue/prefill/decode_round spans and the
+        # serving_request_failed event, so one request's lifecycle can
+        # be followed in /trace and flight-recorder bundles
+        self._queue_span = None
+
+    @property
+    def trace_id(self) -> int:
+        """The id threaded through this request's spans/events in the
+        observability trace view."""
+        return self.request_id
 
     # -- engine-side transitions -------------------------------------------
     def _emit(self, token: int, now: float):

@@ -168,8 +168,17 @@ class InferenceEngine:
 
         self._trace_counts = collections.Counter()
         self._counts = collections.Counter()
-        self._decode_jit = jax.jit(self._decode_block_fn)
-        self._prefill_jit = jax.jit(self._prefill_fn)  # 1 trace per bucket
+        # enrolled in the ProgramCatalog: per-program FLOPs/bytes/peak
+        # attribution for the decode block and each prefill bucket, off
+        # the same single compile each program costs anyway
+        cat = _obs.program_catalog()
+        self._decode_jit = cat.wrap_jit(
+            jax.jit(self._decode_block_fn), name='serving.decode_block',
+            kind='serving')
+        self._prefill_jit = cat.wrap_jit(   # 1 trace per bucket
+            jax.jit(self._prefill_fn),
+            name_fn=lambda args: f'serving.prefill_{args[5].shape[1]}',
+            kind='serving')
         self._init_metrics()
 
     # ------------------------------------------------------------------
@@ -297,6 +306,10 @@ class InferenceEngine:
         self._counts['submitted'] += 1
         if _obs.enabled():
             self._m_requests.labels(status='submitted').inc()
+            # queue span: begins now, ends at admission — the request's
+            # trace id (request_id) threads every span/event it touches
+            h._queue_span = _obs.Span('serving.queue',
+                                      request_id=h.request_id).begin()
         self.scheduler.submit(h)
         return h
 
@@ -314,13 +327,18 @@ class InferenceEngine:
         self._admit()
         if not self._slot_req:
             return 0
-        toks_dev, new_pool = self._decode_jit(
-            self._params, self._frozen, self._buffers, self.pool.cache,
-            self._tok, self._pos, self._steps, self._active, self._temp,
-            self._topk, self._topp, self._greedy, self._keys)
-        self.pool.cache = new_pool
-        toks = call_with_retry(_from_device, toks_dev,
-                               policy=self._retry, site='serving.d2h')
+        with _obs.span('serving.decode_round',
+                       slots=len(self._slot_req),
+                       requests=[h.request_id
+                                 for h in self._slot_req.values()]):
+            toks_dev, new_pool = self._decode_jit(
+                self._params, self._frozen, self._buffers, self.pool.cache,
+                self._tok, self._pos, self._steps, self._active, self._temp,
+                self._topk, self._topp, self._greedy, self._keys)
+            self.pool.cache = new_pool
+            toks = call_with_retry(_from_device, toks_dev,
+                                   policy=self._retry, site='serving.d2h')
+        _obs.note_progress('decode')   # /healthz decode liveness beat
         now = time.perf_counter()
         n = len(self._slot_req)
         self._counts['decode_rounds'] += 1
@@ -407,17 +425,22 @@ class InferenceEngine:
         p = h.params
         s = len(h.prompt_tokens)
         bucket = self.pool.bucket_for(s)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :s] = h.prompt_tokens
-        ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
-                                  site='serving.h2d')
-        greedy = p.strategy == GREEDY
-        key = (np.zeros(2, np.uint32) if greedy else np.asarray(
-            jax.random.PRNGKey(h.request_id if p.seed is None
-                               else p.seed), np.uint32))
-        self.pool.cache = self._prefill_jit(
-            self._params, self._frozen, self._buffers, self.pool.cache,
-            jnp.int32(slot), ids_dev)
+        if h._queue_span is not None:
+            h._queue_span.end()   # admission closes the queue span
+            h._queue_span = None
+        with _obs.span('serving.prefill', request_id=h.request_id,
+                       bucket=bucket, slot=slot, prompt_len=s):
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :s] = h.prompt_tokens
+            ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
+                                      site='serving.h2d')
+            greedy = p.strategy == GREEDY
+            key = (np.zeros(2, np.uint32) if greedy else np.asarray(
+                jax.random.PRNGKey(h.request_id if p.seed is None
+                                   else p.seed), np.uint32))
+            self.pool.cache = self._prefill_jit(
+                self._params, self._frozen, self._buffers, self.pool.cache,
+                jnp.int32(slot), ids_dev)
         h.status = RUNNING
         self._counts['prefills'] += 1
         self._counts['prefill_tokens'] += s
