@@ -18,6 +18,7 @@ reports so) if the 1.3B step OOMs on smaller chips.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -636,16 +637,89 @@ def resilience_overhead_ab(steps=30, trials=3):
     }
 
 
+def elastic_overhead_ab(steps=30, trials=3, batch=32):
+    """A/B a fleet DistTrainStep driven bare vs through
+    ElasticTrainLoop.step (also imported by the tier-1 overhead guard).
+
+    The elastic per-step cost is the device-source poll + mesh
+    comparison + checkpoint-interval check; the transition itself
+    (checkpoint/re-mesh/restore) only happens when topology actually
+    moves, so the steady-state wrapper must be ~free. Checkpoint writes
+    are excluded (interval >> steps) — the guard targets the wrapper,
+    not disk bandwidth."""
+    import tempfile
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.resilience.elastic import ElasticTrainLoop
+
+    if not fleet._fleet.initialized:
+        fleet.init(is_collective=True)
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((batch, 64)).astype('float32')
+    y = rng.randint(0, 10, (batch,))
+
+    def loss_fn(out, lab):
+        return F.cross_entropy(out, lab)
+
+    def run(elastic):
+        import time as _t
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                              nn.Linear(128, 10))
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        if elastic:
+            loop = ElasticTrainLoop(model, loss_fn, opt,
+                                    ckpt_dir=tempfile.mkdtemp(),
+                                    ckpt_interval=10 ** 9)
+            step = loop.step
+        else:
+            fleet.distributed_model(model)
+            step = fleet.DistTrainStep(model, loss_fn, opt)
+        xs, ys = paddle.to_tensor(x), paddle.to_tensor(y)
+        loss = step(xs, ys)          # compile outside the timed window
+        float(loss.numpy())
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            loss = step(xs, ys)
+        float(loss.numpy())          # sync
+        return steps / (_t.perf_counter() - t0)
+
+    best_on = best_off = 0.0
+    for _ in range(trials):
+        best_off = max(best_off, run(elastic=False))
+        best_on = max(best_on, run(elastic=True))
+    overhead = best_off / best_on - 1 if best_on else float('inf')
+    return {
+        'elastic_steps_per_sec': round(best_on, 1),
+        'plain_steps_per_sec': round(best_off, 1),
+        'overhead_ratio': round(best_off / best_on, 4) if best_on else 0.0,
+        'overhead_pct': round(overhead * 100, 2),
+    }
+
+
 def _phase_resilience():
     """Fault-tolerance overhead phase: FaultTolerantStep wrapper on vs
-    off on the eager hot path (mirrors the obs phase; tier-1 guards the
-    ratio under 3% on CPU)."""
+    off on the eager hot path, plus the elastic-wrapper A/B on the
+    fleet step (mirrors the obs phase; tier-1 guards each ratio under
+    3% on CPU)."""
+    out = {}
     try:
-        return {'resilience_overhead': resilience_overhead_ab()}
+        out['resilience_overhead'] = resilience_overhead_ab()
     except Exception as e:
         print(f'# resilience bench failed: {type(e).__name__}: {e}',
               file=sys.stderr)
-        return {'resilience_overhead': {'error': type(e).__name__}}
+        out['resilience_overhead'] = {'error': type(e).__name__}
+    try:
+        out['elastic_overhead'] = elastic_overhead_ab()
+    except Exception as e:
+        print(f'# elastic bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        out['elastic_overhead'] = {'error': type(e).__name__}
+    return out
 
 
 def serving_trace(num_requests=24, seed=0, vocab=512):
@@ -888,6 +962,10 @@ def _phase_7b():
 
 
 def _phase_probe():
+    if os.environ.get('BENCH_TEST_PROBE_HANG'):
+        # regression-test hook: a wedged TPU tunnel (r5: the real probe
+        # hung exactly like this and took the whole perf signal dark)
+        time.sleep(3600)
     import jax
     d = jax.devices()[0]
     return {'device': jax.default_backend(),
@@ -938,9 +1016,21 @@ def _run_phase_subprocess(phase, timeout_s, env_extra=None):
         return {f'{phase}_error': type(e).__name__}
 
 
+def _cpu_phase_plan():
+    """(phase, subprocess timeout) pairs for the CPU tier;
+    BENCH_CPU_PHASES (comma list) restricts the set — the probe-fallback
+    regression test runs a single fast phase."""
+    plan = [('headline', 1500), ('eager', 600), ('obs', 600),
+            ('resilience', 600), ('serving', 900)]
+    only = os.environ.get('BENCH_CPU_PHASES')
+    if only:
+        wanted = {p.strip() for p in only.split(',') if p.strip()}
+        plan = [(p, t) for p, t in plan if p in wanted]
+    return plan
+
+
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == '--phase':
-        import os
         if os.environ.get('BENCH_FORCE_CPU'):
             # test hook for the phase flow: the axon preload ignores
             # JAX_PLATFORMS, so CPU must be forced in-process
@@ -952,22 +1042,30 @@ def main():
     # tunnel, a parent holding the TPU client blocks its own phase
     # subprocesses from attaching (r5: the 7b phase hung for 15 min
     # behind the parent's device handle).
-    probe = _run_phase_subprocess('probe', 300)
+    probe = _run_phase_subprocess(
+        'probe', int(os.environ.get('BENCH_PROBE_TIMEOUT', '300')))
     if 'device' not in probe:
-        # backend attach itself failed/hung (e.g. TPU tunnel down) —
-        # fail fast rather than letting every phase eat its own timeout
-        print(json.dumps({'metric': 'bench_unavailable', 'value': 0,
-                          'unit': 'none', 'vs_baseline': 0,
-                          'error': f'device probe failed: {probe}'}))
-        return 1
+        # Backend attach failed/hung (e.g. TPU tunnel down). The perf
+        # signal must not go dark (BENCH_r05 died here with rc=1 and
+        # zero metrics): degrade to the CPU tier in forced-CPU
+        # subprocesses — the parent still never imports jax — and exit
+        # 0 with the fallback recorded in the JSON.
+        print(f'# device probe failed ({probe}); degrading to CPU '
+              f'phases', file=sys.stderr)
+        out = {'device_probe': 'failed_cpu_fallback'}
+        out.update(probe)
+        for phase, t in _cpu_phase_plan():
+            out.update(_run_phase_subprocess(
+                phase, t, {'BENCH_FORCE_CPU': '1'}))
+        print(json.dumps(out))
+        return 0
     if str(probe.get('device', '')).lower() == 'cpu':
-        out = _run_phase_subprocess('headline', 1500)
-        if 'metric' not in out:
-            raise RuntimeError(f'headline phase failed: {out}')
-        out.update(_run_phase_subprocess('eager', 600))
-        out.update(_run_phase_subprocess('obs', 600))
-        out.update(_run_phase_subprocess('resilience', 600))
-        out.update(_run_phase_subprocess('serving', 900))
+        out = {}
+        for i, (phase, t) in enumerate(_cpu_phase_plan()):
+            res = _run_phase_subprocess(phase, t)
+            if phase == 'headline' and 'metric' not in res:
+                raise RuntimeError(f'headline phase failed: {res}')
+            out.update(res)
         print(json.dumps(out))  # CPU smoke: headline + eager/obs benches
         return 0
     # Measure the pallas CE kernel FIRST, then let the model phases use

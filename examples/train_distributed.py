@@ -6,7 +6,16 @@ multi-chip schedule without hardware:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         JAX_PLATFORMS=cpu python examples/train_distributed.py
+
+`--elastic` drives the same hybrid step through
+`resilience.ElasticTrainLoop` and simulates losing half the hosts
+mid-run: the run checkpoints, re-meshes over the survivors (dp absorbs
+the change, mp stays fixed), reshards, and continues — then grows back.
+See `examples/train_gpt.py --elastic` for the single-model flavor and
+the README "Elastic training" section for the semantics.
 """
+import sys
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -15,7 +24,25 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
 
 
-def main(steps=10):
+def _build(strategy, mp):
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      intermediate_size=128, max_position_embeddings=32,
+                      tensor_parallel=(mp > 1))
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        # next-token objective: logits at t predict token t+1
+        return F.cross_entropy(
+            logits[:, :-1].reshape([-1, cfg.vocab_size]),
+            labels[:, 1:].reshape([-1]))
+    return model, opt, loss_fn
+
+
+def main(steps=10, elastic=False):
     import jax
     n = jax.device_count()
     mp = 2 if n % 2 == 0 and n >= 2 else 1
@@ -26,27 +53,40 @@ def main(steps=10):
     strategy.sharding = True          # ZeRO over dp
     strategy.sharding_configs = {'stage': 2}
     fleet.init(is_collective=True, strategy=strategy)
+    model, opt, loss_fn = _build(strategy, mp)
 
-    paddle.seed(0)
-    cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
-                      num_attention_heads=4, num_key_value_heads=4,
-                      intermediate_size=128, max_position_embeddings=32,
-                      tensor_parallel=(mp > 1))
-    model = LlamaForCausalLM(cfg)
+    if elastic:
+        import tempfile
+
+        from paddle_tpu.resilience import ElasticTrainLoop
+        devs = list(jax.devices())
+        world = {'n': n}
+        loop = ElasticTrainLoop(
+            model, loss_fn, opt, strategy=strategy,
+            ckpt_dir=tempfile.mkdtemp(prefix='dist_elastic_ckpt_'),
+            device_source=lambda: devs[:world['n']])
+        # global batch fixed at 2*dp rows: divisible by every dp the
+        # shrink/grow visits, so the trajectory is preserved up to
+        # reduction-order ulps
+        batch = 2 * dp
+        can = dp % 2 == 0 and batch % (n // 2) == 0
+        rng = np.random.RandomState(0)
+        for i in range(steps):
+            if can and i == steps // 2 and world['n'] == n:
+                world['n'] = n // 2   # half the hosts preempted
+                print(f'--- host loss: re-meshing over {n // 2} '
+                      f'devices ---')
+            ids = rng.randint(0, 256, (batch, 32))
+            loss = loop.step(paddle.to_tensor(ids), paddle.to_tensor(ids))
+            print(f'step {i}  loss {float(loss.numpy()):.4f}  '
+                  f'(mesh {dict(loop.mesh.shape)})')
+        return float(loss.numpy())
+
     fleet.distributed_model(model)
-    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
-                                 parameters=model.parameters())
-    step = fleet.DistTrainStep(
-        model,
-        # next-token objective: logits at t predict token t+1
-        lambda logits, labels: F.cross_entropy(
-            logits[:, :-1].reshape([-1, cfg.vocab_size]),
-            labels[:, 1:].reshape([-1])),
-        opt, strategy=strategy)
-
+    step = fleet.DistTrainStep(model, loss_fn, opt, strategy=strategy)
     rng = np.random.RandomState(0)
     for i in range(steps):
-        ids = rng.randint(0, cfg.vocab_size, (2 * dp, 32))
+        ids = rng.randint(0, 256, (2 * dp, 32))
         loss = step(paddle.to_tensor(ids), paddle.to_tensor(ids))
         print(f'step {i}  loss {float(loss.numpy()):.4f}  '
               f'(mesh dp={dp} mp={mp})')
@@ -54,4 +94,4 @@ def main(steps=10):
 
 
 if __name__ == '__main__':
-    main()
+    main(elastic='--elastic' in sys.argv)

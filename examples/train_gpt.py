@@ -19,6 +19,17 @@ the loop trains:
 
     python examples/train_gpt.py --metrics-port 8000 &
     curl localhost:8000/healthz; curl localhost:8000/metrics
+
+Elastic demo: `--elastic` trains through an ElasticTrainLoop over the
+fleet mesh and simulates a mid-run host loss (shrink to half the
+devices at 1/3 of the run) and capacity return (grow back at 2/3) —
+checkpoint, re-mesh, reshard, resume, with `topology_change` events,
+flight bundles, and the /summary resize history. Global batch is
+preserved across the resizes, so the loss trajectory matches the
+fixed-topology run to reduction-order ulps:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python examples/train_gpt.py --elastic
 """
 import argparse
 
@@ -109,6 +120,77 @@ def main(steps=80, vocab=512, seq=64, batch=8, ckpt_dir=None, resume=None,
     return float(loss.numpy()) if loss is not None else float('nan')
 
 
+def main_elastic(steps=60, vocab=512, seq=64, batch=8, ckpt_dir=None,
+                 resume=None, ckpt_interval=10, metrics_port=None):
+    """--elastic: the same pretraining loop through ElasticTrainLoop,
+    with a simulated shrink (half the devices "preempted") at steps/3
+    and a grow-back at 2*steps/3. Run it under a forced multi-device
+    CPU mesh to watch both transitions on /summary."""
+    import tempfile
+
+    import jax
+
+    paddle.seed(0)
+    server = None
+    if metrics_port is not None:
+        server = observability.start_server(metrics_port)
+        print(f'observability endpoint at {server.url}')
+    devs = list(jax.devices())
+    n = len(devs)
+    world = {'n': n}
+    can_resize = n >= 2 and batch % n == 0 and batch % (n // 2) == 0
+    if not can_resize:
+        print(f'({n} device(s), batch {batch}: running elastic-wrapped '
+              f'without simulated resizes)')
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=256, max_position_embeddings=seq)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    loop = resilience.ElasticTrainLoop(
+        model,
+        lambda logits, labels: F.cross_entropy(
+            logits[:, :-1].reshape([-1, vocab]),
+            labels[:, 1:].reshape([-1])),
+        opt,
+        ckpt_dir=ckpt_dir or tempfile.mkdtemp(prefix='gpt_elastic_ckpt_'),
+        ckpt_interval=ckpt_interval,
+        device_source=lambda: devs[:world['n']],
+        resume=resume)
+
+    def batch_ids(i):
+        r = np.random.RandomState(i)
+        start_tok = r.randint(0, vocab - seq, (batch, 1))
+        return (start_tok + np.arange(seq)) % vocab
+
+    shrink_at, grow_at = steps // 3, (2 * steps) // 3
+    loss = None
+    with resilience.PreemptionHandler() as preempt:
+        while loop.global_step < steps:
+            i = loop.global_step
+            if can_resize and i == shrink_at and world['n'] == n:
+                world['n'] = n // 2
+                print(f'--- simulating host loss: {n} -> {n // 2} '
+                      f'devices ---')
+            if can_resize and i == grow_at and world['n'] < n:
+                world['n'] = n
+                print(f'--- capacity returned: {n // 2} -> {n} '
+                      f'devices ---')
+            ids = batch_ids(i)
+            loss = loop.step(ids, ids)
+            if i % 10 == 0 or i == steps - 1:
+                print(f'step {i:3d}  loss {float(loss.numpy()):.4f}  '
+                      f'mesh {dict(loop.mesh.shape)}')
+            if preempt.requested:
+                loop.save(force=True)
+                print(f'preempted at step {i}: checkpoint forced, '
+                      f'exiting cleanly')
+                break
+    print(debug.observability_summary())
+    return float(loss.numpy()) if loss is not None else float('nan')
+
+
 if __name__ == '__main__':
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument('--steps', type=int, default=80)
@@ -121,6 +203,15 @@ if __name__ == '__main__':
                    help='serve the HTTP observability endpoint '
                         '(/metrics /healthz /summary /events /trace '
                         '/programs) on this port while training')
+    p.add_argument('--elastic', action='store_true',
+                   help='train through ElasticTrainLoop with a simulated '
+                        'mid-run shrink/grow of the device mesh')
     args = p.parse_args()
-    main(steps=args.steps, ckpt_dir=args.ckpt_dir, resume=args.resume,
-         ckpt_interval=args.ckpt_interval, metrics_port=args.metrics_port)
+    if args.elastic:
+        main_elastic(steps=args.steps, ckpt_dir=args.ckpt_dir,
+                     resume=args.resume, ckpt_interval=args.ckpt_interval,
+                     metrics_port=args.metrics_port)
+    else:
+        main(steps=args.steps, ckpt_dir=args.ckpt_dir, resume=args.resume,
+             ckpt_interval=args.ckpt_interval,
+             metrics_port=args.metrics_port)

@@ -240,12 +240,27 @@ def _observability_data(max_rows: int = 10) -> dict:
                 reg, 'paddle_serving_prefills_total')),
             'decode_steps': int(reg.value(
                 'paddle_serving_decode_steps_total'))},
+        'elastic': _elastic_data(reg),
         'programs': _obs.program_catalog().top_programs(n=max_rows),
         'spans': span_rows,
         'events': {'logged': len(log), 'dropped': log.dropped,
                    'flight_dumps': int(_labeled_total(
                        reg, 'paddle_flight_dumps_total'))},
     }
+
+
+def _elastic_data(reg) -> dict:
+    """Elastic-training view: current mesh devices + the resize history
+    every shrink/grow transition appends (fleet.rebuild_mesh)."""
+    try:
+        from .distributed import env, fleet
+        history = fleet.resize_history()
+        devices = int(env.get_mesh(auto_init=False).size) \
+            if env.has_mesh() else 0
+    except Exception:
+        history, devices = [], 0
+    return {'devices': devices, 'resizes': len(history),
+            'history': history}
 
 
 def observability_summary(max_rows: int = 10, as_dict: bool = False):
@@ -318,6 +333,13 @@ def observability_summary(max_rows: int = 10, as_dict: bool = False):
         f'tpot avg {sv["tpot_avg_ms"]:.2f} ms  '
         f'{sv["prefills"]} prefills  '
         f'{sv["decode_steps"]} decode steps')
+    el = d['elastic']
+    lines.append(f'  elastic: {el["devices"]} devices  '
+                 f'{el["resizes"]} resizes')
+    for h in el['history'][-max_rows:]:
+        lines.append(
+            f'    {h["kind"]:<7} {h["from_devices"]}->{h["to_devices"]} '
+            f'devices  mesh {h["to"]}  ({h["reason"]})')
     lines.append(f'  programs: {len(d["programs"])} tracked '
                  f'(top by host time)')
     for p in d['programs']:

@@ -20,7 +20,8 @@ the 'pp' axis — with embed/head outside the pipelined region.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,9 +37,21 @@ from . import env
 from .parallel_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                               RowParallelLinear, VocabParallelEmbedding,
                               get_sharding, shard_batch)
+from .fleet_utils import recompute_degrees
 from .pipeline import gpipe
 
 _tree = jax.tree_util
+
+# every elastic mesh rebuild appends here; surfaced as the `/summary`
+# resize history and debug.observability_summary()'s elastic section
+_resize_history: List[Dict[str, Any]] = []
+
+
+def resize_history() -> List[Dict[str, Any]]:
+    """Chronological record of elastic mesh rebuilds (shrink/grow):
+    [{'time', 'reason', 'kind', 'from', 'to', 'from_devices',
+    'to_devices'}, ...]."""
+    return list(_resize_history)
 
 
 class DistributedStrategy:
@@ -151,6 +164,59 @@ class _Fleet:
             _obs.emit('fleet_init', mesh=dict(mesh.shape))
         return self
 
+    def rebuild_mesh(self, devices=None, reason='device_change',
+                     record=True):
+        """Tear down and rebuild the hybrid mesh over `devices` after a
+        topology change (host loss / capacity return).
+
+        The elastic re-mesh: mp/pp/sp stay fixed (checkpoint-structural),
+        dp is recomputed to absorb the new device count
+        (`fleet_utils.recompute_degrees`). Swaps the env mesh + HCG,
+        updates the topology gauges, appends to the resize history shown
+        on `/summary`, and emits a `topology_change` event. Live arrays
+        still sharded over the OLD mesh are untouched — callers restore
+        state from a host-canonical checkpoint onto the new mesh
+        (resilience.elastic owns that flow).
+        """
+        if not self.initialized:
+            raise RuntimeError('fleet.init must run before rebuild_mesh')
+        devs = list(devices) if devices is not None else list(jax.devices())
+        old_mesh = env.get_mesh(auto_init=False) if env.has_mesh() else None
+        old_shape = dict(old_mesh.shape) if old_mesh is not None else {}
+        old_n = int(old_mesh.size) if old_mesh is not None else 0
+        hc = recompute_degrees(len(devs), self.strategy.hybrid_configs)
+        self.strategy.hybrid_configs.update(hc)
+        mesh = Mesh(
+            np.asarray(devs).reshape(
+                hc['pp_degree'], hc['dp_degree'],
+                hc.get('sep_degree', hc.get('sp_degree', 1)),
+                hc['mp_degree']),
+            ('pp', 'dp', 'sp', 'mp'))
+        env.set_mesh(mesh)
+        self._hcg = HybridCommunicateGroup(mesh)
+        if not record:
+            # startup alignment to the probed device view (a relaunched
+            # process discovering its world) — not an elastic transition
+            return mesh
+        kind = ('shrink' if len(devs) < old_n
+                else 'grow' if len(devs) > old_n else 'remap')
+        entry = {'time': time.time(), 'reason': reason, 'kind': kind,
+                 'from': old_shape, 'to': dict(mesh.shape),
+                 'from_devices': old_n, 'to_devices': len(devs)}
+        _resize_history.append(entry)
+        if _obs.enabled():
+            reg = _obs.get_registry()
+            for ax, size in mesh.shape.items():
+                reg.gauge('paddle_fleet_mesh_axis_size',
+                          'hybrid mesh axis sizes',
+                          ('axis',)).labels(axis=ax).set(size)
+            reg.counter('paddle_elastic_resizes_total',
+                        'elastic mesh rebuilds by kind',
+                        ('kind',)).labels(kind=kind).inc()
+        _obs.emit('topology_change', **{k: v for k, v in entry.items()
+                                        if k != 'time'})
+        return mesh
+
     def get_hybrid_communicate_group(self):
         return self._hcg
 
@@ -175,6 +241,11 @@ def init(role_maker=None, is_collective=True, strategy=None):
 
 def get_hybrid_communicate_group():
     return _fleet.get_hybrid_communicate_group()
+
+
+def rebuild_mesh(devices=None, reason='device_change', record=True):
+    return _fleet.rebuild_mesh(devices=devices, reason=reason,
+                               record=record)
 
 
 from . import fleet_utils as utils  # noqa: E402  (fleet.utils.recompute)
@@ -235,6 +306,10 @@ def shard_optimizer_state(opt_state, param_specs: Dict[str, P], mesh: Mesh,
     Upstream: fleet sharding stage1 (DygraphShardingOptimizer) splits the
     moment buffers across dp ranks; here each moment leaf gets 'dp' added
     to its PartitionSpec and XLA reduce-scatters into it.
+
+    `stage=0` skips the dp extension and places each moment by its
+    param's own TP spec — the elastic restore path uses this to reshard
+    a host-canonical optimizer state onto a rebuilt (non-ZeRO) mesh.
     """
     dp = mesh.shape.get('dp', 1)
 
@@ -250,7 +325,7 @@ def shard_optimizer_state(opt_state, param_specs: Dict[str, P], mesh: Mesh,
         base = param_specs.get(name, P()) if name is not None else P()
         if len(base) > len(leaf.shape):
             base = P()
-        spec = _zero_spec(leaf.shape, base, dp)
+        spec = base if stage == 0 else _zero_spec(leaf.shape, base, dp)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return _tree.tree_map_with_path(place, opt_state)

@@ -52,6 +52,41 @@ def recompute(function, *args, **kwargs):
     return Tensor(out)
 
 
+def recompute_degrees(n_devices, hybrid_configs):
+    """Recompute hybrid-parallel degrees for a changed device count.
+
+    Elastic re-mesh policy: `mp`/`pp`/`sp` are model-structural — they
+    split attention heads, decoder blocks, and sequence dims, so a
+    checkpoint's parallel layout only survives if they stay fixed. `dp`
+    is pure replication and absorbs the whole change (Bamboo/Oobleck
+    make the same call: reconfigure the data-parallel dimension).
+    Returns a fresh hybrid_configs dict; raises ValueError when the
+    surviving count can't host the fixed axes (not divisible by
+    pp*mp*sp, or fewer devices than one model replica needs).
+    """
+    hc = dict(hybrid_configs)
+    pp = int(hc.get('pp_degree', 1))
+    mp = int(hc.get('mp_degree', 1))
+    sp = int(hc.get('sep_degree', hc.get('sp_degree', 1)))
+    fixed = pp * mp * sp
+    if n_devices < fixed:
+        raise ValueError(
+            f'{n_devices} surviving devices cannot host one model replica '
+            f'(pp*sp*mp={fixed}); mp/pp/sp are checkpoint-structural and '
+            f'cannot shrink elastically')
+    if n_devices % fixed:
+        raise ValueError(
+            f'{n_devices} surviving devices not divisible by the fixed '
+            f'pp*sp*mp={fixed} axes')
+    hc['dp_degree'] = n_devices // fixed
+    hc['pp_degree'], hc['mp_degree'] = pp, mp
+    if 'sp_degree' in hc and 'sep_degree' not in hc:
+        hc['sp_degree'] = sp
+    else:
+        hc['sep_degree'] = sp
+    return hc
+
+
 def gather_registry(group=None, registry=None):
     """Gather every host's observability-registry snapshot over the
     existing collectives and merge them into one fleet view (upstream

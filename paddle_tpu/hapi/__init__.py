@@ -194,7 +194,7 @@ class Model:
             callbacks=None, accumulate_grad_batches=1, num_iters=None,
             ckpt_dir=None, ckpt_interval=1, resume=None,
             fault_tolerance=None, step_timeout=None,
-            handle_preemption=None):
+            handle_preemption=None, elastic=None):
         """Train the prepared model.
 
         Fault-tolerance knobs (all off by default):
@@ -215,6 +215,16 @@ class Model:
           handle_preemption: install SIGTERM/SIGINT handlers that force
             a synchronous checkpoint and exit the loop cleanly (defaults
             to True when ckpt_dir is set).
+          elastic: True, a dict of resilience.ElasticTrainStep kwargs
+            (e.g. device_source=), or a ready ElasticTrainStep —
+            requires ckpt_dir. The train step becomes an elastic
+            DistTrainStep over the fleet mesh; at every step boundary
+            the device source is polled, and on topology change fit
+            forces a sync checkpoint, rebuilds the mesh over the
+            survivors (dp absorbs the change), restores the committed
+            checkpoint resharded onto the new mesh, and keeps training
+            (resumed trajectory bit-exact vs an uninterrupted run over
+            the same topology schedule).
         """
         if accumulate_grad_batches != 1:
             raise NotImplementedError(
@@ -250,6 +260,25 @@ class Model:
                     save_interval_steps=max(1, int(ckpt_interval)))
         if resume not in (None, False) and mgr is None:
             raise ValueError("fit(resume=...) requires ckpt_dir")
+        estep = None
+        if elastic:
+            if mgr is None:
+                raise ValueError('fit(elastic=...) requires ckpt_dir')
+            from ..resilience.elastic import ElasticTrainStep
+            if isinstance(elastic, ElasticTrainStep):
+                estep = elastic
+            else:
+                if self._optimizer is None or self._loss is None:
+                    raise RuntimeError('call prepare(optimizer, loss) first')
+
+                def _elastic_loss(outputs, labels):
+                    out = outputs[0] \
+                        if isinstance(outputs, (list, tuple)) else outputs
+                    return self._loss(out, labels)
+                cfg = dict(elastic) if isinstance(elastic, dict) else {}
+                estep = ElasticTrainStep(self.network, _elastic_loss,
+                                         self._optimizer, **cfg)
+            self._train_step = estep
         it_count = 0
         start_epoch = 0
         if resume not in (None, False):
@@ -282,6 +311,15 @@ class Model:
                 epoch_logs = {}
                 for step, batch in enumerate(loader):
                     cblist.on_train_batch_begin(step)
+                    if estep is not None:
+                        # elastic step boundary: re-mesh over the moved
+                        # device set, round-tripping state through the
+                        # committed checkpoint
+                        estep.maybe_resize(
+                            checkpoint_fn=lambda: self._save_train_ckpt(
+                                mgr, it_count, loader, force=True),
+                            restore_fn=lambda: self._restore_train_ckpt(
+                                mgr, it_count, loader))
                     ins, lab = _split_batch(batch)
                     if wd is not None:
                         with wd.watch():

@@ -43,7 +43,7 @@ from . import metrics as _metrics
 # serving/debug — see each site)
 TRIGGER_EVENTS = frozenset((
     'hang_suspected', 'loss_spike', 'bad_step', 'skip_budget_exhausted',
-    'serving_request_failed',
+    'serving_request_failed', 'checkpoint_corrupt',
 ))
 
 

@@ -38,6 +38,7 @@ from urllib.parse import parse_qs, urlparse
 _live_lock = threading.Lock()
 _progress: Dict[str, float] = {}        # kind -> monotonic ts of last beat
 _hangs: Dict[int, Dict[str, Any]] = {}  # watchdog id -> hang info
+_degraded: Dict[str, Dict[str, Any]] = {}  # state name -> context
 _START = time.monotonic()
 
 
@@ -64,20 +65,44 @@ def hang_suspected() -> bool:
     return bool(_hangs)
 
 
+def note_degraded(state: str, info: Optional[Dict[str, Any]] = None):
+    """The process entered a degraded-but-alive phase — re-meshing after
+    a topology change ('resizing'), draining before a preemption exit
+    ('draining'). /healthz reports the state at 503 (so routers stop
+    sending traffic / schedulers know not to kill a transitioning
+    process) until `clear_degraded(state)`."""
+    with _live_lock:
+        _degraded[state] = dict(info or {})
+
+
+def clear_degraded(state: str):
+    with _live_lock:
+        _degraded.pop(state, None)
+
+
+def degraded_states() -> Dict[str, Dict[str, Any]]:
+    with _live_lock:
+        return {k: dict(v) for k, v in _degraded.items()}
+
+
 def health() -> Dict[str, Any]:
-    """The /healthz body: liveness + watchdog state + seconds since the
-    last step/decode heartbeat."""
+    """The /healthz body: liveness + watchdog state + degraded phases +
+    seconds since the last step/decode heartbeat."""
     import os
     now = time.monotonic()
     with _live_lock:
         since = {k: round(now - t, 3) for k, t in _progress.items()}
         hangs = [dict(v) for v in _hangs.values()]
+        degraded = {k: dict(v) for k, v in _degraded.items()}
+    status = ('hang_suspected' if hangs
+              else next(iter(degraded)) if degraded else 'ok')
     return {
-        'status': 'hang_suspected' if hangs else 'ok',
+        'status': status,
         'pid': os.getpid(),
         'uptime_s': round(now - _START, 3),
         'seconds_since_progress': since,
         'hangs': hangs,
+        'degraded': degraded,
     }
 
 

@@ -16,6 +16,12 @@ that turns those from run-enders into logged events:
   `Model.fit(resume='auto')`.
 - `StepWatchdog` — configurable step deadline; emits `hang_suspected`
   with the last-known span before the configured abort action.
+- `ElasticTrainStep` / `ElasticTrainLoop` — survive topology *change*:
+  on host loss/return, force a sync checkpoint, rebuild the mesh over
+  the surviving devices (dp absorbs the change), reshard params/opt
+  state onto the new `NamedSharding`s, resume from the dataloader
+  cursor — `topology_change` events + flight bundles at every
+  transition.
 
 Everything reports into the shared observability registry
 (`paddle_resilience_*` counters: retries, rollbacks, skipped_batches,
@@ -30,10 +36,12 @@ from .retry import (FatalError, RetryPolicy, TransientError,
 from .step import FaultTolerantStep, SkipBudgetExhausted
 from .preemption import PreemptionHandler
 from .watchdog import StepWatchdog
+from .elastic import ElasticTrainLoop, ElasticTrainStep
 
 __all__ = [
     'FatalError', 'RetryPolicy', 'TransientError', 'call_with_retry',
     'is_transient', 'register_transient', 'retry',
     'FaultTolerantStep', 'SkipBudgetExhausted',
     'PreemptionHandler', 'StepWatchdog',
+    'ElasticTrainLoop', 'ElasticTrainStep',
 ]

@@ -151,6 +151,9 @@ class InferenceEngine:
         self.pool = SlotPool(model, num_slots, max_length, dtype, buckets)
         self.scheduler = FCFSScheduler(max_prefill_tokens)
         self._retry = retry_policy or RetryPolicy()
+        self._draining = False
+        self._drain_deadline_s: Optional[float] = None
+        self._preempt = None
 
         n = self.pool.num_slots
         # per-slot decode state + sampling params, host-authoritative
@@ -293,6 +296,14 @@ class InferenceEngine:
         elif kwargs:
             raise TypeError('pass params= or keyword sampling args, '
                             'not both')
+        self._check_drain()
+        if self._draining:
+            self._counts['rejected'] += 1
+            if _obs.enabled():
+                self._m_requests.labels(status='rejected').inc()
+            raise RuntimeError(
+                'engine is draining (preemption signal received): not '
+                'admitting new requests')
         toks = self._normalize_prompt(prompt)
         self.pool.bucket_for(len(toks))   # raises when no bucket fits
         if len(toks) + params.max_new_tokens > self.pool.max_length:
@@ -314,6 +325,89 @@ class InferenceEngine:
         return h
 
     # ------------------------------------------------------------------
+    # graceful drain (preemption)
+    # ------------------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def enable_graceful_drain(self, handler=None, deadline_s: float = 30.0,
+                              signals=None):
+        """Wire a `resilience.PreemptionHandler` into the engine: on
+        SIGTERM (the pod eviction grace window) the engine stops
+        admitting NEW submissions, finishes every already-accepted
+        request — queued and in-flight — under `deadline_s`, flips
+        /healthz to a 503 `draining` state so routers stop sending
+        traffic, and `run()`/`drain()` return so the caller can exit 0.
+        Pass a ready handler to share one across subsystems; returns
+        the handler in use."""
+        if handler is None:
+            import signal as _signal
+            from ..resilience.preemption import PreemptionHandler
+            handler = PreemptionHandler(
+                signals=signals or (_signal.SIGTERM,)).install()
+        self._preempt = handler
+        self._drain_deadline_s = float(deadline_s)
+        return handler
+
+    def _check_drain(self):
+        if (not self._draining and self._preempt is not None
+                and self._preempt.requested):
+            self._begin_drain()
+
+    def _begin_drain(self):
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_t0 = time.monotonic()
+        info = {'queued': self.scheduler.queue_depth,
+                'in_flight': len(self._slot_req)}
+        # 503 from here on: the replica is leaving the pool
+        _obs.note_degraded('draining', info)
+        _obs.emit('serving_drain_begin', **info)
+
+    def _fail_remaining(self, exc: BaseException):
+        for h in self.scheduler.drain():
+            h._fail(exc)
+            self._counts['failed'] += 1
+            if _obs.enabled():
+                self._m_requests.labels(status='failed').inc()
+        for slot, h in list(self._slot_req.items()):
+            del self._slot_req[slot]
+            self._active[slot] = False
+            self.pool.free(slot)
+            h._fail(exc)
+            self._counts['failed'] += 1
+            if _obs.enabled():
+                self._m_requests.labels(status='failed').inc()
+        if _obs.enabled():
+            self._m_active.set(self.pool.used_count)
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Stop admitting new submissions and drive decode until every
+        accepted request (queued + in-flight) finishes, bounded by the
+        deadline. Past the deadline the stragglers FAIL (handles carry
+        the TimeoutError) rather than being silently dropped. Returns
+        True when everything completed in time. /healthz stays
+        `draining` afterwards — the process is expected to exit."""
+        if deadline_s is None:
+            deadline_s = self._drain_deadline_s
+        self._begin_drain()
+        timed_out = False
+        while self.has_work:
+            if deadline_s is not None and \
+                    time.monotonic() - self._drain_t0 > deadline_s:
+                timed_out = True
+                self._fail_remaining(TimeoutError(
+                    f'drain deadline {deadline_s}s exceeded'))
+                break
+            self.step()
+        _obs.emit('serving_drain_complete',
+                  timed_out=timed_out,
+                  seconds=round(time.monotonic() - self._drain_t0, 3))
+        return not timed_out
+
+    # ------------------------------------------------------------------
     # the iteration loop
     # ------------------------------------------------------------------
     @property
@@ -324,6 +418,7 @@ class InferenceEngine:
         """ONE scheduler iteration: admit queued requests into free
         slots, then advance every occupied slot one decode block.
         Returns the number of requests that progressed."""
+        self._check_drain()
         self._admit()
         if not self._slot_req:
             return 0

@@ -62,6 +62,14 @@ class FCFSScheduler:
         self._note_depth()
         return True
 
+    def drain(self) -> List[RequestHandle]:
+        """Pop and return every queued request (drain-deadline expiry:
+        the engine fails them rather than dropping them silently)."""
+        out = list(self._queue)
+        self._queue.clear()
+        self._note_depth()
+        return out
+
     def admissible(self, free_slots: int,
                    bucket_for: Callable[[int], int]) -> List[RequestHandle]:
         """Pop the FCFS prefix that fits `free_slots` and the prefill

@@ -10,6 +10,7 @@ loss trajectory).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -35,6 +36,23 @@ def _tree_bytes(tree: Any) -> int:
         if nbytes is not None:
             total += int(nbytes)
     return total
+
+
+def _payload_checksums(d: str) -> Dict[str, str]:
+    """sha256 of every payload file under a checkpoint dir (the
+    _COMMITTED manifest itself excluded), keyed by relative path."""
+    out: Dict[str, str] = {}
+    for root, _, files in os.walk(d):
+        for name in sorted(files):
+            if name == '_COMMITTED':
+                continue
+            path = os.path.join(root, name)
+            h = hashlib.sha256()
+            with open(path, 'rb') as f:
+                for chunk in iter(lambda: f.read(1 << 20), b''):
+                    h.update(chunk)
+            out[os.path.relpath(path, d)] = h.hexdigest()
+    return out
 
 
 def _try_orbax():
@@ -125,7 +143,8 @@ class CheckpointManager:
                                        os.path.join(tmp, 'tree_sharded'))
         else:
             serialization.save(host_tree, os.path.join(tmp, 'tree.npz'))
-        committed = {'step': step, 'backend': self.backend}
+        committed = {'step': step, 'backend': self.backend,
+                     'checksums': _payload_checksums(tmp)}
         if cursor is not None:
             committed['dataloader'] = cursor
         with open(os.path.join(tmp, '_COMMITTED'), 'w') as f:
@@ -183,14 +202,32 @@ class CheckpointManager:
             self._write(step, host_tree, cursor)
         return True
 
+    def verify(self, step: int) -> bool:
+        """Recompute the payload checksums of a committed step against
+        its _COMMITTED manifest. Manifests from before checksumming
+        (no 'checksums' key) pass vacuously."""
+        d = self._step_dir(step)
+        try:
+            with open(os.path.join(d, '_COMMITTED')) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return False
+        want = meta.get('checksums')
+        if want is None:
+            return True
+        return _payload_checksums(d) == want
+
     def restore(self, step: Optional[int] = None,
                 template: Any = None, dataloader: Any = None) -> Any:
         """Load a checkpoint tree; with `dataloader=`, also push the
         cursor saved in the _COMMITTED sidecar back into it
-        (DataLoader.set_state_dict)."""
-        tree = self._restore_tree(step, template)
+        (DataLoader.set_state_dict). A checkpoint whose payload fails
+        its manifest checksum (torn write, bit rot) is skipped with a
+        `checkpoint_corrupt` event and the previous committed step is
+        restored instead — the cursor comes from the step actually
+        restored."""
+        actual, tree = self._restore_tree(step, template)
         if dataloader is not None:
-            actual = step if step is not None else self.latest_step()
             with open(os.path.join(self._step_dir(actual),
                                    '_COMMITTED')) as f:
                 meta = json.load(f)
@@ -199,25 +236,45 @@ class CheckpointManager:
         return tree
 
     def _restore_tree(self, step: Optional[int] = None,
-                      template: Any = None) -> Any:
+                      template: Any = None):
         self.wait_until_finished()
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        steps = self.all_steps()
+        if step is not None:
+            steps = [s for s in steps if s <= step]
+            if step not in steps:
+                raise FileNotFoundError(
+                    f'no committed checkpoint for step {step} under '
+                    f'{self.directory}')
+        if not steps:
             raise FileNotFoundError(
                 f'no committed checkpoints under {self.directory}')
-        with _obs.span('checkpoint_restore', step=step):
-            tree = call_with_retry(self._read_tree, step, template,
-                                   policy=self._retry_policy,
-                                   site='checkpoint_restore')
-        if _obs.enabled():
-            reg = _obs.get_registry()
-            reg.counter('paddle_checkpoint_restores_total',
-                        'checkpoint restores').inc()
-            reg.counter('paddle_checkpoint_restore_bytes_total',
-                        'checkpoint payload bytes read').inc(
-                            _tree_bytes(tree))
-        return tree
+        for candidate in reversed(steps):
+            if not self.verify(candidate):
+                # half-written/corrupt payload: never restore it — fall
+                # back to the previous committed step
+                _obs.emit('checkpoint_corrupt', step=candidate,
+                          directory=self._step_dir(candidate))
+                if _obs.enabled():
+                    _obs.get_registry().counter(
+                        'paddle_checkpoint_corrupt_total',
+                        'checkpoints skipped on checksum mismatch').inc()
+                continue
+            with _obs.span('checkpoint_restore', step=candidate):
+                tree = call_with_retry(self._read_tree, candidate,
+                                       template,
+                                       policy=self._retry_policy,
+                                       site='checkpoint_restore')
+            if _obs.enabled():
+                reg = _obs.get_registry()
+                reg.counter('paddle_checkpoint_restores_total',
+                            'checkpoint restores').inc()
+                reg.counter('paddle_checkpoint_restore_bytes_total',
+                            'checkpoint payload bytes read').inc(
+                                _tree_bytes(tree))
+            return candidate, tree
+        raise RuntimeError(
+            f'every committed checkpoint under {self.directory} failed '
+            f'its checksum')
 
     def _read_tree(self, step: int, template: Any = None) -> Any:
         d = self._step_dir(step)

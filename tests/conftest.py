@@ -26,3 +26,31 @@ def _seed_everything():
     paddle.seed(42)
     np.random.seed(42)
     yield
+
+
+@pytest.fixture
+def fleet_mesh():
+    """Factory for a hybrid fleet mesh over the forced 8-device CPU
+    platform: `fleet_mesh(dp=..., mp=..., pp=..., sp=...)` runs
+    fleet.init with those degrees and returns the strategy. Tears the
+    whole parallel env (mesh, HCG, resize history) down afterwards so
+    mesh-shaped tests stay independent — the elastic suite re-meshes
+    mid-test and must not leak a shrunken world into the next test."""
+    from paddle_tpu.distributed import env, fleet
+
+    def make(dp=1, mp=1, pp=1, sp=1, sharding=False, stage=1):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {'dp_degree': dp, 'mp_degree': mp,
+                                   'pp_degree': pp, 'sep_degree': sp}
+        if sharding:
+            strategy.sharding = True
+            strategy.sharding_configs['stage'] = stage
+        fleet.init(is_collective=True, strategy=strategy)
+        return strategy
+
+    yield make
+    env.destroy_process_group()
+    fleet._fleet.initialized = False
+    fleet._fleet.strategy = None
+    fleet._fleet._hcg = None
+    fleet._resize_history.clear()

@@ -1,0 +1,551 @@
+"""Elastic training: survive topology change (ISSUE 6 acceptance).
+
+The gauntlet runs on the forced 8-device CPU mesh: a fault-injected
+shrink (8 -> 4 devices) mid-run checkpoints, re-meshes, reshards,
+resumes, and a later grow (4 -> 8) re-meshes again. Both transitions
+emit `topology_change` events + flight-recorder bundles and land in the
+/summary resize history; /healthz reports `resizing` at 503 during the
+transition. Kill-and-resume mid-scenario is bit-exact versus the
+uninterrupted elastic run (same topology schedule); versus a run that
+never changed topology the trajectory matches to reduction-order ulps
+(documented divergence). Plus: topology-independent restore (dp2xmp2 ->
+dp4 / dp1xmp4 / meshless npz), checksummed checkpoints with
+corrupt-step fallback, the Model.fit(elastic=...) wiring, the bench
+probe CPU fallback, and the <3% elastic overhead guard.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import debug, observability as obs
+from paddle_tpu.distributed import env, fleet
+from paddle_tpu.distributed.fleet_utils import recompute_degrees
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.resilience.elastic import (ElasticTrainLoop,
+                                           ElasticTrainStep)
+from paddle_tpu.utils.checkpoint import CheckpointManager
+
+
+def _reg():
+    return obs.get_registry()
+
+
+class _Mlp(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _loss(out, lab):
+    return F.cross_entropy(out, lab)
+
+
+def _batch(i, batch=16):
+    """Step-indexed batch stream: a resumed run replays it identically."""
+    r = np.random.RandomState(i)
+    return (paddle.to_tensor(r.standard_normal((batch, 16))
+                             .astype(np.float32)),
+            paddle.to_tensor(r.randint(0, 4, batch)))
+
+
+def _make_loop(ckpt_dir, source, resume=None, **kw):
+    paddle.seed(7)
+    m = _Mlp()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=m.parameters())
+    return ElasticTrainLoop(m, _loss, opt, ckpt_dir=str(ckpt_dir),
+                            ckpt_interval=1, device_source=source,
+                            resume=resume, **kw)
+
+
+class _DeviceWorld:
+    """Injectable device source simulating host loss/return."""
+
+    def __init__(self, n=8):
+        self.devs = list(jax.devices())
+        self.n = n
+
+    def __call__(self):
+        return self.devs[:self.n]
+
+
+# ---------------------------------------------------------------------------
+# re-mesh policy unit tests
+# ---------------------------------------------------------------------------
+
+class TestRecomputeDegrees:
+    def test_dp_absorbs_the_change(self):
+        hc = {'dp_degree': 4, 'mp_degree': 2, 'pp_degree': 1,
+              'sep_degree': 1}
+        assert recompute_degrees(4, hc)['dp_degree'] == 2
+        assert recompute_degrees(16, hc)['dp_degree'] == 8
+        assert recompute_degrees(4, hc)['mp_degree'] == 2
+
+    def test_structural_axes_never_shrink(self):
+        hc = {'dp_degree': 2, 'mp_degree': 2, 'pp_degree': 2,
+              'sep_degree': 1}
+        with pytest.raises(ValueError, match='model replica'):
+            recompute_degrees(2, hc)   # fewer than one pp2xmp2 replica
+
+    def test_indivisible_count_rejected(self):
+        hc = {'dp_degree': 2, 'mp_degree': 4, 'pp_degree': 1,
+              'sep_degree': 1}
+        with pytest.raises(ValueError, match='not divisible'):
+            recompute_degrees(6, hc)   # 6 % mp4 != 0
+
+    def test_rebuild_mesh_requires_init(self, fleet_mesh):
+        fleet_mesh(dp=8)
+        env.destroy_process_group()
+        fleet._fleet.initialized = False
+        with pytest.raises(RuntimeError, match='fleet.init'):
+            fleet.rebuild_mesh(list(jax.devices())[:4])
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gauntlet: shrink 8->4 mid-run, grow 4->8, kill+resume
+# ---------------------------------------------------------------------------
+
+class TestShrinkGrowGauntlet:
+    def test_full_scenario(self, tmp_path, fleet_mesh):
+        fleet_mesh(dp=8)
+        flight = obs.get_flight_recorder()
+        dumps0 = len(flight.dumps)
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        resizes0 = len(fleet.resize_history())
+
+        # -- reference: fixed dp8 topology, no elastic wrapper ----------
+        paddle.seed(7)
+        ref_m = _Mlp()
+        ref_opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=ref_m.parameters())
+        fleet.distributed_model(ref_m)
+        ref_step = fleet.DistTrainStep(ref_m, _loss, ref_opt)
+        ref = [float(ref_step(*_batch(i)).numpy()) for i in range(12)]
+
+        # -- run A: uninterrupted elastic, shrink @4, grow @8 -----------
+        world = _DeviceWorld(8)
+        loop = _make_loop(tmp_path / 'a', world)
+        losses_a = []
+        for i in range(12):
+            if i == 4:
+                world.n = 4    # two hosts preempted
+            if i == 8:
+                world.n = 8    # capacity returned
+            losses_a.append(float(loop.step(*_batch(i)).numpy()))
+            if i == 4:
+                assert dict(loop.mesh.shape)['dp'] == 4
+                assert len(loop.devices) == 4
+        assert dict(loop.mesh.shape)['dp'] == 8        # grew back
+        assert loop.elastic.resizes == 2
+
+        # both transitions recorded + bundled + surfaced
+        hist = fleet.resize_history()[resizes0:]
+        assert [(h['kind'], h['from_devices'], h['to_devices'])
+                for h in hist] == [('shrink', 8, 4), ('grow', 4, 8)]
+        topo_events = [e for e in log.events()[ev0:]
+                       if e['name'] == 'topology_change']
+        assert [e['attrs']['kind'] for e in topo_events] == ['shrink',
+                                                            'grow']
+        new_dumps = flight.dumps[dumps0:]
+        assert len(new_dumps) == 2
+        for d in new_dumps:
+            assert 'topology_change' in os.path.basename(d)
+            with open(os.path.join(d, 'flight.json')) as f:
+                bundle = json.load(f)
+            assert bundle['trigger']['name'] == 'topology_change'
+
+        # /summary resize history + /healthz recovered
+        summary = debug.observability_summary(as_dict=True)
+        assert summary['elastic']['resizes'] >= 2
+        kinds = [h['kind'] for h in summary['elastic']['history']]
+        assert 'shrink' in kinds and 'grow' in kinds
+        assert 'resizes' in debug.observability_summary()
+        assert obs.health()['status'] == 'ok'
+
+        # bit-exact-where-possible semantics vs the never-resized run:
+        # identical until the shrink, reduction-order ulps after it
+        assert losses_a[:4] == ref[:4]
+        np.testing.assert_allclose(losses_a[4:], ref[4:], rtol=2e-5)
+
+        # -- run B: same scenario, killed mid-dp4, relaunched -----------
+        world_b = _DeviceWorld(8)
+        loop_b = _make_loop(tmp_path / 'b', world_b)
+        losses_b = []
+        for i in range(6):
+            if i == 4:
+                world_b.n = 4
+            losses_b.append(float(loop_b.step(*_batch(i)).numpy()))
+        del loop_b
+        # "new process": fresh fleet world, only 4 devices visible
+        env.destroy_process_group()
+        fleet._fleet.initialized = False
+        fleet._fleet.strategy = None
+        loop_b2 = _make_loop(tmp_path / 'b', world_b, resume='auto')
+        assert loop_b2.global_step == 6
+        assert dict(loop_b2.mesh.shape)['dp'] == 4
+        for i in range(6, 12):
+            if i == 8:
+                world_b.n = 8
+            losses_b.append(float(loop_b2.step(*_batch(i)).numpy()))
+
+        # resumed trajectory bit-exact vs the uninterrupted elastic run
+        assert losses_b == losses_a
+
+    def test_healthz_resizing_during_transition(self, tmp_path,
+                                                fleet_mesh, monkeypatch):
+        fleet_mesh(dp=8)
+        world = _DeviceWorld(8)
+        loop = _make_loop(tmp_path / 'ck', world)
+        seen = {}
+        orig = fleet._fleet.rebuild_mesh
+
+        def spy(devices=None, reason='device_change', record=True):
+            seen['health'] = obs.health()
+            return orig(devices=devices, reason=reason, record=record)
+
+        monkeypatch.setattr(fleet._fleet, 'rebuild_mesh', spy)
+        loop.step(*_batch(0))
+        world.n = 4
+        loop.step(*_batch(1))
+        assert seen['health']['status'] == 'resizing'
+        assert seen['health']['degraded']['resizing']['kind'] == 'shrink'
+        assert obs.health()['status'] == 'ok'   # cleared after
+
+    def test_unusable_count_rejected_once_and_training_continues(
+            self, tmp_path, fleet_mesh):
+        fleet_mesh(dp=4, mp=2)
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        world = _DeviceWorld(8)
+        loop = _make_loop(tmp_path / 'ck', world)
+        # batch 24 divides every dp degree this scenario visits (4, 3)
+        loop.step(*_batch(0, batch=24))
+        world.n = 5            # 5 % mp2 != 0: cannot host the model
+        for i in range(1, 4):
+            loop.step(*_batch(i, batch=24))
+        assert dict(loop.mesh.shape)['mp'] == 2    # old mesh kept
+        assert loop.elastic.resizes == 0
+        rejected = [e for e in log.events()[ev0:]
+                    if e['name'] == 'topology_change_rejected']
+        assert len(rejected) == 1                  # warned once, not 3x
+        world.n = 6                                # 6 = dp3 x mp2: usable
+        loop.step(*_batch(4, batch=24))
+        assert dict(loop.mesh.shape) == {'pp': 1, 'dp': 3, 'sp': 1,
+                                         'mp': 2}
+
+    def test_device_probe_failure_is_survivable(self, tmp_path,
+                                                fleet_mesh):
+        fleet_mesh(dp=8)
+
+        def broken_source():
+            raise OSError('probe transport down')
+
+        loop = _make_loop(tmp_path / 'ck', _DeviceWorld(8))
+        loop.elastic.device_source = broken_source
+        loop.step(*_batch(0))          # survives, keeps the old mesh
+        assert len(loop.devices) == 8
+
+
+# ---------------------------------------------------------------------------
+# satellite: topology-independent restore
+# ---------------------------------------------------------------------------
+
+class _TpMlp(nn.Layer):
+    """mp-sharded MLP: saved under one TP layout, restored under others."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = dist.ColumnParallelLinear(16, 32, gather_output=False)
+        self.fc2 = dist.RowParallelLinear(32, 16, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+class TestTopologyIndependentRestore:
+    def _train_and_save(self, ckpt_dir, fleet_mesh):
+        # a dp2 x mp2 mesh over 4 of the 8 platform devices, via the
+        # same startup alignment a 4-device host would see
+        fleet_mesh(dp=1, mp=2)
+        paddle.seed(11)
+        m = _TpMlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        loop = ElasticTrainLoop(m, _loss, opt, ckpt_dir=str(ckpt_dir),
+                                ckpt_interval=1,
+                                device_source=_DeviceWorld(4))
+        assert dict(loop.mesh.shape) == {'pp': 1, 'dp': 2, 'sp': 1,
+                                         'mp': 2}
+        for i in range(3):
+            loop.step(*_batch(i))
+        loop.save(force=True)
+        host = loop.elastic.capture_host_state()
+        return host
+
+    @pytest.mark.parametrize('target', [{'dp': 4, 'mp': 1},
+                                        {'dp': 1, 'mp': 4}])
+    def test_restore_under_other_mesh_is_bit_exact(self, tmp_path,
+                                                   fleet_mesh, target):
+        host = self._train_and_save(tmp_path, fleet_mesh)
+        # tear down the dp2xmp2 world, come back under the target mesh
+        env.destroy_process_group()
+        fleet._fleet.initialized = False
+        fleet._fleet.strategy = None
+
+        # dp=1 lets fleet.init absorb whatever the full platform has;
+        # the elastic step then aligns to the 4 surviving devices at
+        # startup, exactly like a relaunched process would
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {'dp_degree': 1,
+                                   'mp_degree': target['mp'],
+                                   'pp_degree': 1, 'sep_degree': 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(12)   # deliberately different init: restore must win
+        m2 = _TpMlp()
+        opt2 = paddle.optimizer.Adam(learning_rate=1e-2,
+                                     parameters=m2.parameters())
+        world = _DeviceWorld(target['dp'] * target['mp'])
+        loop2 = ElasticTrainLoop(m2, _loss, opt2, ckpt_dir=str(tmp_path),
+                                 ckpt_interval=1, device_source=world,
+                                 resume='auto', strategy=strategy)
+        assert dict(loop2.mesh.shape)['dp'] == target['dp']
+        assert dict(loop2.mesh.shape)['mp'] == target['mp']
+        assert loop2.global_step == 3
+        got = loop2.elastic.capture_host_state()
+        # params, optimizer state, and the RNG counter all bit-exact
+        assert got['n_calls'] == host['n_calls'] == 3
+        for n, v in host['model'].items():
+            np.testing.assert_array_equal(got['model'][n], v, err_msg=n)
+        for a, b in zip(jax.tree_util.tree_leaves(host['opt']),
+                        jax.tree_util.tree_leaves(got['opt'])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # placements actually follow the NEW mesh
+        shard = dict(m2.named_parameters())['fc1.weight'].value.sharding
+        assert dict(shard.mesh.shape)['mp'] == target['mp']
+        # and the next step runs on the new topology
+        loop2.step(*_batch(3))
+
+    def test_host_canonical_npz_is_meshless(self, tmp_path, fleet_mesh):
+        """A different host count (or no accelerator at all) can read
+        the checkpoint: the npz tree is plain host numpy."""
+        host = self._train_and_save(tmp_path, fleet_mesh)
+        mgr = CheckpointManager(str(tmp_path), backend='npz')
+        tree = mgr.restore()   # no template, no mesh involvement
+        for n, v in host['model'].items():
+            got = tree['model'][n]
+            assert isinstance(got, np.ndarray)
+            np.testing.assert_array_equal(got, v, err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# satellite: checksummed checkpoints, corrupt-step fallback
+# ---------------------------------------------------------------------------
+
+class TestCheckpointChecksums:
+    def _mgr(self, tmp_path, **kw):
+        return CheckpointManager(str(tmp_path), backend='npz', **kw)
+
+    def _save_steps(self, mgr, steps=(1, 2, 3)):
+        for s in steps:
+            mgr.save(s, {'w': np.full(8, float(s))}, force=True)
+
+    def test_manifest_carries_checksums(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        self._save_steps(mgr, (1,))
+        with open(os.path.join(mgr._step_dir(1), '_COMMITTED')) as f:
+            meta = json.load(f)
+        assert meta['checksums']            # non-empty {relpath: sha256}
+        assert all(len(h) == 64 for h in meta['checksums'].values())
+        assert mgr.verify(1)
+
+    def test_corrupt_latest_falls_back_to_previous(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        self._save_steps(mgr)
+        # preemption mid-write / bit rot: flip payload bytes of step 3
+        victim = os.path.join(mgr._step_dir(3), 'tree.npz')
+        with open(victim, 'r+b') as f:
+            f.seek(0)
+            f.write(b'\xde\xad\xbe\xef')
+        assert not mgr.verify(3)
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        corrupt0 = _reg().value('paddle_checkpoint_corrupt_total')
+        tree = mgr.restore()
+        np.testing.assert_array_equal(tree['w'], np.full(8, 2.0))
+        events = [e for e in log.events()[ev0:]
+                  if e['name'] == 'checkpoint_corrupt']
+        assert len(events) == 1 and events[0]['attrs']['step'] == 3
+        assert _reg().value('paddle_checkpoint_corrupt_total') \
+            == corrupt0 + 1
+
+    def test_explicit_corrupt_step_also_falls_back(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        self._save_steps(mgr)
+        with open(os.path.join(mgr._step_dir(3), 'tree.npz'), 'r+b') as f:
+            f.write(b'garbage')
+        tree = mgr.restore(step=3)
+        np.testing.assert_array_equal(tree['w'], np.full(8, 2.0))
+
+    def test_all_corrupt_raises(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        self._save_steps(mgr, (1,))
+        with open(os.path.join(mgr._step_dir(1), 'tree.npz'), 'r+b') as f:
+            f.write(b'garbage')
+        with pytest.raises(RuntimeError, match='checksum'):
+            mgr.restore()
+
+    def test_cursor_comes_from_the_step_actually_restored(self, tmp_path):
+        class FakeLoader:
+            def __init__(self):
+                self.state = None
+
+            def state_dict(self):
+                return {'epoch': 0, 'batch_idx': 0}
+
+            def set_state_dict(self, sd):
+                self.state = sd
+
+        mgr = self._mgr(tmp_path)
+        mgr.save(1, {'w': np.zeros(4)}, force=True)
+        # step 2's cursor says batch 2; step 3 (batch 3) gets corrupted
+        for s in (2, 3):
+            d = mgr._step_dir(s)
+            mgr.save(s, {'w': np.full(4, float(s))}, force=True)
+            with open(os.path.join(d, '_COMMITTED'), 'r+') as f:
+                meta = json.load(f)
+                meta['dataloader'] = {'epoch': 0, 'batch_idx': s}
+                f.seek(0)
+                json.dump(meta, f)
+                f.truncate()
+        with open(os.path.join(mgr._step_dir(3), 'tree.npz'), 'r+b') as f:
+            f.write(b'garbage')
+        loader = FakeLoader()
+        tree = mgr.restore(dataloader=loader)
+        np.testing.assert_array_equal(tree['w'], np.full(4, 2.0))
+        assert loader.state == {'epoch': 0, 'batch_idx': 2}
+
+    def test_legacy_manifest_without_checksums_still_restores(
+            self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        mgr.save(1, {'w': np.arange(4.0)}, force=True)
+        p = os.path.join(mgr._step_dir(1), '_COMMITTED')
+        with open(p) as f:
+            meta = json.load(f)
+        del meta['checksums']
+        with open(p, 'w') as f:
+            json.dump(meta, f)
+        assert mgr.verify(1)   # vacuously: nothing to check against
+        np.testing.assert_array_equal(mgr.restore()['w'], np.arange(4.0))
+
+
+# ---------------------------------------------------------------------------
+# Model.fit(elastic=...) wiring
+# ---------------------------------------------------------------------------
+
+class TestFitElastic:
+    def _model(self):
+        paddle.seed(7)
+        net = _Mlp()
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(
+                learning_rate=1e-2, parameters=net.parameters()),
+            loss=_loss)
+        rng = np.random.RandomState(3)
+        x = rng.standard_normal((48, 16)).astype('float32')
+        y = rng.randint(0, 4, 48).astype('int64')
+        return model, TensorDataset([x, y])
+
+    def test_fit_shrinks_and_continues(self, tmp_path, fleet_mesh):
+        fleet_mesh(dp=8)
+        resizes0 = len(fleet.resize_history())
+        world = _DeviceWorld(8)
+        model, ds = self._model()
+
+        class _ShrinkAt(paddle.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if step == 2:
+                    world.n = 4
+
+        hist = model.fit(ds, batch_size=16, epochs=2, shuffle=False,
+                         verbose=0, ckpt_dir=str(tmp_path / 'ck'),
+                         ckpt_interval=1,
+                         elastic={'device_source': world},
+                         callbacks=[_ShrinkAt()])
+        assert len(hist['loss']) == 6
+        assert all(np.isfinite(hist['loss']))
+        hist_resizes = fleet.resize_history()[resizes0:]
+        assert [(h['kind'], h['to_devices']) for h in hist_resizes] \
+            == [('shrink', 4)]
+        assert dict(env.get_mesh().shape)['dp'] == 4
+
+    def test_fit_elastic_requires_ckpt_dir(self, fleet_mesh):
+        fleet_mesh(dp=8)
+        model, ds = self._model()
+        with pytest.raises(ValueError, match='ckpt_dir'):
+            model.fit(ds, batch_size=16, epochs=1, verbose=0,
+                      elastic=True)
+
+
+# ---------------------------------------------------------------------------
+# satellite: bench.py device-probe CPU fallback (regression for BENCH_r05)
+# ---------------------------------------------------------------------------
+
+def test_bench_probe_timeout_falls_back_to_cpu_phases(tmp_path):
+    """`python bench.py` with a hanging device probe must exit 0 and
+    still produce CPU-phase metrics (BENCH_r05 died with rc=1 and
+    `bench_unavailable`)."""
+    env_vars = dict(os.environ)
+    env_vars.update({
+        'BENCH_TEST_PROBE_HANG': '1',   # the probe subprocess wedges
+        'BENCH_PROBE_TIMEOUT': '3',     # bounded: fall back after 3s
+        'BENCH_CPU_PHASES': 'eager',    # one fast phase keeps tier-1 fast
+        'JAX_PLATFORMS': 'cpu',
+    })
+    bench_path = os.path.join(os.path.dirname(__file__), '..', 'bench.py')
+    proc = subprocess.run([sys.executable, bench_path],
+                          capture_output=True, text=True, timeout=300,
+                          env=env_vars)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out['device_probe'] == 'failed_cpu_fallback'
+    assert out['probe_error'] == 'timeout'
+    # CPU-phase metrics actually present
+    assert 'eager_dispatch' in out
+    assert out['eager_dispatch']['cached']['steps_per_sec'] > 0
+
+
+# ---------------------------------------------------------------------------
+# tier-1 guard: elastic wrapping adds <3% step overhead
+# ---------------------------------------------------------------------------
+
+def test_elastic_overhead_under_3pct(fleet_mesh):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'bench', os.path.join(os.path.dirname(__file__), '..', 'bench.py'))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    # shared-CPU noise: accept the first trial under the bar, retry up
+    # to 3 times — the wrapper's true per-step cost is one device-source
+    # poll + a set comparison
+    res = None
+    for _ in range(3):
+        res = bench.elastic_overhead_ab(steps=20, trials=3)
+        if res['overhead_pct'] < 3.0:
+            break
+    assert res['overhead_pct'] < 3.0, res

@@ -67,3 +67,24 @@ def test_speculative_decode_example_accepts_drafts():
     # a distilled draft must agree often enough to save real forwards
     assert stats['target_forwards_saved'] >= 5, stats
     assert stats['acceptance_rate'] > 0.2, stats
+
+
+@pytest.mark.slow
+def test_train_gpt_elastic_demo_resizes_and_learns():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet as fleet_mod
+    dist.destroy_process_group()
+    fleet_mod._fleet.initialized = False
+    fleet_mod._fleet.strategy = None
+    n0 = len(fleet_mod.resize_history())
+    try:
+        mod = runpy.run_path(f'{EX}/train_gpt.py')
+        final = mod['main_elastic'](steps=30)
+        assert final < 6.0   # learning through both transitions
+        hist = fleet_mod.resize_history()[n0:]
+        assert [h['kind'] for h in hist] == ['shrink', 'grow']
+    finally:
+        dist.destroy_process_group()
+        fleet_mod._fleet.initialized = False
+        fleet_mod._fleet.strategy = None
+        fleet_mod._resize_history.clear()

@@ -453,3 +453,98 @@ def test_bench_serving_guard():
     # sanity-check both arms actually ran
     assert res['engine_tokens_per_sec'] > 0
     assert res['sequential_tokens_per_sec'] > 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-6 satellite: graceful drain wired to PreemptionHandler
+# ---------------------------------------------------------------------------
+
+class TestGracefulDrain:
+    def _engine(self, gpt, **kw):
+        kw.setdefault('num_slots', 2)
+        kw.setdefault('max_length', 64)
+        kw.setdefault('decode_block', 2)
+        return InferenceEngine(gpt, **kw)
+
+    def test_no_accepted_request_dropped_on_sigterm(self, gpt):
+        """Fault-injection: SIGTERM lands with requests queued AND
+        in-flight; every accepted request still finishes, new ones are
+        rejected, /healthz flips to draining/503."""
+        from paddle_tpu.resilience import PreemptionHandler
+        eng = self._engine(gpt)
+        handler = PreemptionHandler()   # not installed: test delivers
+        eng.enable_graceful_drain(handler=handler, deadline_s=120.0)
+        # 2 slots, 4 requests: two decode in-flight, two still queued
+        prompts = _prompts([3, 9, 5, 7], seed=2)
+        hs = [eng.submit(p, SamplingParams(max_new_tokens=6,
+                                           eos_token_id=NO_EOS))
+              for p in prompts]
+        eng.step()                      # two running, two queued
+        assert eng.scheduler.queue_depth == 2
+        handler.request()               # the eviction signal
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        try:
+            ok = eng.drain()
+            assert ok
+            # accepted requests: ALL finished, none dropped/failed
+            for h, p in zip(hs, prompts):
+                assert h.status == FINISHED
+                assert h.tokens == _ref_generate(gpt, p, 6)
+            # new submissions rejected while draining
+            with pytest.raises(RuntimeError, match='draining'):
+                eng.submit(_prompts([4], seed=9)[0])
+            assert eng.stats()['submitted'] == 4   # reject not counted
+            # healthz: 503 draining until the process exits
+            health = obs.health()
+            assert health['status'] == 'draining'
+            assert 'draining' in health['degraded']
+            names = [e['name'] for e in log.events()[ev0:]]
+            assert 'serving_drain_begin' in names
+            assert 'serving_drain_complete' in names
+        finally:
+            obs.clear_degraded('draining')
+
+    def test_drain_deadline_fails_stragglers_not_silently(self, gpt):
+        eng = self._engine(gpt)
+        hs = [eng.submit(p, SamplingParams(max_new_tokens=30,
+                                           eos_token_id=NO_EOS))
+              for p in _prompts([3, 5, 7], seed=4)]
+        eng.step()
+        try:
+            ok = eng.drain(deadline_s=0.0)   # expires immediately
+            assert not ok
+            assert not eng.has_work          # nothing left dangling
+            for h in hs:
+                assert h.status == FAILED
+                assert isinstance(h.error, TimeoutError)
+            assert eng.pool.free_count == eng.pool.num_slots
+        finally:
+            obs.clear_degraded('draining')
+
+    def test_step_picks_up_preemption_flag(self, gpt):
+        from paddle_tpu.resilience import PreemptionHandler
+        eng = self._engine(gpt)
+        handler = PreemptionHandler()
+        eng.enable_graceful_drain(handler=handler, deadline_s=60.0)
+        h = eng.submit(_prompts([3], seed=6)[0],
+                       SamplingParams(max_new_tokens=4,
+                                      eos_token_id=NO_EOS))
+        handler.request()
+        try:
+            eng.run()                        # step() notices the flag
+            assert eng.draining
+            assert h.status == FINISHED
+        finally:
+            obs.clear_degraded('draining')
+
+    def test_drain_without_handler_is_explicit(self, gpt):
+        eng = self._engine(gpt)
+        h = eng.submit(_prompts([4], seed=7)[0],
+                       SamplingParams(max_new_tokens=3,
+                                      eos_token_id=NO_EOS))
+        try:
+            assert eng.drain(deadline_s=60.0)
+            assert h.status == FINISHED
+        finally:
+            obs.clear_degraded('draining')
