@@ -571,6 +571,7 @@ def scrape_overhead_ab(steps=30, trials=3, hz=4.0):
 
     try:
         best_on = best_off = 0.0
+        ratios = []
         for _ in range(trials):
             off = eager_mlp_loop(steps=steps, instrument=True)
             t = threading.Thread(target=scraper, daemon=True)
@@ -583,7 +584,13 @@ def scrape_overhead_ab(steps=30, trials=3, hz=4.0):
                 t.join(timeout=5)
             best_off = max(best_off, off['steps_per_sec'])
             best_on = max(best_on, on['steps_per_sec'])
-        overhead = best_off / best_on - 1 if best_on else float('inf')
+            # min of adjacent-pair ratios, not best-of-N across arms:
+            # on a loaded single-core box the bests can land in
+            # different noise regimes and report phantom overhead; the
+            # least-noisy pair is closest to the uncontended truth
+            if on['steps_per_sec']:
+                ratios.append(off['steps_per_sec'] / on['steps_per_sec'])
+        overhead = min(ratios) - 1 if ratios else float('inf')
         return {
             'scraped_steps_per_sec': best_on,
             'plain_steps_per_sec': best_off,
@@ -689,10 +696,21 @@ def elastic_overhead_ab(steps=30, trials=3, batch=32):
         return steps / (_t.perf_counter() - t0)
 
     best_on = best_off = 0.0
+    ratios = []
     for _ in range(trials):
-        best_off = max(best_off, run(elastic=False))
-        best_on = max(best_on, run(elastic=True))
-    overhead = best_off / best_on - 1 if best_on else float('inf')
+        off = run(elastic=False)
+        on = run(elastic=True)
+        best_off = max(best_off, off)
+        best_on = max(best_on, on)
+        # overhead from the MIN of adjacent-pair ratios: shared-box
+        # contention noise is strictly additive and drift moves both
+        # members of a pair together, so the least-noisy pair is the
+        # closest to the uncontended truth (best-of-N across arms can
+        # land its bests in different noise regimes and report phantom
+        # overhead); a real regression shows up in every pair
+        if on:
+            ratios.append(off / on)
+    overhead = min(ratios) - 1 if ratios else float('inf')
     return {
         'elastic_steps_per_sec': round(best_on, 1),
         'plain_steps_per_sec': round(best_off, 1),
@@ -830,6 +848,188 @@ def _phase_serving():
         print(f'# serving bench failed: {type(e).__name__}: {e}',
               file=sys.stderr)
         return {'serving': {'error': type(e).__name__}}
+
+
+def router_ab(num_requests=24, num_slots=6, max_length=96, decode_block=8,
+              trials=2, kill_at_round=3):
+    """Replicated-serving A/B on the PR-4 mixed trace (also imported by
+    the tier-1 router guard). Four arms over the same weight-heavy GPT:
+
+    - bare: one `InferenceEngine` (num_slots), no router — the overhead
+      baseline.
+    - router1: the same capacity behind a 1-replica `Router`; the
+      no-fault overhead ratio vs bare is tier-1-guarded under 3%.
+    - router2: 2 replicas x num_slots — the scaling number (2x the
+      slots amortizing each weight stream; the 'add a replica, serve
+      more' story).
+    - chaos: 2 replicas with replica 0 fault-injected to die (transient
+      UNAVAILABLE) mid-trace at decode round `kill_at_round`. Reports
+      `lost_requests` — accepted requests that neither finished nor
+      failed with a typed error — which the tier-1 guard pins at 0, and
+      the throughput-degradation ratio vs the no-fault 2-replica run.
+
+    Plus a `qos` section: a 1-replica overload with a protected
+    high-priority tenant and a sheddable low-priority flood
+    (shed_queue_depth), reporting per-class p50 TTFT and the shed
+    count — the 'rejected fast, paid traffic unaffected' numbers.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    from paddle_tpu.resilience import TransientError
+    from paddle_tpu.serving import (AdmissionRejected, InferenceEngine,
+                                    ReplicaSet, Router, SamplingParams)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=384, num_hidden_layers=4,
+                    num_attention_heads=4, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg).eval()
+    trace = serving_trace(num_requests, vocab=cfg.vocab_size)
+    prompts = [p for p, _ in trace]
+    params = [SamplingParams(max_new_tokens=mn, eos_token_id=-1)
+              for _, mn in trace]
+    tokens = sum(mn for _, mn in trace)
+    eng_kw = dict(num_slots=num_slots, max_length=max_length,
+                  decode_block=decode_block)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        hs = fn()
+        return time.perf_counter() - t0, hs
+
+    # warm every arm first, then INTERLEAVE the timed trials: the
+    # bare-vs-router overhead ratio is a few percent at most, so drift
+    # between non-adjacent runs (CI neighbours, GC) must not land on
+    # one arm only (same best-of-N protocol as obs_overhead_ab)
+    engine = InferenceEngine(model, **eng_kw)
+    engine.generate_many(prompts[:num_slots + 1], params[:num_slots + 1])
+    router1 = Router(ReplicaSet(model, 1, **eng_kw))
+    router1.generate_many(prompts[:num_slots + 1], params[:num_slots + 1])
+    router2 = Router(ReplicaSet(model, 2, **eng_kw))
+    router2.generate_many(prompts[:num_slots + 1], params[:num_slots + 1])
+
+    best_bare = best_r1 = best_r2 = float('inf')
+    r1_handles = r2_handles = None
+    ratios = []
+    for _ in range(trials):
+        bare_dt, _hs = timed(lambda: engine.generate_many(prompts, params))
+        best_bare = min(best_bare, bare_dt)
+        dt, hs = timed(lambda: router1.generate_many(prompts, params))
+        if dt < best_r1:
+            best_r1, r1_handles = dt, hs
+        # the overhead estimate pairs ADJACENT runs and takes the MIN
+        # ratio: contention noise on a shared (here single-core) box is
+        # strictly additive, so the least-noisy pair is the closest to
+        # the uncontended truth, and drift moves both members of a pair
+        # together — where best-of-N across arms can land its bests in
+        # different noise regimes and report phantom overhead. A real
+        # regression shows up in EVERY pair, so the min still catches it.
+        ratios.append(dt / bare_dt)
+        dt, hs = timed(lambda: router2.generate_many(prompts, params))
+        if dt < best_r2:
+            best_r2, r2_handles = dt, hs
+    bare_tps = tokens / best_bare
+    r1_tps = tokens / best_r1
+    r2_tps = tokens / best_r2
+    overhead = min(ratios) - 1
+
+    # --- chaos arm: replica 0 dies mid-trace, failover must lose 0 ----
+    rs = ReplicaSet(model, 2, **eng_kw)
+    router = Router(rs)
+    router.generate_many(prompts[:num_slots + 1], params[:num_slots + 1])
+    calls = [0]
+    victim = rs[0].engine
+    real_step = victim.step
+
+    def dying_step():
+        calls[0] += 1
+        if calls[0] == kill_at_round:
+            raise TransientError('UNAVAILABLE: injected replica loss')
+        return real_step()
+
+    victim.step = dying_step
+    try:
+        t0 = time.perf_counter()
+        chaos_handles = router.generate_many(prompts, params)
+        chaos_dt = time.perf_counter() - t0
+    finally:
+        victim.step = real_step
+    lost = sum(1 for h in chaos_handles
+               if not (h.status == 'FINISHED'
+                       or (h.status == 'FAILED' and h.error is not None)))
+    chaos_tps = tokens / chaos_dt
+    failed_over = sum(1 for h in chaos_handles if h.failovers)
+
+    # --- qos arm: protected high tenant under a sheddable flood -------
+    qrouter = Router(
+        ReplicaSet(model, 1, **eng_kw),
+        tenants=('paid:priority=high;'
+                 f'free:priority=low,concurrency={max(num_slots // 2, 1)}'),
+        shed_queue_depth=num_slots)
+    qrouter.generate_many(prompts[:num_slots + 1], params[:num_slots + 1])
+    accepted, shed = [], 0
+    for i, (p, sp) in enumerate(zip(prompts, params)):
+        tenant = 'paid' if i % 3 == 0 else 'free'
+        try:
+            accepted.append((tenant, qrouter.submit(p, sp, tenant=tenant)))
+        except AdmissionRejected:
+            shed += 1
+        qrouter.step()    # interleave decode so the queue drains/overloads
+    qrouter.run()
+
+    def p50(vals):
+        vals = sorted(vals)
+        return round(vals[len(vals) // 2] * 1e3, 2) if vals else None
+
+    qos = {
+        'shed': shed,
+        'accepted': len(accepted),
+        'p50_ttft_ms_high': p50([h.ttft for t, h in accepted
+                                 if t == 'paid' and h.ttft is not None]),
+        'p50_ttft_ms_low': p50([h.ttft for t, h in accepted
+                                if t == 'free' and h.ttft is not None]),
+    }
+
+    return {
+        'bare_tokens_per_sec': round(bare_tps, 1),
+        'router1_tokens_per_sec': round(r1_tps, 1),
+        'router2_tokens_per_sec': round(r2_tps, 1),
+        'scaling_2_replica': round(r2_tps / r1_tps, 2) if r1_tps else 0.0,
+        'scaling_note': 'replicas share one driver thread + one CPU '
+                        'here, so 2-replica scaling measures router '
+                        'overhead at 2x capacity, not hardware scaling; '
+                        'on a fleet each replica owns its own chips',
+        'router_overhead_pct': round(overhead * 100, 2),
+        'num_requests': num_requests, 'num_slots': num_slots,
+        'tokens': tokens,
+        'parity': ([h.tokens for h in r2_handles]
+                   == [h.tokens for h in r1_handles]),
+        'chaos': {
+            'tokens_per_sec': round(chaos_tps, 1),
+            'lost_requests': lost,
+            'failed_over_requests': failed_over,
+            'completed': sum(1 for h in chaos_handles
+                             if h.status == 'FINISHED'),
+            'failed_typed': sum(1 for h in chaos_handles
+                                if h.status == 'FAILED'),
+            'degradation_vs_2_replica': round(chaos_tps / r2_tps, 3)
+            if r2_tps else 0.0,
+        },
+        'qos': qos,
+    }
+
+
+def _phase_router():
+    """Replicated-serving phase: router overhead + 2-replica scaling +
+    the chaos (replica killed mid-trace) and QoS-shedding numbers
+    (tier-1 guards lost_requests == 0 and overhead < 3%)."""
+    try:
+        return {'router': router_ab()}
+    except Exception as e:
+        print(f'# router bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        return {'router': {'error': type(e).__name__}}
 
 
 def _bench_eager_dispatch():
@@ -984,6 +1184,7 @@ PHASES = {
     'obs': _phase_obs,
     'resilience': _phase_resilience,
     'serving': _phase_serving,
+    'router': _phase_router,
 }
 
 
@@ -1021,7 +1222,7 @@ def _cpu_phase_plan():
     BENCH_CPU_PHASES (comma list) restricts the set — the probe-fallback
     regression test runs a single fast phase."""
     plan = [('headline', 1500), ('eager', 600), ('obs', 600),
-            ('resilience', 600), ('serving', 900)]
+            ('resilience', 600), ('serving', 900), ('router', 900)]
     only = os.environ.get('BENCH_CPU_PHASES')
     if only:
         wanted = {p.strip() for p in only.split(',') if p.strip()}
@@ -1087,6 +1288,7 @@ def main():
     out.update(_run_phase_subprocess('obs', 600))
     out.update(_run_phase_subprocess('resilience', 600))
     out.update(_run_phase_subprocess('serving', 900))
+    out.update(_run_phase_subprocess('router', 900))
     print(json.dumps(out))
     return 0
 

@@ -4,11 +4,25 @@ token by token, and print the engine's serving telemetry.
 
     python examples/serve_gpt.py
 
+Replicated serving: `--replicas N` puts the health-checked `Router` in
+front of N engine replicas (least-outstanding-tokens placement,
+per-replica circuit breakers, mid-flight failover), and `--tenants`
+adds per-tenant QoS — priority classes, token-bucket rates, concurrency
+caps — with fast-fail load shedding past `--shed-queue-depth`:
+
+    python examples/serve_gpt.py --replicas 2 \\
+        --tenants 'paid:priority=high;free:priority=low,rate=5,concurrency=2' \\
+        --shed-queue-depth 8
+
+Tenant spec format: `name:key=value,...;name2:...` with keys
+priority (high|normal|low), rate (requests/sec), burst, concurrency.
+
 Live introspection: `--metrics-port 8000` serves the HTTP observability
 endpoint while the engine decodes — /metrics (Prometheus, incl. the
-paddle_serving_* family), /healthz (decode-round liveness), /trace
-(queue/prefill/decode spans with per-request trace ids), /programs
-(decode block + per-bucket prefill FLOPs/bytes attribution):
+paddle_serving_* and paddle_router_* families), /healthz (decode-round
+liveness + per-replica degraded states), /trace (queue/prefill/decode
+spans with per-request trace ids), /programs (decode block + per-bucket
+prefill FLOPs/bytes attribution):
 
     python examples/serve_gpt.py --metrics-port 8000
 """
@@ -19,23 +33,13 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import debug, observability
 from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
-from paddle_tpu.serving import InferenceEngine, SamplingParams
+from paddle_tpu.serving import (AdmissionRejected, InferenceEngine,
+                                ReplicaSet, Router, SamplingParams)
 
 
-def main(num_requests=10, metrics_port=None):
-    paddle.seed(0)
-    if metrics_port is not None:
-        server = observability.start_server(metrics_port)
-        print(f'observability endpoint at {server.url}')
-    model = GPTForCausalLM(GPTConfig.tiny()).eval()
-
-    # one engine = one slot pool + scheduler; 4 slots serve the whole
-    # burst by admitting queued requests as running ones retire
-    engine = InferenceEngine(model, num_slots=4, max_length=64,
-                             decode_block=4)
-
+def _make_requests(model, num_requests):
     rng = np.random.RandomState(0)
-    handles = []
+    out = []
     for i in range(num_requests):
         prompt = rng.randint(1, model.config.vocab_size,
                              (int(rng.randint(3, 20)),)).tolist()
@@ -44,7 +48,16 @@ def main(num_requests=10, metrics_port=None):
             # mix greedy and seeded sampling in the SAME batch
             strategy='sampling' if i % 3 == 2 else 'greedy_search',
             temperature=1.2, top_k=40, seed=i, eos_token_id=-1)
-        handles.append(engine.submit(prompt, params))
+        out.append((prompt, params))
+    return out
+
+
+def _serve_single(model, requests):
+    # one engine = one slot pool + scheduler; 4 slots serve the whole
+    # burst by admitting queued requests as running ones retire
+    engine = InferenceEngine(model, num_slots=4, max_length=64,
+                             decode_block=4)
+    handles = [engine.submit(p, sp) for p, sp in requests]
 
     # stream the FIRST request token-by-token; the engine advances every
     # running request under the hood on each step
@@ -63,6 +76,53 @@ def main(num_requests=10, metrics_port=None):
           f"{stats['tokens']} tokens, {stats['decode_rounds']} decode "
           f"rounds, prefill buckets traced: "
           f"{sorted(k for k in stats['traces'] if k.startswith('prefill'))}")
+    return handles
+
+
+def _serve_routed(model, requests, replicas, tenants, shed_queue_depth):
+    router = Router(
+        ReplicaSet(model, replicas, num_slots=4, max_length=64,
+                   decode_block=4),
+        tenants=tenants, shed_queue_depth=shed_queue_depth)
+    tenant_names = (sorted(router.tenants.tenants()) or ['default'])
+    handles, rejected = [], 0
+    for i, (p, sp) in enumerate(requests):
+        tenant = tenant_names[i % len(tenant_names)]
+        try:
+            handles.append((tenant, router.submit(p, sp, tenant=tenant)))
+        except AdmissionRejected as exc:
+            rejected += 1
+            print(f'req {i}: REJECTED for {exc.tenant!r} '
+                  f'({exc.reason}, retry after {exc.retry_after_s})')
+    router.run()
+    for tenant, h in handles:
+        print(f'req {h.router_id}: {h.status.lower():8s} '
+              f'tenant={tenant:8s} replica={h.replica_id} '
+              f'failovers={h.failovers} tokens={h.tokens}')
+    st = router.stats()
+    print(f"\nrouter: {st['completed']}/{st['accepted']} completed, "
+          f"{st['failed']} failed, {rejected} rejected at admission")
+    for row in st['replicas']:
+        states = ','.join(row['health_states']) or 'healthy'
+        print(f"  replica {row['id']}: breaker {row['breaker']}  "
+              f"{states}  {row['active_slots']} active slots")
+    return [h for _, h in handles]
+
+
+def main(num_requests=10, metrics_port=None, replicas=1, tenants=None,
+         shed_queue_depth=None):
+    paddle.seed(0)
+    if metrics_port is not None:
+        server = observability.start_server(metrics_port)
+        print(f'observability endpoint at {server.url}')
+    model = GPTForCausalLM(GPTConfig.tiny()).eval()
+    requests = _make_requests(model, num_requests)
+
+    if replicas > 1 or tenants or shed_queue_depth is not None:
+        handles = _serve_routed(model, requests, max(replicas, 1),
+                                tenants, shed_queue_depth)
+    else:
+        handles = _serve_single(model, requests)
     print(debug.observability_summary())
     return handles
 
@@ -70,8 +130,19 @@ def main(num_requests=10, metrics_port=None):
 if __name__ == '__main__':
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument('--num-requests', type=int, default=10)
+    p.add_argument('--replicas', type=int, default=1,
+                   help='serve through a Router over this many engine '
+                        'replicas (health checks, failover, breakers)')
+    p.add_argument('--tenants', type=str, default=None,
+                   help="per-tenant QoS spec, e.g. 'paid:priority=high;"
+                        "free:priority=low,rate=5,concurrency=2'")
+    p.add_argument('--shed-queue-depth', type=int, default=None,
+                   help='queue depth past which low-priority work is '
+                        'shed with a typed AdmissionRejected')
     p.add_argument('--metrics-port', type=int, default=None,
                    help='serve the HTTP observability endpoint on this '
                         'port while decoding')
     args = p.parse_args()
-    main(num_requests=args.num_requests, metrics_port=args.metrics_port)
+    main(num_requests=args.num_requests, metrics_port=args.metrics_port,
+         replicas=args.replicas, tenants=args.tenants,
+         shed_queue_depth=args.shed_queue_depth)

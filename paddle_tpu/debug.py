@@ -240,12 +240,51 @@ def _observability_data(max_rows: int = 10) -> dict:
                 reg, 'paddle_serving_prefills_total')),
             'decode_steps': int(reg.value(
                 'paddle_serving_decode_steps_total'))},
+        'router': _router_data(reg),
         'elastic': _elastic_data(reg),
         'programs': _obs.program_catalog().top_programs(n=max_rows),
         'spans': span_rows,
         'events': {'logged': len(log), 'dropped': log.dropped,
                    'flight_dumps': int(_labeled_total(
                        reg, 'paddle_flight_dumps_total'))},
+    }
+
+
+def _router_data(reg) -> dict:
+    """Serving-router view: fleet counters + per-replica breaker state,
+    load, and active degraded states (the /summary per-replica health)."""
+    breaker_names = {0: 'closed', 1: 'half_open', 2: 'open'}
+    per_replica = []
+    fam = reg.get('paddle_router_breaker_state')
+    out_fam = reg.get('paddle_router_outstanding_tokens')
+    if fam is not None:
+        for (rid,), child in sorted(fam._children.items()):
+            outstanding = 0
+            if out_fam is not None:
+                oc = out_fam._children.get((rid,))
+                outstanding = int(oc.value) if oc is not None else 0
+            per_replica.append({
+                'replica': rid,
+                'breaker': breaker_names.get(int(child.value),
+                                             str(child.value)),
+                'outstanding_tokens': outstanding,
+                'health_states': sorted(
+                    _obs.degraded_states(scope=f'replica:{rid}')),
+            })
+    outcomes: dict = {}
+    req_fam = reg.get('paddle_router_requests_total')
+    if req_fam is not None:
+        for (tenant, outcome), child in req_fam._children.items():
+            outcomes[outcome] = outcomes.get(outcome, 0) + int(child.value)
+    return {
+        'replicas': int(reg.value('paddle_router_replicas')),
+        'available': int(reg.value('paddle_router_available_replicas')),
+        'queue_depth': int(reg.value('paddle_router_queue_depth')),
+        'failovers': int(_labeled_total(
+            reg, 'paddle_router_failovers_total')),
+        'shed': int(_labeled_total(reg, 'paddle_router_shed_total')),
+        'outcomes': outcomes,
+        'per_replica': per_replica,
     }
 
 
@@ -333,6 +372,16 @@ def observability_summary(max_rows: int = 10, as_dict: bool = False):
         f'tpot avg {sv["tpot_avg_ms"]:.2f} ms  '
         f'{sv["prefills"]} prefills  '
         f'{sv["decode_steps"]} decode steps')
+    rt = d['router']
+    lines.append(
+        f'  router: {rt["replicas"]} replicas '
+        f'({rt["available"]} available)  queue {rt["queue_depth"]}  '
+        f'{rt["failovers"]} failovers  {rt["shed"]} shed')
+    for row in rt['per_replica']:
+        states = ','.join(row['health_states']) or 'healthy'
+        lines.append(
+            f'    replica {row["replica"]}: breaker {row["breaker"]}  '
+            f'{states}  outstanding {row["outstanding_tokens"]} tokens')
     el = d['elastic']
     lines.append(f'  elastic: {el["devices"]} devices  '
                  f'{el["resizes"]} resizes')

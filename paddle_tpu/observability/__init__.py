@@ -31,8 +31,9 @@ from .telemetry import (StepTelemetry, collective_totals,
 from .cost import (CatalogedJit, ProgramCatalog, ProgramRecord,
                    get_catalog as program_catalog)
 from .flight import FlightRecorder, get_flight_recorder
-from .server import (ObservabilityServer, clear_degraded, health,
-                     note_degraded, note_progress, start_server)
+from .server import (ObservabilityServer, clear_degraded, degraded_states,
+                     hang_suspected, health, note_degraded, note_progress,
+                     start_server)
 from . import cost as _cost
 from . import flight as _flight
 
@@ -45,8 +46,9 @@ __all__ = [
     'install', 'note_jit_cache_entry',
     'CatalogedJit', 'ProgramCatalog', 'ProgramRecord', 'program_catalog',
     'FlightRecorder', 'get_flight_recorder',
-    'ObservabilityServer', 'clear_degraded', 'health', 'note_degraded',
-    'note_progress', 'start_server',
+    'ObservabilityServer', 'clear_degraded', 'degraded_states',
+    'hang_suspected', 'health', 'note_degraded', 'note_progress',
+    'start_server',
 ]
 
 # register the jax.monitoring listeners + dispatch collector once at

@@ -31,14 +31,21 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 # -- liveness state (written by instrumented loops + the watchdog) ----------
 _live_lock = threading.Lock()
 _progress: Dict[str, float] = {}        # kind -> monotonic ts of last beat
 _hangs: Dict[int, Dict[str, Any]] = {}  # watchdog id -> hang info
-_degraded: Dict[str, Dict[str, Any]] = {}  # state name -> context
+# (scope, state) -> {'count': refs, 'info': context}. Ref-counted, NOT
+# last-writer-wins: two independent reasons to be degraded (a replica
+# draining WHILE the process re-meshes, two engines draining at once)
+# each hold their own reference, and /healthz stays 503 until every
+# holder clears. `scope` namespaces per-entity states (the serving
+# router tags each replica's engine) so a fleet router can tell WHICH
+# replica is degraded; scope None is the process itself.
+_degraded: Dict[Tuple[Optional[str], str], Dict[str, Any]] = {}
 _START = time.monotonic()
 
 
@@ -65,43 +72,86 @@ def hang_suspected() -> bool:
     return bool(_hangs)
 
 
-def note_degraded(state: str, info: Optional[Dict[str, Any]] = None):
+def note_degraded(state: str, info: Optional[Dict[str, Any]] = None,
+                  scope: Optional[str] = None):
     """The process entered a degraded-but-alive phase — re-meshing after
     a topology change ('resizing'), draining before a preemption exit
     ('draining'). /healthz reports the state at 503 (so routers stop
     sending traffic / schedulers know not to kill a transitioning
-    process) until `clear_degraded(state)`."""
+    process) until every `note_degraded` is matched by a
+    `clear_degraded`: each call takes one reference, so concurrent
+    holders of the same state (two draining engines) keep the 503 up
+    until BOTH clear. `scope` namespaces the state per entity (the
+    serving router scopes each replica's engine as 'replica:N')."""
     with _live_lock:
-        _degraded[state] = dict(info or {})
+        entry = _degraded.get((scope, state))
+        if entry is None:
+            entry = _degraded[(scope, state)] = {'count': 0, 'info': {}}
+        entry['count'] += 1
+        if info:
+            entry['info'] = dict(info)
 
 
-def clear_degraded(state: str):
+def clear_degraded(state: str, scope: Optional[str] = None,
+                   force: bool = False):
+    """Drop one reference on `state` (the pair to a `note_degraded`);
+    the state leaves /healthz when the last holder clears. `force`
+    removes it outright regardless of holders (test teardown)."""
     with _live_lock:
-        _degraded.pop(state, None)
+        entry = _degraded.get((scope, state))
+        if entry is None:
+            return
+        entry['count'] -= 1
+        if force or entry['count'] <= 0:
+            del _degraded[(scope, state)]
 
 
-def degraded_states() -> Dict[str, Dict[str, Any]]:
+def degraded_states(scope: Optional[str] = '*') -> Dict[str, Dict[str, Any]]:
+    """Active degraded states: `scope='*'` merges every scope, `None`
+    returns only process-global states, any other string returns that
+    scope's states."""
     with _live_lock:
-        return {k: dict(v) for k, v in _degraded.items()}
+        out: Dict[str, Dict[str, Any]] = {}
+        for (sc, state), entry in _degraded.items():
+            if scope == '*' or sc == scope:
+                out[state] = dict(entry['info'])
+        return out
 
 
 def health() -> Dict[str, Any]:
     """The /healthz body: liveness + watchdog state + degraded phases +
-    seconds since the last step/decode heartbeat."""
+    seconds since the last step/decode heartbeat. `states` lists EVERY
+    active degraded state (+hang) — a process that is simultaneously
+    draining and hang-suspected shows both, and stays 503 until both
+    clear."""
     import os
     now = time.monotonic()
     with _live_lock:
         since = {k: round(now - t, 3) for k, t in _progress.items()}
         hangs = [dict(v) for v in _hangs.values()]
-        degraded = {k: dict(v) for k, v in _degraded.items()}
+        degraded = {}
+        names = set()
+        for (scope, state), entry in sorted(
+                _degraded.items(), key=lambda kv: (kv[0][0] or '',
+                                                   kv[0][1])):
+            key = state if scope is None else f'{scope}/{state}'
+            degraded[key] = dict(entry['info'])
+            degraded[key]['count'] = entry['count']
+            if scope is not None:
+                degraded[key]['scope'] = scope
+            names.add(state)
+    if hangs:
+        names.add('hang_suspected')
+    states = sorted(names)
     status = ('hang_suspected' if hangs
-              else next(iter(degraded)) if degraded else 'ok')
+              else '+'.join(states) if states else 'ok')
     return {
         'status': status,
         'pid': os.getpid(),
         'uptime_s': round(now - _START, 3),
         'seconds_since_progress': since,
         'hangs': hangs,
+        'states': states,
         'degraded': degraded,
     }
 

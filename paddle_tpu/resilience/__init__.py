@@ -31,8 +31,8 @@ activity next to throughput and comm ledgers.
 from __future__ import annotations
 
 from .retry import (FatalError, RetryPolicy, TransientError,
-                    call_with_retry, is_transient, register_transient,
-                    retry)
+                    call_with_retry, exception_chain, is_transient,
+                    register_transient, retry)
 from .step import FaultTolerantStep, SkipBudgetExhausted
 from .preemption import PreemptionHandler
 from .watchdog import StepWatchdog
@@ -40,7 +40,7 @@ from .elastic import ElasticTrainLoop, ElasticTrainStep
 
 __all__ = [
     'FatalError', 'RetryPolicy', 'TransientError', 'call_with_retry',
-    'is_transient', 'register_transient', 'retry',
+    'exception_chain', 'is_transient', 'register_transient', 'retry',
     'FaultTolerantStep', 'SkipBudgetExhausted',
     'PreemptionHandler', 'StepWatchdog',
     'ElasticTrainLoop', 'ElasticTrainStep',

@@ -80,20 +80,55 @@ def register_transient(exc_type: Type[BaseException]):
     return exc_type
 
 
+# chain walk bounds: real chains are 2-3 deep; the cap guards against a
+# pathological graph (and the id-set against __context__ cycles)
+_CHAIN_LIMIT = 16
+
+_NEVER_TRANSIENT = (KeyboardInterrupt, SystemExit, GeneratorExit)
+_PROGRAMMING_ERRORS = (AssertionError, TypeError, ValueError, KeyError,
+                       AttributeError, NotImplementedError)
+
+
+def exception_chain(exc: BaseException):
+    """Yield `exc` and its `__cause__`/`__context__` ancestry, outermost
+    first. Follows `raise X from Y` (`__cause__`) when explicit,
+    otherwise the implicit `__context__` unless suppressed
+    (`raise X from None`). Cycle-safe and depth-bounded."""
+    seen = set()
+    depth = 0
+    while (exc is not None and id(exc) not in seen
+           and depth < _CHAIN_LIMIT):
+        seen.add(id(exc))
+        depth += 1
+        yield exc
+        if exc.__cause__ is not None:
+            exc = exc.__cause__
+        elif not exc.__suppress_context__:
+            exc = exc.__context__
+        else:
+            exc = None
+
+
 def is_transient(exc: BaseException) -> bool:
     """True if `exc` looks like a failure that a bounded retry can
-    outlive. Fatal-by-construction errors (FatalError, KeyboardInterrupt,
-    programming errors) are never transient."""
-    if isinstance(exc, FatalError):
-        return False
-    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit,
-                        AssertionError, TypeError, ValueError, KeyError,
-                        AttributeError, NotImplementedError)):
-        return False
-    if isinstance(exc, _transient_types):
-        return True
-    msg = f'{type(exc).__name__}: {exc}'.lower()
-    return any(marker in msg for marker in _TRANSIENT_MARKERS)
+    outlive. Walks the `__cause__`/`__context__` chain: a transient PjRt
+    error wrapped in a framework exception (the serving router's
+    resubmission path raises `ReplicaFailure ... from the device error`)
+    is still classified transient, while a FatalError anywhere in the
+    chain — or fatal-by-construction errors (KeyboardInterrupt,
+    programming errors) at the top — poisons the whole chain."""
+    for e in exception_chain(exc):
+        if isinstance(e, (FatalError,) + _NEVER_TRANSIENT):
+            return False
+    for e in exception_chain(exc):
+        if isinstance(e, _PROGRAMMING_ERRORS):
+            continue   # a caller bug never matches, even by message
+        if isinstance(e, _transient_types):
+            return True
+        msg = f'{type(e).__name__}: {e}'.lower()
+        if any(marker in msg for marker in _TRANSIENT_MARKERS):
+            return True
+    return False
 
 
 class RetryPolicy:

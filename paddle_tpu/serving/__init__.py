@@ -22,17 +22,39 @@ retry layer with request-level (not engine-level) failure.
     for tok in h.stream():
         ...                       # per-token, as slots advance
     hs = eng.generate_many(prompts)   # continuous-batched batch API
+
+Fleet layer (`router.py` + `tenancy.py`): a `Router` over a
+`ReplicaSet` of N engines adds health-checked least-loaded placement,
+mid-flight failover with per-replica circuit breakers, and per-tenant
+QoS (token-bucket rates, concurrency caps, priority classes, typed
+fast-fail load shedding):
+
+    from paddle_tpu.serving import ReplicaSet, Router
+    router = Router(ReplicaSet(model, 2, num_slots=8, max_length=256),
+                    tenants='paid:priority=high;free:priority=low,rate=2',
+                    shed_queue_depth=64)
+    h = router.submit(prompt_ids, tenant='paid')
 """
 from __future__ import annotations
 
-from .api import (FAILED, FINISHED, GREEDY, QUEUED, RUNNING, SAMPLING,
-                  RequestHandle, SamplingParams)
+from .api import (FAILED, FINISHED, GREEDY, PRIORITY_HIGH, PRIORITY_LOW,
+                  PRIORITY_NAMES, PRIORITY_NORMAL, QUEUED, RUNNING,
+                  SAMPLING, RequestHandle, SamplingParams)
 from .engine import InferenceEngine, sample_rows
 from .kv_pool import SlotPool, default_buckets
+from .router import (CircuitBreaker, Replica, ReplicaFailure, ReplicaSet,
+                     Router, RouterHandle)
 from .scheduler import FCFSScheduler
+from .tenancy import (AdmissionRejected, Tenant, TenantRegistry,
+                      TokenBucket, parse_tenant_spec)
 
 __all__ = [
     'FAILED', 'FINISHED', 'GREEDY', 'QUEUED', 'RUNNING', 'SAMPLING',
+    'PRIORITY_HIGH', 'PRIORITY_NORMAL', 'PRIORITY_LOW', 'PRIORITY_NAMES',
     'RequestHandle', 'SamplingParams', 'InferenceEngine', 'sample_rows',
     'SlotPool', 'default_buckets', 'FCFSScheduler',
+    'CircuitBreaker', 'Replica', 'ReplicaFailure', 'ReplicaSet',
+    'Router', 'RouterHandle',
+    'AdmissionRejected', 'Tenant', 'TenantRegistry', 'TokenBucket',
+    'parse_tenant_spec',
 ]

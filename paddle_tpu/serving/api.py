@@ -17,6 +17,14 @@ from typing import Any, List, Optional
 GREEDY = 'greedy_search'
 SAMPLING = 'sampling'
 
+# priority classes (lower = more urgent). The scheduler orders admission
+# by (priority, FCFS-within-class); the router maps tenants onto these.
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_LOW = 2
+PRIORITY_NAMES = {'high': PRIORITY_HIGH, 'normal': PRIORITY_NORMAL,
+                  'low': PRIORITY_LOW}
+
 _request_ids = itertools.count()
 
 
@@ -84,6 +92,7 @@ class RequestHandle:
         self.request_id = next(_request_ids)
         self.prompt_tokens = list(prompt_tokens)
         self.params = params
+        self.priority = PRIORITY_NORMAL   # scheduler admission class
         self.tokens: List[int] = []
         self.status = QUEUED
         self.error: Optional[BaseException] = None

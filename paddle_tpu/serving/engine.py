@@ -132,7 +132,8 @@ class InferenceEngine:
                  buckets: Optional[Sequence[int]] = None,
                  max_prefill_tokens: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
-                 dtype=None, retry_policy: Optional[RetryPolicy] = None):
+                 dtype=None, retry_policy: Optional[RetryPolicy] = None,
+                 max_wait_s: Optional[float] = None):
         cfg = getattr(model, 'config', None)
         max_pos = getattr(cfg, 'max_position_embeddings', None)
         if max_pos is not None and max_length > max_pos:
@@ -149,11 +150,17 @@ class InferenceEngine:
             else eos_token_id)
         self.decode_block = int(decode_block)
         self.pool = SlotPool(model, num_slots, max_length, dtype, buckets)
-        self.scheduler = FCFSScheduler(max_prefill_tokens)
+        self.scheduler = FCFSScheduler(max_prefill_tokens,
+                                       max_wait_s=max_wait_s)
         self._retry = retry_policy or RetryPolicy()
         self._draining = False
         self._drain_deadline_s: Optional[float] = None
         self._preempt = None
+        # observability scope for degraded-state notes: None = the whole
+        # process (single-engine deployments); the router tags each
+        # replica's engine 'replica:N' so /healthz and placement can
+        # tell WHICH replica is draining
+        self.obs_scope: Optional[str] = None
 
         n = self.pool.num_slots
         # per-slot decode state + sampling params, host-authoritative
@@ -287,10 +294,11 @@ class InferenceEngine:
         return [int(t) for t in arr]
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
-               **kwargs) -> RequestHandle:
+               priority: Optional[int] = None, **kwargs) -> RequestHandle:
         """Queue one request; returns its live handle. Validation errors
         raise HERE (caller bug); runtime failures mark the handle
-        FAILED instead."""
+        FAILED instead. `priority` sets the scheduler admission class
+        (PRIORITY_HIGH/NORMAL/LOW; default NORMAL)."""
         if params is None:
             params = SamplingParams(**kwargs)
         elif kwargs:
@@ -312,6 +320,8 @@ class InferenceEngine:
                 f'({params.max_new_tokens}) exceeds the slot length '
                 f'({self.pool.max_length})')
         h = RequestHandle(toks, params, engine=self)
+        if priority is not None:
+            h.priority = int(priority)
         h._eos = int(self.eos_token_id if params.eos_token_id is None
                      else params.eos_token_id)
         self._counts['submitted'] += 1
@@ -355,6 +365,14 @@ class InferenceEngine:
                 and self._preempt.requested):
             self._begin_drain()
 
+    def begin_drain(self):
+        """Stop admitting new submissions NOW, without driving decode:
+        the non-blocking half of `drain()`. The router uses this to take
+        one replica out of rotation (its scoped `draining` state excludes
+        it from placement) while router steps keep finishing its
+        accepted requests."""
+        self._begin_drain()
+
     def _begin_drain(self):
         if self._draining:
             return
@@ -363,7 +381,7 @@ class InferenceEngine:
         info = {'queued': self.scheduler.queue_depth,
                 'in_flight': len(self._slot_req)}
         # 503 from here on: the replica is leaving the pool
-        _obs.note_degraded('draining', info)
+        _obs.note_degraded('draining', info, scope=self.obs_scope)
         _obs.emit('serving_drain_begin', **info)
 
     def _fail_remaining(self, exc: BaseException):
@@ -382,6 +400,29 @@ class InferenceEngine:
                 self._m_requests.labels(status='failed').inc()
         if _obs.enabled():
             self._m_active.set(self.pool.used_count)
+
+    def evict_all(self) -> List[RequestHandle]:
+        """Pull every accepted request — queued AND in-flight — out of
+        the engine WITHOUT failing it, returning the handles in
+        submission order (queued first is irrelevant to the router; it
+        re-sorts). This is the failover hand-off: when the router
+        declares this replica dead, the orphans are resubmitted
+        elsewhere, so their handles must leave this engine untouched.
+        Slots free, actives clear; the engine itself stays serviceable
+        (a transient device blip doesn't scrap the pool)."""
+        out = self.scheduler.drain()
+        for slot, h in list(self._slot_req.items()):
+            del self._slot_req[slot]
+            self._active[slot] = False
+            self.pool.free(slot)
+            out.append(h)
+        for h in out:
+            if h._queue_span is not None:   # don't leak open queue spans
+                h._queue_span.end()
+                h._queue_span = None
+        if _obs.enabled():
+            self._m_active.set(self.pool.used_count)
+        return out
 
     def drain(self, deadline_s: Optional[float] = None) -> bool:
         """Stop admitting new submissions and drive decode until every

@@ -1,0 +1,759 @@
+"""Replicated serving: health-checked router, failover, QoS admission.
+
+One `InferenceEngine` is one failure domain: when it dies mid-decode,
+every accepted request it held dies with it, and nothing tells clients
+to back off when it saturates. Production TPU serving fleets
+(MegaScale-style, Jiang et al. NSDI'24) treat replica failure and
+overload as the STEADY STATE; this module composes the pieces the
+repo already has — the continuous-batching engine (PR 4), the
+transient-error classifier (PR 3), graceful drain + degraded-state
+health (PR 5/6) — into that posture:
+
+- `ReplicaSet` owns N engine replicas over the SAME weights
+  (independent slot pools, independent compiled programs), each tagged
+  with an observability scope ('replica:N') so degraded states are
+  attributable per replica.
+- `Router` places each accepted request on the healthy replica with
+  the fewest outstanding decode tokens (least-loaded, not round-robin:
+  a replica stuck behind a long-budget batch stops receiving work).
+  Replicas are EXCLUDED while any degraded state (draining / resizing /
+  hang_suspected) is active for their scope — the same machinery
+  /healthz reports, not a parallel health system.
+- Failover: a replica failure mid-step evicts its accepted-but-
+  unfinished requests and resubmits them to survivors — IF the failure
+  classifies as transient (`resilience.retry.is_transient`, which walks
+  the `__cause__` chain, so the `ReplicaFailure`-wrapped PjRt error
+  still reads as transient) and the per-request failover budget is not
+  exhausted. Otherwise the request FAILS with the typed
+  `ReplicaFailure` — accepted requests complete or fail loudly, never
+  silently vanish. Greedy (and seeded-sampling) requests re-decode
+  deterministically, so a failed-over request's tokens are bit-identical
+  to an undisturbed run.
+- A per-replica `CircuitBreaker` (closed -> open on consecutive
+  failures -> half-open single probe -> closed) keeps the router from
+  hammering a sick replica with resubmissions.
+- Admission control (`tenancy.py`): per-tenant token-bucket rate +
+  concurrency caps + priority classes, and explicit load shedding —
+  past the queue-depth / estimated-TTFT budget, work below the
+  protected priority is rejected FAST with a typed `AdmissionRejected`
+  carrying a `retry_after_s` hint, before any prefill happens.
+
+Everything reports: `paddle_router_*` metrics, `router_failover` /
+`request_shed` / `breaker_*` events, a flight-recorder bundle on
+failover storms, and a per-replica router section in
+`debug.observability_summary()` / the HTTP `/summary`.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+from typing import Callable, List, Optional, Sequence
+
+from .. import observability as _obs
+from ..resilience.retry import is_transient
+from .api import (FAILED, FINISHED, PRIORITY_LOW, QUEUED, RequestHandle,
+                  SamplingParams)
+from .engine import InferenceEngine
+from .tenancy import AdmissionRejected, TenantRegistry, parse_tenant_spec
+
+_router_ids = itertools.count()
+
+# breaker states (gauge encoding: closed=0, half_open=1, open=2)
+BREAKER_CLOSED = 'closed'
+BREAKER_HALF_OPEN = 'half_open'
+BREAKER_OPEN = 'open'
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+
+
+class ReplicaFailure(RuntimeError):
+    """A replica failed with requests in flight. Raised `from` the
+    underlying error, so the transient classifier (which walks
+    `__cause__`) still sees the root cause; carried as the typed error
+    on requests whose failover budget is exhausted (or whose root cause
+    is fatal)."""
+
+    def __init__(self, replica_id: int, msg: str):
+        self.replica_id = replica_id
+        super().__init__(msg)
+
+
+class CircuitBreaker:
+    """Per-replica circuit breaker: closed -> open after
+    `failure_threshold` CONSECUTIVE failures -> half-open after
+    `reset_after_s` -> one probe decides (success closes, failure
+    reopens). `clock` is injectable for tests."""
+
+    def __init__(self, name: str = '', failure_threshold: int = 3,
+                 reset_after_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = str(name)
+        self.failure_threshold = int(failure_threshold)
+        self.reset_after_s = float(reset_after_s)
+        self._clock = clock
+        self._state = BREAKER_CLOSED
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    def _transition(self, state: str, **attrs):
+        if state == self._state:
+            return
+        self._state = state
+        _obs.emit(f'breaker_{state}', replica=self.name, **attrs)
+        if _obs.enabled():
+            reg = _obs.get_registry()
+            reg.counter('paddle_router_breaker_transitions_total',
+                        'circuit-breaker state transitions',
+                        ('replica', 'state')).labels(
+                            replica=self.name, state=state).inc()
+            reg.gauge('paddle_router_breaker_state',
+                      'breaker state per replica (0 closed, 1 half-open,'
+                      ' 2 open)', ('replica',)).labels(
+                          replica=self.name).set(_BREAKER_GAUGE[state])
+
+    @property
+    def state(self) -> str:
+        """Current state; an elapsed open-cooldown surfaces as
+        half_open (the transition happens on inspection)."""
+        if (self._state == BREAKER_OPEN and self._opened_at is not None
+                and self._clock() - self._opened_at >= self.reset_after_s):
+            self._probing = False
+            self._transition(BREAKER_HALF_OPEN)
+        return self._state
+
+    def admits(self) -> bool:
+        """May the router place NEW work here? Open: no. Half-open:
+        only the single probe (claim it with `begin_probe`)."""
+        s = self.state
+        if s == BREAKER_CLOSED:
+            return True
+        if s == BREAKER_HALF_OPEN:
+            return not self._probing
+        return False
+
+    def begin_probe(self):
+        if self.state == BREAKER_HALF_OPEN:
+            self._probing = True
+
+    def record_success(self):
+        self._consecutive = 0
+        self._probing = False
+        if self._state != BREAKER_CLOSED:
+            self._transition(BREAKER_CLOSED)
+
+    def record_failure(self):
+        self._consecutive += 1
+        self._probing = False
+        if (self.state == BREAKER_HALF_OPEN
+                or self._consecutive >= self.failure_threshold):
+            self._opened_at = self._clock()
+            self._transition(BREAKER_OPEN,
+                             consecutive_failures=self._consecutive)
+
+
+class Replica:
+    """One engine + its breaker + its observability scope."""
+
+    def __init__(self, rid: int, engine: InferenceEngine,
+                 breaker: Optional[CircuitBreaker] = None):
+        self.id = int(rid)
+        self.engine = engine
+        self.scope = f'replica:{self.id}'
+        engine.obs_scope = self.scope
+        self.breaker = breaker or CircuitBreaker(name=str(self.id))
+        self.failures = 0
+
+    def health_states(self) -> set:
+        """Active degraded states for this replica: its own scope, plus
+        process-global states (a process-wide 'resizing' grounds every
+        replica), plus watchdog hang suspicion."""
+        states = set(_obs.degraded_states(scope=self.scope))
+        states |= set(_obs.degraded_states(scope=None))
+        if _obs.hang_suspected():
+            states.add('hang_suspected')
+        return states
+
+    def outstanding_tokens(self) -> int:
+        """The placement score: decode tokens still owed to accepted
+        requests (in-flight remaining budgets + queued full budgets)."""
+        eng = self.engine
+        out = 0
+        for h in eng._slot_req.values():
+            out += max(h.params.max_new_tokens - len(h.tokens), 0)
+        for h in eng.scheduler.pending():
+            out += h.params.max_new_tokens
+        return out
+
+    def __repr__(self):
+        return (f'Replica({self.id}, breaker={self.breaker.state}, '
+                f'states={sorted(self.health_states())}, '
+                f'outstanding={self.outstanding_tokens()})')
+
+
+class ReplicaSet:
+    """N `InferenceEngine` replicas over the same model weights —
+    independent slot pools and compiled programs, one shared parameter
+    snapshot. `breaker_kwargs` feeds every replica's CircuitBreaker
+    (tests inject clocks/thresholds here)."""
+
+    def __init__(self, model, num_replicas: int = 2,
+                 breaker_kwargs: Optional[dict] = None, **engine_kwargs):
+        if num_replicas < 1:
+            raise ValueError('num_replicas must be >= 1')
+        self.replicas: List[Replica] = []
+        for i in range(int(num_replicas)):
+            eng = InferenceEngine(model, **engine_kwargs)
+            self.replicas.append(Replica(
+                i, eng, CircuitBreaker(name=str(i),
+                                       **(breaker_kwargs or {}))))
+
+    def __len__(self):
+        return len(self.replicas)
+
+    def __iter__(self):
+        return iter(self.replicas)
+
+    def __getitem__(self, i) -> Replica:
+        return self.replicas[i]
+
+
+class RouterHandle:
+    """Router-level view of one ACCEPTED request. Proxies the live
+    engine handle; survives failover (the inner handle is replaced and
+    the request re-decodes deterministically from its prompt — greedy
+    and seeded-sampling tokens are bit-identical to an undisturbed
+    run). `status` is FAILED only with a typed error attached; accepted
+    requests never dangle."""
+
+    def __init__(self, router: 'Router', prompt_tokens: List[int],
+                 params: SamplingParams, tenant: str, priority: int):
+        self.router_id = next(_router_ids)
+        self.prompt_tokens = list(prompt_tokens)
+        self.params = params
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.failovers = 0
+        self.inner: Optional[RequestHandle] = None
+        self.replica_id: Optional[int] = None
+        self._router = router
+        self._error: Optional[BaseException] = None
+        self._finalized = False
+        self._t_submit = time.perf_counter()
+        self._t_first: Optional[float] = None
+
+    @property
+    def tokens(self) -> List[int]:
+        return self.inner.tokens if self.inner is not None else []
+
+    @property
+    def status(self) -> str:
+        if self._error is not None:
+            return FAILED
+        return self.inner.status if self.inner is not None else QUEUED
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        if self._error is not None:
+            return self._error
+        return self.inner.error if self.inner is not None else None
+
+    @property
+    def done(self) -> bool:
+        return self.status in (FINISHED, FAILED)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Seconds from ROUTER submit to the first observed token
+        (failover does not reset it — the client's clock never
+        restarts)."""
+        if self._t_first is None:
+            return None
+        return self._t_first - self._t_submit
+
+    def stream(self):
+        """Per-token iterator driving the whole router (all replicas
+        advance; failover happens under the hood). After a failover the
+        re-decoded prefix is identical, so the cursor just waits for
+        the new inner handle to catch up — no token is yielded twice."""
+        cursor = 0
+        while True:
+            toks = self.tokens
+            while cursor < len(toks):
+                yield toks[cursor]
+                cursor += 1
+                toks = self.tokens
+            if self.done:
+                if self.status == FAILED:
+                    raise self.error
+                return
+            self._router.step()
+
+    def result(self) -> List[int]:
+        """Drive the router until this request finishes; returns its
+        tokens, or raises its typed error."""
+        for _ in self.stream():
+            pass
+        return self.tokens
+
+    def __repr__(self):
+        return (f'RouterHandle(id={self.router_id}, tenant={self.tenant},'
+                f' status={self.status}, replica={self.replica_id}, '
+                f'failovers={self.failovers}, tokens={len(self.tokens)})')
+
+
+class Router:
+    """Health-checked, load-aware front of a `ReplicaSet`.
+
+    Args:
+        replicas: a ReplicaSet (or a plain sequence of Replica).
+        tenants: TenantRegistry | {name: spec-dict} | CLI spec string |
+            None (everyone is the default tenant: unlimited, NORMAL).
+        max_failovers: per-request resubmission budget across replica
+            failures; past it the request FAILs with `ReplicaFailure`.
+        classify: transient/fatal judgment for failover decisions
+            (default `resilience.retry.is_transient` — walks the
+            exception chain).
+        shed_queue_depth: total queued requests (across replicas) past
+            which sheddable work is rejected (None = no depth shedding).
+        ttft_budget_s: estimated-TTFT budget; when the queue would make
+            a new request wait longer than this, sheddable work is
+            rejected (None = off; the estimate is queue/replicas *
+            observed round time, so it needs a few rounds of history).
+        shed_priority: MINIMUM priority class that may be shed
+            (default PRIORITY_LOW: only best-effort work sheds; set
+            PRIORITY_NORMAL to protect only 'high').
+        retry_after_s: the default `retry_after_s` hint when no better
+            estimate exists.
+        storm_threshold/storm_window_s: failover-storm detector — this
+            many failovers inside the window emits
+            `router_failover_storm` (a flight-recorder trigger).
+    """
+
+    def __init__(self, replicas, tenants=None, max_failovers: int = 2,
+                 classify: Optional[Callable[[BaseException], bool]] = None,
+                 shed_queue_depth: Optional[int] = None,
+                 ttft_budget_s: Optional[float] = None,
+                 shed_priority: int = PRIORITY_LOW,
+                 retry_after_s: float = 1.0,
+                 storm_threshold: int = 3, storm_window_s: float = 60.0):
+        if isinstance(replicas, ReplicaSet):
+            self.replicas = list(replicas)
+        else:
+            self.replicas = list(replicas)
+        if not self.replicas:
+            raise ValueError('router needs at least one replica')
+        self._by_id = {r.id: r for r in self.replicas}
+        if isinstance(tenants, TenantRegistry):
+            self.tenants = tenants
+        elif isinstance(tenants, str):
+            self.tenants = parse_tenant_spec(tenants)
+        elif isinstance(tenants, dict):
+            self.tenants = TenantRegistry(tenants)
+        else:
+            self.tenants = TenantRegistry()
+        self.max_failovers = int(max_failovers)
+        self.classify = classify or is_transient
+        self.shed_queue_depth = shed_queue_depth
+        self.ttft_budget_s = ttft_budget_s
+        self.shed_priority = int(shed_priority)
+        self.retry_after_s = float(retry_after_s)
+        self.storm_threshold = int(storm_threshold)
+        self.storm_window_s = float(storm_window_s)
+        self._live: List[RouterHandle] = []
+        self._rounds = 0
+        self._ema_round_s: Optional[float] = None
+        self._failover_times: collections.deque = collections.deque(
+            maxlen=max(self.storm_threshold, 8))
+        self._last_storm_t: Optional[float] = None
+        self._counts = collections.Counter()
+        self._init_metrics()
+
+    # ------------------------------------------------------------------
+    # metrics
+    # ------------------------------------------------------------------
+    def _init_metrics(self):
+        reg = _obs.get_registry()
+        self._m_requests = reg.counter(
+            'paddle_router_requests_total',
+            'router requests by tenant and outcome',
+            ('tenant', 'outcome'))
+        self._m_failovers = reg.counter(
+            'paddle_router_failovers_total',
+            'requests resubmitted after a replica failure', ('replica',))
+        self._m_shed = reg.counter(
+            'paddle_router_shed_total',
+            'admissions rejected fast, by tenant and reason',
+            ('tenant', 'reason'))
+        self._m_replicas = reg.gauge(
+            'paddle_router_replicas', 'replicas behind the router')
+        self._m_available = reg.gauge(
+            'paddle_router_available_replicas',
+            'replicas currently accepting placements')
+        self._m_queue = reg.gauge(
+            'paddle_router_queue_depth',
+            'queued requests summed across replicas')
+        self._m_outstanding = reg.gauge(
+            'paddle_router_outstanding_tokens',
+            'decode tokens owed to accepted requests, per replica',
+            ('replica',))
+        self._m_ttft = reg.histogram(
+            'paddle_router_ttft_seconds',
+            'router submit -> first token, by priority class',
+            ('priority',))
+        self._m_breaker = reg.gauge(
+            'paddle_router_breaker_state',
+            'breaker state per replica (0 closed, 1 half-open, 2 open)',
+            ('replica',))
+        if _obs.enabled():
+            self._m_replicas.set(len(self.replicas))
+            self._refresh_gauges()
+
+    def _refresh_gauges(self):
+        if not _obs.enabled():
+            return
+        avail = 0
+        depth = 0
+        for r in self.replicas:
+            if not r.health_states() and r.breaker.state != BREAKER_OPEN:
+                avail += 1
+            depth += r.engine.scheduler.queue_depth
+            self._m_outstanding.labels(replica=r.id).set(
+                r.outstanding_tokens())
+            self._m_breaker.labels(replica=r.id).set(
+                _BREAKER_GAUGE[r.breaker.state])
+        self._m_available.set(avail)
+        self._m_queue.set(depth)
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return sum(r.engine.scheduler.queue_depth for r in self.replicas)
+
+    def _estimated_ttft_s(self) -> Optional[float]:
+        """Queue wait estimate for a NEW request: rounds of queued work
+        ahead of it divided over serving replicas, times the observed
+        round time. None until a round has been timed."""
+        if self._ema_round_s is None:
+            return None
+        serving = sum(1 for r in self.replicas
+                      if not r.health_states()
+                      and r.breaker.state != BREAKER_OPEN) or 1
+        return (self.queue_depth / serving + 1) * self._ema_round_s
+
+    def _reject(self, tenant: str, reason: str,
+                retry_after: Optional[float], detail: str = ''):
+        self._counts[f'rejected_{reason}'] += 1
+        if _obs.enabled():
+            self._m_requests.labels(tenant=tenant, outcome=reason).inc()
+            self._m_shed.labels(tenant=tenant, reason=reason).inc()
+        raise AdmissionRejected(tenant, reason, retry_after, detail)
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None, **kwargs) -> RouterHandle:
+        """Admit one request for `tenant` (QoS checks first — a
+        rejection is synchronous, typed, and consumed NO model work),
+        then place it on the least-loaded healthy replica. Returns the
+        live RouterHandle; raises `AdmissionRejected` (with
+        `retry_after_s`) on rate limit / concurrency cap / load shed /
+        no healthy replica, or ValueError on malformed requests."""
+        if params is None:
+            params = SamplingParams(**kwargs)
+        elif kwargs:
+            raise TypeError('pass params= or keyword sampling args, '
+                            'not both')
+        t = self.tenants.get(tenant)
+        prio = int(priority) if priority is not None else t.priority
+
+        # 1. per-tenant token-bucket rate
+        if t.bucket is not None and not t.bucket.try_acquire():
+            self._reject(t.name, 'rate_limited', t.bucket.retry_after(),
+                         f'rate {t.bucket.rate}/s exceeded')
+        # 2. per-tenant concurrency cap
+        if (t.max_concurrency is not None
+                and t.in_flight >= t.max_concurrency):
+            est = self._estimated_ttft_s()
+            self._reject(t.name, 'concurrency',
+                         est if est is not None else self.retry_after_s,
+                         f'{t.in_flight} in flight >= cap '
+                         f'{t.max_concurrency}')
+        # 3. load shedding: overload rejects sheddable work FAST
+        if prio >= self.shed_priority:
+            est = self._estimated_ttft_s()
+            depth_over = (self.shed_queue_depth is not None
+                          and self.queue_depth >= self.shed_queue_depth)
+            ttft_over = (self.ttft_budget_s is not None
+                         and est is not None
+                         and est > self.ttft_budget_s)
+            if depth_over or ttft_over:
+                reason_bits = []
+                if depth_over:
+                    reason_bits.append(
+                        f'queue {self.queue_depth} >= '
+                        f'{self.shed_queue_depth}')
+                if ttft_over:
+                    reason_bits.append(
+                        f'est ttft {est:.3f}s > {self.ttft_budget_s}s')
+                _obs.emit('request_shed', tenant=t.name, priority=prio,
+                          queue_depth=self.queue_depth,
+                          detail='; '.join(reason_bits))
+                self._counts['shed'] += 1
+                self._reject(
+                    t.name, 'shed',
+                    est if est is not None else self.retry_after_s,
+                    '; '.join(reason_bits))
+        # 4. placement on the least-loaded healthy replica
+        replica = self._pick_replica()
+        if replica is None:
+            self._reject(t.name, 'no_healthy_replica',
+                         self.retry_after_s,
+                         'every replica is degraded or circuit-broken')
+
+        rh = RouterHandle(self, InferenceEngine._normalize_prompt(prompt),
+                          params, t.name, prio)
+        self._place(rh, replica)
+        t.in_flight += 1
+        self._live.append(rh)
+        self._counts['accepted'] += 1
+        if _obs.enabled():
+            self._m_requests.labels(tenant=t.name,
+                                    outcome='accepted').inc()
+            self._refresh_gauges()
+        return rh
+
+    def _pick_replica(self, exclude: Sequence[Replica] = ()
+                      ) -> Optional[Replica]:
+        best = None
+        for r in self.replicas:
+            if r in exclude or r.health_states() or not r.breaker.admits():
+                continue
+            score = (r.outstanding_tokens(), r.id)
+            if best is None or score < best[0]:
+                best = (score, r)
+        return best[1] if best else None
+
+    def _place(self, rh: RouterHandle, replica: Replica):
+        if replica.breaker.state == BREAKER_HALF_OPEN:
+            replica.breaker.begin_probe()   # this request IS the probe
+        rh.inner = replica.engine.submit(rh.prompt_tokens, rh.params,
+                                         priority=rh.priority)
+        rh.replica_id = replica.id
+
+    # ------------------------------------------------------------------
+    # the iteration loop
+    # ------------------------------------------------------------------
+    def step(self) -> int:
+        """ONE fleet iteration: advance every replica that has work
+        (degraded replicas still DRIVE their in-flight requests — they
+        just receive no new placements), fail over anything a dying
+        replica drops, retire finished requests. Returns the number of
+        requests that progressed."""
+        progressed = 0
+        t0 = time.perf_counter()
+        stepped = False
+        for r in list(self.replicas):
+            if not r.engine.has_work:
+                continue
+            try:
+                progressed += r.engine.step()
+                stepped = True
+            except BaseException as exc:
+                self._on_replica_failure(r, exc)
+        if stepped:
+            dt = time.perf_counter() - t0
+            self._ema_round_s = (dt if self._ema_round_s is None
+                                 else 0.8 * self._ema_round_s + 0.2 * dt)
+        self._reap()
+        self._rounds += 1
+        # gauges are monitoring, not control flow: refreshing every 8th
+        # round keeps the per-round router cost out of the decode path
+        # (submit/finalize still refresh immediately where it matters)
+        if _obs.enabled() and (self._rounds % 8 == 0 or not self._live):
+            self._refresh_gauges()
+        return progressed
+
+    def run(self) -> int:
+        """Drive until every accepted request is FINISHED or FAILED;
+        returns the number of router iterations."""
+        rounds = 0
+        while self._live:
+            progressed = self.step()
+            rounds += 1
+            if (not progressed and self._live
+                    and not any(r.engine.has_work for r in self.replicas)):
+                # defensive: a handle with no engine work behind it is a
+                # router bug — fail it typed rather than spin forever
+                for rh in self._live:
+                    rh._error = ReplicaFailure(
+                        rh.replica_id if rh.replica_id is not None else -1,
+                        'request stranded with no engine work (router '
+                        'invariant violated)')
+                self._reap()
+                break
+        return rounds
+
+    def _reap(self):
+        now = time.perf_counter()
+        still: List[RouterHandle] = []
+        for rh in self._live:
+            if (rh._t_first is None and rh.inner is not None
+                    and rh.inner.tokens):
+                rh._t_first = now
+            replica = self._by_id.get(rh.replica_id)
+            if rh._error is not None:
+                self._finalize(rh, 'failed')
+            elif rh.inner is not None and rh.inner.status == FINISHED:
+                if replica is not None:
+                    replica.breaker.record_success()
+                self._finalize(rh, 'completed')
+                if _obs.enabled() and rh.ttft is not None:
+                    self._m_ttft.labels(priority=rh.priority).observe(
+                        rh.ttft)
+            elif rh.inner is not None and rh.inner.status == FAILED:
+                # request-level failure (engine already classified and
+                # retried transients; this is final) — typed, not lost
+                rh._error = rh.inner.error
+                if (replica is not None
+                        and replica.breaker.state == BREAKER_HALF_OPEN):
+                    replica.breaker.record_failure()   # failed probe
+                self._finalize(rh, 'failed')
+            else:
+                still.append(rh)
+        self._live = still
+
+    def _finalize(self, rh: RouterHandle, outcome: str):
+        if rh._finalized:
+            return
+        rh._finalized = True
+        self.tenants.get(rh.tenant).in_flight -= 1
+        self._counts[outcome] += 1
+        if _obs.enabled():
+            self._m_requests.labels(tenant=rh.tenant,
+                                    outcome=outcome).inc()
+
+    # ------------------------------------------------------------------
+    # failover
+    # ------------------------------------------------------------------
+    def _on_replica_failure(self, replica: Replica, exc: BaseException):
+        """A replica failed mid-step: open-circuit accounting, evict its
+        accepted requests, resubmit the ones the classifier deems
+        recoverable (bounded per request), fail the rest typed."""
+        replica.failures += 1
+        replica.breaker.record_failure()
+        orphans = replica.engine.evict_all()
+        by_inner = {id(rh.inner): rh for rh in self._live
+                    if rh.inner is not None}
+        _obs.emit('router_failover', replica=replica.id,
+                  error=type(exc).__name__, orphans=len(orphans))
+        self._note_failover_storm()
+        if _obs.enabled():
+            self._m_failovers.labels(replica=replica.id).inc(
+                len(orphans) or 1)
+        transient = self.classify(self._wrap(replica, exc))
+        for h in orphans:
+            rh = by_inner.get(id(h))
+            if rh is None:
+                continue   # an engine-level handle the router never saw
+            err = self._wrap(replica, exc)
+            if not transient or rh.failovers >= self.max_failovers:
+                rh._error = err
+                continue
+            target = self._pick_replica(exclude=(replica,))
+            if target is None:
+                rh._error = ReplicaFailure(
+                    replica.id,
+                    f'replica {replica.id} failed and no healthy '
+                    f'replica remains for failover')
+                rh._error.__cause__ = exc
+                continue
+            rh.failovers += 1
+            try:
+                self._place(rh, target)
+            except BaseException as place_exc:
+                rh._error = ReplicaFailure(
+                    target.id,
+                    f'failover resubmission to replica {target.id} '
+                    f'failed: {place_exc}')
+                rh._error.__cause__ = place_exc
+
+    @staticmethod
+    def _wrap(replica: Replica, exc: BaseException) -> ReplicaFailure:
+        err = ReplicaFailure(
+            replica.id,
+            f'replica {replica.id} failed mid-flight: '
+            f'{type(exc).__name__}: {exc}')
+        err.__cause__ = exc   # the classifier walks this chain
+        return err
+
+    def _note_failover_storm(self):
+        now = time.monotonic()
+        self._failover_times.append(now)
+        if len(self._failover_times) < self.storm_threshold:
+            return
+        window = now - self._failover_times[-self.storm_threshold]
+        if window > self.storm_window_s:
+            return
+        if (self._last_storm_t is not None
+                and now - self._last_storm_t < self.storm_window_s):
+            return   # one storm event per window
+        self._last_storm_t = now
+        _obs.emit('router_failover_storm',
+                  failovers=len(self._failover_times),
+                  window_s=round(window, 3))
+
+    # ------------------------------------------------------------------
+    # operations
+    # ------------------------------------------------------------------
+    def drain_replica(self, rid: int):
+        """Take replica `rid` out of rotation NOW (runbook: rolling
+        restart / eviction). Its scoped `draining` state excludes it
+        from placement immediately; router steps keep driving its
+        accepted requests to completion. Returns the replica."""
+        r = self._by_id[rid]
+        r.engine.begin_drain()
+        return r
+
+    def generate_many(self, prompts, params=None, tenant=None,
+                      priority=None) -> List[RouterHandle]:
+        """Submit a batch and drive the fleet dry (the router analogue
+        of `InferenceEngine.generate_many`)."""
+        if params is None or isinstance(params, SamplingParams):
+            params = [params or SamplingParams()] * len(prompts)
+        if len(params) != len(prompts):
+            raise ValueError('one SamplingParams per prompt')
+        handles = [self.submit(p, sp, tenant=tenant, priority=priority)
+                   for p, sp in zip(prompts, params)]
+        self.run()
+        return handles
+
+    def stats(self) -> dict:
+        """Router-level counters + a per-replica health/load snapshot
+        (the chaos tests' 'none dangle' assertions read this)."""
+        per_replica = []
+        for r in self.replicas:
+            per_replica.append({
+                'id': r.id,
+                'breaker': r.breaker.state,
+                'health_states': sorted(r.health_states()),
+                'outstanding_tokens': r.outstanding_tokens(),
+                'queued': r.engine.scheduler.queue_depth,
+                'active_slots': r.engine.pool.used_count,
+                'failures': r.failures,
+            })
+        return {
+            'accepted': self._counts['accepted'],
+            'completed': self._counts['completed'],
+            'failed': self._counts['failed'],
+            'shed': self._counts['shed'],
+            'rejected': {k[len('rejected_'):]: v
+                         for k, v in self._counts.items()
+                         if k.startswith('rejected_')},
+            'in_flight': len(self._live),
+            'queue_depth': self.queue_depth,
+            'replicas': per_replica,
+            'tenants': {name: {'in_flight': t.in_flight, **t.spec()}
+                        for name, t in self.tenants.tenants().items()},
+        }

@@ -1,38 +1,56 @@
-"""Iteration-level FCFS scheduler (Orca-style continuous batching).
+"""Iteration-level scheduler (Orca-style continuous batching) with
+priority classes.
 
 The engine calls `admissible()` between decode steps; the scheduler
-hands back the queue head(s) that fit the currently free slots, under a
-per-iteration prefill token budget so a burst of long prompts cannot
-starve the decode of already-running requests (the prefill/decode
-interleave knob). Admission is strictly FCFS — the head request is never
-overtaken by a shorter one behind it (no starvation), and the FIRST
-admission of an iteration ignores the budget so a single over-budget
-prompt still makes progress.
+hands back the queued request(s) that fit the currently free slots,
+under a per-iteration prefill token budget so a burst of long prompts
+cannot starve the decode of already-running requests (the
+prefill/decode interleave knob).
+
+Admission order is a STABLE priority key: (priority class, then FCFS
+within class). With a single priority class — the default, every
+handle is PRIORITY_NORMAL — this degenerates to exactly the original
+FCFS policy: the head request is never overtaken by a shorter one
+behind it, and the FIRST admission of an iteration ignores the budget
+so a single over-budget prompt still makes progress. The router's
+tenancy layer maps tenants onto classes so paid traffic overtakes
+best-effort traffic at the queue, not mid-decode.
+
+Starvation guard: a request that has waited longer than `max_wait_s`
+is promoted ONE class (once), so an overload of high-priority work can
+delay low-priority requests but never park them forever.
 
 Queue depth is exported as `paddle_serving_queue_depth` on every
 mutation, so the gauge is live even between scrapes.
 """
 from __future__ import annotations
 
-import collections
-from typing import Callable, Deque, List, Optional
+import time
+from typing import Callable, List, Optional
 
 from .. import observability as _obs
-from .api import RequestHandle
+from .api import PRIORITY_NORMAL, RequestHandle
 
 
 class FCFSScheduler:
-    """FCFS request queue + iteration-level admission policy.
+    """Priority + FCFS request queue and iteration-level admission.
 
     `max_prefill_tokens` caps the summed BUCKETED prompt lengths admitted
     in one scheduling iteration (0/None = unbounded). Bucketed — not raw
     — lengths, because the bucket is what the prefill actually computes.
+
+    `max_wait_s` arms the starvation guard (None = off): a request older
+    than this is promoted one priority class, once, and counted in
+    `promotions`.
     """
 
-    def __init__(self, max_prefill_tokens: Optional[int] = None):
+    def __init__(self, max_prefill_tokens: Optional[int] = None,
+                 max_wait_s: Optional[float] = None):
         self.max_prefill_tokens = (int(max_prefill_tokens)
                                    if max_prefill_tokens else 0)
-        self._queue: Deque[RequestHandle] = collections.deque()
+        self.max_wait_s = (float(max_wait_s) if max_wait_s else None)
+        self.promotions = 0
+        self._queue: List[RequestHandle] = []
         self._gauge = None
         if _obs.enabled():
             self._gauge = _obs.get_registry().gauge(
@@ -47,6 +65,10 @@ class FCFSScheduler:
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    def pending(self) -> List[RequestHandle]:
+        """Snapshot of the queued handles (router introspection)."""
+        return list(self._queue)
 
     def submit(self, handle: RequestHandle):
         self._queue.append(handle)
@@ -70,19 +92,46 @@ class FCFSScheduler:
         self._note_depth()
         return out
 
+    def _effective_priority(self, handle: RequestHandle,
+                            now: float) -> int:
+        p = int(getattr(handle, 'priority', PRIORITY_NORMAL))
+        if (self.max_wait_s is not None and p > 0
+                and now - handle._t_submit > self.max_wait_s):
+            if not getattr(handle, '_promoted', False):
+                handle._promoted = True
+                self.promotions += 1
+                _obs.emit('request_promoted',
+                          request_id=handle.request_id,
+                          from_priority=p, to_priority=p - 1,
+                          waited_s=round(now - handle._t_submit, 3))
+            p -= 1
+        return p
+
     def admissible(self, free_slots: int,
                    bucket_for: Callable[[int], int]) -> List[RequestHandle]:
-        """Pop the FCFS prefix that fits `free_slots` and the prefill
-        token budget this iteration."""
+        """Pop the admission-order prefix that fits `free_slots` and the
+        prefill token budget this iteration. Order = stable sort by
+        (effective priority, submit order); the prefix rule is the same
+        as FCFS — once the next-in-order request doesn't fit the budget,
+        nothing behind it is considered (no overtaking)."""
+        if not self._queue or free_slots <= 0:
+            return []
+        now = time.perf_counter()
+        # python's sort is stable: within a class, list order == FCFS
+        order = sorted(self._queue,
+                       key=lambda h: self._effective_priority(h, now))
         admitted: List[RequestHandle] = []
         budget = self.max_prefill_tokens
-        while self._queue and free_slots > 0:
-            cost = bucket_for(len(self._queue[0].prompt_tokens))
+        for h in order:
+            if len(admitted) >= free_slots:
+                break
+            cost = bucket_for(len(h.prompt_tokens))
             if admitted and self.max_prefill_tokens and cost > budget:
-                break   # budget spent; head waits for the next iteration
-            admitted.append(self._queue.popleft())
-            free_slots -= 1
+                break   # budget spent; the head waits, nothing overtakes
+            admitted.append(h)
             budget -= cost
+        for h in admitted:
+            self._queue.remove(h)
         if admitted:
             self._note_depth()
         return admitted

@@ -61,6 +61,19 @@ def test_serve_gpt_example_serves_all_requests(capsys):
 
 
 @pytest.mark.slow
+def test_serve_gpt_example_routed_replicas_and_tenants(capsys):
+    mod = runpy.run_path(f'{EX}/serve_gpt.py')
+    handles = mod['main'](
+        num_requests=8, replicas=2,
+        tenants='paid:priority=high;free:priority=low,concurrency=2')
+    # accepted requests all finish; rejected ones never produced handles
+    assert handles and all(h.status == 'FINISHED' for h in handles)
+    assert all(h.tokens for h in handles)
+    out = capsys.readouterr().out
+    assert 'router:' in out and 'replica 0: breaker' in out
+
+
+@pytest.mark.slow
 def test_speculative_decode_example_accepts_drafts():
     mod = runpy.run_path(f'{EX}/speculative_decode.py')
     stats = mod['main'](distill_steps=150)

@@ -60,6 +60,116 @@ class TestClassifier:
         assert res.is_transient(StorageThrottled('slow down'))
 
 
+class TestClassifierChainWalk:
+    """ISSUE-7 satellite: the classifier walks `__cause__`/`__context__`
+    so a transient PjRt error wrapped in a framework exception — exactly
+    what the router's resubmission path produces — still classifies
+    transient, while fatal causes poison the whole chain."""
+
+    @staticmethod
+    def _wrap(outer, inner):
+        """outer raised `from` inner (explicit __cause__ chain)."""
+        try:
+            try:
+                raise inner
+            except BaseException as e:
+                raise outer from e
+        except BaseException as got:
+            return got
+
+    def test_transient_cause_under_framework_wrapper(self):
+        exc = self._wrap(RuntimeError('replica 0 failed mid-flight'),
+                         res.TransientError('UNAVAILABLE: device lost'))
+        assert res.is_transient(exc)
+
+    def test_transient_by_message_in_cause(self):
+        exc = self._wrap(RuntimeError('router resubmission failed'),
+                         RuntimeError('DEADLINE_EXCEEDED: rpc timeout'))
+        assert res.is_transient(exc)
+
+    def test_double_nesting(self):
+        inner = self._wrap(RuntimeError('engine step failed'),
+                           ConnectionResetError('peer gone'))
+        exc = self._wrap(RuntimeError('replica failure'), inner)
+        assert res.is_transient(exc)
+
+    def test_fatal_cause_poisons_the_chain(self):
+        exc = self._wrap(RuntimeError('UNAVAILABLE-looking wrapper'),
+                         res.FatalError('corrupt checkpoint'))
+        assert not res.is_transient(exc)
+
+    def test_fatal_outer_wins_over_transient_cause(self):
+        exc = self._wrap(res.FatalError('do not retry'),
+                         res.TransientError('blip'))
+        assert not res.is_transient(exc)
+
+    def test_programming_error_cause_stays_fatal(self):
+        exc = self._wrap(RuntimeError('step crashed'),
+                         ValueError('rank mismatch'))
+        assert not res.is_transient(exc)
+
+    def test_implicit_context_is_walked(self):
+        # an error raised WHILE HANDLING a transient (no `from`): the
+        # implicit __context__ still carries the transient evidence
+        try:
+            try:
+                raise res.TransientError('UNAVAILABLE')
+            except res.TransientError:
+                raise RuntimeError('cleanup failed')
+        except RuntimeError as got:
+            exc = got
+        assert exc.__cause__ is None and exc.__context__ is not None
+        assert res.is_transient(exc)
+
+    def test_suppressed_context_is_not_walked(self):
+        try:
+            try:
+                raise res.TransientError('UNAVAILABLE')
+            except res.TransientError:
+                raise RuntimeError('opaque failure') from None
+        except RuntimeError as got:
+            exc = got
+        assert exc.__suppress_context__
+        assert not res.is_transient(exc)
+
+    def test_chain_cycle_is_safe(self):
+        a = RuntimeError('a')
+        b = RuntimeError('b: UNAVAILABLE')
+        a.__cause__, b.__cause__ = b, a          # pathological cycle
+        assert res.is_transient(a)               # terminates, finds b
+        c = RuntimeError('c')
+        d = RuntimeError('d')
+        c.__cause__, d.__cause__ = d, c
+        assert not res.is_transient(c)           # terminates, finds none
+
+    def test_chain_depth_is_bounded(self):
+        from paddle_tpu.resilience.retry import _CHAIN_LIMIT
+        exc = res.TransientError('root blip')
+        for i in range(_CHAIN_LIMIT + 5):
+            exc = self._wrap(RuntimeError(f'layer {i}'), exc)
+        assert len(list(res.exception_chain(exc))) == _CHAIN_LIMIT
+        # the transient root is beyond the cap: classified fatal — the
+        # bound is a safety valve, not a correctness promise at depth 20
+        assert not res.is_transient(exc)
+
+    def test_call_with_retry_retries_wrapped_transient(self):
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                try:
+                    raise res.TransientError('UNAVAILABLE: blip')
+                except res.TransientError as e:
+                    raise RuntimeError('framework wrapper') from e
+            return 'ok'
+
+        policy = res.RetryPolicy(max_retries=5, base_delay=0.0,
+                                 sleep=lambda d: None)
+        assert res.call_with_retry(flaky, policy=policy) == 'ok'
+        assert calls[0] == 3
+
+
 class TestRetry:
     def _policy(self, **kw):
         kw.setdefault('base_delay', 0.0)

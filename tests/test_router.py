@@ -1,0 +1,722 @@
+"""Replicated serving: router placement/failover, per-tenant QoS
+admission, load shedding, circuit breakers, the priority scheduler, and
+the ref-counted degraded-state health machinery (ISSUE-7).
+
+The chaos gauntlet at the center: kill a replica mid-decode, drain one
+while the other serves, shed under synthetic overload, cycle a breaker
+open -> half-open -> closed — each scenario asserting the invariant
+"every ACCEPTED request finishes or FAILs with a typed error, none
+dangle", plus the event/metric counters that make the incident
+observable from the outside.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import debug, observability as obs
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.resilience import FatalError, TransientError
+from paddle_tpu.serving import (FAILED, FINISHED, PRIORITY_HIGH,
+                                PRIORITY_LOW, PRIORITY_NORMAL,
+                                AdmissionRejected, CircuitBreaker,
+                                FCFSScheduler, ReplicaFailure, ReplicaSet,
+                                RequestHandle, Router, SamplingParams,
+                                Tenant, TenantRegistry, TokenBucket,
+                                parse_tenant_spec)
+from paddle_tpu.serving.router import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                       BREAKER_OPEN)
+
+from fault_injection import FaultInjector
+
+NO_EOS = -1
+
+
+@pytest.fixture(scope='module')
+def gpt():
+    paddle.seed(7)
+    return GPTForCausalLM(GPTConfig.tiny()).eval()
+
+
+def _prompts(lens, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, (s,)).tolist() for s in lens]
+
+
+def _ref_generate(model, prompt, max_new):
+    out, _ = model.generate(
+        paddle.to_tensor(np.array([prompt])), max_new_tokens=max_new,
+        decode_strategy='greedy_search', eos_token_id=NO_EOS)
+    return out.numpy()[0].tolist()
+
+
+def _sp(n=6):
+    return SamplingParams(max_new_tokens=n, eos_token_id=NO_EOS)
+
+
+def _router(gpt, n=2, **kw):
+    kw.setdefault('num_slots', 2)
+    kw.setdefault('max_length', 64)
+    kw.setdefault('decode_block', 2)
+    breaker_kwargs = kw.pop('breaker_kwargs', None)
+    router_kw = {k: kw.pop(k) for k in list(kw)
+                 if k in ('tenants', 'max_failovers', 'shed_queue_depth',
+                          'ttft_budget_s', 'shed_priority',
+                          'storm_threshold', 'storm_window_s')}
+    return Router(ReplicaSet(gpt, n, breaker_kwargs=breaker_kwargs, **kw),
+                  **router_kw)
+
+
+def _assert_none_dangle(handles):
+    """The chaos invariant: every accepted request FINISHED or FAILED
+    with a typed error attached — nothing QUEUED/RUNNING, nothing
+    errorless-failed."""
+    for h in handles:
+        assert h.done, f'request dangles: {h!r}'
+        if h.status == FAILED:
+            assert h.error is not None, f'untyped failure: {h!r}'
+
+
+# ---------------------------------------------------------------------------
+# tenancy primitives
+# ---------------------------------------------------------------------------
+
+class TestTenancy:
+    def test_token_bucket_rate_and_retry_after(self):
+        t = [0.0]
+        b = TokenBucket(rate=2.0, burst=2.0, clock=lambda: t[0])
+        assert b.try_acquire() and b.try_acquire()
+        assert not b.try_acquire()            # burst spent
+        assert b.retry_after() == pytest.approx(0.5)
+        t[0] += 0.5                           # one token refilled
+        assert b.try_acquire()
+        assert not b.try_acquire()
+        t[0] += 10.0                          # refills cap at burst
+        assert b.tokens == pytest.approx(2.0)
+
+    def test_tenant_spec_parsing_round_trip(self):
+        reg = parse_tenant_spec(
+            'paid:priority=high,rate=50,burst=100;'
+            'free:priority=low,rate=2,concurrency=2;bare')
+        paid, free = reg.get('paid'), reg.get('free')
+        assert paid.priority == PRIORITY_HIGH
+        assert paid.bucket.rate == 50 and paid.bucket.capacity == 100
+        assert paid.max_concurrency is None
+        assert free.priority == PRIORITY_LOW
+        assert free.max_concurrency == 2
+        assert reg.get('bare').priority == PRIORITY_NORMAL
+        # unknown tenants get their OWN default-template tenant
+        other = reg.get('newcomer')
+        assert other.name == 'newcomer' and other.priority == PRIORITY_NORMAL
+        with pytest.raises(ValueError):
+            parse_tenant_spec('x:bogus_key=1')
+        with pytest.raises(ValueError):
+            parse_tenant_spec('x:priority=platinum')
+        with pytest.raises(ValueError):
+            Tenant('x', rate=0)
+
+    def test_registry_default_template(self):
+        reg = TenantRegistry(default={'priority': 'low', 'rate': 1.0})
+        a, b = reg.get('a'), reg.get('b')
+        assert a.priority == PRIORITY_LOW and a.bucket is not None
+        assert a is reg.get('a') and a is not b   # separate accounting
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_full_cycle_closed_open_half_open_closed(self):
+        t = [0.0]
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        b = CircuitBreaker(name='9', failure_threshold=2,
+                           reset_after_s=10.0, clock=lambda: t[0])
+        assert b.state == BREAKER_CLOSED and b.admits()
+        b.record_failure()
+        assert b.state == BREAKER_CLOSED      # 1 < threshold
+        b.record_failure()
+        assert b.state == BREAKER_OPEN and not b.admits()
+        t[0] += 9.0
+        assert not b.admits()                 # cooldown not elapsed
+        t[0] += 1.5
+        assert b.state == BREAKER_HALF_OPEN
+        assert b.admits()
+        b.begin_probe()
+        assert not b.admits()                 # ONE probe at a time
+        b.record_success()
+        assert b.state == BREAKER_CLOSED and b.admits()
+        names = [e['name'] for e in log.events()[ev0:]]
+        assert 'breaker_open' in names
+        assert 'breaker_half_open' in names
+        assert 'breaker_closed' in names
+
+    def test_half_open_failure_reopens(self):
+        t = [0.0]
+        b = CircuitBreaker(name='8', failure_threshold=1,
+                           reset_after_s=5.0, clock=lambda: t[0])
+        b.record_failure()
+        assert b.state == BREAKER_OPEN
+        t[0] += 5.0
+        assert b.state == BREAKER_HALF_OPEN
+        b.begin_probe()
+        b.record_failure()                    # probe failed
+        assert b.state == BREAKER_OPEN
+        # success resets consecutive failures in closed state too
+        t[0] += 5.0
+        b.record_success()
+        assert b.state == BREAKER_CLOSED
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_two_replica_greedy_parity_and_spread(self, gpt):
+        router = _router(gpt, 2)
+        prompts = _prompts([3, 9, 5, 14, 7, 11], seed=1)
+        news = [6, 9, 4, 12, 8, 5]
+        hs = router.generate_many(
+            prompts, [_sp(n) for n in news])
+        for h, p, n in zip(hs, prompts, news):
+            assert h.status == FINISHED
+            assert h.tokens == _ref_generate(gpt, p, n)
+        # least-loaded placement used both replicas
+        assert len({h.replica_id for h in hs}) == 2
+        st = router.stats()
+        assert st['completed'] == 6 and st['failed'] == 0
+
+    def test_least_outstanding_tokens_scoring(self, gpt):
+        router = _router(gpt, 2, num_slots=4)
+        h1 = router.submit(_prompts([4], seed=2)[0],
+                           _sp(30))            # heavy -> replica 0
+        h2 = router.submit(_prompts([4], seed=3)[0],
+                           _sp(2))             # light -> replica 1
+        h3 = router.submit(_prompts([4], seed=4)[0],
+                           _sp(2))             # replica 1 again (2 < 30)
+        assert h1.replica_id == 0
+        assert h2.replica_id == 1
+        assert h3.replica_id == 1
+        router.run()
+        _assert_none_dangle([h1, h2, h3])
+
+    def test_no_healthy_replica_is_fast_typed_rejection(self, gpt):
+        router = _router(gpt, 1)
+        try:
+            router.drain_replica(0)
+            with pytest.raises(AdmissionRejected) as ei:
+                router.submit(_prompts([4], seed=5)[0], _sp())
+            assert ei.value.reason == 'no_healthy_replica'
+            assert ei.value.retry_after_s is not None
+        finally:
+            obs.clear_degraded('draining', scope='replica:0', force=True)
+
+
+# ---------------------------------------------------------------------------
+# chaos gauntlet
+# ---------------------------------------------------------------------------
+
+class TestChaosGauntlet:
+    def test_replica_killed_mid_decode_fails_over_bit_identical(self, gpt):
+        """The headline guarantee: a replica dies mid-decode (transient
+        device loss), its accepted requests fail over and their greedy
+        outputs are BIT-IDENTICAL to a single-replica run. Zero lost."""
+        router = _router(gpt, 2)
+        reg = obs.get_registry()
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+
+        def failovers_total():
+            fam = reg.get('paddle_router_failovers_total')
+            return sum(c.value for c in fam._children.values()) \
+                if fam else 0
+
+        before_fo = failovers_total()
+        prompts = _prompts([3, 9, 5, 14], seed=6)
+        inj = FaultInjector(nth=2, exc=TransientError(
+            'UNAVAILABLE: injected mid-decode device loss'))
+        with inj.patch(router._by_id[0].engine, 'step'):
+            hs = [router.submit(p, _sp(8)) for p in prompts]
+            router.run()
+        assert inj.fired == 1
+        _assert_none_dangle(hs)
+        for h, p in zip(hs, prompts):
+            assert h.status == FINISHED
+            assert h.tokens == _ref_generate(gpt, p, 8), \
+                f'failed-over request {h.router_id} diverged'
+        assert sum(h.failovers for h in hs) >= 1
+        st = router.stats()
+        assert st['completed'] == 4 and st['failed'] == 0
+        names = [e['name'] for e in log.events()[ev0:]]
+        assert 'router_failover' in names
+        assert failovers_total() > before_fo
+        # the fleet keeps serving afterwards
+        h = router.submit(prompts[0], _sp(4))
+        router.run()
+        assert h.tokens == _ref_generate(gpt, prompts[0], 4)
+
+    def test_fatal_replica_failure_fails_typed_not_failed_over(self, gpt):
+        """A FATAL root cause must not be resubmitted: the classifier
+        walks the ReplicaFailure chain, sees FatalError, and the
+        orphans FAIL with the typed wrapper instead of dangling."""
+        router = _router(gpt, 2)
+        prompts = _prompts([3, 4], seed=7)
+        inj = FaultInjector(nth=1, exc=FatalError('real assert blew up'))
+        with inj.patch(router._by_id[0].engine, 'step'):
+            hs = [router.submit(p, _sp(4)) for p in prompts]
+            victims = [h for h in hs if h.replica_id == 0]
+            survivors = [h for h in hs if h.replica_id == 1]
+            assert victims and survivors   # load spread both ways
+            router.run()
+        _assert_none_dangle(hs)
+        for h in victims:
+            assert h.status == FAILED
+            assert isinstance(h.error, ReplicaFailure)
+            assert isinstance(h.error.__cause__, FatalError)
+            assert h.failovers == 0
+            with pytest.raises(ReplicaFailure):
+                h.result()
+        for h in survivors:
+            assert h.status == FINISHED
+
+    def test_failover_budget_exhaustion_is_typed(self, gpt):
+        """Every replica keeps dying: after max_failovers resubmissions
+        the request FAILS with ReplicaFailure — bounded attempts, no
+        infinite bounce, nothing silent."""
+        router = _router(gpt, 2, max_failovers=1,
+                         breaker_kwargs={'failure_threshold': 99})
+        boom = TransientError('UNAVAILABLE: flapping')
+        injs = [FaultInjector(nth=1, exc=boom, repeat=99),
+                FaultInjector(nth=1, exc=boom, repeat=99)]
+        with injs[0].patch(router._by_id[0].engine, 'step'), \
+                injs[1].patch(router._by_id[1].engine, 'step'):
+            h = router.submit(_prompts([4], seed=8)[0], _sp(4))
+            router.run()
+        _assert_none_dangle([h])
+        assert h.status == FAILED
+        assert isinstance(h.error, ReplicaFailure)
+        assert h.failovers == 1               # budget spent, then typed
+
+    def test_drain_one_replica_while_the_other_serves(self, gpt):
+        """Runbook scenario: drain replica 0 with work in flight. Its
+        accepted requests still finish (router steps keep driving it),
+        new placements all land on replica 1, and the drained replica's
+        scoped 503 is visible in /healthz."""
+        router = _router(gpt, 2)
+        try:
+            a = router.submit(_prompts([3], seed=9)[0], _sp(6))
+            b = router.submit(_prompts([5], seed=10)[0], _sp(6))
+            assert {a.replica_id, b.replica_id} == {0, 1}
+            router.step()
+            router.drain_replica(0)
+            health = obs.health()
+            assert 'draining' in health['states']
+            assert 'replica:0/draining' in health['degraded']
+            # new traffic only lands on the survivor
+            cs = [router.submit(p, _sp(4))
+                  for p in _prompts([4, 6, 3], seed=11)]
+            assert all(c.replica_id == 1 for c in cs)
+            router.run()
+            _assert_none_dangle([a, b] + cs)
+            assert a.status == FINISHED and b.status == FINISHED
+            assert a.tokens == _ref_generate(gpt, a.prompt_tokens, 6)
+        finally:
+            obs.clear_degraded('draining', scope='replica:0', force=True)
+
+    def test_breaker_opens_excludes_then_half_open_probe_recovers(
+            self, gpt):
+        """Breaker lifecycle on a real replica: repeated death opens the
+        breaker (placement skips it), the cooldown elapses, the next
+        submit is the half-open probe, its completion closes the
+        breaker and the replica rejoins the pool."""
+        t = [0.0]
+        router = _router(
+            gpt, 2, breaker_kwargs={'failure_threshold': 1,
+                                    'reset_after_s': 30.0,
+                                    'clock': lambda: t[0]})
+        inj = FaultInjector(nth=1, exc=TransientError(
+            'UNAVAILABLE: sick replica'), repeat=1)
+        with inj.patch(router._by_id[0].engine, 'step'):
+            hs = [router.submit(p, _sp(4))
+                  for p in _prompts([3, 5], seed=12)]
+            router.run()
+        _assert_none_dangle(hs)
+        assert all(h.status == FINISHED for h in hs)
+        assert router._by_id[0].breaker.state == BREAKER_OPEN
+        # while open: every placement goes to replica 1
+        hs2 = [router.submit(p, _sp(2))
+               for p in _prompts([4, 4, 4], seed=13)]
+        assert all(h.replica_id == 1 for h in hs2)
+        router.run()
+        # cooldown elapses -> half-open -> the next submit probes 0
+        t[0] += 31.0
+        assert router._by_id[0].breaker.state == BREAKER_HALF_OPEN
+        probe = router.submit(_prompts([4], seed=14)[0], _sp(2))
+        assert probe.replica_id == 0
+        # the single-probe rule: the NEXT placement avoids replica 0
+        other = router.submit(_prompts([4], seed=15)[0], _sp(2))
+        assert other.replica_id == 1
+        router.run()
+        assert probe.status == FINISHED
+        assert router._by_id[0].breaker.state == BREAKER_CLOSED
+        back = router.submit(_prompts([4], seed=16)[0], _sp(2))
+        assert back.replica_id == 0           # rejoined the pool
+        router.run()
+        _assert_none_dangle(hs2 + [probe, other, back])
+
+    def test_failover_storm_emits_flight_trigger_event(self, gpt):
+        """Two replica failures inside the storm window emit
+        `router_failover_storm` — which is a flight-recorder trigger,
+        so the storm ships its own postmortem bundle."""
+        from paddle_tpu.observability.flight import TRIGGER_EVENTS
+        assert 'router_failover_storm' in TRIGGER_EVENTS
+        router = _router(gpt, 2, storm_threshold=2, storm_window_s=60.0,
+                         max_failovers=4,
+                         breaker_kwargs={'failure_threshold': 99})
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        boom = TransientError('UNAVAILABLE: storm')
+        inj0 = FaultInjector(nth=2, exc=boom)    # r0 dies mid-decode...
+        inj1 = FaultInjector(nth=4, exc=boom)    # ...then r1 dies too
+        with inj0.patch(router._by_id[0].engine, 'step'), \
+                inj1.patch(router._by_id[1].engine, 'step'):
+            hs = [router.submit(p, _sp(10))
+                  for p in _prompts([3, 5, 4, 6], seed=17)]
+            router.run()
+        names = [e['name'] for e in log.events()[ev0:]]
+        assert names.count('router_failover') >= 2
+        assert 'router_failover_storm' in names
+        _assert_none_dangle(hs)
+
+
+# ---------------------------------------------------------------------------
+# QoS admission + load shedding
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_rate_limit_fast_fail_with_retry_after(self, gpt):
+        t = [0.0]
+        tenants = TenantRegistry(
+            {'metered': {'rate': 1.0, 'burst': 2.0, 'priority': 'normal'}},
+            clock=lambda: t[0])
+        router = Router(ReplicaSet(gpt, 1, num_slots=2, max_length=64,
+                                   decode_block=2), tenants=tenants)
+        p = _prompts([4], seed=20)[0]
+        h1 = router.submit(p, _sp(2), tenant='metered')
+        h2 = router.submit(p, _sp(2), tenant='metered')
+        with pytest.raises(AdmissionRejected) as ei:
+            router.submit(p, _sp(2), tenant='metered')
+        assert ei.value.reason == 'rate_limited'
+        assert ei.value.retry_after_s == pytest.approx(1.0)
+        t[0] += 1.0                           # bucket refills one token
+        h3 = router.submit(p, _sp(2), tenant='metered')
+        router.run()
+        _assert_none_dangle([h1, h2, h3])
+        assert router.stats()['rejected'] == {'rate_limited': 1}
+
+    def test_concurrency_cap_releases_on_completion(self, gpt):
+        router = _router(
+            gpt, 1, num_slots=2,
+            tenants={'capped': {'max_concurrency': 1}})
+        p = _prompts([4], seed=21)[0]
+        h1 = router.submit(p, _sp(2), tenant='capped')
+        with pytest.raises(AdmissionRejected) as ei:
+            router.submit(p, _sp(2), tenant='capped')
+        assert ei.value.reason == 'concurrency'
+        router.run()
+        assert h1.status == FINISHED
+        h2 = router.submit(p, _sp(2), tenant='capped')   # slot released
+        router.run()
+        assert h2.status == FINISHED
+
+    def test_load_shed_is_fast_typed_and_consumes_no_prefill(self, gpt):
+        """Overload: sheddable (low-priority) work rejects synchronously
+        with retry_after, WITHOUT touching the engines — no prefill, no
+        queue entry. Protected (high) traffic keeps being accepted."""
+        router = _router(
+            gpt, 1, num_slots=2, shed_queue_depth=2,
+            tenants='paid:priority=high;free:priority=low')
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        p = _prompts([4], seed=22)[0]
+        # fill slots + queue past the shed depth with protected work
+        hs = [router.submit(p, _sp(8), tenant='paid') for _ in range(4)]
+        assert router.queue_depth >= 2
+        prefills_before = router._by_id[0].engine._counts['prefills']
+        with pytest.raises(AdmissionRejected) as ei:
+            router.submit(p, _sp(8), tenant='free')
+        assert ei.value.reason == 'shed'
+        assert ei.value.retry_after_s is not None
+        # fast-fail: nothing reached the engine
+        assert router._by_id[0].engine._counts['prefills'] \
+            == prefills_before
+        assert router.queue_depth >= 2        # unchanged by the reject
+        # protected traffic still admitted under the same overload
+        hs.append(router.submit(p, _sp(8), tenant='paid'))
+        names = [e['name'] for e in log.events()[ev0:]]
+        assert 'request_shed' in names
+        router.run()
+        _assert_none_dangle(hs)
+        assert all(h.status == FINISHED for h in hs)
+        st = router.stats()
+        assert st['shed'] == 1
+        reg = obs.get_registry()
+        assert reg.value('paddle_router_shed_total', tenant='free',
+                         reason='shed') >= 1
+
+    def test_high_priority_ttft_shielded_by_concurrency_reservation(
+            self, gpt):
+        """The QoS composition: cap the best-effort tenant BELOW the
+        slot count so slots stay free, and priority-order the queue —
+        a high-priority request submitted into a best-effort flood is
+        admitted on the very next scheduler iteration (its TTFT is the
+        no-load TTFT, structurally)."""
+        router = _router(
+            gpt, 1, num_slots=3,
+            tenants='paid:priority=high;free:priority=low,concurrency=2')
+        p = _prompts([4], seed=23)[0]
+        flood = [router.submit(p, _sp(20), tenant='free')
+                 for _ in range(2)]
+        with pytest.raises(AdmissionRejected):   # cap holds the flood
+            router.submit(p, _sp(20), tenant='free')
+        router.step()                            # flood decoding
+        vip = router.submit(p, _sp(2), tenant='paid')
+        router.step()                            # one iteration later...
+        assert vip.inner.status != 'QUEUED'      # ...vip holds a slot
+        assert vip.tokens                        # and already has tokens
+        router.run()
+        _assert_none_dangle(flood + [vip])
+        assert vip.tokens == _ref_generate(gpt, p, 2)
+
+
+# ---------------------------------------------------------------------------
+# priority scheduler (ISSUE-7 satellite)
+# ---------------------------------------------------------------------------
+
+def _handle(prompt_len, max_new=4, priority=PRIORITY_NORMAL):
+    h = RequestHandle(list(range(1, prompt_len + 1)),
+                      SamplingParams(max_new_tokens=max_new))
+    h.priority = priority
+    return h
+
+
+class TestPriorityScheduler:
+    def test_single_class_is_byte_identical_to_fcfs(self):
+        """Parity guard: with one priority class (the default), the
+        admission sequence is EXACTLY the old FCFS policy's, for the
+        same random stream of submits/admissible calls."""
+        import collections as _c
+        rng = np.random.RandomState(0)
+        lens = [int(v) for v in rng.randint(1, 30, 200)]
+        slots = [int(v) for v in rng.randint(0, 5, 120)]
+
+        for budget in (0, 16):
+            # both policies drain the SAME handle objects
+            hs = [_handle(n) for n in lens]
+            ref_q = _c.deque(hs)
+            sched = FCFSScheduler(max_prefill_tokens=budget)
+            for h in hs:
+                sched.submit(h)
+            it = iter(slots)
+            while ref_q:
+                free = next(it, 2)
+                # the pre-priority deque implementation, verbatim
+                ref_admitted, b, f = [], budget, free
+                while ref_q and f > 0:
+                    cost = len(ref_q[0].prompt_tokens)
+                    if ref_admitted and budget and cost > b:
+                        break
+                    ref_admitted.append(ref_q.popleft())
+                    b -= cost
+                    f -= 1
+                got = sched.admissible(free, bucket_for=lambda n: n)
+                assert got == ref_admitted, \
+                    f'priority scheduler diverged from FCFS at ' \
+                    f'budget={budget}'
+            assert sched.queue_depth == 0
+
+    def test_priority_classes_order_stably(self):
+        sched = FCFSScheduler()
+        lo1 = _handle(4, priority=PRIORITY_LOW)
+        hi1 = _handle(4, priority=PRIORITY_HIGH)
+        no1 = _handle(4, priority=PRIORITY_NORMAL)
+        hi2 = _handle(4, priority=PRIORITY_HIGH)
+        for h in (lo1, hi1, no1, hi2):
+            sched.submit(h)
+        assert sched.admissible(4, bucket_for=lambda n: n) \
+            == [hi1, hi2, no1, lo1]           # class, then FCFS inside
+
+    def test_budget_never_lets_later_overtake(self):
+        sched = FCFSScheduler(max_prefill_tokens=10)
+        a = _handle(8, priority=PRIORITY_HIGH)
+        b = _handle(8, priority=PRIORITY_HIGH)
+        c = _handle(1, priority=PRIORITY_LOW)
+        for h in (a, b, c):
+            sched.submit(h)
+        # a admits (first ignores budget, 8 of 10 spent); b (8) busts
+        # the rest -> STOP, and the cheap low-priority c behind b must
+        # NOT sneak past it
+        assert sched.admissible(3, bucket_for=lambda n: n) == [a]
+        # next iteration: b fits fresh budget, then c (8+1 <= 10)
+        assert sched.admissible(3, bucket_for=lambda n: n) == [b, c]
+
+    def test_starvation_guard_promotes_one_class(self):
+        sched = FCFSScheduler(max_wait_s=10.0)
+        old_low = _handle(4, priority=PRIORITY_LOW)
+        young_norm = _handle(4, priority=PRIORITY_NORMAL)
+        sched.submit(old_low)
+        sched.submit(young_norm)
+        # not yet aged: NORMAL wins
+        assert sched.admissible(1, bucket_for=lambda n: n) \
+            == [young_norm]
+        sched.submit(young_norm)
+        old_low._t_submit -= 11.0             # now older than max_wait_s
+        # promoted LOW -> NORMAL; FCFS within the class favors the
+        # older request
+        assert sched.admissible(1, bucket_for=lambda n: n) == [old_low]
+        assert sched.promotions == 1
+        # promotion is one class, not an escalator to HIGH
+        hi = _handle(4, priority=PRIORITY_HIGH)
+        aged = _handle(4, priority=PRIORITY_LOW)
+        aged._t_submit -= 100.0
+        sched2 = FCFSScheduler(max_wait_s=10.0)
+        sched2.submit(aged)
+        sched2.submit(hi)
+        assert sched2.admissible(2, bucket_for=lambda n: n) \
+            == [hi, aged]
+
+    def test_engine_threads_priority_through_submit(self, gpt):
+        from paddle_tpu.serving import InferenceEngine
+        eng = InferenceEngine(gpt, num_slots=1, max_length=64,
+                              decode_block=2)
+        p = _prompts([4], seed=24)[0]
+        running = eng.submit(p, _sp(8))       # occupies the only slot
+        eng.step()
+        lo = eng.submit(p, _sp(2), priority=PRIORITY_LOW)
+        hi = eng.submit(p, _sp(2), priority=PRIORITY_HIGH)
+        eng.run()
+        assert all(h.status == FINISHED for h in (running, lo, hi))
+        # hi got the freed slot before the earlier-submitted lo
+        assert hi._t_first < lo._t_first
+
+
+# ---------------------------------------------------------------------------
+# ref-counted degraded health states (ISSUE-7 satellite)
+# ---------------------------------------------------------------------------
+
+class TestDegradedHealth:
+    def test_refcounted_states_clear_only_when_all_holders_clear(self):
+        try:
+            obs.note_degraded('draining', {'who': 'engine-a'})
+            obs.note_degraded('draining', {'who': 'engine-b'})
+            h = obs.health()
+            assert h['status'] == 'draining'
+            assert h['degraded']['draining']['count'] == 2
+            obs.clear_degraded('draining')    # engine-a leaves
+            assert obs.health()['status'] == 'draining'   # b still holds
+            obs.clear_degraded('draining')
+            assert obs.health()['status'] == 'ok'
+        finally:
+            obs.clear_degraded('draining', force=True)
+
+    def test_multiple_states_all_listed_until_each_clears(self):
+        try:
+            obs.note_degraded('draining')
+            obs.note_degraded('resizing')
+            h = obs.health()
+            assert h['states'] == ['draining', 'resizing']
+            assert h['status'] == 'draining+resizing'
+            obs.clear_degraded('resizing')
+            h = obs.health()
+            assert h['states'] == ['draining']
+            assert h['status'] == 'draining'
+            obs.clear_degraded('draining')
+            assert obs.health()['status'] == 'ok'
+        finally:
+            obs.clear_degraded('draining', force=True)
+            obs.clear_degraded('resizing', force=True)
+
+    def test_degraded_plus_hang_requires_both_to_clear(self):
+        """The satellite's exact scenario: simultaneously draining and
+        hang-suspected -> 503 until BOTH clear."""
+        try:
+            obs.note_degraded('draining')
+            from paddle_tpu.observability import server as srv
+            srv.note_hang(12345, {'step': 7})
+            h = obs.health()
+            assert h['status'] == 'hang_suspected'
+            assert set(h['states']) == {'draining', 'hang_suspected'}
+            srv.clear_hang(12345)
+            h = obs.health()
+            assert h['status'] == 'draining'    # still 503
+            obs.clear_degraded('draining')
+            assert obs.health()['status'] == 'ok'
+        finally:
+            from paddle_tpu.observability import server as srv
+            srv.clear_hang(12345)
+            obs.clear_degraded('draining', force=True)
+
+    def test_healthz_endpoint_returns_503_and_lists_states(self):
+        import json
+        import urllib.request
+        srv = obs.start_server(0)
+        try:
+            obs.note_degraded('draining', scope='replica:3')
+            try:
+                urllib.request.urlopen(f'{srv.url}/healthz', timeout=5)
+                assert False, 'expected 503'
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                body = json.loads(e.read().decode())
+            assert body['states'] == ['draining']
+            assert 'replica:3/draining' in body['degraded']
+        finally:
+            obs.clear_degraded('draining', scope='replica:3', force=True)
+            srv.stop()
+
+    def test_scoped_states_are_attributable_per_replica(self):
+        try:
+            obs.note_degraded('draining', scope='replica:0')
+            assert 'draining' in obs.degraded_states(scope='replica:0')
+            assert 'draining' not in obs.degraded_states(scope='replica:1')
+            assert 'draining' not in obs.degraded_states(scope=None)
+            assert 'draining' in obs.degraded_states()   # '*' merges
+        finally:
+            obs.clear_degraded('draining', scope='replica:0', force=True)
+
+
+# ---------------------------------------------------------------------------
+# observability wiring + tier-1 bench guard
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_router_metrics_and_summary_section(self, gpt):
+        reg = obs.get_registry()
+        router = _router(gpt, 2)
+        hs = router.generate_many(_prompts([3, 7], seed=30),
+                                  [_sp(3), _sp(3)])
+        assert all(h.status == FINISHED for h in hs)
+        assert reg.value('paddle_router_replicas') == 2
+        assert reg.value('paddle_router_requests_total',
+                         tenant='default', outcome='completed') >= 2
+        d = debug.observability_summary(as_dict=True)
+        assert d['router']['replicas'] == 2
+        assert len(d['router']['per_replica']) >= 2
+        text = debug.observability_summary()
+        assert 'router:' in text and 'replica 0: breaker' in text
+
+
+def test_bench_router_guard():
+    """Tier-1 acceptance: zero lost requests under the chaos kill, and
+    <3% router overhead in the no-fault A/B."""
+    import bench
+    res = bench.router_ab(num_requests=10, num_slots=4, decode_block=8,
+                          trials=5)
+    assert res['chaos']['lost_requests'] == 0, \
+        f'chaos run lost requests: {res["chaos"]}'
+    assert res['chaos']['completed'] + res['chaos']['failed_typed'] == 10
+    assert res['router_overhead_pct'] < 3.0, \
+        f'router overhead {res["router_overhead_pct"]}% >= 3%'
+    assert res['parity'], '1- vs 2-replica outputs diverged'
+    assert res['qos']['shed'] >= 0
