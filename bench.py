@@ -1032,6 +1032,233 @@ def _phase_router():
         return {'router': {'error': type(e).__name__}}
 
 
+def coldstart_child(opts):
+    """One restart measurement, run IN A FRESH PROCESS (bench.py
+    --coldstart-child '<json>'): build the small GPT, preload the
+    program store, then measure wall time AND XLA compile counts around
+    the first train step and the first served tokens. With an empty
+    store dir this is the cold arm (compiles happen inside the measured
+    windows); re-run against the now-populated dir it is the warm arm —
+    the tier-1 guard asserts the warm windows contain ZERO backend
+    compiles (`paddle_jit_compiles_total`) for the unchanged signatures,
+    and that losses/tokens are bit-identical to the cold run."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import observability as obs
+    from paddle_tpu import programs
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import InferenceEngine, SamplingParams
+
+    store_dir = opts.get('store_dir') or None
+    steps = int(opts.get('steps', 3))
+    vocab, seq, batch = 256, 32, 4
+    if store_dir:
+        programs.configure(store_dir)
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=256, max_position_embeddings=seq,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = TrainStep(
+        model,
+        lambda logits, labels: F.cross_entropy(
+            logits[:, :-1].reshape([-1, vocab]),
+            labels[:, 1:].reshape([-1])),
+        opt)
+    ids = ((np.random.RandomState(0).randint(0, vocab - seq, (batch, 1))
+            + np.arange(seq)) % vocab)
+    # warm the incidental non-store programs (RNG fold-in, host<->device
+    # converts, optimizer-state zero-fills — a real resume restores opt
+    # state from the checkpoint instead) OUTSIDE the measured windows so
+    # the compile deltas isolate the store-owned executables — the ones
+    # worth minutes at production scale
+    from paddle_tpu.jit import functional_state
+    _ = jax.random.fold_in(step._step_key_root, 0)
+    _ = np.asarray(jnp.asarray(ids))
+    _ = float(np.asarray(jnp.asarray(0.001, jnp.float32)))
+    _params, _, _ = functional_state(model)
+    step._opt_state = opt.init_state(_params)
+    reg = obs.get_registry()
+
+    def real_compiles(marks):
+        # backend-compile ticks NOT served by the persistent XLA cache
+        return int((reg.value('paddle_jit_compiles_total') - marks[0])
+                   - (reg.value('paddle_jit_cache_hits_total')
+                      - marks[1]))
+
+    def marks():
+        return (reg.value('paddle_jit_compiles_total'),
+                reg.value('paddle_jit_cache_hits_total'))
+
+    t0 = time.perf_counter()
+    pre = programs.get_store().preload()
+    m0 = marks()
+    losses = [float(step(ids, ids).numpy()) for _ in range(steps)]
+    train_compiles = real_compiles(m0)
+    t_first_step = time.perf_counter() - t0
+
+    model.eval()
+    engine = InferenceEngine(model, num_slots=2, max_length=seq,
+                             decode_block=2)
+    prompts = [((np.arange(5) + 7) % vocab).tolist(),
+               ((np.arange(9) + 3) % vocab).tolist()]
+    t1 = time.perf_counter()
+    m1 = marks()
+    handles = engine.generate_many(
+        prompts, [SamplingParams(max_new_tokens=6, eos_token_id=-1)] * 2)
+    decode_compiles = real_compiles(m1)
+    t_first_tokens = time.perf_counter() - t1
+
+    return {
+        'store_dir': store_dir,
+        'preload': pre,
+        'time_to_first_step_s': round(t_first_step, 4),
+        'time_to_first_tokens_s': round(t_first_tokens, 4),
+        'train_compiles_measured': train_compiles,
+        'decode_compiles_measured': decode_compiles,
+        'losses': losses,
+        'tokens': [h.tokens for h in handles],
+        'store': {k: v for k, v in programs.get_store().stats().items()
+                  if k in ('hits_disk', 'misses', 'rejects', 'persisted',
+                           'disk_entries', 'coldstart_seconds')},
+    }
+
+
+def coldstart_ab(steps=3, timeout_s=420):
+    """A/B process restart against an empty vs populated program store
+    (also imported by the tier-1 coldstart guard). Pure orchestration —
+    this function never imports jax, so on a single-chip tunnel the
+    child processes can attach to the device. Reports the warm/cold
+    ratio of time-to-first-(step|tokens) and the two warm-path compile
+    counts the guard pins to zero, plus bit-exactness of the warm run's
+    losses and greedy tokens vs the cold run's."""
+    import subprocess
+    import tempfile
+
+    store_dir = tempfile.mkdtemp(prefix='bench_coldstart_')
+
+    def run_child():
+        proc = subprocess.run(
+            [sys.executable, __file__, '--coldstart-child',
+             json.dumps({'store_dir': store_dir, 'steps': steps})],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ))
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(f'coldstart child failed: '
+                               f'exit {proc.returncode}')
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold = run_child()
+    warm = run_child()
+    cold_work = (cold['time_to_first_step_s']
+                 + cold['time_to_first_tokens_s'])
+    warm_work = (warm['time_to_first_step_s']
+                 + warm['time_to_first_tokens_s'])
+    return {
+        'cold_first_work_s': round(cold_work, 4),
+        'warm_first_work_s': round(warm_work, 4),
+        'warm_cold_ratio': round(cold_work / warm_work, 2)
+        if warm_work else 0.0,
+        'warm_train_compiles': warm['train_compiles_measured'],
+        'warm_decode_compiles': warm['decode_compiles_measured'],
+        'cold_train_compiles': cold['train_compiles_measured'],
+        'cold_decode_compiles': cold['decode_compiles_measured'],
+        'warm_loaded_from_disk': warm['preload']['loaded'],
+        'warm_rejects': warm['store']['rejects'],
+        'parity_losses': warm['losses'] == cold['losses'],
+        'parity_tokens': warm['tokens'] == cold['tokens'],
+        'steps': steps,
+    }
+
+
+def coldstart_overhead_ab(steps=30, trials=3):
+    """A/B a jitted TrainStep loop with the program store bypassed
+    (FLAGS_program_store=False — the pre-store AOT path) vs enrolled
+    (memory tier; no directory), with the same min-of-adjacent-pair-
+    ratios estimator as the elastic guard. The store's per-call cost
+    after the first signature resolution is one dict hit either way, so
+    the steady-state ratio must stay under the tier-1 3% bar."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import flags as _pflags
+    from paddle_tpu.jit import TrainStep
+    import paddle_tpu.nn as nn
+
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((32, 64)).astype('float32')
+    y = rng.randint(0, 10, (32,))
+
+    def run(store_on):
+        import time as _t
+        _pflags.set_flags({'FLAGS_program_store': bool(store_on)})
+        try:
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                                  nn.Linear(128, 10))
+            opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=model.parameters())
+            step = TrainStep(model,
+                             lambda out, lab: F.cross_entropy(out, lab),
+                             opt)
+            xs, ys = paddle.to_tensor(x), paddle.to_tensor(y)
+            float(step(xs, ys).numpy())   # compile outside the window
+            t0 = _t.perf_counter()
+            for _ in range(steps):
+                loss = step(xs, ys)
+            float(loss.numpy())           # sync
+            return steps / (_t.perf_counter() - t0)
+        finally:
+            _pflags.set_flags({'FLAGS_program_store': True})
+
+    best_on = best_off = 0.0
+    ratios = []
+    for _ in range(trials):
+        off = run(store_on=False)
+        on = run(store_on=True)
+        best_off = max(best_off, off)
+        best_on = max(best_on, on)
+        if on:
+            ratios.append(off / on)
+    overhead = min(ratios) - 1 if ratios else float('inf')
+    return {
+        'store_steps_per_sec': round(best_on, 1),
+        'bypass_steps_per_sec': round(best_off, 1),
+        'overhead_ratio': round(best_off / best_on, 4) if best_on else 0.0,
+        'overhead_pct': round(overhead * 100, 2),
+    }
+
+
+def _phase_coldstart():
+    """Cold-restart phase: empty-store vs populated-store process
+    restart A/B (warm path guarded to zero XLA compiles + bit-exact),
+    then the store-bypassed overhead guard. The restart A/B runs FIRST
+    and entirely in subprocesses — this phase process must not touch
+    the device before its children have."""
+    out = {}
+    try:
+        out['coldstart'] = coldstart_ab()
+    except Exception as e:
+        print(f'# coldstart bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        out['coldstart'] = {'error': type(e).__name__}
+    try:
+        out['coldstart_overhead'] = coldstart_overhead_ab()
+    except Exception as e:
+        print(f'# coldstart overhead bench failed: '
+              f'{type(e).__name__}: {e}', file=sys.stderr)
+        out['coldstart_overhead'] = {'error': type(e).__name__}
+    return out
+
+
 def _bench_eager_dispatch():
     """Eager dispatch fast path A/B: the same DyGraph MLP train loop with
     the dispatch cache on vs off (per-call re-tracing), reporting ops/sec
@@ -1185,6 +1412,7 @@ PHASES = {
     'resilience': _phase_resilience,
     'serving': _phase_serving,
     'router': _phase_router,
+    'coldstart': _phase_coldstart,
 }
 
 
@@ -1222,7 +1450,8 @@ def _cpu_phase_plan():
     BENCH_CPU_PHASES (comma list) restricts the set — the probe-fallback
     regression test runs a single fast phase."""
     plan = [('headline', 1500), ('eager', 600), ('obs', 600),
-            ('resilience', 600), ('serving', 900), ('router', 900)]
+            ('resilience', 600), ('serving', 900), ('router', 900),
+            ('coldstart', 900)]
     only = os.environ.get('BENCH_CPU_PHASES')
     if only:
         wanted = {p.strip() for p in only.split(',') if p.strip()}
@@ -1231,6 +1460,12 @@ def _cpu_phase_plan():
 
 
 def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == '--coldstart-child':
+        if os.environ.get('BENCH_FORCE_CPU'):
+            import jax
+            jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps(coldstart_child(json.loads(sys.argv[2]))))
+        return 0
     if len(sys.argv) >= 3 and sys.argv[1] == '--phase':
         if os.environ.get('BENCH_FORCE_CPU'):
             # test hook for the phase flow: the axon preload ignores
@@ -1289,6 +1524,7 @@ def main():
     out.update(_run_phase_subprocess('resilience', 600))
     out.update(_run_phase_subprocess('serving', 900))
     out.update(_run_phase_subprocess('router', 900))
+    out.update(_run_phase_subprocess('coldstart', 900))
     print(json.dumps(out))
     return 0
 

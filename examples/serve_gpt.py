@@ -110,8 +110,16 @@ def _serve_routed(model, requests, replicas, tenants, shed_queue_depth):
 
 
 def main(num_requests=10, metrics_port=None, replicas=1, tenants=None,
-         shed_queue_depth=None):
+         shed_queue_depth=None, program_store=None):
     paddle.seed(0)
+    if program_store:
+        # persistent program store: a cold replica loads its decode/
+        # prefill executables instead of compiling them (the engine
+        # preloads automatically; /healthz holds `warming` meanwhile)
+        from paddle_tpu import programs
+        programs.configure(program_store)
+        print(f'program store at {program_store} '
+              f'({programs.get_store().disk_entries()} entries on disk)')
     if metrics_port is not None:
         server = observability.start_server(metrics_port)
         print(f'observability endpoint at {server.url}')
@@ -142,7 +150,12 @@ if __name__ == '__main__':
     p.add_argument('--metrics-port', type=int, default=None,
                    help='serve the HTTP observability endpoint on this '
                         'port while decoding')
+    p.add_argument('--program-store', default=None,
+                   help='persistent program-store directory: a restarted '
+                        'replica loads its compiled decode/prefill '
+                        'programs instead of recompiling them')
     args = p.parse_args()
     main(num_requests=args.num_requests, metrics_port=args.metrics_port,
          replicas=args.replicas, tenants=args.tenants,
-         shed_queue_depth=args.shed_queue_depth)
+         shed_queue_depth=args.shed_queue_depth,
+         program_store=args.program_store)

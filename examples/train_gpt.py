@@ -44,8 +44,18 @@ from paddle_tpu.utils.checkpoint import CheckpointManager
 
 
 def main(steps=80, vocab=512, seq=64, batch=8, ckpt_dir=None, resume=None,
-         ckpt_interval=20, metrics_port=None):
+         ckpt_interval=20, metrics_port=None, program_store=None):
     paddle.seed(0)
+    if program_store:
+        # persistent program store: executables serialize next to the
+        # checkpoints, so `--resume auto` restarts pay zero XLA compiles
+        from paddle_tpu import programs
+        programs.configure(program_store)
+        pre = programs.get_store().preload(match='train')
+        print(f'program store at {program_store}: '
+              f"{pre['loaded']} warm program(s) in {pre['seconds']:.2f}s"
+              + (f", {pre['rejected']} rejected" if pre['rejected']
+                 else ''))
     server = None
     if metrics_port is not None:
         server = observability.start_server(metrics_port)
@@ -206,12 +216,20 @@ if __name__ == '__main__':
     p.add_argument('--elastic', action='store_true',
                    help='train through ElasticTrainLoop with a simulated '
                         'mid-run shrink/grow of the device mesh')
+    p.add_argument('--program-store', default=None,
+                   help='persistent program-store directory: compiled '
+                        'executables survive restarts, so a resumed run '
+                        'pays zero XLA compiles (pair with --resume auto)')
     args = p.parse_args()
     if args.elastic:
+        if args.program_store:
+            from paddle_tpu import programs
+            programs.configure(args.program_store)
         main_elastic(steps=args.steps, ckpt_dir=args.ckpt_dir,
                      resume=args.resume, ckpt_interval=args.ckpt_interval,
                      metrics_port=args.metrics_port)
     else:
         main(steps=args.steps, ckpt_dir=args.ckpt_dir, resume=args.resume,
              ckpt_interval=args.ckpt_interval,
-             metrics_port=args.metrics_port)
+             metrics_port=args.metrics_port,
+             program_store=args.program_store)

@@ -49,9 +49,19 @@ from . import flags as _flags
 _tree = jax.tree_util
 
 _flags.register_flag('FLAGS_eager_dispatch_cache', True)
+# LRU capacity of the dispatch cache: long-running serving/training
+# processes with churning shapes must not grow executable memory
+# without bound. Read at use time so set_flags() applies live.
+_flags.register_flag('FLAGS_eager_dispatch_cache_size', 512)
 
-_MAX_ENTRIES = 512
 _MAX_BLACKLIST = 4096
+
+
+def _max_entries() -> int:
+    try:
+        return max(int(_flags.flag('FLAGS_eager_dispatch_cache_size')), 1)
+    except (TypeError, ValueError):
+        return 512
 
 _enabled = [bool(_flags.flag('FLAGS_eager_dispatch_cache'))]
 _cache: "collections.OrderedDict[Any, _Entry]" = collections.OrderedDict()
@@ -313,6 +323,8 @@ def run(fn, name, treedef, leaves, t_idx, vals, record
 
     try:
         entry = _cache.get(key)
+        if entry is not None:
+            _cache.move_to_end(key)   # true LRU: a hit is a touch
     except Exception:
         _note_fallback(name)
         return None
@@ -382,7 +394,8 @@ def run(fn, name, treedef, leaves, t_idx, vals, record
         if fresh_entry:
             try:
                 _cache[key] = entry
-                if len(_cache) > _MAX_ENTRIES:
+                cap = _max_entries()
+                while len(_cache) > cap:
                     _cache.popitem(last=False)
                     _counters.evictions += 1
             except Exception:

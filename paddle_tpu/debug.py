@@ -243,11 +243,27 @@ def _observability_data(max_rows: int = 10) -> dict:
         'router': _router_data(reg),
         'elastic': _elastic_data(reg),
         'programs': _obs.program_catalog().top_programs(n=max_rows),
+        'program_store': _program_store_data(),
         'spans': span_rows,
         'events': {'logged': len(log), 'dropped': log.dropped,
                    'flight_dumps': int(_labeled_total(
                        reg, 'paddle_flight_dumps_total'))},
     }
+
+
+def _program_store_data() -> dict:
+    """Program-store view: tiers, warm/cold posture, cold-start wall
+    time (the first-class availability number for restarts)."""
+    try:
+        from .programs import get_store
+        return get_store().stats()
+    except Exception:
+        return {'persistent': False, 'dir': None, 'memory_entries': 0,
+                'programs': 0, 'loaded_from_disk': 0, 'hits_memory': 0,
+                'hits_disk': 0, 'misses': 0, 'rejects': 0,
+                'persisted': 0, 'persist_skips': 0, 'invalidated': 0,
+                'preload': None, 'coldstart_seconds': None,
+                'disk_entries': 0}
 
 
 def _router_data(reg) -> dict:
@@ -389,6 +405,21 @@ def observability_summary(max_rows: int = 10, as_dict: bool = False):
         lines.append(
             f'    {h["kind"]:<7} {h["from_devices"]}->{h["to_devices"]} '
             f'devices  mesh {h["to"]}  ({h["reason"]})')
+    ps = d['program_store']
+    tier = (f'persistent @ {ps["dir"]}' if ps['persistent']
+            else 'memory-only')
+    lines.append(
+        f'  program store: {tier}  {ps["memory_entries"]} resident '
+        f'({ps["loaded_from_disk"]} warm-loaded)  '
+        f'hits {ps["hits_memory"]}m/{ps["hits_disk"]}d  '
+        f'misses {ps["misses"]}  rejects {ps["rejects"]}')
+    if ps.get('coldstart_seconds') is not None:
+        pl = ps.get('preload') or {}
+        lines.append(
+            f'    cold start: warm at {ps["coldstart_seconds"]:.3f}s '
+            f'(preload {pl.get("loaded", 0)} programs in '
+            f'{pl.get("seconds", 0.0):.3f}s, '
+            f'{pl.get("rejected", 0)} rejected)')
     lines.append(f'  programs: {len(d["programs"])} tracked '
                  f'(top by host time)')
     for p in d['programs']:

@@ -215,7 +215,12 @@ class Generator:
 
     @property
     def root_key(self):
-        return jax.random.key(self._seed)
+        # legacy raw uint32[2] key, NOT jax.random.key(): the bits and
+        # every downstream jax.random.* op are identical, but the typed
+        # key<fry> aval cannot ride through jax.export serialization —
+        # and these keys are inputs to every persisted train/to_static
+        # program in the program store
+        return jax.random.PRNGKey(self._seed)
 
     def next_key(self):
         if self._trace_key is not None:

@@ -112,10 +112,14 @@ class Model:
             if self._optimizer is None or self._loss is None:
                 raise RuntimeError('call prepare(optimizer, loss) first')
 
+            # close over the loss itself (not `self`) so the program
+            # store's key sees WHICH loss this step bakes in
+            _loss = self._loss
+
             def loss_fn(outputs, labels):
                 out = outputs[0] if isinstance(outputs, (list, tuple)) \
                     else outputs
-                return self._loss(out, labels)
+                return _loss(out, labels)
             self._train_step = TrainStep(self.network, loss_fn,
                                          self._optimizer)
             restored = self.__dict__.pop('_restored_opt_state', None)
@@ -270,15 +274,25 @@ class Model:
             else:
                 if self._optimizer is None or self._loss is None:
                     raise RuntimeError('call prepare(optimizer, loss) first')
+                _eloss = self._loss
 
                 def _elastic_loss(outputs, labels):
                     out = outputs[0] \
                         if isinstance(outputs, (list, tuple)) else outputs
-                    return self._loss(out, labels)
+                    return _eloss(out, labels)
                 cfg = dict(elastic) if isinstance(elastic, dict) else {}
                 estep = ElasticTrainStep(self.network, _elastic_loss,
                                          self._optimizer, **cfg)
             self._train_step = estep
+        # warm restart: with a persistent program store, materialize the
+        # persisted train executables BEFORE the first step (a resumed
+        # trainer then pays zero XLA compiles for unchanged signatures);
+        # /healthz holds the ref-counted `warming` state for the
+        # duration. No-op when the store has no directory.
+        from .. import programs as _programs
+        _pstore = _programs.get_store()
+        if _pstore.persistent:
+            _pstore.preload(match='train')
         it_count = 0
         start_epoch = 0
         if resume not in (None, False):

@@ -25,6 +25,8 @@ import numpy as np
 
 from .. import autograd, framework
 from .. import observability as _obs
+from .. import programs as _programs
+from ..programs import ProgramDeserializeError
 from ..nn.layer import Layer
 from ..tensor import Tensor
 
@@ -171,8 +173,11 @@ class StaticLayer:
                     is_leaf=lambda t: isinstance(t, Tensor))
         target_name = getattr(self._target, '__name__',
                               type(self._target).__name__)
-        f = _obs.program_catalog().wrap_jit(
-            jax.jit(fn), name=f'to_static:{target_name}', kind='to_static')
+        f = _programs.get_store().wrap_jit(
+            jax.jit(fn), name=f'to_static:{target_name}', kind='to_static',
+            statics={'target': target_name,
+                     'src': _programs.code_token(self._target),
+                     'static_kwargs': repr(key)})
         self._jit_cache[key] = f
         # executable-cache telemetry: compile count/seconds ride the
         # jax.monitoring listeners (observability.telemetry); the
@@ -268,6 +273,15 @@ class TrainStep:
                 grads, params, opt_state, lr)
             return loss, new_params, new_opt, new_bufs
 
+        # the persistent key must see what the avals cannot: the layer
+        # and loss bodies and the optimizer's baked-in hyperparameters
+        # (two Adams with different betas share every input aval)
+        step_statics = {
+            'layer': type(layer).__qualname__,
+            'layer_src': _programs.code_token(type(layer)),
+            'loss_src': _programs.code_token(loss_fn),
+            'optimizer': _programs.describe_statics(optimizer),
+        }
         self._offload = getattr(optimizer, '_offload', None) == 'host'
         if self._offload:
             # host-offloaded optimizer state: jit ONLY the grad step
@@ -275,15 +289,18 @@ class TrainStep:
             # per-leaf through optimizer.offload.OffloadEngine
             from ..optimizer.offload import OffloadEngine
 
-            self._jitted_grads = _obs.program_catalog().wrap_jit(
+            self._jitted_grads = _programs.get_store().wrap_jit(
                 jax.jit(loss_and_grads, donate_argnums=(1,)),
-                name='train_step_grads', kind='train')
+                name='train_step_grads', kind='train',
+                statics=step_statics, donate_argnums=(1,))
             self._engine = OffloadEngine(optimizer)
-        # enrolled in the ProgramCatalog: the one AOT compile serves the
-        # traffic AND yields cost/memory analysis for top_programs()
-        self._jitted = _obs.program_catalog().wrap_jit(
+        # enrolled in the program store: the one AOT compile (or warm
+        # disk load) serves the traffic AND yields cost/memory analysis
+        # for top_programs()
+        self._jitted = _programs.get_store().wrap_jit(
             jax.jit(step_fn, donate_argnums=(0, 1, 2)),
-            name='train_step', kind='train')
+            name='train_step', kind='train', statics=step_statics,
+            donate_argnums=(0, 1, 2))
 
     @staticmethod
     def _as_batch(inputs, labels):
@@ -492,7 +509,22 @@ def load(path, layer=None):
             f'{hlo_path} not found: this artifact predates program '
             f'serialization — pass the layer instance to restore into')
     with open(hlo_path, 'rb') as f:
-        exported = _jax_export.deserialize(bytearray(f.read()))
+        raw = f.read()
+    try:
+        exported = _jax_export.deserialize(bytearray(raw))
+    except Exception as exc:
+        # a truncated/garbage artifact used to raise a raw internal
+        # exception; the typed error lets callers fall back (re-export,
+        # restore-into-layer) instead of crashing
+        _obs.emit('program_cache_reject', path=hlo_path,
+                  reason='deserialize', error=type(exc).__name__)
+        if _obs.enabled():
+            _obs.get_registry().counter(
+                'paddle_program_cache_rejects_total',
+                'persisted entries rejected at load',
+                ('reason',)).labels(reason='deserialize').inc()
+        raise ProgramDeserializeError(
+            hlo_path, f'{type(exc).__name__}: {exc}') from exc
     params, frozen, buffers = {}, {}, {}
     manifest = {}
     try:

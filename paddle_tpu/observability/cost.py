@@ -18,7 +18,10 @@ signature and then invokes the captured `Compiled` object directly, so
 the cost/memory analyses are read off the very executable that serves
 the traffic — the catalog never compiles anything the program would not
 have compiled anyway (guarded by the serving zero-recompile tests over
-`paddle_jit_compiles_total`).
+`paddle_jit_compiles_total`). Since the program-store consolidation
+(`paddle_tpu.programs`), compilation itself is owned by the store —
+`wrap_jit` delegates there, THIS catalog remains the bookkeeping, and
+every program is tracked exactly once (tier-1 catalog==store guard).
 
 Hot paths never pay: the eager dispatch cache reports only from its
 cold miss path (`note_dispatch_compile`) and its per-op invocation
@@ -220,8 +223,22 @@ class ProgramCatalog:
 
     def wrap_jit(self, fn, name: Optional[str] = None,
                  name_fn: Optional[Callable] = None,
-                 kind: str = 'jit') -> CatalogedJit:
-        """Enroll a jax.jit'd callable; returns the drop-in wrapper."""
+                 kind: str = 'jit', statics: Any = None,
+                 persist: bool = True):
+        """Enroll a jax.jit'd callable; returns the drop-in wrapper.
+
+        Since the program-store consolidation this delegates to
+        `paddle_tpu.programs.ProgramStore.wrap_jit` — the store owns
+        compilation (and the persistent tier); THIS catalog stays the
+        bookkeeping, so every program is tracked exactly once. A
+        catalog that is not the store's own (tests constructing a
+        private one) keeps the legacy in-wrapper AOT path."""
+        from ..programs import get_store
+        store = get_store()
+        if store.catalog is self:
+            return store.wrap_jit(fn, name=name, name_fn=name_fn,
+                                  kind=kind, statics=statics,
+                                  persist=persist)
         return CatalogedJit(self, fn, name=name, name_fn=name_fn, kind=kind)
 
     def note_invocation(self, name: str, seconds: float = 0.0, n: int = 1,

@@ -113,8 +113,16 @@ class FlightRecorder:
                      'paddle_resilience_rollbacks_total',
                      'paddle_resilience_hangs_total',
                      'paddle_serving_tokens_total',
-                     'paddle_serving_decode_steps_total'):
+                     'paddle_serving_decode_steps_total',
+                     'paddle_program_cache_misses_total'):
             out[name] = reg.value(name)
+        # program-store hit/reject counters are labeled (tier/reason):
+        # the headline view wants the totals
+        for name in ('paddle_program_cache_hits_total',
+                     'paddle_program_cache_rejects_total'):
+            fam = reg.get(name)
+            out[name] = (sum(c.value for c in fam._children.values())
+                         if fam is not None else 0.0)
         return out
 
     def dump(self, dir: Optional[str] = None, reason: str = 'manual',
@@ -153,8 +161,16 @@ class FlightRecorder:
             with open(os.path.join(path, 'metrics.json'), 'w') as f:
                 json.dump(reg.snapshot(), f, indent=1)
             cat = get_catalog()
+            programs_doc = cat.snapshot()
+            try:
+                from ..programs import get_store
+                # cold-start posture rides every postmortem: was this
+                # process serving warm-loaded or freshly-compiled code?
+                programs_doc['store'] = get_store().stats()
+            except Exception:
+                pass
             with open(os.path.join(path, 'programs.json'), 'w') as f:
-                json.dump(cat.snapshot(), f, indent=1)
+                json.dump(programs_doc, f, indent=1, default=str)
             try:
                 from .. import debug
                 summary = debug.observability_summary() + '\n'

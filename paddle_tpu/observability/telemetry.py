@@ -40,6 +40,23 @@ def _on_jax_duration(name: str, secs: float, **kw):
                     'seconds spent tracing python to jaxpr').inc(secs)
 
 
+def _on_jax_event(name: str, **kw):
+    """Instant-event listener: the persistent compilation cache emits
+    `/jax/compilation_cache/cache_hits` when a backend "compile" was
+    actually served from disk. `paddle_jit_compiles_total` ticks either
+    way (the duration event wraps the whole compile-or-get-cached
+    call), so REAL compiles in a window = compiles delta minus cache
+    hits delta — the program store's zero-compile warm-restart guards
+    assert that difference is zero."""
+    if not _metrics.enabled():
+        return
+    if name.endswith('cache_hits'):
+        _metrics.get_registry().counter(
+            'paddle_jit_cache_hits_total',
+            'XLA backend compiles served from the persistent '
+            'compilation cache').inc()
+
+
 def _dispatch_collector(reg: '_metrics.MetricsRegistry'):
     """Scrape-time mirror of the dispatch cache's raw counters."""
     from .. import _dispatch
@@ -54,6 +71,9 @@ def _dispatch_collector(reg: '_metrics.MetricsRegistry'):
     reg.gauge('paddle_dispatch_cache_entries',
               'compiled entries resident in the dispatch cache').set(
                   s['cache_size'])
+    ev = reg.counter('paddle_dispatch_evictions_total',
+                     'dispatch-cache LRU evictions')
+    ev._sole().value = float(s['evictions'])   # mirror, not accumulate
 
 
 def install():
@@ -68,6 +88,7 @@ def install():
     try:
         from jax import monitoring as _mon
         _mon.register_event_duration_secs_listener(_on_jax_duration)
+        _mon.register_event_listener(_on_jax_event)
         _installed[0] = True
     except Exception:
         pass   # jax without monitoring: compile metrics stay at zero

@@ -1,0 +1,942 @@
+"""Unified persistent program store: one owner for every compiled XLA
+program in the process, with a crash-safe on-disk tier.
+
+Before this module, three independent caches each managed compiled
+executables — the eager dispatch cache (`_dispatch.py`), the
+`jit`/`to_static` python-side caches, and the serving engine's
+decode/prefill set — none of which survived a restart, so every
+preemption resume and every cold serving replica paid minutes of XLA
+recompiles before doing useful work. The `ProgramStore` is the single
+compilation owner for the jitted tiers: `wrap_jit` AOT-compiles ONCE
+per (name, fn source, statics, treedef, avals, sharding) key through
+`lower().compile()`, folds the `ProgramCatalog` cost attribution in as
+its bookkeeping (one `ProgramRecord` per named program — never tracked
+twice), shares executables across wrappers with the same key (N serving
+replicas of one model compile the decode block once), and — when a
+store directory is configured — persists each executable so the next
+process *loads* instead of compiling.
+
+Persistence is two complementary layers under one store directory:
+
+  'stablehlo'   `jax.export` bytes (the serialization `jit.save` already
+                uses) — removes Python tracing from the restart path.
+                The cold path compiles THROUGH the exported program
+                (`jax.jit(exported.call)`, donation re-applied), so the
+                cold and warm processes compile the identical module.
+  <dir>/xla     jax's persistent compilation cache, pointed inside the
+                store directory — serves the compiled executable BYTES
+                on the warm path, so re-compiling the deserialized
+                module is a cache read, not an XLA compile. The
+                warm-restart tier-1 guard asserts every
+                `paddle_jit_compiles_total` tick in the warm window is
+                matched by a `paddle_jit_cache_hits_total` tick (zero
+                real compiles).
+
+(`jax.experimental.serialize_executable` — pickling the PjRt executable
+itself — was evaluated first and rejected: deserialized donated
+executables intermittently corrupt the heap on this jaxlib. The
+export+cache pair reaches the same zero-compile warm restart through
+two independently hardened upstream paths.)
+
+Crash safety (the robustness contract, fault-injection-tested in
+tests/test_programs.py): entries are written payload-first with atomic
+renames and committed by their manifest, every manifest carries a
+sha256 of the payload plus a backend fingerprint (paddle_tpu/jax/jaxlib
+versions, backend, device kind, device/process counts), and the load
+path verifies ALL of it — a truncated file, a flipped byte, a stale
+jaxlib, a half-written entry from a killed writer, or a racing second
+writer can only ever produce a `program_cache_reject` event and a fresh
+compile, never an exception out of the store. A poisoned cache degrades
+to cold-start behavior; it cannot take down a trainer or replica.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from .. import flags as _flags
+from .. import observability as _obs
+from ..observability import cost as _cost
+
+_MANIFEST_VERSION = 1
+
+_flags.register_flag('FLAGS_program_store', True)
+_flags.register_flag('FLAGS_program_store_dir', '')
+
+
+class ProgramDeserializeError(RuntimeError):
+    """A serialized program artifact could not be deserialized.
+
+    Typed so callers (jit.load, the store's own disk tier) can fall back
+    to a fresh compile instead of crashing on a raw internal exception.
+    Carries the artifact path and the underlying reason."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = path
+        self.reason = reason
+        super().__init__(f'cannot deserialize program artifact {path}: '
+                         f'{reason}')
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + keying
+# ---------------------------------------------------------------------------
+
+def backend_fingerprint() -> Dict[str, Any]:
+    """The compatibility envelope of a compiled executable: an entry
+    written under a different fingerprint is rejected at load (a PjRt
+    executable is only valid for the exact runtime that produced it;
+    StableHLO survives more skew, but version-gating both keeps the
+    invalidation rule simple and safe)."""
+    try:
+        import jaxlib
+        jaxlib_version = jaxlib.__version__
+    except Exception:
+        jaxlib_version = 'unknown'
+    try:
+        from .. import version as _version
+        own = _version.full_version
+    except Exception:
+        own = 'unknown'
+    try:
+        devs = jax.devices()
+        kind = devs[0].device_kind if devs else 'none'
+        count = len(devs)
+    except Exception:
+        kind, count = 'unknown', 0
+    try:
+        procs = jax.process_count()
+    except Exception:
+        procs = 1
+    return {
+        'paddle_tpu': own,
+        'jax': jax.__version__,
+        'jaxlib': jaxlib_version,
+        'backend': jax.default_backend(),
+        'device_kind': kind,
+        'device_count': count,
+        'process_count': procs,
+    }
+
+
+def code_token(fn, _depth: int = 0) -> str:
+    """Best-effort stable identity for a function/class body ACROSS
+    processes (the in-process `id()` the dispatch cache uses is
+    meaningless after a restart): sha256 of the source text plus the
+    tokens of closure cells (a generic wrapper closing over the real
+    loss fn keys on THAT fn's body, not the wrapper's), falling back to
+    the bytecode, falling back to the qualified name. Catches a changed
+    function/closure body; deeper changes (a helper the body calls) are
+    covered by the fingerprint + the documented wipe rule."""
+    target = getattr(fn, '__wrapped__', fn)
+    try:
+        import inspect
+        blob = inspect.getsource(target)
+    except Exception:
+        code = getattr(target, '__code__', None)
+        if code is not None:
+            blob = code.co_code.hex() + repr(code.co_consts)
+        else:
+            blob = getattr(target, '__qualname__',
+                           type(target).__name__)
+    if _depth < 3:
+        func = getattr(target, '__func__', target)
+        for cell in (getattr(func, '__closure__', None) or ()):
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if callable(v):
+                # body token + scalar-attr token: a loss Layer keys on
+                # its class AND its baked hyperparams (label smoothing)
+                blob += code_token(v, _depth + 1) + describe_statics(v)
+            else:
+                blob += describe_statics(v)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def describe_statics(obj, _depth: int = 0) -> str:
+    """Stable textual token for compile-time constants baked into a
+    program (optimizer hyperparams, model config, engine geometry) —
+    values that change the compiled computation WITHOUT changing any
+    input aval. Best-effort: unknown objects degrade to their class
+    name, never raise."""
+    if _depth > 4:
+        return '...'
+    try:
+        if obj is None or isinstance(obj, (bool, int, float, str)):
+            return repr(obj)
+        if isinstance(obj, (list, tuple)):
+            inner = ','.join(describe_statics(v, _depth + 1) for v in obj)
+            return f'[{inner}]'
+        if isinstance(obj, dict):
+            inner = ','.join(
+                f'{k!r}:{describe_statics(obj[k], _depth + 1)}'
+                for k in sorted(obj, key=repr))
+            return f'{{{inner}}}'
+        if hasattr(obj, '__dict__'):
+            scalars = {k: v for k, v in vars(obj).items()
+                       if isinstance(v, (bool, int, float, str, type(None)))
+                       and not k.startswith('_')}
+            return (f'{type(obj).__qualname__}'
+                    f'({describe_statics(scalars, _depth + 1)})')
+        return type(obj).__qualname__
+    except Exception:
+        return type(obj).__name__
+
+
+def _leaf_sig(leaf):
+    dt = getattr(leaf, 'dtype', None)
+    if dt is not None:
+        shard = ''
+        try:
+            s = getattr(leaf, 'sharding', None)
+            if s is not None and type(s).__name__ not in (
+                    'SingleDeviceSharding',):
+                shard = str(s)
+        except Exception:
+            pass
+        return (tuple(getattr(leaf, 'shape', ())), str(dt),
+                bool(getattr(leaf, 'weak_type', False)), shard)
+    if isinstance(leaf, (bool, int, float, str, type(None))):
+        return ('py', repr(leaf))
+    return ('py', type(leaf).__name__)
+
+
+def _mesh_token() -> str:
+    """Active fleet mesh topology (axis names/sizes), part of the key so
+    re-meshed programs never collide with their pre-resize ancestors."""
+    try:
+        from ..distributed import fleet
+        mesh = fleet.get_mesh()
+        if mesh is None:
+            return ''
+        return repr(tuple(zip(mesh.axis_names, mesh.devices.shape)))
+    except Exception:
+        return ''
+
+
+def store_key(name: str, fn_token: str, statics_token: str, args) -> str:
+    """The persistent cache key: sha256 over (name, fn identity, input
+    treedef, tensor avals, static leaves, sharding, mesh) — the dispatch
+    cache's key shape, made process-independent. The backend fingerprint
+    is deliberately NOT part of the key: a skewed entry must be FOUND
+    and rejected (with an event) rather than silently missed."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = tuple(_leaf_sig(leaf) for leaf in leaves)
+    blob = repr((_MANIFEST_VERSION, name, fn_token, statics_token,
+                 str(treedef), sig, _mesh_token()))
+    return hashlib.sha256(blob.encode('utf-8')).hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+def _export_program(jitted, args):
+    """Trace `jitted` into a portable `jax.export.Exported` at the
+    abstract shapes of `args` (the artifact the persistent tier
+    stores). Typed PRNG-key leaves are rejected up front (the export
+    flatbuffer cannot encode `key<fry>` avals — framework RNG uses raw
+    keys for exactly this reason); callers degrade to the plain
+    unpersisted compile."""
+    from jax import export as _jex
+    for leaf in jax.tree_util.tree_leaves(args):
+        dt = getattr(leaf, 'dtype', None)
+        if dt is not None and jax.dtypes.issubdtype(
+                dt, jax.dtypes.prng_key):
+            raise TypeError(
+                'typed PRNG-key argument cannot be exported; pass raw '
+                'uint32 key data (jax.random.PRNGKey / key_data)')
+    plats = {'tpu', 'cpu', jax.default_backend()}
+    abstract = jax.tree_util.tree_map(
+        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
+        if hasattr(v, 'shape') else v, args)
+    return _jex.export(jitted, platforms=tuple(sorted(plats)))(*abstract)
+
+
+def _compile_exported(exported, donate_argnums=()):
+    """AOT-compile an exported program from its own recorded in_avals.
+
+    No Python tracing of the original function; the backend compile of
+    this module is served by jax's persistent compilation cache on warm
+    restarts (same module bytes -> same cache key), so it costs a disk
+    read, not an XLA compile.
+
+    Donation is deliberately NOT applied: donation does not survive the
+    export round trip on this jax version, and re-applying it on the
+    wrapper jit intermittently corrupts the heap under real train-step
+    programs (fault-injection gauntlet caught segfaults/garbage losses
+    ~50% of runs; stable 12/12 without). Store-served programs
+    therefore trade transient double-buffering of donated state for
+    memory safety — `donate_argnums` still rides the manifest so a
+    future jax can restore the aliasing. Processes that need donation's
+    HBM headroom more than warm restarts can leave the store
+    unconfigured (the direct donated path is untouched)."""
+    del donate_argnums
+    specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+             for a in exported.in_avals]
+    args, kwargs = jax.tree_util.tree_unflatten(exported.in_tree, specs)
+    return jax.jit(exported.call).lower(*args, **kwargs).compile()
+
+
+def _load_stablehlo(payload: bytes, path: str, donate_argnums=()):
+    """Deserialize exported StableHLO and AOT-compile it — the warm
+    half of the restart path."""
+    from jax import export as _jex
+    try:
+        exported = _jex.deserialize(bytearray(payload))
+    except Exception as exc:
+        raise ProgramDeserializeError(
+            path, f'{type(exc).__name__}: {exc}') from exc
+    try:
+        return _compile_exported(exported, donate_argnums)
+    except Exception as exc:
+        raise ProgramDeserializeError(
+            path, f'aot compile of deserialized program failed: '
+                  f'{type(exc).__name__}: {exc}') from exc
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+class _StoreEntry:
+    __slots__ = ('key', 'name', 'kind', 'callable', 'source', 'format',
+                 'fingerprint')
+
+    def __init__(self, key, name, kind, call, source, fmt, fingerprint):
+        self.key = key
+        self.name = name
+        self.kind = kind
+        self.callable = call
+        self.source = source          # 'compile' | 'disk'
+        self.format = fmt             # 'stablehlo' | '' (unpersisted)
+        self.fingerprint = fingerprint
+
+
+class ProgramStore:
+    """Process-wide owner of AOT-compiled executables, with an optional
+    persistent tier. All state-changing paths are exception-safe: disk
+    problems degrade to a fresh compile, never propagate."""
+
+    def __init__(self, catalog: Optional[_cost.ProgramCatalog] = None,
+                 directory: Optional[str] = None):
+        self.catalog = catalog or _cost.get_catalog()
+        self._lock = threading.RLock()
+        self._mem: Dict[str, _StoreEntry] = {}
+        self._dir = directory
+        self._fingerprint = backend_fingerprint()
+        self._hits_memory = 0
+        self._hits_disk = 0
+        self._misses = 0
+        self._rejects = 0
+        self._persisted = 0
+        self._persist_skips = 0
+        self._invalidated = 0
+        self._preload: Optional[Dict[str, Any]] = None
+        self._coldstart_s: Optional[float] = None
+
+    # -- configuration -------------------------------------------------------
+    @property
+    def directory(self) -> Optional[str]:
+        if self._dir is not None:
+            return self._dir or None
+        d = str(_flags.flag('FLAGS_program_store_dir') or '')
+        return d or None
+
+    @property
+    def persistent(self) -> bool:
+        return self.directory is not None
+
+    def configure(self, directory: Optional[str]):
+        """Point the store at a directory ('' / None disables the
+        persistent tier; the in-memory tier is unaffected). Enabling
+        also points jax's persistent compilation cache at
+        `<directory>/xla` — the second half of the warm-restart path:
+        our manifests carry the traced program, the XLA cache carries
+        its compiled bytes."""
+        self._dir = directory if directory else ''
+        try:
+            if directory:
+                os.makedirs(directory, exist_ok=True)
+                jax.config.update('jax_compilation_cache_dir',
+                                  os.path.join(directory, 'xla'))
+                # cache every program, however small/fast: the
+                # zero-compile warm guard covers incidental converts too
+                jax.config.update(
+                    'jax_persistent_cache_min_compile_time_secs', 0.0)
+                jax.config.update(
+                    'jax_persistent_cache_min_entry_size_bytes', 0)
+            else:
+                jax.config.update('jax_compilation_cache_dir', None)
+            # jax memoizes "is the cache used" at the FIRST compile of
+            # the process — a store configured after any compile would
+            # silently never cache. Reset so the next compile re-reads
+            # the (re)configured directory.
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass   # an older jax without these knobs still gets the
+            # stablehlo tier (warm restarts then skip tracing only)
+        return self
+
+    def refresh_fingerprint(self):
+        """Recompute the backend fingerprint (the elastic layer calls
+        this after a re-mesh: device count changed, so entries written
+        under the old topology must stop matching) and drop in-memory
+        entries that no longer match."""
+        with self._lock:
+            self._fingerprint = backend_fingerprint()
+            stale = [k for k, e in self._mem.items()
+                     if e.fingerprint != self._fingerprint]
+            for k in stale:
+                del self._mem[k]
+            self._invalidated += len(stale)
+        if stale:
+            _obs.emit('program_store_invalidate', entries=len(stale),
+                      reason='fingerprint_change')
+        return len(stale)
+
+    # -- metrics/events helpers ---------------------------------------------
+    def _counter(self, name, help_, **labels):
+        if not _obs.enabled():
+            return None
+        reg = _obs.get_registry()
+        if labels:
+            return reg.counter(name, help_,
+                               tuple(sorted(labels))).labels(**labels)
+        return reg.counter(name, help_)
+
+    def _note_hit(self, name: str, tier: str, fmt: str = ''):
+        with self._lock:
+            if tier == 'memory':
+                self._hits_memory += 1
+            else:
+                self._hits_disk += 1
+        c = self._counter('paddle_program_cache_hits_total',
+                          'program-store hits by tier', tier=tier)
+        if c is not None:
+            c.inc()
+        _obs.emit('program_cache_hit', program=name, tier=tier,
+                  **({'format': fmt} if fmt else {}))
+
+    def _note_miss(self, name: str):
+        with self._lock:
+            self._misses += 1
+        c = self._counter('paddle_program_cache_misses_total',
+                          'program-store misses (fresh compiles)')
+        if c is not None:
+            c.inc()
+        _obs.emit('program_cache_miss', program=name)
+
+    def _note_reject(self, name: str, path: str, reason: str,
+                     detail: str = ''):
+        with self._lock:
+            self._rejects += 1
+        c = self._counter('paddle_program_cache_rejects_total',
+                          'persisted entries rejected at load',
+                          reason=reason)
+        if c is not None:
+            c.inc()
+        _obs.emit('program_cache_reject', program=name, path=path,
+                  reason=reason, **({'detail': detail} if detail else {}))
+
+    # -- disk tier -----------------------------------------------------------
+    def _paths(self, key: str):
+        d = self.directory
+        return (os.path.join(d, f'{key}.bin'),
+                os.path.join(d, f'{key}.json'))
+
+    def _save_disk(self, key: str, name: str, kind: str, payload: bytes,
+                   donate_argnums=()) -> Optional[str]:
+        """Persist one exported program: payload first, manifest second,
+        both through atomic renames (a crash between the two leaves a
+        manifest-less payload, which the load path treats as absent; a
+        racing writer's os.replace wins wholesale — either way every
+        committed entry is internally consistent)."""
+        d = self.directory
+        if d is None:
+            return None
+        try:
+            os.makedirs(d, exist_ok=True)
+            fmt = 'stablehlo'
+            bin_path, man_path = self._paths(key)
+            nonce = f'.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp'
+            tmp_bin = bin_path + nonce
+            with open(tmp_bin, 'wb') as f:
+                f.write(payload)
+            os.replace(tmp_bin, bin_path)
+            manifest = {
+                'version': _MANIFEST_VERSION,
+                'key': key,
+                'name': name,
+                'kind': kind,
+                'format': fmt,
+                'sha256': hashlib.sha256(payload).hexdigest(),
+                'size': len(payload),
+                'donate_argnums': list(donate_argnums),
+                'fingerprint': self._fingerprint,
+                'created': time.time(),
+            }
+            tmp_man = man_path + nonce
+            with open(tmp_man, 'w') as f:
+                json.dump(manifest, f, indent=1)
+            os.replace(tmp_man, man_path)
+            with self._lock:
+                self._persisted += 1
+            _obs.emit('program_store_persist', program=name, format=fmt,
+                      bytes=len(payload))
+            return fmt
+        except Exception as exc:
+            # persistence is an optimization: failing to write must
+            # never fail the call that just compiled successfully
+            with self._lock:
+                self._persist_skips += 1
+            _obs.emit('program_store_persist_skipped', program=name,
+                      error=type(exc).__name__)
+            return None
+
+    def _load_disk(self, key: str):
+        """Integrity-verified load of one persisted entry. Returns a
+        `_StoreEntry` or None; NEVER raises. Every rejection emits
+        `program_cache_reject` with its reason."""
+        d = self.directory
+        if d is None:
+            return None
+        bin_path, man_path = self._paths(key)
+        if not os.path.exists(man_path):
+            return None   # absent (or uncommitted half-write): plain miss
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except Exception as exc:
+            self._note_reject(key, man_path, 'manifest_unreadable',
+                              type(exc).__name__)
+            return None
+        name = str(manifest.get('name', key))
+        if manifest.get('version') != _MANIFEST_VERSION:
+            self._note_reject(name, man_path, 'manifest_version')
+            return None
+        if manifest.get('fingerprint') != self._fingerprint:
+            self._note_reject(name, man_path, 'fingerprint')
+            return None
+        try:
+            with open(bin_path, 'rb') as f:
+                payload = f.read()
+        except OSError:
+            self._note_reject(name, bin_path, 'payload_missing')
+            return None
+        if hashlib.sha256(payload).hexdigest() != manifest.get('sha256'):
+            self._note_reject(name, bin_path, 'checksum')
+            return None
+        fmt = manifest.get('format', '')
+        try:
+            if fmt == 'stablehlo':
+                call = _load_stablehlo(
+                    payload, bin_path,
+                    tuple(manifest.get('donate_argnums') or ()))
+            else:
+                self._note_reject(name, bin_path, 'format', fmt)
+                return None
+        except ProgramDeserializeError as exc:
+            self._note_reject(name, bin_path, 'deserialize', exc.reason)
+            return None
+        except Exception as exc:   # belt and braces: load NEVER raises
+            self._note_reject(name, bin_path, 'deserialize',
+                              type(exc).__name__)
+            return None
+        return _StoreEntry(key, name, str(manifest.get('kind', 'jit')),
+                           call, 'disk', fmt, self._fingerprint)
+
+    # -- the acquisition path ------------------------------------------------
+    def acquire(self, key: str, name: str, kind: str,
+                record: _cost.ProgramRecord,
+                compile_fn: Callable[[], Any],
+                jitted=None, args=None, persist: bool = True,
+                donate_argnums=()):
+        """Resolve one program key to an executable: memory tier, then
+        the integrity-verified disk tier, then a fresh AOT compile.
+
+        With a persistent store, the fresh compile goes THROUGH the
+        export artifact (trace -> serialize -> compile the exported
+        module) so the cold process compiles the exact module a warm
+        process will deserialize — the XLA persistent cache then serves
+        the warm compile from disk. Export failures fall back to the
+        plain direct compile (memory tier only, note='aot_noexport').
+        Returns None when no AOT path works at all — callers fall back
+        to their plain jitted call."""
+        with self._lock:
+            ent = self._mem.get(key)
+        if ent is not None:
+            self._note_hit(name, 'memory', ent.format)
+            if ent.source == 'disk':
+                record.note = record.note or f'loaded:{ent.format}'
+            return ent.callable
+        ent = self._load_disk(key)
+        if ent is not None:
+            t0 = time.perf_counter()
+            _cost._read_analysis(ent.callable, record)
+            record.note = f'loaded:{ent.format}'
+            with self._lock:
+                self._mem[key] = ent
+            with self.catalog._lock:
+                record.compile_seconds += time.perf_counter() - t0
+            self._note_hit(name, 'disk', ent.format)
+            return ent.callable
+        # cold: compile fresh
+        persisting = (persist and self.persistent
+                      and bool(_flags.flag('FLAGS_program_store'))
+                      and jitted is not None and args is not None)
+        t0 = time.perf_counter()
+        compiled = payload = None
+        fmt = ''
+        if persisting:
+            try:
+                exported = _export_program(jitted, args)
+                payload = exported.serialize()
+                compiled = _compile_exported(exported, donate_argnums)
+                fmt = 'stablehlo'
+            except Exception as exc:
+                _obs.emit('program_store_persist_skipped', program=name,
+                          error=type(exc).__name__)
+        if compiled is None:
+            try:
+                compiled = compile_fn()
+            except Exception:
+                return None   # no AOT path; caller serves the plain call
+            if persisting:
+                record.note = 'aot_noexport'
+        dt = time.perf_counter() - t0
+        with self.catalog._lock:
+            record.compile_count += 1
+            record.compile_seconds += dt
+        _cost._read_analysis(compiled, record)
+        self._note_miss(name)
+        ent = _StoreEntry(key, name, kind, compiled, 'compile', fmt,
+                          self._fingerprint)
+        with self._lock:
+            self._mem[key] = ent
+        if payload is not None:
+            self._save_disk(key, name, kind, payload,
+                            donate_argnums=donate_argnums)
+        return compiled
+
+    # -- warm restart --------------------------------------------------------
+    def preload(self, match: Optional[str] = None) -> Dict[str, Any]:
+        """Bulk-load every committed, fingerprint-matching entry into
+        the in-memory tier (the warm-restart path: a resumed trainer or
+        a cold replica materializes its executables BEFORE serving).
+        Holds the ref-counted `warming` degraded state on /healthz for
+        the duration. Idempotent: already-resident keys are skipped.
+        `match` restricts to names containing the substring."""
+        d = self.directory
+        stats = {'loaded': 0, 'skipped': 0, 'rejected': 0, 'seconds': 0.0}
+        if d is None or not os.path.isdir(d):
+            return stats
+        t0 = time.perf_counter()
+        rejects_before = self._rejects
+        _obs.note_degraded('warming', {'dir': d})
+        try:
+            for fname in sorted(os.listdir(d)):
+                if not fname.endswith('.json') or '.tmp' in fname:
+                    continue
+                key = fname[:-len('.json')]
+                with self._lock:
+                    if key in self._mem:
+                        stats['skipped'] += 1
+                        continue
+                if match is not None:
+                    try:
+                        with open(os.path.join(d, fname)) as f:
+                            if match not in str(json.load(f).get('name')):
+                                stats['skipped'] += 1
+                                continue
+                    except Exception:
+                        pass   # unreadable manifest: let _load_disk reject
+                ent = self._load_disk(key)
+                if ent is None:
+                    continue
+                record = self.catalog.record(ent.name, kind=ent.kind)
+                _cost._read_analysis(ent.callable, record)
+                record.note = f'loaded:{ent.format}'
+                with self._lock:
+                    self._mem[key] = ent
+                self._note_hit(ent.name, 'disk', ent.format)
+                stats['loaded'] += 1
+        finally:
+            _obs.clear_degraded('warming')
+        stats['seconds'] = round(time.perf_counter() - t0, 4)
+        stats['rejected'] = self._rejects - rejects_before
+        try:
+            from ..observability import server as _srv
+            self._coldstart_s = round(
+                time.monotonic() - _srv._START, 4)
+        except Exception:
+            self._coldstart_s = None
+        with self._lock:
+            self._preload = dict(stats)
+        if _obs.enabled():
+            reg = _obs.get_registry()
+            reg.gauge('paddle_program_preload_seconds',
+                      'wall seconds of the last program-store preload'
+                      ).set(stats['seconds'])
+            reg.gauge('paddle_program_preload_loaded',
+                      'programs loaded by the last preload'
+                      ).set(stats['loaded'])
+            if self._coldstart_s is not None:
+                reg.gauge('paddle_coldstart_seconds',
+                          'process start -> program store warm'
+                          ).set(self._coldstart_s)
+        _obs.emit('program_store_preload', **stats)
+        return stats
+
+    # -- wrapping ------------------------------------------------------------
+    def wrap_jit(self, fn, name: Optional[str] = None,
+                 name_fn: Optional[Callable] = None, kind: str = 'jit',
+                 statics: Any = None, persist: bool = True,
+                 donate_argnums=()) -> 'StoredJit':
+        """Enroll a jax.jit'd callable: AOT compile through the store
+        (memory -> disk -> compile), cost attribution folded into the
+        catalog. `statics` names the compile-time constants baked into
+        the program that its input avals cannot see (optimizer
+        hyperparams, model config, engine geometry) — part of the
+        persistent key. `donate_argnums` mirrors the wrapped jit's
+        donation so it survives the export round trip (recorded in the
+        manifest for the warm process)."""
+        return StoredJit(self, fn, name=name, name_fn=name_fn, kind=kind,
+                         statics=statics, persist=persist,
+                         donate_argnums=donate_argnums)
+
+    # -- bookkeeping / reporting --------------------------------------------
+    def program_names(self) -> List[str]:
+        with self._lock:
+            return sorted({e.name for e in self._mem.values()})
+
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{'key': e.key, 'name': e.name, 'kind': e.kind,
+                     'source': e.source, 'format': e.format}
+                    for e in self._mem.values()]
+
+    def disk_entries(self) -> int:
+        d = self.directory
+        if d is None or not os.path.isdir(d):
+            return 0
+        try:
+            return sum(1 for f in os.listdir(d)
+                       if f.endswith('.json') and '.tmp' not in f)
+        except OSError:
+            return 0
+
+    def wipe(self) -> int:
+        """Safely clear the persistent tier (the documented answer to a
+        suspect cache): removes committed entries AND stray tmp files;
+        in-memory executables stay valid."""
+        d = self.directory
+        if d is None or not os.path.isdir(d):
+            return 0
+        n = 0
+        for fname in os.listdir(d):
+            if fname.endswith(('.bin', '.json')) or '.tmp' in fname:
+                try:
+                    os.unlink(os.path.join(d, fname))
+                    n += 1
+                except OSError:
+                    pass
+        _obs.emit('program_store_wipe', files=n, dir=d)
+        return n
+
+    def clear_memory(self):
+        with self._lock:
+            self._mem.clear()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                'persistent': self.persistent,
+                'dir': self.directory,
+                'memory_entries': len(self._mem),
+                'programs': len({e.name for e in self._mem.values()}),
+                'loaded_from_disk': sum(1 for e in self._mem.values()
+                                        if e.source == 'disk'),
+                'hits_memory': self._hits_memory,
+                'hits_disk': self._hits_disk,
+                'misses': self._misses,
+                'rejects': self._rejects,
+                'persisted': self._persisted,
+                'persist_skips': self._persist_skips,
+                'invalidated': self._invalidated,
+                'preload': dict(self._preload) if self._preload else None,
+                'coldstart_seconds': self._coldstart_s,
+            }
+        out['disk_entries'] = self.disk_entries()
+        return out
+
+    def verify_catalog_consistency(self) -> Dict[str, Any]:
+        """The double-attribution guard: every store-owned program is
+        tracked by exactly one catalog record, and no jitted-tier
+        catalog record exists outside the store. (Dispatch-tier records
+        mirror the eager cache and are excluded — the eager tier keeps
+        its own in-process cache and reports through the same catalog.)
+        Returns the comparison; tier-1 asserts the sets match."""
+        store_names = set(self.program_names())
+        catalog_names = {r.name for r in self.catalog.records()
+                         if r.kind != 'dispatch'
+                         and (r.compile_count > 0
+                              or r.note.startswith('loaded:'))}
+        return {
+            'store': sorted(store_names),
+            'catalog': sorted(catalog_names),
+            'only_in_store': sorted(store_names - catalog_names),
+            'only_in_catalog': sorted(catalog_names - store_names),
+            'consistent': store_names == catalog_names,
+        }
+
+    def reset_stats(self):
+        with self._lock:
+            self._hits_memory = self._hits_disk = 0
+            self._misses = self._rejects = 0
+            self._persisted = self._persist_skips = 0
+            self._invalidated = 0
+            self._preload = None
+
+
+class StoredJit:
+    """A jax.jit'd callable enrolled in the program store (the successor
+    of observability.cost.CatalogedJit — same calling contract, same
+    cost attribution, plus the shared memory tier and persistence).
+
+    First call per input signature resolves through the store: an
+    executable already resident (compiled by another wrapper with the
+    same key — e.g. a sibling serving replica) or persisted on disk is
+    reused; otherwise the one AOT `lower().compile()` the plain call
+    would have cost runs here, and its analysis lands in the program
+    record. Any AOT failure falls back to the plain jitted call for
+    that signature ('aot_unavailable')."""
+
+    def __init__(self, store: ProgramStore, fn, name: Optional[str] = None,
+                 name_fn: Optional[Callable] = None, kind: str = 'jit',
+                 statics: Any = None, persist: bool = True,
+                 donate_argnums=()):
+        if name is None and name_fn is None:
+            raise ValueError('StoredJit needs name= or name_fn=')
+        self._store = store
+        self._fn = fn
+        self._name = name
+        self._name_fn = name_fn
+        self._kind = kind
+        self._persist = persist
+        self._donate = tuple(donate_argnums)
+        self._fn_token = code_token(fn)
+        self._statics_token = describe_statics(statics)
+        self._entries: Dict[Any, Any] = {}   # sig -> (record, callable)
+
+    def _signature(self, args):
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        sig = []
+        for leaf in leaves:
+            dt = getattr(leaf, 'dtype', None)
+            if dt is not None:
+                sig.append((tuple(getattr(leaf, 'shape', ())), str(dt),
+                            bool(getattr(leaf, 'weak_type', False))))
+            else:
+                sig.append(('py', type(leaf)))
+        key = (treedef, tuple(sig))
+        hash(key)
+        return key
+
+    def _build(self, key, args):
+        if self._name is not None:
+            name = self._name
+        else:
+            try:
+                name = self._name_fn(args)
+            except Exception:
+                name = f'{self._kind}:unnamed'   # naming must never fail
+        record = self._store.catalog.record(name, kind=self._kind)
+        call = self._fn
+        if key is not None:
+            try:
+                skey = store_key(name, self._fn_token,
+                                 self._statics_token, args)
+            except Exception:
+                skey = None
+            got = None
+            if skey is not None and bool(_flags.flag('FLAGS_program_store')):
+                got = self._store.acquire(
+                    skey, name, self._kind, record,
+                    compile_fn=lambda: self._fn.lower(*args).compile(),
+                    jitted=self._fn, args=args, persist=self._persist,
+                    donate_argnums=self._donate)
+            else:
+                # store bypassed: keep the plain AOT-compile behavior
+                t0 = time.perf_counter()
+                try:
+                    got = self._fn.lower(*args).compile()
+                    dt = time.perf_counter() - t0
+                    with self._store.catalog._lock:
+                        record.compile_count += 1
+                        record.compile_seconds += dt
+                    _cost._read_analysis(got, record)
+                except Exception:
+                    got = None
+            if got is not None:
+                call = got
+            else:
+                record.note = 'aot_unavailable'
+            self._entries[key] = (record, call)
+        return record, call
+
+    def __call__(self, *args):
+        try:
+            key = self._signature(args)
+        except Exception:
+            key = None
+        entry = self._entries.get(key) if key is not None else None
+        t0 = time.perf_counter()
+        if entry is None:
+            record, call = self._build(key, args)
+        else:
+            record, call = entry
+        out = call(*args)
+        dt = time.perf_counter() - t0
+        with self._store.catalog._lock:
+            record.invocations += 1
+            record.host_seconds += dt
+        return out
+
+    # the wrapped object still answers AOT introspection (TrainStep's
+    # memory_analysis does `self._jitted.lower(...)`); the lowering
+    # cache makes that free after the wrapper's own compile
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+_store: Optional[ProgramStore] = None
+_store_lock = threading.Lock()
+
+
+def get_store() -> ProgramStore:
+    global _store
+    with _store_lock:
+        if _store is None:
+            _store = ProgramStore()
+            d = _store.directory
+            if d:   # flag/env-configured: engage the full persistent
+                _store.configure(d)   # tier incl. the XLA cache dir
+        return _store
+
+
+def configure(directory: Optional[str]) -> ProgramStore:
+    """Point the process-wide store at `directory` (None/'' = memory
+    only). The env/flag `FLAGS_program_store_dir` is the declarative
+    form; this is the programmatic one (examples' --program-store)."""
+    return get_store().configure(directory)

@@ -260,6 +260,15 @@ class ElasticTrainStep:
                 host = self.capture_host_state() if restore_fn is None \
                     else None
                 fleet.rebuild_mesh(devices, reason=reason)
+                # executables compiled/persisted under the old topology
+                # no longer match: refresh the program-store fingerprint
+                # so stale in-memory entries drop and stale disk entries
+                # are rejected (not loaded) after the transition
+                try:
+                    from .. import programs as _programs
+                    _programs.get_store().refresh_fingerprint()
+                except Exception:
+                    pass   # store trouble must never fail a re-mesh
                 self._inner = None
                 if restore_fn is not None:
                     restore_fn()

@@ -178,18 +178,47 @@ class InferenceEngine:
 
         self._trace_counts = collections.Counter()
         self._counts = collections.Counter()
-        # enrolled in the ProgramCatalog: per-program FLOPs/bytes/peak
+        # enrolled in the program store: per-program FLOPs/bytes/peak
         # attribution for the decode block and each prefill bucket, off
-        # the same single compile each program costs anyway
-        cat = _obs.program_catalog()
-        self._decode_jit = cat.wrap_jit(
+        # the same single compile each program costs anyway — and, with
+        # a persistent store, a cold replica LOADS these instead of
+        # compiling. The statics cover what the avals cannot: the model
+        # body/config and the engine geometry (decode_block is a scan
+        # length, invisible in any input aval). Sibling replicas over
+        # the same model produce identical keys, so N replicas compile
+        # (or load) each program once.
+        from .. import programs as _programs
+        store = _programs.get_store()
+        engine_statics = {
+            'model': type(model).__qualname__,
+            'model_src': _programs.code_token(type(model)),
+            'config': _programs.describe_statics(cfg),
+            'num_slots': self.pool.num_slots,
+            'max_length': self.pool.max_length,
+            'decode_block': self.decode_block,
+        }
+        self._decode_jit = store.wrap_jit(
             jax.jit(self._decode_block_fn), name='serving.decode_block',
-            kind='serving')
-        self._prefill_jit = cat.wrap_jit(   # 1 trace per bucket
+            kind='serving', statics=engine_statics)
+        self._prefill_jit = store.wrap_jit(   # 1 trace per bucket
             jax.jit(self._prefill_fn),
             name_fn=lambda args: f'serving.prefill_{args[5].shape[1]}',
-            kind='serving')
+            kind='serving', statics=engine_statics)
         self._init_metrics()
+        if store.persistent:
+            # cold-replica warm start: materialize persisted serving
+            # executables BEFORE the first request (holds the
+            # ref-counted /healthz `warming` state while loading);
+            # idempotent, so sibling replicas after the first skip it
+            self.preload_programs()
+
+    def preload_programs(self) -> dict:
+        """Bulk-load this engine's persisted executables (decode block,
+        prefill buckets) from the program store into memory, so the
+        first submitted request decodes instead of compiling. No-op
+        without a persistent store."""
+        from .. import programs as _programs
+        return _programs.get_store().preload(match='serving.')
 
     # ------------------------------------------------------------------
     # metrics

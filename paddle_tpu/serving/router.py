@@ -193,14 +193,25 @@ class Replica:
 
 class ReplicaSet:
     """N `InferenceEngine` replicas over the same model weights —
-    independent slot pools and compiled programs, one shared parameter
-    snapshot. `breaker_kwargs` feeds every replica's CircuitBreaker
-    (tests inject clocks/thresholds here)."""
+    independent slot pools, one shared parameter snapshot, and ONE
+    shared program store: sibling replicas produce identical program
+    keys, so the fleet compiles (or, with a persistent store, loads
+    from disk) each decode/prefill executable exactly once instead of
+    once per replica. `breaker_kwargs` feeds every replica's
+    CircuitBreaker (tests inject clocks/thresholds here)."""
 
     def __init__(self, model, num_replicas: int = 2,
                  breaker_kwargs: Optional[dict] = None, **engine_kwargs):
         if num_replicas < 1:
             raise ValueError('num_replicas must be >= 1')
+        from .. import programs as _programs
+        store = _programs.get_store()
+        if store.persistent:
+            # one bulk preload for the whole fleet (each engine's own
+            # preload is then an idempotent no-op); holds the
+            # ref-counted `warming` degraded state on /healthz so the
+            # router reports not-ready during the bulk load
+            store.preload(match='serving.')
         self.replicas: List[Replica] = []
         for i in range(int(num_replicas)):
             eng = InferenceEngine(model, **engine_kwargs)

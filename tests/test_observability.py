@@ -542,6 +542,11 @@ class TestEndToEnd:
         import runpy
         import os
         obs.get_registry().reset()
+        # the program store shares executables process-wide: drop its
+        # memory tier so this run really compiles (the compile counters
+        # below are the point of the test)
+        from paddle_tpu import programs
+        programs.get_store().clear_memory()
         mod = runpy.run_path(os.path.join(
             os.path.dirname(__file__), '..', 'examples', 'train_gpt.py'))
         mod['main'](steps=6)

@@ -1,0 +1,677 @@
+"""ISSUE-8: unified persistent program store.
+
+Tentpole coverage: compile -> persist -> warm-load round trips with
+zero XLA backend compiles and bit-identical outputs; the corruption
+gauntlet (truncated entry, bit-flipped payload, checksum mismatch,
+fingerprint skew, half-written entry from a killed writer, racing
+writers) each degrading to recompile-and-continue with
+`program_cache_reject` events and counters, never an unhandled
+exception; warm-restart semantics for both a trainer (resume='auto')
+and a serving engine; the ref-counted /healthz `warming` state during
+bulk preload; the catalog==store no-double-attribution guard; the
+dispatch-cache LRU satellite; the typed `ProgramDeserializeError` in
+jit.load; and the bench coldstart tier-1 guards.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import debug, jit, observability as obs, programs
+from paddle_tpu.flags import set_flags
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.programs import ProgramDeserializeError
+from paddle_tpu.serving import InferenceEngine, SamplingParams
+
+NO_EOS = -1
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def pstore(tmp_path):
+    """The process-wide store pointed at a private tmp dir; teardown
+    restores the previous directory (and detaches the XLA cache) and
+    the previous in-memory entries (other tests' executables stay
+    resident)."""
+    store = programs.get_store()
+    saved_dir = store._dir
+    with store._lock:
+        snap = dict(store._mem)
+    store.configure(str(tmp_path / 'pstore'))
+    yield store
+    with store._lock:
+        store._mem.clear()
+        store._mem.update(snap)
+    store.configure(None)
+    store._dir = saved_dir
+
+
+def _compile_marks(reg):
+    return (reg.value('paddle_jit_compiles_total'),
+            reg.value('paddle_jit_cache_hits_total'))
+
+
+def _real_compiles(reg, marks):
+    """XLA compiles that actually ran since `marks` — backend-compile
+    ticks not served by the persistent compilation cache."""
+    c0, h0 = marks
+    return ((reg.value('paddle_jit_compiles_total') - c0)
+            - (reg.value('paddle_jit_cache_hits_total') - h0))
+
+
+@pytest.fixture(scope='module')
+def gpt():
+    paddle.seed(7)
+    return GPTForCausalLM(GPTConfig.tiny()).eval()
+
+
+def _wrap(store, tag, c=2.0):
+    """A distinct store-enrolled program per tag (same source, distinct
+    statics -> distinct persistent key)."""
+    def f(x, y):
+        return jnp.sin(x) @ y + c
+    return store.wrap_jit(jax.jit(f), name=f'test.{tag}', kind='jit',
+                          statics={'tag': tag, 'c': c})
+
+
+def _args():
+    return jnp.ones((4, 4)), jnp.full((4, 4), 0.5)
+
+
+def _populate(store, tag):
+    """Compile + persist one entry; returns (reference output, args)."""
+    w = _wrap(store, tag)
+    x, y = _args()
+    return np.asarray(w(x, y)), (x, y)
+
+
+def _entry_files(store, tag=None):
+    d = store.directory
+    mans = sorted(f for f in os.listdir(d) if f.endswith('.json'))
+    if tag is not None:
+        mans = [f for f in mans
+                if json.load(open(os.path.join(d, f)))['name']
+                == f'test.{tag}']
+    assert mans, f'no committed entries in {d}'
+    man = os.path.join(d, mans[0])
+    return man[:-len('.json')] + '.bin', man
+
+
+def _reject_total(reason=None):
+    reg = obs.get_registry()
+    fam = reg.get('paddle_program_cache_rejects_total')
+    if fam is None:
+        return 0.0
+    if reason is None:
+        return sum(c.value for c in fam._children.values())
+    return reg.value('paddle_program_cache_rejects_total', reason=reason)
+
+
+def _recent_events(name):
+    return [e for e in obs.get_event_log().events() if e.get('name') == name]
+
+
+# ---------------------------------------------------------------------------
+# round trip: compile -> persist -> warm load
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+    def test_compile_persists_and_warm_loads_with_zero_compiles(self, pstore):
+        ref, (x, y) = _populate(pstore, 'rt')
+        assert pstore.disk_entries() >= 1
+        bin_path, man_path = _entry_files(pstore, 'rt')
+        man = json.load(open(man_path))
+        assert man['sha256'] and man['fingerprint']['jax']
+        # simulated restart: drop the memory tier, rebuild the wrapper
+        # from a NEW function object — only the disk knows the program
+        pstore.clear_memory()
+        reg = obs.get_registry()
+        marks = _compile_marks(reg)
+        w2 = _wrap(pstore, 'rt')
+        out = np.asarray(w2(x, y))
+        assert _real_compiles(reg, marks) == 0, \
+            'warm load must not pay a real XLA compile'
+        assert (out == ref).all(), 'warm output must be bit-identical'
+        assert pstore.stats()['hits_disk'] >= 1
+        assert _recent_events('program_cache_hit')
+
+    def test_memory_tier_shared_across_wrappers(self, pstore):
+        ref, (x, y) = _populate(pstore, 'share')
+        misses = pstore.stats()['misses']
+        w2 = _wrap(pstore, 'share')   # sibling wrapper, identical key
+        out = np.asarray(w2(x, y))
+        assert (out == ref).all()
+        st = pstore.stats()
+        assert st['misses'] == misses, 'sibling wrapper recompiled'
+        assert st['hits_memory'] >= 1
+
+    def test_store_without_directory_writes_nothing(self, pstore):
+        d = pstore.directory
+        pstore.configure(None)
+        try:
+            w = _wrap(pstore, 'nodisk')
+            x, y = _args()
+            w(x, y)
+            assert not pstore.persistent
+        finally:
+            pstore.configure(d)
+        assert not [f for f in os.listdir(d) if 'nodisk' in f]
+
+    def test_flag_bypass_keeps_serving(self, pstore):
+        set_flags({'FLAGS_program_store': False})
+        try:
+            before = pstore.stats()['memory_entries']
+            w = _wrap(pstore, 'bypass')
+            x, y = _args()
+            out = np.asarray(w(x, y))
+            assert np.isfinite(out).all()
+            assert pstore.stats()['memory_entries'] == before, \
+                'bypassed call must not touch the store'
+        finally:
+            set_flags({'FLAGS_program_store': True})
+
+
+# ---------------------------------------------------------------------------
+# the corruption gauntlet: every poisoning degrades to recompile
+# ---------------------------------------------------------------------------
+
+class TestCorruptionGauntlet:
+    def _assert_recovers(self, pstore, tag, ref, args, reason):
+        """After the poisoning: the load path rejects (event+counter,
+        right reason), the call transparently recompiles, the output is
+        correct, and the store re-heals the disk entry."""
+        rej0 = _reject_total(reason)
+        pstore.clear_memory()
+        out = np.asarray(_wrap(pstore, tag)(*args))   # must NOT raise
+        assert (out == ref).all()
+        assert _reject_total(reason) == rej0 + 1, \
+            f'expected one {reason} reject'
+        ev = _recent_events('program_cache_reject')
+        assert any(e.get('attrs', {}).get('reason', '').startswith(reason)
+                   for e in ev)
+        # self-healed: the fresh compile re-persisted a loadable entry
+        pstore.clear_memory()
+        reg = obs.get_registry()
+        marks = _compile_marks(reg)
+        out2 = np.asarray(_wrap(pstore, tag)(*args))
+        assert (out2 == ref).all()
+        assert _real_compiles(reg, marks) == 0, \
+            'store did not re-heal after the reject'
+
+    def test_truncated_payload(self, pstore):
+        ref, args = _populate(pstore, 'trunc')
+        bin_path, _ = _entry_files(pstore, 'trunc')
+        blob = open(bin_path, 'rb').read()
+        with open(bin_path, 'wb') as f:
+            f.write(blob[:max(1, len(blob) // 2)])
+        self._assert_recovers(pstore, 'trunc', ref, args, 'checksum')
+
+    def test_bit_flipped_payload(self, pstore):
+        ref, args = _populate(pstore, 'flip')
+        bin_path, _ = _entry_files(pstore, 'flip')
+        blob = bytearray(open(bin_path, 'rb').read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(bin_path, 'wb') as f:
+            f.write(bytes(blob))
+        self._assert_recovers(pstore, 'flip', ref, args, 'checksum')
+
+    def test_manifest_checksum_mismatch(self, pstore):
+        ref, args = _populate(pstore, 'sum')
+        _, man_path = _entry_files(pstore, 'sum')
+        man = json.load(open(man_path))
+        man['sha256'] = '0' * 64
+        json.dump(man, open(man_path, 'w'))
+        self._assert_recovers(pstore, 'sum', ref, args, 'checksum')
+
+    def test_fingerprint_skew_stale_jaxlib(self, pstore):
+        ref, args = _populate(pstore, 'skew')
+        _, man_path = _entry_files(pstore, 'skew')
+        man = json.load(open(man_path))
+        man['fingerprint']['jaxlib'] = '0.0.1-stale'
+        json.dump(man, open(man_path, 'w'))
+        self._assert_recovers(pstore, 'skew', ref, args, 'fingerprint')
+
+    def test_garbage_manifest(self, pstore):
+        ref, args = _populate(pstore, 'garble')
+        _, man_path = _entry_files(pstore, 'garble')
+        with open(man_path, 'w') as f:
+            f.write('{not json')
+        self._assert_recovers(pstore, 'garble', ref, args,
+                              'manifest_unreadable')
+
+    def test_payload_missing(self, pstore):
+        ref, args = _populate(pstore, 'gone')
+        bin_path, _ = _entry_files(pstore, 'gone')
+        os.unlink(bin_path)
+        self._assert_recovers(pstore, 'gone', ref, args, 'payload_missing')
+
+    def test_checksummed_garbage_rejects_at_deserialize(self, pstore):
+        import hashlib
+        ref, args = _populate(pstore, 'pickle')
+        bin_path, man_path = _entry_files(pstore, 'pickle')
+        garbage = b'\x80\x04not an executable at all'
+        with open(bin_path, 'wb') as f:
+            f.write(garbage)
+        man = json.load(open(man_path))
+        man['sha256'] = hashlib.sha256(garbage).hexdigest()
+        json.dump(man, open(man_path, 'w'))
+        self._assert_recovers(pstore, 'pickle', ref, args, 'deserialize')
+
+    def test_half_written_entry_from_killed_writer(self, pstore):
+        """A writer killed between payload and manifest leaves a
+        manifest-less payload plus stray tmp files: the loader treats
+        the entry as absent (clean miss, no crash) and the next compile
+        commits over it."""
+        ref, args = _populate(pstore, 'half')
+        bin_path, man_path = _entry_files(pstore, 'half')
+        os.unlink(man_path)                    # killed before commit
+        with open(bin_path + '.1234.deadbeef.tmp', 'wb') as f:
+            f.write(b'partial')               # killed mid-payload-write
+        pstore.clear_memory()
+        rej0 = _reject_total()
+        out = np.asarray(_wrap(pstore, 'half')(*args))
+        assert (out == ref).all()
+        assert _reject_total() == rej0, 'uncommitted entry is not a reject'
+        # committed again; stray tmp ignored by preload too
+        assert os.path.exists(man_path)
+        pstore.clear_memory()
+        st = pstore.preload()
+        assert st['loaded'] >= 1
+
+    def test_racing_writers_same_store_dir(self, pstore):
+        """Two processes (modeled as two independent ProgramStore
+        instances over one dir) compile and persist the same key
+        concurrently: atomic renames make last-writer-wins safe — both
+        calls succeed, the committed entry verifies, and a third
+        'process' warm-loads it."""
+        stores = [programs.ProgramStore(directory=pstore.directory)
+                  for _ in range(2)]
+        x, y = _args()
+        outs, errs = [None, None], []
+
+        def worker(i):
+            try:
+                outs[i] = np.asarray(_wrap(stores[i], 'race')(x, y))
+            except BaseException as e:   # noqa: BLE001
+                errs.append(e)
+        ts = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs, f'racing writer raised: {errs}'
+        assert (outs[0] == outs[1]).all()
+        reader = programs.ProgramStore(directory=pstore.directory)
+        reg = obs.get_registry()
+        marks = _compile_marks(reg)
+        out3 = np.asarray(_wrap(reader, 'race')(x, y))
+        assert (out3 == outs[0]).all()
+        assert _real_compiles(reg, marks) == 0
+
+    def test_wipe_clears_committed_and_tmp(self, pstore):
+        _populate(pstore, 'wipe')
+        d = pstore.directory
+        with open(os.path.join(d, 'stray.0.aaaa.tmp'), 'wb') as f:
+            f.write(b'x')
+        assert pstore.wipe() >= 3   # bin + manifest + stray tmp
+        assert pstore.disk_entries() == 0
+
+
+# ---------------------------------------------------------------------------
+# preload / warming / invalidation
+# ---------------------------------------------------------------------------
+
+class TestPreload:
+    def test_preload_holds_refcounted_warming_state(self, pstore,
+                                                    monkeypatch):
+        _populate(pstore, 'warm1')
+        _populate(pstore, 'warm2')
+        pstore.clear_memory()
+        seen = []
+        orig = programs.ProgramStore._load_disk
+
+        def spy(self, key):
+            seen.append(sorted(obs.degraded_states()))
+            return orig(self, key)
+        monkeypatch.setattr(programs.ProgramStore, '_load_disk', spy)
+        st = pstore.preload()
+        assert st['loaded'] == 2
+        assert seen and all('warming' in s for s in seen), \
+            '/healthz must report warming during the bulk load'
+        assert 'warming' not in obs.degraded_states(), \
+            'warming must clear when preload finishes'
+        assert obs.health()['status'] == 'ok' or \
+            'warming' not in obs.health()['states']
+
+    def test_preload_idempotent_and_coldstart_metric(self, pstore):
+        _populate(pstore, 'once')
+        pstore.clear_memory()
+        st1 = pstore.preload()
+        assert st1['loaded'] >= 1
+        st2 = pstore.preload()
+        assert st2['loaded'] == 0 and st2['skipped'] >= 1
+        assert pstore.stats()['coldstart_seconds'] is not None
+        assert obs.get_registry().value('paddle_coldstart_seconds') > 0
+        text = debug.observability_summary()
+        assert 'program store:' in text and 'cold start' in text
+
+    def test_preload_match_filter(self, pstore):
+        _populate(pstore, 'pick_me')
+        _populate(pstore, 'not_me')
+        pstore.clear_memory()
+        st = pstore.preload(match='test.pick_me')
+        assert st['loaded'] == 1
+
+    def test_refresh_fingerprint_drops_stale_entries(self, pstore):
+        _populate(pstore, 'stale')
+        key = next(iter(pstore._mem))
+        pstore._mem[key].fingerprint = {'jaxlib': 'other'}
+        dropped = pstore.refresh_fingerprint()
+        assert dropped == 1
+        assert pstore.stats()['invalidated'] >= 1
+        assert _recent_events('program_store_invalidate')
+
+
+# ---------------------------------------------------------------------------
+# warm restart: trainer
+# ---------------------------------------------------------------------------
+
+def _mlp_model():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    m = paddle.Model(net)
+    m.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    return m
+
+
+def _mlp_data(n=8):
+    rng = np.random.RandomState(0)
+    from paddle_tpu.io import DataLoader, TensorDataset
+    ds = TensorDataset([
+        paddle.to_tensor(rng.standard_normal((n, 16)).astype('float32')),
+        paddle.to_tensor(rng.randint(0, 4, (n,)))])
+    return DataLoader(ds, batch_size=4, shuffle=False)
+
+
+class TestWarmRestartTrainer:
+    def test_resume_auto_zero_compiles_bit_exact(self, pstore, tmp_path):
+        ckpt = str(tmp_path / 'ckpt')
+        # uninterrupted reference: 4 steps
+        ref = _mlp_model().fit(_mlp_data(), epochs=2, verbose=0)
+        # leg 1: 2 steps (1 epoch), checkpointed, programs persisted
+        m1 = _mlp_model()
+        m1.fit(_mlp_data(), epochs=1, verbose=0, ckpt_dir=ckpt)
+        assert pstore.disk_entries() >= 1
+        # 'process restart': fresh Model, empty store memory
+        pstore.clear_memory()
+        m2 = _mlp_model()
+        reg = obs.get_registry()
+        marks = _compile_marks(reg)
+        hist = m2.fit(_mlp_data(), epochs=2, verbose=0, ckpt_dir=ckpt,
+                      resume='auto')
+        assert _real_compiles(reg, marks) == 0, \
+            'warm resume must not pay any real XLA compile'
+        assert pstore.stats()['hits_disk'] >= 1
+        # the resumed trajectory is bit-exact vs the uninterrupted run
+        assert hist['loss'] == ref['loss'][2:]
+
+    def test_fit_preload_is_noop_without_store_dir(self, tmp_path):
+        store = programs.get_store()
+        saved = store._dir
+        store.configure(None)
+        try:
+            hist = _mlp_model().fit(_mlp_data(), epochs=1, verbose=0)
+            assert len(hist['loss']) == 2
+        finally:
+            store._dir = saved
+
+
+# ---------------------------------------------------------------------------
+# warm restart: serving replica
+# ---------------------------------------------------------------------------
+
+class TestWarmRestartServing:
+    def test_cold_replica_decodes_with_zero_compiles(self, pstore, gpt):
+        prompts = [[1, 2, 3], [5, 6, 7, 8, 9]]
+        sp = [SamplingParams(max_new_tokens=5, eos_token_id=NO_EOS)] * 2
+        eng1 = InferenceEngine(gpt, num_slots=2, max_length=48,
+                               decode_block=2)
+        ref = [h.tokens for h in eng1.generate_many(prompts, sp)]
+        assert pstore.disk_entries() >= 2   # decode block + bucket(s)
+        # 'replica restart': fresh engine, disk-only knowledge
+        pstore.clear_memory()
+        reg = obs.get_registry()
+        marks = _compile_marks(reg)
+        eng2 = InferenceEngine(gpt, num_slots=2, max_length=48,
+                               decode_block=2)
+        got = [h.tokens for h in eng2.generate_many(prompts, sp)]
+        assert _real_compiles(reg, marks) == 0, \
+            'warm replica must not pay any real XLA compile'
+        assert got == ref, 'warm replica outputs must be bit-identical'
+        assert not eng2._trace_counts, \
+            'warm replica must never re-trace python'
+        assert pstore.stats()['hits_disk'] >= 2
+
+    def test_engine_auto_preloads_on_persistent_store(self, pstore, gpt):
+        eng1 = InferenceEngine(gpt, num_slots=2, max_length=48,
+                               decode_block=2)
+        eng1.generate_many(
+            [[4, 4, 4]],
+            [SamplingParams(max_new_tokens=3, eos_token_id=NO_EOS)])
+        pstore.clear_memory()
+        InferenceEngine(gpt, num_slots=2, max_length=48, decode_block=2)
+        assert pstore.stats()['loaded_from_disk'] >= 1, \
+            'engine construction must preload persisted serving programs'
+
+
+# ---------------------------------------------------------------------------
+# satellite: no double attribution (catalog == store)
+# ---------------------------------------------------------------------------
+
+_CONSISTENCY_CHILD = r'''
+import json
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit, observability as obs, programs
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import InferenceEngine, SamplingParams
+
+paddle.seed(0)
+# tier 1: eager dispatch (catalog 'dispatch' records, store-external)
+x = paddle.ones([8, 8])
+for _ in range(3):
+    x = x * 1.0 + 0.5
+# tier 2: jitted train step + to_static
+net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+opt = paddle.optimizer.SGD(learning_rate=0.01,
+                           parameters=net.parameters())
+step = jit.TrainStep(net, lambda o, l: F.cross_entropy(o, l), opt)
+ids = paddle.to_tensor(np.random.RandomState(0).standard_normal(
+    (4, 8)).astype('float32'))
+lab = paddle.to_tensor(np.array([0, 1, 2, 3]))
+step(ids, lab); step(ids, lab)
+
+@paddle.jit.to_static
+def affine(t):
+    return t @ t + 1.0
+affine(paddle.ones([4, 4]))
+# tier 3: the serving engine
+gpt = GPTForCausalLM(GPTConfig.tiny()).eval()
+eng = InferenceEngine(gpt, num_slots=2, max_length=32, decode_block=2)
+eng.generate_many([[1, 2, 3]],
+                  [SamplingParams(max_new_tokens=3, eos_token_id=-1)])
+res = programs.get_store().verify_catalog_consistency()
+cat = obs.program_catalog()
+res['n_dispatch'] = sum(1 for r in cat.records() if r.kind == 'dispatch')
+print(json.dumps(res))
+'''
+
+
+def test_catalog_store_consistency_after_example_flow():
+    """Satellite: once the store owns compilation, every jitted-tier
+    program is tracked by exactly one catalog record — store entry
+    names == catalog record names (dispatch-tier records excluded; they
+    mirror the eager cache through the same catalog). Run in a fresh
+    process so the comparison sees exactly one flow."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    proc = subprocess.run(
+        [sys.executable, '-c', _CONSISTENCY_CHILD],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), '..'))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert res['consistent'], (
+        f"double attribution: only_in_store={res['only_in_store']} "
+        f"only_in_catalog={res['only_in_catalog']}")
+    assert len(res['store']) >= 4   # train_step, to_static, decode, prefill
+    assert 'train_step' in res['store']
+    assert 'serving.decode_block' in res['store']
+    assert any(n.startswith('to_static:') for n in res['store'])
+    assert res['n_dispatch'] >= 1   # eager tier reported, not duplicated
+
+
+# ---------------------------------------------------------------------------
+# satellite: bounded eager dispatch cache (LRU + flag + counter)
+# ---------------------------------------------------------------------------
+
+class TestDispatchLRUBound:
+    def _op_on(self, n):
+        # distinct shape => distinct dispatch key for the same op
+        t = paddle.to_tensor(np.ones(n, np.float32))
+        return (t * 2.0).numpy()
+
+    def test_cap_bounds_cache_and_counts_evictions(self):
+        from paddle_tpu import _dispatch
+        debug.clear_dispatch_cache()
+        debug.reset_dispatch_stats()
+        set_flags({'FLAGS_eager_dispatch_cache_size': 4})
+        try:
+            for n in range(1, 10):
+                self._op_on(n)
+            s = _dispatch.stats()
+            assert s['cache_size'] <= 4, s
+            assert s['evictions'] > 0
+            # the registry mirror exposes the evictions to scrapes
+            obs.get_registry().snapshot()
+            assert obs.get_registry().value(
+                'paddle_dispatch_evictions_total') == s['evictions']
+            text = obs.to_prometheus_text()
+            assert 'paddle_dispatch_evictions_total' in text
+        finally:
+            set_flags({'FLAGS_eager_dispatch_cache_size': 512})
+            debug.clear_dispatch_cache()
+
+    def test_lru_keeps_the_touched_entry(self):
+        from paddle_tpu import _dispatch
+        debug.clear_dispatch_cache()
+        debug.reset_dispatch_stats()
+        set_flags({'FLAGS_eager_dispatch_cache_size': 2})
+        try:
+            self._op_on(2)              # A (miss)
+            self._op_on(3)              # B (miss)
+            self._op_on(2)              # touch A (hit)
+            self._op_on(4)              # C (miss) -> evicts B, not A
+            hits_before = _dispatch.stats()['hits']
+            self._op_on(2)              # A must still be resident
+            assert _dispatch.stats()['hits'] == hits_before + 1, \
+                'LRU evicted the most-recently-touched entry'
+        finally:
+            set_flags({'FLAGS_eager_dispatch_cache_size': 512})
+            debug.clear_dispatch_cache()
+
+
+# ---------------------------------------------------------------------------
+# satellite: typed deserialize error in jit.load
+# ---------------------------------------------------------------------------
+
+class TestJitLoadTyped:
+    def _save(self, tmp_path):
+        paddle.seed(1)
+        net = nn.Linear(4, 2)
+        path = str(tmp_path / 'model')
+        jit.save(net, path, input_spec=[jit.InputSpec([2, 4])])
+        return net, path
+
+    def test_corrupt_artifact_raises_typed_error(self, tmp_path):
+        _, path = self._save(tmp_path)
+        hlo = path + '.pdmodel.stablehlo'
+        blob = open(hlo, 'rb').read()
+        with open(hlo, 'wb') as f:
+            f.write(blob[:len(blob) // 3])
+        rej0 = _reject_total('deserialize')
+        with pytest.raises(ProgramDeserializeError) as ei:
+            jit.load(path)
+        assert ei.value.path == hlo
+        assert ei.value.reason
+        assert _reject_total('deserialize') == rej0 + 1
+        assert _recent_events('program_cache_reject')
+
+    def test_caller_can_fall_back_to_layer_restore(self, tmp_path):
+        net, path = self._save(tmp_path)
+        hlo = path + '.pdmodel.stablehlo'
+        with open(hlo, 'wb') as f:
+            f.write(b'garbage')
+        paddle.seed(2)
+        net2 = nn.Linear(4, 2)
+        try:
+            loaded = jit.load(path)
+        except ProgramDeserializeError:
+            loaded = jit.load(path, net2)   # the documented fallback
+        x = paddle.ones([2, 4])
+        np.testing.assert_allclose(np.asarray(loaded(x).numpy()),
+                                   np.asarray(net(x).numpy()), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 bench guards: coldstart A/B + store-disabled overhead
+# ---------------------------------------------------------------------------
+
+def _bench():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'bench', os.path.join(os.path.dirname(__file__), '..', 'bench.py'))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_coldstart_guard():
+    """Tier-1: the warm arm of the restart A/B pays ZERO XLA compiles
+    in both measured windows (train step, first served tokens) and is
+    bit-identical to the cold arm."""
+    res = _bench().coldstart_ab(steps=2)
+    assert res['warm_train_compiles'] == 0, res
+    assert res['warm_decode_compiles'] == 0, res
+    assert res['cold_train_compiles'] >= 1   # the contrast is real
+    assert res['parity_losses'] and res['parity_tokens'], res
+    assert res['warm_loaded_from_disk'] >= 3
+    assert res['warm_rejects'] == 0
+    assert res['warm_cold_ratio'] > 1.0, res
+
+
+def test_bench_coldstart_overhead_under_3pct():
+    """Tier-1: the store-disabled fallback path (FLAGS_program_store
+    off) costs < 3% vs the enrolled path on a steady-state jitted train
+    loop (same retry protocol as the other overhead guards)."""
+    bench = _bench()
+    res = None
+    for _ in range(3):
+        res = bench.coldstart_overhead_ab(steps=20, trials=2)
+        if res['overhead_pct'] < 3.0:
+            break
+    assert res['overhead_pct'] < 3.0, res

@@ -253,7 +253,10 @@ def test_midflight_admission_reuses_slot_with_zero_recompiles(gpt):
         _prompts([3, 9, 6], seed=1),
         [SamplingParams(max_new_tokens=4, eos_token_id=NO_EOS)] * 3)
     traces = dict(eng.stats()['traces'])
-    assert traces['decode_step'] == 1
+    # 1 trace when this engine compiled the decode block itself; 0 when
+    # the program store handed it a sibling engine's executable (same
+    # model/geometry key) — either way it must never grow below
+    assert traces.get('decode_step', 0) <= 1
     compiles_before = obs.get_registry().value('paddle_jit_compiles_total')
 
     # second wave, same buckets, more requests than slots: every
