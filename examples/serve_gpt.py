@@ -17,6 +17,16 @@ caps — with fast-fail load shedding past `--shed-queue-depth`:
 Tenant spec format: `name:key=value,...;name2:...` with keys
 priority (high|normal|low), rate (requests/sec), burst, concurrency.
 
+Serving latency stack: `--prefix-cache` retains finished prompts' KV in
+a radix cache so shared prefixes (system prompts) prefill once,
+`--prefill-chunk N` splits long prefills into N-token chunks that
+interleave with decode rounds (bounded TTFT for the short requests
+behind them), and `--draft-model tiny|self` enables per-slot
+speculative decoding (greedy outputs stay bit-identical):
+
+    python examples/serve_gpt.py --prefix-cache 0.5 --prefill-chunk 16 \\
+        --draft-model tiny
+
 Live introspection: `--metrics-port 8000` serves the HTTP observability
 endpoint while the engine decodes — /metrics (Prometheus, incl. the
 paddle_serving_* and paddle_router_* families), /healthz (decode-round
@@ -52,11 +62,11 @@ def _make_requests(model, num_requests):
     return out
 
 
-def _serve_single(model, requests):
+def _serve_single(model, requests, engine_kwargs=None):
     # one engine = one slot pool + scheduler; 4 slots serve the whole
     # burst by admitting queued requests as running ones retire
     engine = InferenceEngine(model, num_slots=4, max_length=64,
-                             decode_block=4)
+                             decode_block=4, **(engine_kwargs or {}))
     handles = [engine.submit(p, sp) for p, sp in requests]
 
     # stream the FIRST request token-by-token; the engine advances every
@@ -76,13 +86,26 @@ def _serve_single(model, requests):
           f"{stats['tokens']} tokens, {stats['decode_rounds']} decode "
           f"rounds, prefill buckets traced: "
           f"{sorted(k for k in stats['traces'] if k.startswith('prefill'))}")
+    if 'prefix_cache' in stats:
+        px = stats['prefix_cache']
+        print(f"prefix cache: {px['hits']} hits / {px['misses']} misses, "
+              f"{px['tokens_reused']} tokens reused, "
+              f"{px['retained_slots']}/{px['budget_slots']} retained")
+    if stats['chunk_rounds']:
+        print(f"chunked prefill: {stats['chunked_prefills']} prompts in "
+              f"{stats['chunk_rounds']} chunk rounds")
+    if 'spec' in stats:
+        sp = stats['spec']
+        print(f"speculation (k={sp['k']}): {sp['rounds']} rounds, "
+              f"acceptance {sp['acceptance_rate']:.1%}")
     return handles
 
 
-def _serve_routed(model, requests, replicas, tenants, shed_queue_depth):
+def _serve_routed(model, requests, replicas, tenants, shed_queue_depth,
+                  engine_kwargs=None):
     router = Router(
         ReplicaSet(model, replicas, num_slots=4, max_length=64,
-                   decode_block=4),
+                   decode_block=4, **(engine_kwargs or {})),
         tenants=tenants, shed_queue_depth=shed_queue_depth)
     tenant_names = (sorted(router.tenants.tenants()) or ['default'])
     handles, rejected = [], 0
@@ -110,7 +133,8 @@ def _serve_routed(model, requests, replicas, tenants, shed_queue_depth):
 
 
 def main(num_requests=10, metrics_port=None, replicas=1, tenants=None,
-         shed_queue_depth=None, program_store=None):
+         shed_queue_depth=None, program_store=None, prefix_cache=None,
+         prefill_chunk=None, draft_model=None):
     paddle.seed(0)
     if program_store:
         # persistent program store: a cold replica loads its decode/
@@ -126,11 +150,28 @@ def main(num_requests=10, metrics_port=None, replicas=1, tenants=None,
     model = GPTForCausalLM(GPTConfig.tiny()).eval()
     requests = _make_requests(model, num_requests)
 
+    engine_kwargs = {}
+    if prefix_cache is not None:
+        engine_kwargs['prefix_cache'] = prefix_cache
+    if prefill_chunk is not None:
+        engine_kwargs['prefill_chunk_tokens'] = prefill_chunk
+    if draft_model is not None:
+        if draft_model == 'self':
+            draft = model      # oracle draft: exercises the machinery
+        else:
+            paddle.seed(1)
+            draft = GPTForCausalLM(
+                GPTConfig.tiny(num_hidden_layers=1)).eval()
+        engine_kwargs['draft_model'] = draft
+        engine_kwargs['num_draft_tokens'] = 3
+
     if replicas > 1 or tenants or shed_queue_depth is not None:
         handles = _serve_routed(model, requests, max(replicas, 1),
-                                tenants, shed_queue_depth)
+                                tenants, shed_queue_depth,
+                                engine_kwargs=engine_kwargs)
     else:
-        handles = _serve_single(model, requests)
+        handles = _serve_single(model, requests,
+                                engine_kwargs=engine_kwargs)
     print(debug.observability_summary())
     return handles
 
@@ -147,6 +188,21 @@ if __name__ == '__main__':
     p.add_argument('--shed-queue-depth', type=int, default=None,
                    help='queue depth past which low-priority work is '
                         'shed with a typed AdmissionRejected')
+    p.add_argument('--prefix-cache', type=float, nargs='?', const=0.5,
+                   default=None, metavar='FRACTION',
+                   help='radix prefix cache over the slot pool: shared '
+                        'prompt prefixes prefill once (optional pool '
+                        'fraction for the retention budget, default 0.5)')
+    p.add_argument('--prefill-chunk', type=int, default=None,
+                   metavar='TOKENS',
+                   help='chunked prefill: prompts longer than this '
+                        'prefill across decode rounds instead of '
+                        'stalling in-flight requests')
+    p.add_argument('--draft-model', choices=('tiny', 'self'),
+                   default=None,
+                   help='per-slot speculative decoding: "tiny" builds a '
+                        '1-layer draft, "self" uses the target as an '
+                        'oracle draft (high acceptance demo)')
     p.add_argument('--metrics-port', type=int, default=None,
                    help='serve the HTTP observability endpoint on this '
                         'port while decoding')
@@ -158,4 +214,7 @@ if __name__ == '__main__':
     main(num_requests=args.num_requests, metrics_port=args.metrics_port,
          replicas=args.replicas, tenants=args.tenants,
          shed_queue_depth=args.shed_queue_depth,
-         program_store=args.program_store)
+         program_store=args.program_store,
+         prefix_cache=args.prefix_cache,
+         prefill_chunk=args.prefill_chunk,
+         draft_model=args.draft_model)

@@ -239,7 +239,30 @@ def _observability_data(max_rows: int = 10) -> dict:
             'prefills': int(_labeled_total(
                 reg, 'paddle_serving_prefills_total')),
             'decode_steps': int(reg.value(
-                'paddle_serving_decode_steps_total'))},
+                'paddle_serving_decode_steps_total')),
+            'prefix': {
+                'hits': int(reg.value(
+                    'paddle_serving_prefix_hits_total')),
+                'misses': int(reg.value(
+                    'paddle_serving_prefix_misses_total')),
+                'tokens_reused': int(reg.value(
+                    'paddle_serving_prefix_tokens_reused_total')),
+                'retained_slots': int(reg.value(
+                    'paddle_serving_prefix_retained_slots')),
+                'evictions': int(reg.value(
+                    'paddle_serving_prefix_evictions_total'))},
+            'chunk': {
+                'rounds': int(reg.value(
+                    'paddle_serving_chunk_rounds_total')),
+                'tokens': int(reg.value(
+                    'paddle_serving_chunk_tokens_total'))},
+            'spec': {
+                'rounds': int(reg.value(
+                    'paddle_serving_spec_rounds_total')),
+                'proposed': int(reg.value(
+                    'paddle_serving_spec_proposed_total')),
+                'accepted': int(reg.value(
+                    'paddle_serving_spec_accepted_total'))}},
         'router': _router_data(reg),
         'elastic': _elastic_data(reg),
         'programs': _obs.program_catalog().top_programs(n=max_rows),
@@ -388,6 +411,20 @@ def observability_summary(max_rows: int = 10, as_dict: bool = False):
         f'tpot avg {sv["tpot_avg_ms"]:.2f} ms  '
         f'{sv["prefills"]} prefills  '
         f'{sv["decode_steps"]} decode steps')
+    px, chk, spc = sv['prefix'], sv['chunk'], sv['spec']
+    hit_rate = (px['hits'] / (px['hits'] + px['misses'])
+                if px['hits'] + px['misses'] else 0.0)
+    lines.append(
+        f'    prefix cache: {px["hits"]} hits / {px["misses"]} misses '
+        f'({hit_rate:.1%})  {px["tokens_reused"]} tokens reused  '
+        f'{px["retained_slots"]} retained  {px["evictions"]} evicted')
+    spec_rate = (spc['accepted'] / spc['proposed']
+                 if spc['proposed'] else 0.0)
+    lines.append(
+        f'    chunked prefill: {chk["rounds"]} rounds '
+        f'{chk["tokens"]} tokens  |  speculation: {spc["rounds"]} '
+        f'rounds  accept {spc["accepted"]}/{spc["proposed"]} '
+        f'({spec_rate:.1%})')
     rt = d['router']
     lines.append(
         f'  router: {rt["replicas"]} replicas '

@@ -18,10 +18,35 @@ import jax
 import jax.numpy as jnp
 
 from .. import framework
+from .. import observability as _obs
 from ..jit import functional_call, functional_method, functional_state
 from ..tensor import Tensor, to_jax
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _record_spec_stats(rounds: int, emitted: int, accepted: int,
+                       proposed: int, source: str = 'generate'):
+    """Mirror speculative-decode stats into the shared registry
+    (`paddle_spec_*`, labeled by source) so standalone
+    `speculative_generate()` and the serving engine's per-slot
+    speculation report acceptance through ONE surface instead of
+    ad-hoc per-call stats dicts."""
+    if not _obs.enabled():
+        return
+    reg = _obs.get_registry()
+    reg.counter('paddle_spec_rounds_total',
+                'speculative-decode rounds by source',
+                ('source',)).labels(source=source).inc(rounds)
+    reg.counter('paddle_spec_emitted_tokens_total',
+                'tokens emitted by speculative decode by source',
+                ('source',)).labels(source=source).inc(emitted)
+    reg.counter('paddle_spec_proposed_drafts_total',
+                'draft tokens proposed by source',
+                ('source',)).labels(source=source).inc(proposed)
+    reg.counter('paddle_spec_accepted_drafts_total',
+                'draft tokens accepted by source',
+                ('source',)).labels(source=source).inc(accepted)
 
 # warn-once latch for the prompt-already-at-max_length case (tests reset it)
 _warned_max_length = [False]
@@ -640,6 +665,7 @@ class GenerationMixin:
         e_raw = int(emitted)
         emitted_i = min(e_raw, max_new_tokens)
         accepted = max(e_raw - 1 - rounds_i, 0)
+        _record_spec_stats(rounds_i, emitted_i, accepted, rounds_i * k)
         return Tensor(out), {
             'rounds': rounds_i, 'emitted': emitted_i,
             'target_forwards_saved': accepted,
@@ -992,6 +1018,7 @@ class Seq2SeqGenerationMixin:
         e_raw = int(emitted)
         emitted_i = min(e_raw, max_new_tokens)
         accepted = max(e_raw - 1 - rounds_i, 0)
+        _record_spec_stats(rounds_i, emitted_i, accepted, rounds_i * k)
         return Tensor(out), {
             'rounds': rounds_i, 'emitted': emitted_i,
             'target_forwards_saved': accepted,

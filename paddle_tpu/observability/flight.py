@@ -21,6 +21,7 @@ runtime already emits (`hang_suspected`, `loss_spike`, `bad_step`,
   trace.json     the same window as a chrome trace
   metrics.json   full registry snapshot
   programs.json  ProgramCatalog snapshot (per-program cost attribution)
+  prefix_cache.json  serving radix-prefix-cache state (when serving)
   summary.txt    debug.observability_summary()
 
 Auto-dumps are debounced (`min_interval_s`) so an anomaly storm
@@ -171,6 +172,18 @@ class FlightRecorder:
                 pass
             with open(os.path.join(path, 'programs.json'), 'w') as f:
                 json.dump(programs_doc, f, indent=1, default=str)
+            try:
+                # serving prefix-cache posture: what was retained /
+                # pinned when the anomaly fired (an eviction storm or a
+                # pinned-full cache is a likely TTFT-regression cause)
+                from ..serving.prefix_cache import snapshot_all
+                caches = snapshot_all()
+            except Exception:
+                caches = []
+            if caches:
+                with open(os.path.join(path, 'prefix_cache.json'),
+                          'w') as f:
+                    json.dump(caches, f, indent=1, default=str)
             try:
                 from .. import debug
                 summary = debug.observability_summary() + '\n'

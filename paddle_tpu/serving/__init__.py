@@ -23,6 +23,17 @@ retry layer with request-level (not engine-level) failure.
         ...                       # per-token, as slots advance
     hs = eng.generate_many(prompts)   # continuous-batched batch API
 
+Latency stack (ISSUE 9), all composable and parity-preserving: a radix
+prefix cache over the slot pool (`prefix_cache.py` — shared prompt
+prefixes prefill once), chunked prefill (`prefill_chunk_tokens=` —
+long prompts interleave with decode rounds instead of stalling TTFT),
+and per-slot speculative decoding (`draft_model=` — k draft proposals
+verified in one target forward, exactly greedy for any draft):
+
+    eng = InferenceEngine(model, num_slots=16, max_length=256,
+                          prefix_cache=0.25, prefill_chunk_tokens=32,
+                          draft_model=draft)
+
 Fleet layer (`router.py` + `tenancy.py`): a `Router` over a
 `ReplicaSet` of N engines adds health-checked least-loaded placement,
 mid-flight failover with per-replica circuit breakers, and per-tenant
@@ -42,19 +53,21 @@ from .api import (FAILED, FINISHED, GREEDY, PRIORITY_HIGH, PRIORITY_LOW,
                   SAMPLING, RequestHandle, SamplingParams)
 from .engine import InferenceEngine, sample_rows
 from .kv_pool import SlotPool, default_buckets
+from .prefix_cache import RadixPrefixCache
 from .router import (CircuitBreaker, Replica, ReplicaFailure, ReplicaSet,
                      Router, RouterHandle)
 from .scheduler import FCFSScheduler
 from .tenancy import (AdmissionRejected, Tenant, TenantRegistry,
-                      TokenBucket, parse_tenant_spec)
+                      TokenBucket, estimate_queue_rounds,
+                      parse_tenant_spec, prefill_rounds)
 
 __all__ = [
     'FAILED', 'FINISHED', 'GREEDY', 'QUEUED', 'RUNNING', 'SAMPLING',
     'PRIORITY_HIGH', 'PRIORITY_NORMAL', 'PRIORITY_LOW', 'PRIORITY_NAMES',
     'RequestHandle', 'SamplingParams', 'InferenceEngine', 'sample_rows',
-    'SlotPool', 'default_buckets', 'FCFSScheduler',
+    'SlotPool', 'default_buckets', 'FCFSScheduler', 'RadixPrefixCache',
     'CircuitBreaker', 'Replica', 'ReplicaFailure', 'ReplicaSet',
     'Router', 'RouterHandle',
     'AdmissionRejected', 'Tenant', 'TenantRegistry', 'TokenBucket',
-    'parse_tenant_spec',
+    'parse_tenant_spec', 'prefill_rounds', 'estimate_queue_rounds',
 ]

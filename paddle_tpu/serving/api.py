@@ -105,6 +105,11 @@ class RequestHandle:
         # serving_request_failed event, so one request's lifecycle can
         # be followed in /trace and flight-recorder bundles
         self._queue_span = None
+        # prefix-cache attachment: the node this request was admitted
+        # off (pinned until retirement) and how many prompt tokens its
+        # copied KV covered
+        self._prefix_node = None
+        self._prefix_len = 0
 
     @property
     def trace_id(self) -> int:

@@ -18,7 +18,13 @@ Compiled-program inventory (asserted by the zero-recompile tests):
   lands above the live position where the slot-causal mask hides it
   until the slot's own decode overwrites it — the stale-slot argument
   speculative decoding already relies on),
-- the slot-pool writer.
+- the slot-pool writer and row copier,
+and, when the latency stack is enabled (ISSUE 9):
+- one chunk-prefill program per chunk bucket (chunked prefill AND
+  prefix-cache suffix prefill — `start`/`slot`/`src` are traced),
+- one speculation round per k (draft + verify; replaces the decode
+  block when a draft model is configured),
+- one draft prefill program per bucket.
 
 Greedy requests take the raw argmax exactly like `generate()`, so their
 outputs are token-for-token identical to a per-request generate() call
@@ -46,6 +52,7 @@ from ..resilience import RetryPolicy, call_with_retry
 from ..tensor import Tensor
 from .api import GREEDY, RUNNING, RequestHandle, SamplingParams
 from .kv_pool import SlotPool
+from .prefix_cache import RadixPrefixCache
 from .scheduler import FCFSScheduler
 
 # occupancy is a ratio; the latency-shaped default buckets are wrong here
@@ -122,6 +129,23 @@ class InferenceEngine:
         eos_token_id: default eos (-1 = never); per-request params win.
         retry_policy: resilience.RetryPolicy for host<->device
             transfers (default: flag-configured policy).
+        prefix_cache: radix prefix cache over the slot pool — shared
+            prompt prefixes (system prompts) prefill once. True = cache
+            at the default 0.5 pool fraction, a float = that fraction,
+            a ready `RadixPrefixCache` = use it, None/False = off.
+        prefill_chunk_tokens: prompts longer than this prefill in
+            bucket-shaped chunks across successive decode rounds
+            (Sarathi-Serve-style interleaving) instead of stalling
+            every in-flight request's TPOT behind one long prefill.
+            None = whole-prompt prefill (the PR-4 behavior).
+        draft_model: optional smaller causal LM for per-slot
+            speculative decoding: each round it proposes
+            `num_draft_tokens` greedily and the decode step verifies
+            k+1 positions in ONE target forward, accepting the longest
+            matching prefix (output identical to plain greedy). Draft
+            KV lives in a parallel SlotPool. Sampling requests in the
+            same engine simply decode one token per round.
+        num_draft_tokens: draft proposals per speculation round (k).
 
     Not thread-safe: one engine is one event loop; drive it with
     `step()`, `run()`, `stream()`, or `generate_many()`.
@@ -133,7 +157,10 @@ class InferenceEngine:
                  max_prefill_tokens: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
                  dtype=None, retry_policy: Optional[RetryPolicy] = None,
-                 max_wait_s: Optional[float] = None):
+                 max_wait_s: Optional[float] = None,
+                 prefix_cache=None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 draft_model=None, num_draft_tokens: int = 4):
         cfg = getattr(model, 'config', None)
         max_pos = getattr(cfg, 'max_position_embeddings', None)
         if max_pos is not None and max_length > max_pos:
@@ -152,6 +179,49 @@ class InferenceEngine:
         self.pool = SlotPool(model, num_slots, max_length, dtype, buckets)
         self.scheduler = FCFSScheduler(max_prefill_tokens,
                                        max_wait_s=max_wait_s)
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ValueError('prefill_chunk_tokens must be >= 1')
+        self.prefill_chunk_tokens = (int(prefill_chunk_tokens)
+                                     if prefill_chunk_tokens else None)
+        self.pool.prefill_chunk_tokens = self.prefill_chunk_tokens
+        if isinstance(prefix_cache, RadixPrefixCache):
+            self.prefix_cache: Optional[RadixPrefixCache] = prefix_cache
+        elif prefix_cache:
+            fraction = (0.5 if prefix_cache is True
+                        else float(prefix_cache))
+            self.prefix_cache = RadixPrefixCache(self.pool, fraction)
+        else:
+            self.prefix_cache = None
+        if self.prefix_cache is not None \
+                and self.prefix_cache.budget_slots < 1:
+            raise ValueError(
+                'prefix cache budget rounds to zero slots; raise the '
+                'fraction or the slot count (retention must leave at '
+                'least one slot for decode)')
+        self.draft_model = draft_model
+        self.spec_k = int(num_draft_tokens)
+        if draft_model is not None:
+            if self.spec_k < 1:
+                raise ValueError('num_draft_tokens must be >= 1')
+            d_cfg = getattr(draft_model, 'config', None)
+            d_pos = getattr(d_cfg, 'max_position_embeddings', None)
+            if d_pos is not None and max_length > d_pos:
+                raise ValueError(
+                    f'max_length {max_length} exceeds the DRAFT model\'s '
+                    f'max_position_embeddings {d_pos}')
+            draft_model.eval()
+            self._draft_state = functional_state(draft_model)
+            # parallel draft KV: same slot indices as the target pool
+            # (never alloc/freed itself — slot i of both pools always
+            # belongs to the same request)
+            self.draft_pool = SlotPool(draft_model, num_slots,
+                                       max_length, dtype, buckets)
+        else:
+            self._draft_state = None
+            self.draft_pool = None
+        # slot -> [handle, prefill cursor]: slots mid-chunked-prefill
+        # (inactive for decode until the cursor reaches the prompt end)
+        self._prefilling: dict = {}
         self._retry = retry_policy or RetryPolicy()
         self._draining = False
         self._drain_deadline_s: Optional[float] = None
@@ -174,6 +244,7 @@ class InferenceEngine:
         self._topp = np.ones(n, np.float32)
         self._greedy = np.ones(n, bool)
         self._keys = np.zeros((n, 2), np.uint32)
+        self._eos_arr = np.full(n, -1, np.int32)   # spec accept stop
         self._slot_req: dict = {}               # slot -> RequestHandle
 
         self._trace_counts = collections.Counter()
@@ -204,6 +275,31 @@ class InferenceEngine:
             jax.jit(self._prefill_fn),
             name_fn=lambda args: f'serving.prefill_{args[5].shape[1]}',
             kind='serving', statics=engine_statics)
+        self._chunk_prefill_jit = store.wrap_jit(  # 1 per chunk bucket
+            jax.jit(self._chunk_prefill_fn),
+            name_fn=lambda args: f'serving.chunk_prefill_'
+                                 f'{args[5].shape[1]}',
+            kind='serving', statics=engine_statics)
+        if draft_model is not None:
+            spec_statics = dict(
+                engine_statics,
+                draft_model=type(draft_model).__qualname__,
+                draft_src=_programs.code_token(type(draft_model)),
+                draft_config=_programs.describe_statics(
+                    getattr(draft_model, 'config', None)),
+                spec_k=self.spec_k)
+            # one compiled speculation round per k: the drafts/verify
+            # shapes are internal, invisible in any input aval, so k
+            # MUST ride the statics
+            self._spec_jit = store.wrap_jit(
+                jax.jit(self._spec_decode_fn),
+                name=f'serving.spec_decode_k{self.spec_k}',
+                kind='serving', statics=spec_statics)
+            self._draft_prefill_jit = store.wrap_jit(
+                jax.jit(self._draft_prefill_fn),
+                name_fn=lambda args: f'serving.draft_prefill_'
+                                     f'{args[5].shape[1]}',
+                kind='serving', statics=spec_statics)
         self._init_metrics()
         if store.persistent:
             # cold-replica warm start: materialize persisted serving
@@ -256,6 +352,32 @@ class InferenceEngine:
         self._m_tpot = reg.histogram(
             'paddle_serving_tpot_seconds',
             'mean inter-token latency per finished request')
+        self._m_chunk_rounds = reg.counter(
+            'paddle_serving_chunk_rounds_total',
+            'chunked-prefill rounds executed')
+        self._m_chunk_tokens = reg.counter(
+            'paddle_serving_chunk_tokens_total',
+            'prompt tokens prefilled via chunk rounds')
+        self._m_spec_rounds = reg.counter(
+            'paddle_serving_spec_rounds_total',
+            'speculation rounds (draft + verify) executed')
+        self._m_spec_proposed = reg.counter(
+            'paddle_serving_spec_proposed_total',
+            'draft tokens proposed to the verifier')
+        self._m_spec_accepted = reg.counter(
+            'paddle_serving_spec_accepted_total',
+            'draft tokens accepted by the verifier')
+        # one reporting surface with standalone speculative_generate():
+        # the paddle_spec_* family, labeled by source
+        self._m_spec_shared = reg.counter(
+            'paddle_spec_rounds_total',
+            'speculative-decode rounds by source', ('source',))
+        self._m_spec_shared_prop = reg.counter(
+            'paddle_spec_proposed_drafts_total',
+            'draft tokens proposed by source', ('source',))
+        self._m_spec_shared_acc = reg.counter(
+            'paddle_spec_accepted_drafts_total',
+            'draft tokens accepted by source', ('source',))
         if _obs.enabled():
             self._m_slots.set(self.pool.num_slots)
 
@@ -306,6 +428,112 @@ class InferenceEngine:
                 c, s.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1)),
             pool, slab)
 
+    def _chunk_prefill_fn(self, params, frozen, buffers, pool, slot, ids,
+                          start, src):
+        """Prefill ONE chunk of ONE request's prompt, writing slot `slot`
+        at positions [start, start+chunk): the shared program behind
+        both chunked prefill and prefix-cache suffix prefill. Unlike
+        `_prefill_fn` it forwards against an EXISTING row — gathered
+        from `src`, which is the slot itself for follow-up chunks but
+        the RETAINED slot on a prefix-cache hit's first chunk (fusing
+        the prefix copy into the chunk, so a hit costs exactly one
+        pool update, never copy + prefill) — with an explicit
+        slot-causal mask because `start` is traced. One compile per
+        chunk bucket (ids.shape); `start`/`slot`/`src` traced."""
+        self._trace_counts[f'chunk_prefill_{ids.shape[1]}'] += 1
+        fwd = cached_forward(self.model, params, frozen, buffers)
+        row = jax.tree_util.tree_map(
+            lambda c: jax.lax.dynamic_slice(
+                c, (src,) + (0,) * (c.ndim - 1), (1,) + c.shape[1:]),
+            pool)
+        b = ids.shape[1]
+        k_slot = jnp.arange(self.pool.max_length, dtype=jnp.int32)
+        q_pos = start + jnp.arange(b, dtype=jnp.int32)
+        mask = (k_slot[None, :] <= q_pos[:, None])[None, None]
+        _, row = fwd(ids, row, start, start, mask)
+        return jax.tree_util.tree_map(
+            lambda c, s: jax.lax.dynamic_update_slice(
+                c, s.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1)),
+            pool, row)
+
+    def _draft_prefill_fn(self, params, frozen, buffers, pool, slot, ids):
+        """`_prefill_fn` for the DRAFT model/pool: the draft needs its
+        own prompt KV before it can propose. One compile per bucket."""
+        self._trace_counts[f'draft_prefill_{ids.shape[1]}'] += 1
+        fwd = cached_forward(self.draft_model, params, frozen, buffers)
+        slab = jax.tree_util.tree_map(
+            lambda c: jnp.zeros((1,) + c.shape[1:], c.dtype), pool)
+        _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
+        return jax.tree_util.tree_map(
+            lambda c, s: jax.lax.dynamic_update_slice(
+                c, s.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1)),
+            pool, slab)
+
+    def _spec_decode_fn(self, params, frozen, buffers, pool,
+                        d_params, d_frozen, d_buffers, d_pool,
+                        tok, pos, steps, active, temp, topk, topp,
+                        greedy, keys, eos):
+        """One compiled SPECULATION round over all slots (replaces the
+        plain decode block when a draft model is configured): the draft
+        proposes k tokens autoregressively for every slot, the target
+        verifies [pending, d_1..d_k] — k+1 positions — in ONE forward,
+        and each greedy slot accepts its longest matching draft prefix
+        plus the target's own next token (`_spec_decode_jit` semantics:
+        output EXACTLY plain greedy, in fewer target passes). Sampling
+        slots ignore the drafts and sample one token from the pending
+        position's logits, exactly like the plain block. Rejected draft
+        KV (target and draft pools) is stale-above-live and overwritten
+        next round before anything attends it.
+
+        Returns (tokens [N, k+1], accepted-counts [N], new pools)."""
+        k = self.spec_k
+        self._trace_counts[f'spec_decode_k{k}'] += 1
+        fwd_t = cached_forward(self.model, params, frozen, buffers)
+        fwd_d = cached_forward(self.draft_model, d_params, d_frozen,
+                               d_buffers)
+        max_len = self.pool.max_length
+        k_slot = jnp.arange(max_len, dtype=jnp.int32)
+        n = tok.shape[0]
+
+        def draft_body(j, carry):
+            cur, d_pool, drafts = carry
+            p = pos + j
+            mask = (k_slot[None, :] <= p[:, None])[:, None, None, :]
+            lg, d_pool = fwd_d(cur[:, None], d_pool, p, p, mask)
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return nxt, d_pool, drafts.at[:, j].set(nxt)
+
+        _, d_pool, drafts = jax.lax.fori_loop(
+            0, k, draft_body,
+            (tok, d_pool, jnp.zeros((n, k), jnp.int32)))
+
+        # target scores [pending, d_1..d_k] at positions pos..pos+k
+        block = jnp.concatenate([tok[:, None], drafts], axis=1)
+        q_pos = pos[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
+        mask = (k_slot[None, None, :] <= q_pos[:, :, None])[:, None]
+        logits, pool = fwd_t(block, pool, pos, pos, mask)
+
+        choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [N,k+1]
+        # longest accepted draft prefix; acceptance stops at EOS
+        # (everything after an emitted EOS is discarded anyway) and is
+        # zero for sampling rows — they take the plain-sampling path
+        match = ((drafts == choice[:, :k])
+                 & (drafts != eos[:, None]) & greedy[:, None])
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        sampled = sample_rows(logits[:, 0], temp, topk, topp, greedy,
+                              keys, steps)
+        v_new = jnp.where(
+            greedy,
+            jnp.take_along_axis(choice, a[:, None], axis=1)[:, 0],
+            sampled)
+        j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        draft_ext = jnp.concatenate([drafts, drafts[:, -1:]], axis=1)
+        toks = jnp.where(j < a[:, None], draft_ext,
+                         jnp.where(j == a[:, None], v_new[:, None], 0))
+        toks = jnp.where(active[:, None], toks, 0).astype(jnp.int32)
+        counts = jnp.where(active, a + 1, 0).astype(jnp.int32)
+        return toks, counts, pool, d_pool
+
     # ------------------------------------------------------------------
     # submission
     # ------------------------------------------------------------------
@@ -343,11 +571,19 @@ class InferenceEngine:
                 'admitting new requests')
         toks = self._normalize_prompt(prompt)
         self.pool.bucket_for(len(toks))   # raises when no bucket fits
-        if len(toks) + params.max_new_tokens > self.pool.max_length:
+        # speculating engines verify a [pos, pos+k] block every round,
+        # so every slot needs k tokens of cache headroom past its
+        # budget (and the headroom is what keeps clamped block writes
+        # above every retained prefix's kv_len)
+        headroom = self.spec_k if self.draft_model is not None else 0
+        if len(toks) + params.max_new_tokens + headroom \
+                > self.pool.max_length:
             raise ValueError(
                 f'prompt ({len(toks)}) + max_new_tokens '
-                f'({params.max_new_tokens}) exceeds the slot length '
-                f'({self.pool.max_length})')
+                f'({params.max_new_tokens})'
+                + (f' + speculation headroom ({headroom})' if headroom
+                   else '')
+                + f' exceeds the slot length ({self.pool.max_length})')
         h = RequestHandle(toks, params, engine=self)
         if priority is not None:
             h.priority = int(priority)
@@ -413,6 +649,17 @@ class InferenceEngine:
         _obs.note_degraded('draining', info, scope=self.obs_scope)
         _obs.emit('serving_drain_begin', **info)
 
+    def _detach_slot(self, slot: int, h: RequestHandle):
+        """Common slot teardown for fail/evict/retire: drop the engine's
+        references and release the request's prefix pin. Does NOT free
+        the pool slot — retirement may hand it to the prefix cache."""
+        del self._slot_req[slot]
+        self._active[slot] = False
+        self._prefilling.pop(slot, None)
+        if h._prefix_node is not None:
+            self.prefix_cache.release(h._prefix_node)
+            h._prefix_node = None
+
     def _fail_remaining(self, exc: BaseException):
         for h in self.scheduler.drain():
             h._fail(exc)
@@ -420,15 +667,14 @@ class InferenceEngine:
             if _obs.enabled():
                 self._m_requests.labels(status='failed').inc()
         for slot, h in list(self._slot_req.items()):
-            del self._slot_req[slot]
-            self._active[slot] = False
+            self._detach_slot(slot, h)
             self.pool.free(slot)
             h._fail(exc)
             self._counts['failed'] += 1
             if _obs.enabled():
                 self._m_requests.labels(status='failed').inc()
         if _obs.enabled():
-            self._m_active.set(self.pool.used_count)
+            self._m_active.set(len(self._slot_req))
 
     def evict_all(self) -> List[RequestHandle]:
         """Pull every accepted request — queued AND in-flight — out of
@@ -441,8 +687,7 @@ class InferenceEngine:
         (a transient device blip doesn't scrap the pool)."""
         out = self.scheduler.drain()
         for slot, h in list(self._slot_req.items()):
-            del self._slot_req[slot]
-            self._active[slot] = False
+            self._detach_slot(slot, h)
             self.pool.free(slot)
             out.append(h)
         for h in out:
@@ -450,7 +695,7 @@ class InferenceEngine:
                 h._queue_span.end()
                 h._queue_span = None
         if _obs.enabled():
-            self._m_active.set(self.pool.used_count)
+            self._m_active.set(len(self._slot_req))
         return out
 
     def drain(self, deadline_s: Optional[float] = None) -> bool:
@@ -486,38 +731,44 @@ class InferenceEngine:
 
     def step(self) -> int:
         """ONE scheduler iteration: admit queued requests into free
-        slots, then advance every occupied slot one decode block.
-        Returns the number of requests that progressed."""
+        slots, advance every mid-prefill slot one chunk, then advance
+        every ACTIVE slot one decode round (a plain block, or one
+        speculation round when a draft model is configured). Returns
+        the number of requests that progressed."""
         self._check_drain()
         self._admit()
-        if not self._slot_req:
-            return 0
-        with _obs.span('serving.decode_round',
-                       slots=len(self._slot_req),
-                       requests=[h.request_id
-                                 for h in self._slot_req.values()]):
-            toks_dev, new_pool = self._decode_jit(
-                self._params, self._frozen, self._buffers, self.pool.cache,
-                self._tok, self._pos, self._steps, self._active, self._temp,
-                self._topk, self._topp, self._greedy, self._keys)
-            self.pool.cache = new_pool
-            toks = call_with_retry(_from_device, toks_dev,
-                                   policy=self._retry, site='serving.d2h')
-        _obs.note_progress('decode')   # /healthz decode liveness beat
-        now = time.perf_counter()
+        self._advance_prefills()
         n = len(self._slot_req)
+        if not np.any(self._active):
+            return n            # chunk-prefill-only progress this round
+        if self.draft_model is not None:
+            toks, counts = self._spec_round()
+        else:
+            toks, counts = self._decode_round()
+        now = time.perf_counter()
         self._counts['decode_rounds'] += 1
-        self._counts['decode_steps'] += self.decode_block
         if _obs.enabled():
             self._m_rounds.inc()
-            self._m_decode_steps.inc(self.decode_block)
             self._m_occupancy.observe(self.pool.occupancy)
             self._m_tokens.inc(0)   # ensure the family exists even idle
         for slot, h in list(self._slot_req.items()):
+            if not self._active[slot]:
+                continue            # mid-chunked-prefill: no tokens yet
+            c = self.decode_block if counts is None else int(counts[slot])
+            if self.draft_model is not None and self._greedy[slot]:
+                self._counts['spec_proposed'] += self.spec_k
+                self._counts['spec_accepted'] += c - 1
+                if _obs.enabled():
+                    self._m_spec_proposed.inc(self.spec_k)
+                    self._m_spec_accepted.inc(c - 1)
+                    self._m_spec_shared_prop.labels(
+                        source='engine').inc(self.spec_k)
+                    self._m_spec_shared_acc.labels(
+                        source='engine').inc(c - 1)
             done = False
             emitted = 0
             first = not h.tokens
-            for j in range(self.decode_block):
+            for j in range(c):
                 t = int(toks[slot, j])
                 h._emit(t, now)
                 emitted += 1
@@ -533,10 +784,61 @@ class InferenceEngine:
             if done:
                 self._retire(slot, h, now)
             else:
-                self._tok[slot] = toks[slot, self.decode_block - 1]
-                self._pos[slot] += self.decode_block
-                self._steps[slot] += self.decode_block
+                self._tok[slot] = toks[slot, c - 1]
+                self._pos[slot] += c
+                self._steps[slot] += (1 if counts is not None else c)
         return n
+
+    def _decode_round(self):
+        """The plain compiled decode block (no draft model): every
+        active slot advances `decode_block` tokens."""
+        with _obs.span('serving.decode_round',
+                       slots=len(self._slot_req),
+                       requests=[h.request_id
+                                 for h in self._slot_req.values()]):
+            toks_dev, new_pool = self._decode_jit(
+                self._params, self._frozen, self._buffers, self.pool.cache,
+                self._tok, self._pos, self._steps, self._active, self._temp,
+                self._topk, self._topp, self._greedy, self._keys)
+            self.pool.cache = new_pool
+            toks = call_with_retry(_from_device, toks_dev,
+                                   policy=self._retry, site='serving.d2h')
+        _obs.note_progress('decode')   # /healthz decode liveness beat
+        self._counts['decode_steps'] += self.decode_block
+        if _obs.enabled():
+            self._m_decode_steps.inc(self.decode_block)
+        return toks, None
+
+    def _spec_round(self):
+        """One compiled speculation round: k draft proposals + one
+        k+1-position target verify; greedy slots advance by their
+        accepted count, sampling slots by one."""
+        d_params, d_frozen, d_buffers = self._draft_state
+        with _obs.span('serving.spec_round',
+                       slots=len(self._slot_req), k=self.spec_k,
+                       requests=[h.request_id
+                                 for h in self._slot_req.values()]):
+            toks_dev, counts_dev, new_pool, new_d_pool = self._spec_jit(
+                self._params, self._frozen, self._buffers, self.pool.cache,
+                d_params, d_frozen, d_buffers, self.draft_pool.cache,
+                self._tok, self._pos, self._steps, self._active,
+                self._temp, self._topk, self._topp, self._greedy,
+                self._keys, self._eos_arr)
+            self.pool.cache = new_pool
+            self.draft_pool.cache = new_d_pool
+            toks = call_with_retry(_from_device, toks_dev,
+                                   policy=self._retry, site='serving.d2h')
+            counts = call_with_retry(_from_device, counts_dev,
+                                     policy=self._retry,
+                                     site='serving.d2h')
+        _obs.note_progress('decode')
+        self._counts['decode_steps'] += 1   # one target verify pass
+        self._counts['spec_rounds'] += 1
+        if _obs.enabled():
+            self._m_decode_steps.inc(1)
+            self._m_spec_rounds.inc()
+            self._m_spec_shared.labels(source='engine').inc()
+        return toks, counts
 
     def run(self) -> int:
         """Drive until queue and slots drain; returns decode rounds."""
@@ -566,15 +868,51 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # admission / retirement
     # ------------------------------------------------------------------
+    def _admission_cost(self, prompt_len: int) -> int:
+        """Prefill cost charged against the scheduler's per-iteration
+        budget: with chunking, an admission costs ONE chunk bucket this
+        round (the rest spreads over later rounds); without, the whole
+        prompt's bucket."""
+        if self.prefill_chunk_tokens:
+            prompt_len = min(prompt_len, self.prefill_chunk_tokens)
+        return self.pool.bucket_for(prompt_len)
+
+    def _effective_free(self) -> int:
+        """Slots admissible right now: free-list + zero-ref cached
+        prefixes the pool can reclaim on demand."""
+        free = self.pool.free_count
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.reclaimable_count
+        return free
+
+    def _alloc_slot(self) -> int:
+        if self.pool.free_count == 0 and self.prefix_cache is not None:
+            # pool pressure: retained prefixes yield to live requests
+            self.prefix_cache.evict_lru()
+        return self.pool.alloc()
+
     def _admit(self):
-        for h in self.scheduler.admissible(self.pool.free_count,
-                                           self.pool.bucket_for):
-            slot = self.pool.alloc()
+        admitted = self.scheduler.admissible(self._effective_free(),
+                                             self._admission_cost)
+        for idx, h in enumerate(admitted):
             try:
-                self._prefill_into(slot, h)
+                slot = self._alloc_slot()
+            except RuntimeError:
+                # the reclaimable slot this admission was promised got
+                # pinned mid-pass (a sibling admission hit its prefix):
+                # not a failure — THIS handle and everything behind it
+                # in the popped batch go back to the queue front in
+                # order (admissible() already removed them)
+                for back in reversed(admitted[idx:]):
+                    self.scheduler.requeue(back)
+                break
+            try:
+                self._begin_request(slot, h)
             except Exception as exc:
                 # REQUEST-level failure: free the slot, fail the handle,
                 # keep the engine serving everyone else
+                if slot in self._slot_req:
+                    self._detach_slot(slot, h)
                 self.pool.free(slot)
                 h._fail(exc)
                 self._counts['failed'] += 1
@@ -584,37 +922,140 @@ class InferenceEngine:
                               request_id=h.request_id,
                               error=type(exc).__name__)
         if _obs.enabled():
-            self._m_active.set(self.pool.used_count)
+            self._m_active.set(len(self._slot_req))
 
-    def _prefill_into(self, slot: int, h: RequestHandle):
-        p = h.params
+    def _begin_request(self, slot: int, h: RequestHandle):
+        """Admission: claim the longest cached prefix (jitted row copy,
+        suffix-only prefill), then either whole-prompt prefill (short
+        cold prompts — the PR-4 path, one compile per bucket) or enter
+        the chunked-prefill state machine."""
         s = len(h.prompt_tokens)
-        bucket = self.pool.bucket_for(s)
         if h._queue_span is not None:
             h._queue_span.end()   # admission closes the queue span
             h._queue_span = None
+        self._slot_req[slot] = h
+        h.status = RUNNING
+        cursor = 0
+        src = slot
+        if self.prefix_cache is not None:
+            node, matched = self.prefix_cache.lookup(h.prompt_tokens)
+            if node is not None:
+                self.prefix_cache.acquire(node)
+                h._prefix_node = node
+                h._prefix_len = matched
+                cursor = matched
+                src = node.slot
+                _obs.emit('prefix_hit', request_id=h.request_id,
+                          matched=matched, prompt_len=s, slot=slot)
+        if cursor >= s:
+            # full-prompt hit: ZERO prefill — copy the retained row and
+            # let the pending token re-forward the last prompt position
+            self.pool.copy_slot(src, slot)
+            self._activate(slot, h)
+            return
+        chunk = self.prefill_chunk_tokens
+        if cursor == 0 and (chunk is None or s <= chunk):
+            self._whole_prefill(slot, h)
+            self._activate(slot, h)
+            return
+        # suffix and/or long prompt: per-slot cursor, one bucket-shaped
+        # chunk per scheduler iteration (the first lands this step via
+        # _advance_prefills, gathering its KV floor from `src` — the
+        # retained row on a prefix hit); the slot stays inactive for
+        # decode — its position parks at the last row, where stray
+        # inactive-row KV writes land above every live position
+        self._pos[slot] = self.pool.max_length - 1
+        self._tok[slot] = 0
+        self._active[slot] = False
+        self._prefilling[slot] = [h, cursor, src]
+        self._counts['chunked_prefills'] += 1
+
+    def _whole_prefill(self, slot: int, h: RequestHandle):
+        s = len(h.prompt_tokens)
+        bucket = self.pool.bucket_for(s)
         with _obs.span('serving.prefill', request_id=h.request_id,
                        bucket=bucket, slot=slot, prompt_len=s):
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :s] = h.prompt_tokens
             ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
                                       site='serving.h2d')
-            greedy = p.strategy == GREEDY
-            key = (np.zeros(2, np.uint32) if greedy else np.asarray(
-                jax.random.PRNGKey(h.request_id if p.seed is None
-                                   else p.seed), np.uint32))
             self.pool.cache = self._prefill_jit(
                 self._params, self._frozen, self._buffers, self.pool.cache,
                 jnp.int32(slot), ids_dev)
-        h.status = RUNNING
         self._counts['prefills'] += 1
         self._counts['prefill_tokens'] += s
         if _obs.enabled():
             self._m_prefills.labels(bucket=bucket).inc()
             self._m_prefill_tokens.inc(s)
-        # pending = the LAST prompt token at position s-1: the next decode
-        # block re-forwards it (identical KV overwrite) and its sampled
-        # output is the request's first generated token
+
+    def _advance_prefills(self):
+        """Drive every mid-prefill slot forward one bucket-shaped chunk
+        (FCFS by admission). A slot whose cursor reaches the prompt end
+        activates for decode in the same round."""
+        for slot in list(self._prefilling):
+            h, cursor, src = self._prefilling[slot]
+            try:
+                self._prefill_chunk(slot, h, cursor, src)
+            except Exception as exc:
+                self._detach_slot(slot, h)
+                self.pool.free(slot)
+                h._fail(exc)
+                self._counts['failed'] += 1
+                if _obs.enabled():
+                    self._m_requests.labels(status='failed').inc()
+                    _obs.emit('serving_request_failed',
+                              request_id=h.request_id,
+                              error=type(exc).__name__)
+
+    def _prefill_chunk(self, slot: int, h: RequestHandle, cursor: int,
+                       src: int):
+        s = len(h.prompt_tokens)
+        c = min(self.prefill_chunk_tokens or s, s - cursor)
+        bucket = self.pool.bucket_for(c)
+        # tail chunks whose bucket would overrun the slot shift their
+        # window start down and RE-forward already-prefilled tokens —
+        # an identical KV overwrite (the pending-token trick), so the
+        # window always fits and pad queries stay above the prompt
+        start = min(cursor, self.pool.max_length - bucket)
+        window = h.prompt_tokens[start:start + bucket]
+        with _obs.span('serving.prefill_chunk', request_id=h.request_id,
+                       bucket=bucket, slot=slot, start=start,
+                       cursor=cursor, prompt_len=s):
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :len(window)] = window
+            ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
+                                      site='serving.h2d')
+            self.pool.cache = self._chunk_prefill_jit(
+                self._params, self._frozen, self._buffers, self.pool.cache,
+                jnp.int32(slot), ids_dev, jnp.int32(start),
+                jnp.int32(src))
+        new_cursor = min(start + bucket, s)
+        self._prefilling[slot][1] = new_cursor
+        self._prefilling[slot][2] = slot   # later chunks extend own row
+        self._counts['chunk_rounds'] += 1
+        self._counts['prefill_tokens'] += new_cursor - cursor
+        if _obs.enabled():
+            self._m_chunk_rounds.inc()
+            self._m_chunk_tokens.inc(new_cursor - cursor)
+            self._m_prefill_tokens.inc(new_cursor - cursor)
+        if new_cursor >= s:
+            del self._prefilling[slot]
+            self._activate(slot, h)
+
+    def _activate(self, slot: int, h: RequestHandle):
+        """Prompt KV complete (prefilled, copied, or both): arm the slot
+        for decode. The pending token is the LAST prompt token at
+        position s-1 — the next decode round re-forwards it (identical
+        KV overwrite) and its sampled output is the request's first
+        generated token."""
+        p = h.params
+        s = len(h.prompt_tokens)
+        if self.draft_model is not None:
+            self._draft_prefill(slot, h)
+        greedy = p.strategy == GREEDY
+        key = (np.zeros(2, np.uint32) if greedy else np.asarray(
+            jax.random.PRNGKey(h.request_id if p.seed is None
+                               else p.seed), np.uint32))
         self._tok[slot] = h.prompt_tokens[-1]
         self._pos[slot] = s - 1
         self._steps[slot] = 0
@@ -624,17 +1065,41 @@ class InferenceEngine:
         self._topp[slot] = p.top_p
         self._greedy[slot] = greedy
         self._keys[slot] = key
-        self._slot_req[slot] = h
+        self._eos_arr[slot] = h._eos
+
+    def _draft_prefill(self, slot: int, h: RequestHandle):
+        """Whole-bucket prompt prefill into the DRAFT pool row (the
+        draft proposes from its own KV). Runs once at activation —
+        deliberately un-chunked and un-cached: the draft is small, and
+        keeping its path trivial keeps the compiled set bounded."""
+        s = len(h.prompt_tokens)
+        bucket = self.pool.bucket_for(s)
+        d_params, d_frozen, d_buffers = self._draft_state
+        with _obs.span('serving.draft_prefill', request_id=h.request_id,
+                       bucket=bucket, slot=slot):
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :s] = h.prompt_tokens
+            ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
+                                      site='serving.h2d')
+            self.draft_pool.cache = self._draft_prefill_jit(
+                d_params, d_frozen, d_buffers, self.draft_pool.cache,
+                jnp.int32(slot), ids_dev)
 
     def _retire(self, slot: int, h: RequestHandle, now: float):
         h._finish(now)
-        del self._slot_req[slot]
-        self._active[slot] = False
-        self.pool.free(slot)
+        self._detach_slot(slot, h)
+        retained = False
+        if self.prefix_cache is not None:
+            # retention costs nothing: the slot's rows [0, prompt_len)
+            # ARE the prompt's prefill KV (generated-token KV above is
+            # stale-by-construction for the next user)
+            retained = self.prefix_cache.insert(h.prompt_tokens, slot)
+        if not retained:
+            self.pool.free(slot)
         self._counts['completed'] += 1
         if _obs.enabled():
             self._m_requests.labels(status='completed').inc()
-            self._m_active.set(self.pool.used_count)
+            self._m_active.set(len(self._slot_req))
             tpot = h.tpot
             if tpot is not None:
                 self._m_tpot.observe(tpot)
@@ -646,7 +1111,7 @@ class InferenceEngine:
         """Host-side counters + compile-trace counts (the zero-recompile
         assertions read `traces`: after warmup it must stop growing
         across admissions)."""
-        return {
+        out = {
             'submitted': self._counts['submitted'],
             'completed': self._counts['completed'],
             'failed': self._counts['failed'],
@@ -655,11 +1120,26 @@ class InferenceEngine:
             'prefill_tokens': self._counts['prefill_tokens'],
             'decode_rounds': self._counts['decode_rounds'],
             'decode_steps': self._counts['decode_steps'],
+            'chunked_prefills': self._counts['chunked_prefills'],
+            'chunk_rounds': self._counts['chunk_rounds'],
             'queue_depth': self.scheduler.queue_depth,
-            'active_slots': self.pool.used_count,
+            'active_slots': len(self._slot_req),
             'traces': dict(self._trace_counts),
             'pool': self.pool.stats(),
         }
+        if self.prefix_cache is not None:
+            out['prefix_cache'] = self.prefix_cache.stats()
+        if self.draft_model is not None:
+            proposed = self._counts['spec_proposed']
+            out['spec'] = {
+                'k': self.spec_k,
+                'rounds': self._counts['spec_rounds'],
+                'proposed': proposed,
+                'accepted': self._counts['spec_accepted'],
+                'acceptance_rate': (self._counts['spec_accepted']
+                                    / proposed if proposed else 0.0),
+            }
+        return out
 
     def reset_stats(self):
         """Zero the host-side counters (trace counts survive — they

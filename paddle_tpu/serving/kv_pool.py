@@ -20,6 +20,7 @@ O(len(buckets)), not O(distinct prompt lengths).
 """
 from __future__ import annotations
 
+import bisect
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -62,8 +63,13 @@ class SlotPool:
         if not self.buckets:
             raise ValueError('no prefill bucket <= max_length')
         self._free = sorted(range(self.num_slots), reverse=True)
+        # chunked-prefill config rides the pool so stats()/debuggers see
+        # the full prefill geometry in one place (the engine sets it)
+        self.prefill_chunk_tokens: Optional[int] = None
         self._write_traces = 0
+        self._copy_traces = 0
         self._write_jit = jax.jit(self._write_fn)
+        self._copy_jit = jax.jit(self._copy_fn)
 
     # -- slot lifecycle ----------------------------------------------------
     @property
@@ -95,13 +101,17 @@ class SlotPool:
 
     # -- prefill bucketing -------------------------------------------------
     def bucket_for(self, length: int) -> int:
-        """Smallest bucket >= length; ValueError past the largest."""
-        for b in self.buckets:
-            if b >= length:
-                return b
-        raise ValueError(
-            f'prompt length {length} exceeds the largest prefill bucket '
-            f'{self.buckets[-1]} (max_length {self.max_length})')
+        """Smallest bucket >= length; ValueError past the largest.
+        `bisect` over the sorted bucket tuple — this runs once per
+        submit AND once per scheduler admission pass, so it must not be
+        a linear scan of a long custom bucket list."""
+        i = bisect.bisect_left(self.buckets, length)
+        if i == len(self.buckets):
+            raise ValueError(
+                f'prompt length {length} exceeds the largest prefill '
+                f'bucket {self.buckets[-1]} (max_length '
+                f'{self.max_length})')
+        return self.buckets[i]
 
     # -- pooled-cache writes -----------------------------------------------
     def _write_fn(self, pool, slab, slot):
@@ -120,8 +130,31 @@ class SlotPool:
         self.cache = self._write_jit(self.cache, slab,
                                      jnp.int32(slot))
 
+    def _copy_fn(self, pool, src, dst):
+        # one compile total: src/dst are traced, shapes are static
+        self._copy_traces += 1
+        return jax.tree_util.tree_map(
+            lambda c: jax.lax.dynamic_update_slice(
+                c,
+                jax.lax.dynamic_slice(
+                    c, (src,) + (0,) * (c.ndim - 1),
+                    (1,) + c.shape[1:]),
+                (dst,) + (0,) * (c.ndim - 1)),
+            pool)
+
+    def copy_slot(self, src: int, dst: int):
+        """Copy row `src` into row `dst` across the whole cache pytree
+        (the prefix-cache hit path: a retained prefix row becomes the
+        new request's KV floor; stale positions above the prefix are
+        masked until the request's own prefill/decode overwrites them).
+        One compiled program regardless of src/dst."""
+        self.cache = self._copy_jit(self.cache, jnp.int32(src),
+                                    jnp.int32(dst))
+
     def stats(self) -> dict:
         return {'num_slots': self.num_slots, 'max_length': self.max_length,
                 'used': self.used_count, 'free': self.free_count,
                 'buckets': list(self.buckets),
-                'write_traces': self._write_traces}
+                'prefill_chunk_tokens': self.prefill_chunk_tokens,
+                'write_traces': self._write_traces,
+                'copy_traces': self._copy_traces}

@@ -1,0 +1,325 @@
+"""Radix prefix cache: shared prompt prefixes prefill ONCE.
+
+Production traffic shares prefixes — a system prompt in front of every
+request, few-shot templates, multi-turn histories. RadixAttention
+(SGLang, Zheng et al. 2023) showed that keeping prefill KV keyed by the
+token-id prefix tree and reusing the longest cached prefix is the
+single biggest serving win at such traffic shapes. This is that idea
+over the slot pool's STATIC shapes: instead of paged blocks, a cached
+prefix retains a whole pool slot (its KV rows [0, kv_len) are the
+prefix KV; rows above are stale and never attended — the same
+overwrite-before-attend argument the engine's decode already relies
+on), and a hit copies the retained row into the new request's slot with
+one jitted row copy, so only the prompt SUFFIX is prefilled.
+
+Structure: a compressed radix trie over token ids. Only nodes created
+by an insertion own a slot; edge splits create structural nodes. A
+lookup walks the prompt and returns the deepest slot-owning node whose
+full root path is a prompt prefix.
+
+Lifecycle:
+- `insert(tokens, slot)` at request retirement ADOPTS the slot (the
+  prompt KV is already in it — retention costs zero extra compute). The
+  caller keeps the slot when the prefix is already covered or no budget
+  can be freed (insert returns False).
+- `acquire`/`release` pin a node for the lifetime of a request admitted
+  off it: pinned nodes are never evicted, so a hot shared prefix
+  survives pool pressure (the ref-count guarantee the tests gauntlet).
+- Eviction is LRU over ZERO-REF owning nodes, under `budget_slots` =
+  `fraction * num_slots` (retention must never starve decode capacity:
+  the engine reclaims LRU entries on demand when the pool runs dry).
+
+Sampling-params independence is by construction: the key is the token
+prefix alone — prefill KV does not depend on temperature/top-k/top-p,
+so greedy and sampled requests share entries.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from .. import observability as _obs
+
+# live caches, for flight-recorder bundles (prefix_cache.json)
+_live_caches: 'weakref.WeakSet' = weakref.WeakSet()
+
+
+def snapshot_all() -> List[dict]:
+    """State of every live prefix cache (flight-recorder hook)."""
+    return [c.snapshot() for c in list(_live_caches)]
+
+
+class _Node:
+    """One radix-trie node. `edge` is the token run from the parent;
+    `slot`/`kv_len` are set only on owning nodes (kv_len == depth)."""
+
+    __slots__ = ('edge', 'children', 'parent', 'slot', 'kv_len', 'refs',
+                 'last_use')
+
+    def __init__(self, edge: Tuple[int, ...], parent: Optional['_Node']):
+        self.edge = edge
+        self.children: Dict[int, '_Node'] = {}
+        self.parent = parent
+        self.slot: Optional[int] = None
+        self.kv_len = 0
+        self.refs = 0
+        self.last_use = 0
+
+
+def _common(a: Tuple[int, ...], b: List[int], off: int) -> int:
+    """Length of the common prefix of `a` and `b[off:]`."""
+    n = min(len(a), len(b) - off)
+    i = 0
+    while i < n and a[i] == b[off + i]:
+        i += 1
+    return i
+
+
+class RadixPrefixCache:
+    """Token-prefix -> retained KV slot, LRU under a pool-fraction
+    budget, with per-node ref-count pinning.
+
+    Args:
+        pool: the `SlotPool` whose slots are retained (evictions free
+            straight back into it).
+        fraction: max share of the pool the cache may pin as retained
+            prefixes (budget_slots = int(fraction * num_slots); at
+            least one slot is always left to the pool).
+        min_tokens: don't retain prompts shorter than this (a 2-token
+            prefix is cheaper to recompute than a slot is worth).
+    """
+
+    def __init__(self, pool, fraction: float = 0.5, min_tokens: int = 1):
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError('fraction must be in (0, 1]')
+        self.pool = pool
+        self.budget_slots = min(int(fraction * pool.num_slots),
+                                pool.num_slots - 1)
+        self.min_tokens = max(int(min_tokens), 1)
+        self._root = _Node((), None)
+        self._owners: set = set()
+        self._tick = 0
+        self._counts = {'hits': 0, 'misses': 0, 'inserts': 0,
+                        'evictions': 0, 'tokens_reused': 0}
+        self._init_metrics()
+        _live_caches.add(self)
+
+    def _init_metrics(self):
+        reg = _obs.get_registry()
+        self._m_hits = reg.counter(
+            'paddle_serving_prefix_hits_total',
+            'submissions served a cached prefix')
+        self._m_misses = reg.counter(
+            'paddle_serving_prefix_misses_total',
+            'submissions with no usable cached prefix')
+        self._m_reused = reg.counter(
+            'paddle_serving_prefix_tokens_reused_total',
+            'prompt tokens whose prefill was skipped via the cache')
+        self._m_inserts = reg.counter(
+            'paddle_serving_prefix_inserts_total',
+            'prefixes retained at retirement')
+        self._m_evictions = reg.counter(
+            'paddle_serving_prefix_evictions_total',
+            'retained prefixes evicted (LRU / pool pressure)')
+        self._m_retained = reg.gauge(
+            'paddle_serving_prefix_retained_slots',
+            'pool slots currently pinned by cached prefixes')
+        if _obs.enabled():
+            self._m_retained.set(0)
+
+    # -- bookkeeping --------------------------------------------------------
+    @property
+    def retained_count(self) -> int:
+        return len(self._owners)
+
+    @property
+    def reclaimable_count(self) -> int:
+        """Owning nodes evictable right now (zero refs)."""
+        return sum(1 for n in self._owners if n.refs == 0)
+
+    def _touch(self, node: _Node):
+        self._tick += 1
+        node.last_use = self._tick
+
+    # -- lookup -------------------------------------------------------------
+    @staticmethod
+    def _subtree_owner(node: _Node) -> Optional[_Node]:
+        """Most-recently-used slot-owning node at/under `node`. Any such
+        node works: its retained KV rows cover its whole root path, so
+        the first `matched` of them are exactly the querying prompt's
+        prefix KV."""
+        best, stack = None, [node]
+        while stack:
+            n = stack.pop()
+            if n.slot is not None and (best is None
+                                       or n.last_use > best.last_use):
+                best = n
+            stack.extend(n.children.values())
+        return best
+
+    def lookup(self, tokens) -> Tuple[Optional[_Node], int]:
+        """Longest common prefix between `tokens` and ANY cached entry:
+        (node, matched_len), or (None, 0). The matched length is the
+        common-prefix length — it may be shorter than the owning node's
+        own kv_len (a cached "system prompt + suffix A" serves a
+        "system prompt + suffix B" request for the shared prefix; the
+        stale A-rows above are overwritten/masked). A hit refreshes the
+        node's LRU position."""
+        tokens = list(tokens)
+        node, depth = self._root, 0
+        deepest, deepest_len = self._root, 0   # divergence point
+        best_exact: Tuple[Optional[_Node], int] = (None, 0)
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                break
+            m = _common(child.edge, tokens, depth)
+            if m < len(child.edge):
+                if m > 0:          # diverges mid-edge: the child's
+                    deepest, deepest_len = child, depth + m
+                break              # subtree still shares depth+m tokens
+            depth += m
+            node = child
+            deepest, deepest_len = node, depth
+            if node.slot is not None:
+                best_exact = (node, depth)
+        hit = self._subtree_owner(deepest)
+        if hit is not None and deepest_len > best_exact[1]:
+            best = (hit, deepest_len)
+        else:
+            best = best_exact
+        if best[0] is not None and best[1] > 0:
+            self._touch(best[0])
+            self._counts['hits'] += 1
+            self._counts['tokens_reused'] += best[1]
+            if _obs.enabled():
+                self._m_hits.inc()
+                self._m_reused.inc(best[1])
+            return best
+        self._counts['misses'] += 1
+        if _obs.enabled():
+            self._m_misses.inc()
+        return (None, 0)
+
+    # -- pinning ------------------------------------------------------------
+    def acquire(self, node: _Node):
+        """Pin `node` for the lifetime of a request admitted off it
+        (pinned nodes survive every eviction path)."""
+        node.refs += 1
+
+    def release(self, node: _Node):
+        if node.refs <= 0:
+            raise RuntimeError('prefix node released more than acquired')
+        node.refs -= 1
+
+    # -- insertion ----------------------------------------------------------
+    def insert(self, tokens, slot: int) -> bool:
+        """Retain `slot` (whose rows [0, len(tokens)) hold the prefill KV
+        of `tokens`) as a cached prefix. Returns True when the cache
+        ADOPTED the slot — the caller must NOT free it — and False when
+        the caller keeps it (already covered / under min_tokens / budget
+        exhausted by pinned entries)."""
+        tokens = list(tokens)
+        if len(tokens) < self.min_tokens or self.budget_slots < 1:
+            return False
+        node, depth = self._root, 0
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                new = _Node(tuple(tokens[depth:]), node)
+                node.children[tokens[depth]] = new
+                node, depth = new, len(tokens)
+                break
+            m = _common(child.edge, tokens, depth)
+            if m == len(child.edge):
+                node, depth = child, depth + m
+                continue
+            # split the edge at m: structural midpoint node
+            mid = _Node(child.edge[:m], node)
+            mid.children[child.edge[m]] = child
+            node.children[tokens[depth]] = mid
+            child.edge = child.edge[m:]
+            child.parent = mid
+            node, depth = mid, depth + m
+        covering = self._subtree_owner(node)
+        if covering is not None:
+            # some retained entry already extends (or equals) this
+            # prompt, so its rows already serve this prefix: refresh it
+            # rather than spending a second slot
+            self._touch(covering)
+            return False
+        while len(self._owners) >= self.budget_slots:
+            if not self.evict_lru():
+                return False        # everything is pinned
+        node.slot = int(slot)
+        node.kv_len = len(tokens)
+        self._owners.add(node)
+        self._touch(node)
+        self._counts['inserts'] += 1
+        if _obs.enabled():
+            self._m_inserts.inc()
+            self._m_retained.set(len(self._owners))
+        return True
+
+    # -- eviction -----------------------------------------------------------
+    def evict_lru(self) -> bool:
+        """Free the least-recently-used ZERO-REF retained prefix back
+        into the pool. False when every entry is pinned (or empty)."""
+        cands = [n for n in self._owners if n.refs == 0]
+        if not cands:
+            return False
+        victim = min(cands, key=lambda n: n.last_use)
+        slot, kv_len = victim.slot, victim.kv_len
+        self.pool.free(victim.slot)
+        victim.slot = None
+        victim.kv_len = 0
+        self._owners.discard(victim)
+        # prune now-empty leaves upward (structural nodes with children
+        # stay: they still route longer retained paths)
+        n = victim
+        while (n.parent is not None and n.slot is None
+               and not n.children):
+            del n.parent.children[n.edge[0]]
+            n = n.parent
+        self._counts['evictions'] += 1
+        if _obs.enabled():
+            self._m_evictions.inc()
+            self._m_retained.set(len(self._owners))
+        _obs.emit('prefix_evict', slot=slot, kv_len=kv_len,
+                  retained=len(self._owners))
+        return True
+
+    def clear(self):
+        """Evict every unpinned entry (tests / manual reset)."""
+        while self.evict_lru():
+            pass
+
+    # -- introspection ------------------------------------------------------
+    def _node_count(self) -> int:
+        n, stack = 0, [self._root]
+        while stack:
+            node = stack.pop()
+            n += 1
+            stack.extend(node.children.values())
+        return n - 1                # root is structural
+
+    def stats(self) -> dict:
+        return {
+            'budget_slots': self.budget_slots,
+            'retained_slots': len(self._owners),
+            'pinned': sum(1 for n in self._owners if n.refs > 0),
+            'nodes': self._node_count(),
+            **self._counts,
+        }
+
+    def snapshot(self) -> dict:
+        """Flight-recorder view: stats + the retained prefix inventory
+        (lengths + pin state, NOT token contents — prompts are user
+        data and postmortem bundles travel)."""
+        return {
+            **self.stats(),
+            'entries': sorted(
+                ({'kv_len': n.kv_len, 'slot': n.slot, 'refs': n.refs,
+                  'last_use': n.last_use} for n in self._owners),
+                key=lambda e: -e['last_use']),
+        }

@@ -55,7 +55,8 @@ from ..resilience.retry import is_transient
 from .api import (FAILED, FINISHED, PRIORITY_LOW, QUEUED, RequestHandle,
                   SamplingParams)
 from .engine import InferenceEngine
-from .tenancy import AdmissionRejected, TenantRegistry, parse_tenant_spec
+from .tenancy import (AdmissionRejected, TenantRegistry,
+                      estimate_queue_rounds, parse_tenant_spec)
 
 _router_ids = itertools.count()
 
@@ -446,13 +447,24 @@ class Router:
     def _estimated_ttft_s(self) -> Optional[float]:
         """Queue wait estimate for a NEW request: rounds of queued work
         ahead of it divided over serving replicas, times the observed
-        round time. None until a round has been timed."""
+        round time. Chunking-aware: on a chunked-prefill engine each
+        queued prompt costs ceil(prompt/chunk) CHEAP rounds (the round
+        time the EMA observes is chunk-bounded), not one whole-prompt
+        prefill — charging full prefills against chunk-sized round
+        times would over-fire the shed budget. None until a round has
+        been timed."""
         if self._ema_round_s is None:
             return None
         serving = sum(1 for r in self.replicas
                       if not r.health_states()
                       and r.breaker.state != BREAKER_OPEN) or 1
-        return (self.queue_depth / serving + 1) * self._ema_round_s
+        rounds = sum(
+            estimate_queue_rounds(
+                (len(h.prompt_tokens)
+                 for h in r.engine.scheduler.pending()),
+                r.engine.prefill_chunk_tokens)
+            for r in self.replicas)
+        return (rounds / serving + 1) * self._ema_round_s
 
     def _reject(self, tenant: str, reason: str,
                 retry_after: Optional[float], detail: str = ''):
@@ -751,7 +763,7 @@ class Router:
                 'health_states': sorted(r.health_states()),
                 'outstanding_tokens': r.outstanding_tokens(),
                 'queued': r.engine.scheduler.queue_depth,
-                'active_slots': r.engine.pool.used_count,
+                'active_slots': len(r.engine._slot_req),
                 'failures': r.failures,
             })
         return {

@@ -74,6 +74,14 @@ class FCFSScheduler:
         self._queue.append(handle)
         self._note_depth()
 
+    def requeue(self, handle: RequestHandle):
+        """Put an already-admitted handle back at the queue FRONT (the
+        engine could not actually seat it — e.g. the free slot it was
+        promised got pinned by a prefix-cache hit in the same admission
+        pass). Front insertion preserves FCFS-within-class order."""
+        self._queue.insert(0, handle)
+        self._note_depth()
+
     def cancel(self, handle: RequestHandle) -> bool:
         """Drop a still-queued request; False if it already left the
         queue (running requests retire through the engine)."""

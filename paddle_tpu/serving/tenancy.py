@@ -168,6 +168,29 @@ class TenantRegistry:
         return name in self._tenants
 
 
+def prefill_rounds(prompt_len: int, chunk_tokens: Optional[int]) -> int:
+    """Scheduler iterations a queued prompt occupies before its slot can
+    decode: ONE whole-prompt prefill without chunking, else
+    ceil(prompt/chunk) bounded chunk rounds. The shed estimator's unit
+    of head-of-line delay."""
+    if not chunk_tokens or chunk_tokens <= 0:
+        return 1
+    return max(1, -(-int(prompt_len) // int(chunk_tokens)))
+
+
+def estimate_queue_rounds(queued_prompt_lens,
+                          chunk_tokens: Optional[int] = None) -> float:
+    """Rounds of prefill work ahead of a NEW request: queue depth x
+    per-prompt chunk rounds — NOT x whole-prompt prefills. With chunked
+    prefill enabled, each round is bounded by the chunk bucket, so the
+    observed round time stays small and a queued long prompt is many
+    CHEAP rounds instead of one expensive one; an estimator that still
+    charged a full-prompt prefill per queued request would over-fire the
+    shed budget the moment chunking lands (the old behavior)."""
+    return float(sum(prefill_rounds(s, chunk_tokens)
+                     for s in queued_prompt_lens))
+
+
 _SPEC_KEYS = {'priority': str, 'rate': float, 'burst': float,
               'concurrency': int, 'max_concurrency': int}
 

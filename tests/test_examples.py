@@ -61,6 +61,19 @@ def test_serve_gpt_example_serves_all_requests(capsys):
 
 
 @pytest.mark.slow
+def test_serve_gpt_example_latency_stack(capsys):
+    mod = runpy.run_path(f'{EX}/serve_gpt.py')
+    handles = mod['main'](num_requests=6, prefix_cache=0.5,
+                          prefill_chunk=8, draft_model='self')
+    assert all(h.status == 'FINISHED' for h in handles)
+    assert all(h.tokens for h in handles)
+    out = capsys.readouterr().out
+    assert 'prefix cache:' in out
+    assert 'chunked prefill:' in out
+    assert 'speculation (k=3):' in out
+
+
+@pytest.mark.slow
 def test_serve_gpt_example_routed_replicas_and_tenants(capsys):
     mod = runpy.run_path(f'{EX}/serve_gpt.py')
     handles = mod['main'](

@@ -1,0 +1,273 @@
+"""Radix prefix cache: lifecycle gauntlet + engine integration.
+
+ISSUE-9 acceptance surface: ref-count pinning under a full pool, LRU
+eviction under budget pressure, hit-after-evict-and-repopulate, and
+bit-identical greedy outputs for shared-prefix vs cold-prefill
+requests (with zero recompiles across cache churn). Unit tests drive
+`RadixPrefixCache` directly over a real `SlotPool`; the integration
+tests drive it through `InferenceEngine(prefix_cache=...)`.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import (InferenceEngine, RadixPrefixCache,
+                                SamplingParams, SlotPool)
+
+NO_EOS = -1
+
+
+@pytest.fixture(scope='module')
+def gpt():
+    paddle.seed(7)
+    return GPTForCausalLM(GPTConfig.tiny()).eval()
+
+
+def _pool(gpt, n=4):
+    return SlotPool(gpt, num_slots=n, max_length=32)
+
+
+def _ref_generate(model, prompt, max_new):
+    out, _ = model.generate(
+        paddle.to_tensor(np.array([prompt])), max_new_tokens=max_new,
+        decode_strategy='greedy_search', eos_token_id=NO_EOS)
+    return out.numpy()[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# radix mechanics
+# ---------------------------------------------------------------------------
+
+class TestRadixMechanics:
+    def test_insert_adopts_slot_and_lookup_matches(self, gpt):
+        pool = _pool(gpt)
+        cache = RadixPrefixCache(pool, fraction=0.75)
+        s = pool.alloc()
+        assert cache.insert([1, 2, 3, 4], s)
+        assert pool.used_count == 1          # adopted, not freed
+        node, matched = cache.lookup([1, 2, 3, 4, 9, 9])
+        assert node is not None and matched == 4
+        assert node.slot == s
+
+    def test_common_prefix_serves_diverging_prompt(self, gpt):
+        """A cached 'system + suffix A' entry serves a 'system +
+        suffix B' request for the shared prefix — the RadixAttention
+        semantics, not exact-prompt matching."""
+        pool = _pool(gpt)
+        cache = RadixPrefixCache(pool, fraction=0.75)
+        cache.insert([5, 6, 7, 8, 100, 101], pool.alloc())
+        node, matched = cache.lookup([5, 6, 7, 8, 200, 201, 202])
+        assert node is not None and matched == 4
+        # and an unrelated prompt misses
+        assert cache.lookup([9, 9, 9]) == (None, 0)
+        st = cache.stats()
+        assert st['hits'] == 1 and st['misses'] == 1
+        assert st['tokens_reused'] == 4
+
+    def test_edge_split_and_exact_cover_dedup(self, gpt):
+        pool = _pool(gpt)
+        cache = RadixPrefixCache(pool, fraction=1.0)
+        s1, s2 = pool.alloc(), pool.alloc()
+        assert cache.insert([1, 2, 3, 4], s1)
+        # a prefix of a retained path is already covered: NOT adopted
+        assert not cache.insert([1, 2], s2)
+        pool.free(s2)
+        # a sibling path splits the edge and retains separately
+        s3 = pool.alloc()
+        assert cache.insert([1, 2, 9, 9], s3)
+        n1, m1 = cache.lookup([1, 2, 3, 4])
+        n2, m2 = cache.lookup([1, 2, 9, 9, 5])
+        assert m1 == 4 and m2 == 4 and n1 is not n2
+        assert cache.stats()['retained_slots'] == 2
+
+    def test_budget_leaves_decode_capacity(self, gpt):
+        pool = _pool(gpt, n=2)
+        cache = RadixPrefixCache(pool, fraction=1.0)
+        # fraction 1.0 still clamps to num_slots - 1
+        assert cache.budget_slots == 1
+
+
+# ---------------------------------------------------------------------------
+# lifecycle gauntlet
+# ---------------------------------------------------------------------------
+
+class TestLifecycleGauntlet:
+    def test_refcount_pins_under_full_pool(self, gpt):
+        """A pinned node survives pool pressure: eviction skips it and
+        reports no reclaimable capacity."""
+        pool = _pool(gpt, n=3)
+        cache = RadixPrefixCache(pool, fraction=0.9)   # budget 2
+        cache.insert([1, 2, 3], pool.alloc())
+        node, matched = cache.lookup([1, 2, 3, 7])
+        cache.acquire(node)
+        assert cache.reclaimable_count == 0
+        assert not cache.evict_lru()        # pinned: nothing to evict
+        assert node.slot is not None
+        cache.release(node)
+        assert cache.reclaimable_count == 1
+        assert cache.evict_lru()            # unpinned: evicts and frees
+        assert pool.free_count == 3
+        with pytest.raises(RuntimeError):
+            cache.release(node)             # over-release is a bug
+
+    def test_lru_eviction_under_budget_pressure(self, gpt):
+        pool = _pool(gpt, n=4)
+        cache = RadixPrefixCache(pool, fraction=0.5)   # budget 2
+        cache.insert([1, 1, 1], pool.alloc())
+        cache.insert([2, 2, 2], pool.alloc())
+        # refresh [1,1,1] so [2,2,2] is the LRU
+        assert cache.lookup([1, 1, 1])[1] == 3
+        cache.insert([3, 3, 3], pool.alloc())   # evicts LRU [2,2,2]
+        assert cache.stats()['retained_slots'] == 2
+        assert cache.lookup([2, 2, 2]) == (None, 0)
+        assert cache.lookup([1, 1, 1])[1] == 3
+        assert cache.lookup([3, 3, 3])[1] == 3
+        assert cache.stats()['evictions'] == 1
+        assert pool.used_count == 2         # evicted slot back in pool
+
+    def test_hit_after_evict_and_repopulate(self, gpt):
+        pool = _pool(gpt, n=3)
+        cache = RadixPrefixCache(pool, fraction=0.5)   # budget 1
+        s = pool.alloc()
+        assert cache.insert([4, 5, 6, 7], s)
+        assert cache.evict_lru()
+        assert cache.lookup([4, 5, 6, 7]) == (None, 0)
+        s2 = pool.alloc()
+        assert cache.insert([4, 5, 6, 7], s2)   # repopulate same path
+        node, matched = cache.lookup([4, 5, 6, 7, 8])
+        assert matched == 4 and node.slot == s2
+
+    def test_eviction_emits_event_and_metrics(self, gpt):
+        pool = _pool(gpt, n=3)
+        cache = RadixPrefixCache(pool, fraction=0.5)
+        reg = obs.get_registry()
+        ev0 = reg.value('paddle_serving_prefix_evictions_total')
+        log = obs.get_event_log()
+        n0 = len(log.events())
+        cache.insert([1, 2, 3, 4, 5], pool.alloc())
+        assert cache.evict_lru()
+        assert reg.value('paddle_serving_prefix_evictions_total') \
+            == ev0 + 1
+        names = [e['name'] for e in log.events()[n0:]]
+        assert 'prefix_evict' in names
+
+
+# ---------------------------------------------------------------------------
+# engine integration: parity + pinning + recompiles
+# ---------------------------------------------------------------------------
+
+class TestEngineIntegration:
+    def _shared_prefix_trace(self, vocab=128, seed=3):
+        rng = np.random.RandomState(seed)
+        system = rng.randint(1, vocab, (16,)).tolist()
+        return [system + rng.randint(1, vocab, (k,)).tolist()
+                for k in (3, 6, 4, 8, 5)]
+
+    def test_shared_prefix_bit_identical_to_cold(self, gpt):
+        """The acceptance bar: greedy outputs with the cache on are
+        bit-identical to per-request generate() — for cache-seeding
+        requests, suffix-prefilled hits, AND full-prompt hits."""
+        prompts = self._shared_prefix_trace()
+        refs = [_ref_generate(gpt, p, 6) for p in prompts]
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              decode_block=2, prefix_cache=True)
+        sp = [SamplingParams(max_new_tokens=6, eos_token_id=NO_EOS)] * 5
+        hs = eng.generate_many(prompts, sp)
+        assert [h.tokens for h in hs] == refs
+        st = eng.stats()['prefix_cache']
+        assert st['hits'] > 0 and st['tokens_reused'] > 0
+        traces = dict(eng.stats()['traces'])
+        compiles0 = obs.get_registry().value('paddle_jit_compiles_total')
+        # wave 2: same prompts — now including FULL-prompt hits (zero
+        # prefill) — still bit-identical, still zero recompiles
+        hs2 = eng.generate_many(prompts, sp)
+        assert [h.tokens for h in hs2] == refs
+        assert eng.stats()['traces'] == traces
+        assert obs.get_registry().value('paddle_jit_compiles_total') \
+            == compiles0
+        # wave 2 reused strictly more than wave 1
+        assert eng.stats()['prefix_cache']['hits'] > st['hits']
+
+    def test_prefill_tokens_actually_saved(self, gpt):
+        prompts = self._shared_prefix_trace(seed=9)
+        sp = [SamplingParams(max_new_tokens=4, eos_token_id=NO_EOS)] * 5
+        cold = InferenceEngine(gpt, num_slots=2, max_length=64,
+                               decode_block=2)
+        cold.generate_many(prompts, sp)
+        warm = InferenceEngine(gpt, num_slots=2, max_length=64,
+                               decode_block=2, prefix_cache=True)
+        warm.generate_many(prompts, sp)
+        assert warm.stats()['prefill_tokens'] \
+            < cold.stats()['prefill_tokens']
+
+    def test_pool_pressure_reclaims_retained_slots(self, gpt):
+        """More live requests than unretained slots: the engine evicts
+        zero-ref cached prefixes to seat new work (retention never
+        starves decode)."""
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              decode_block=2, prefix_cache=True)
+        prompts = self._shared_prefix_trace(seed=5)
+        sp = [SamplingParams(max_new_tokens=4, eos_token_id=NO_EOS)] * 5
+        hs = eng.generate_many(prompts, sp)
+        assert all(h.status == 'FINISHED' for h in hs)
+        assert [h.tokens for h in hs] \
+            == [_ref_generate(gpt, p, 4) for p in prompts]
+        # after drain: retained entries remain, but never more than the
+        # budget, and no slot leaked
+        st = eng.stats()['prefix_cache']
+        assert st['retained_slots'] <= st['budget_slots']
+        assert eng.pool.free_count \
+            == eng.pool.num_slots - st['retained_slots']
+
+    def test_admission_batch_survives_pinned_reclaim(self, gpt):
+        """Regression: when a mid-pass allocation fails because sibling
+        admissions pinned the reclaimable entries, the WHOLE remaining
+        popped batch must return to the queue — nothing may strand in
+        QUEUED with the scheduler unaware of it."""
+        eng = InferenceEngine(gpt, num_slots=3, max_length=64,
+                              decode_block=2, prefix_cache=0.9)
+        prompts = self._shared_prefix_trace(seed=29)  # 5 shared-prefix
+        refs = [_ref_generate(gpt, p, 5) for p in prompts]
+        sp = SamplingParams(max_new_tokens=5, eos_token_id=NO_EOS)
+        # seed the cache so the burst below hits (and pins) entries
+        eng.submit(prompts[0], sp)
+        eng.run()
+        hs = [eng.submit(p, sp) for p in prompts]    # burst > slots
+        eng.run()
+        assert [h.status for h in hs] == ['FINISHED'] * 5
+        assert [h.tokens for h in hs] == refs
+        assert eng.scheduler.queue_depth == 0
+
+    def test_full_prompt_hit_skips_prefill_entirely(self, gpt):
+        prompt = self._shared_prefix_trace(seed=13)[0]
+        ref = _ref_generate(gpt, prompt, 5)
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              decode_block=2, prefix_cache=True)
+        sp = SamplingParams(max_new_tokens=5, eos_token_id=NO_EOS)
+        h1 = eng.submit(prompt, sp)
+        eng.run()
+        prefills_after_seed = eng.stats()['prefills'] \
+            + eng.stats()['chunk_rounds']
+        h2 = eng.submit(prompt, sp)       # identical prompt: full hit
+        eng.run()
+        assert h1.tokens == h2.tokens == ref
+        assert eng.stats()['prefills'] + eng.stats()['chunk_rounds'] \
+            == prefills_after_seed        # ZERO prefill work for h2
+
+    def test_flight_recorder_bundle_includes_prefix_state(self, gpt,
+                                                          tmp_path):
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              prefix_cache=True)
+        eng.generate_many(
+            self._shared_prefix_trace(seed=21)[:2],
+            [SamplingParams(max_new_tokens=3, eos_token_id=NO_EOS)] * 2)
+        rec = obs.get_flight_recorder()
+        path = rec.dump(dir=str(tmp_path), reason='manual')
+        import json
+        import os
+        with open(os.path.join(path, 'prefix_cache.json')) as f:
+            caches = json.load(f)
+        assert any(c['retained_slots'] >= 1 for c in caches)
+        assert all('entries' in c for c in caches)

@@ -120,6 +120,51 @@ class TestTenancy:
         assert a.priority == PRIORITY_LOW and a.bucket is not None
         assert a is reg.get('a') and a is not b   # separate accounting
 
+    def test_chunking_aware_prefill_rounds(self):
+        """ISSUE-9 satellite: the shed estimator's unit of head-of-line
+        delay is chunk rounds, not whole-prompt prefills."""
+        from paddle_tpu.serving.tenancy import (estimate_queue_rounds,
+                                                prefill_rounds)
+        # unchunked: every prompt is one prefill round (the old model)
+        assert prefill_rounds(500, None) == 1
+        assert prefill_rounds(500, 0) == 1
+        # chunked: ceil(prompt / chunk), floor 1
+        assert prefill_rounds(500, 100) == 5
+        assert prefill_rounds(501, 100) == 6
+        assert prefill_rounds(3, 100) == 1
+        assert estimate_queue_rounds([500, 3, 250], 100) == 5 + 1 + 3
+        assert estimate_queue_rounds([500, 3, 250], None) == 3
+        assert estimate_queue_rounds([], 100) == 0
+
+    def test_estimator_counts_chunk_rounds_not_prompts(self, gpt):
+        """A router over a chunking engine estimates TTFT from queued
+        CHUNK rounds; the same queue on an unchunked engine counts one
+        round per prompt — so chunk-bounded round times don't get
+        multiplied into whole-prompt estimates (shed over-fire)."""
+        from paddle_tpu.serving import ReplicaSet
+        long_prompt = _prompts([30], seed=77)[0]
+
+        def est(chunk):
+            r = Router(ReplicaSet(gpt, 1, num_slots=1, max_length=64,
+                                  decode_block=2,
+                                  prefill_chunk_tokens=chunk))
+            eng = r.replicas[0].engine
+            # occupy the only slot, then queue two long prompts
+            h = r.submit(_prompts([4], seed=78)[0], _sp(30))
+            r.step()
+            r.submit(long_prompt, _sp(4))
+            r.submit(long_prompt, _sp(4))
+            r._ema_round_s = 0.010      # pin the round time: isolate
+            est = r._estimated_ttft_s()  # the rounds model
+            r.run()
+            _assert_none_dangle([h])
+            return est
+        unchunked = est(None)
+        chunked = est(8)
+        # two queued 30-token prompts: 2 rounds unchunked vs 2*ceil(30/8)
+        assert unchunked == pytest.approx((2 + 1) * 0.010)
+        assert chunked == pytest.approx((8 + 1) * 0.010)
+
 
 # ---------------------------------------------------------------------------
 # circuit breaker

@@ -443,6 +443,174 @@ def test_serving_metrics_and_summary(gpt):
 
 
 # ---------------------------------------------------------------------------
+# ISSUE-9: chunked prefill
+# ---------------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    def test_long_prompt_chunks_and_matches_generate(self, gpt):
+        prompts = _prompts([26, 4, 17, 9], seed=31)
+        refs = [_ref_generate(gpt, p, 5) for p in prompts]
+        eng = InferenceEngine(gpt, num_slots=4, max_length=64,
+                              decode_block=2, prefill_chunk_tokens=8)
+        hs = eng.generate_many(
+            prompts, [SamplingParams(max_new_tokens=5,
+                                     eos_token_id=NO_EOS)] * 4)
+        assert [h.tokens for h in hs] == refs
+        st = eng.stats()
+        assert st['chunked_prefills'] == 3      # the 26/17/9-token ones
+        assert st['chunk_rounds'] >= 4
+        assert st['prefill_tokens'] == sum(len(p) for p in prompts)
+
+    def test_short_requests_stream_while_long_prefills(self, gpt):
+        """The TTFT story: with chunking, a short request admitted with
+        a long one gets its first token BEFORE the long prompt finishes
+        prefilling."""
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              decode_block=2, prefill_chunk_tokens=8)
+        long_h = eng.submit(_prompts([30], seed=33)[0],
+                            SamplingParams(max_new_tokens=4,
+                                           eos_token_id=NO_EOS))
+        short_h = eng.submit(_prompts([3], seed=34)[0],
+                             SamplingParams(max_new_tokens=4,
+                                            eos_token_id=NO_EOS))
+        eng.step()
+        eng.step()
+        assert short_h.tokens                  # already streaming
+        assert not long_h.tokens               # still chunking
+        assert long_h.status == 'RUNNING'
+        eng.run()
+        assert long_h.tokens == _ref_generate(gpt,
+                                              long_h.prompt_tokens, 4)
+
+    def test_chunked_zero_recompiles_across_waves(self, gpt):
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              decode_block=2, prefill_chunk_tokens=8)
+        sp = [SamplingParams(max_new_tokens=4, eos_token_id=NO_EOS)] * 3
+        eng.generate_many(_prompts([25, 6, 14], seed=35), sp)
+        traces = dict(eng.stats()['traces'])
+        compiles = obs.get_registry().value('paddle_jit_compiles_total')
+        hs = eng.generate_many(_prompts([22, 5, 12], seed=36), sp)
+        assert all(h.status == FINISHED for h in hs)
+        assert eng.stats()['traces'] == traces
+        assert obs.get_registry().value('paddle_jit_compiles_total') \
+            == compiles
+
+    def test_chunked_drain_finishes_mid_prefill_requests(self, gpt):
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              decode_block=2, prefill_chunk_tokens=8)
+        h = eng.submit(_prompts([28], seed=37)[0],
+                       SamplingParams(max_new_tokens=3,
+                                      eos_token_id=NO_EOS))
+        eng.step()                     # mid-chunked-prefill
+        assert not h.tokens
+        try:
+            assert eng.drain(deadline_s=120.0)
+            assert h.status == FINISHED
+            assert h.tokens == _ref_generate(gpt, h.prompt_tokens, 3)
+        finally:
+            obs.clear_degraded('draining')
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-9: per-slot speculative decoding
+# ---------------------------------------------------------------------------
+
+class TestSpeculativeEngine:
+    def _draft(self):
+        paddle.seed(99)
+        return GPTForCausalLM(
+            GPTConfig.tiny(num_hidden_layers=1)).eval()
+
+    def test_independent_draft_bit_identical_greedy(self, gpt):
+        """The exactness guarantee, in-engine: even a draft that almost
+        never agrees leaves greedy outputs token-identical."""
+        prompts = _prompts([4, 9, 6], seed=41)
+        refs = [_ref_generate(gpt, p, 7) for p in prompts]
+        eng = InferenceEngine(gpt, num_slots=3, max_length=64,
+                              decode_block=2, draft_model=self._draft(),
+                              num_draft_tokens=3)
+        hs = eng.generate_many(
+            prompts, [SamplingParams(max_new_tokens=7,
+                                     eos_token_id=NO_EOS)] * 3)
+        assert [h.tokens for h in hs] == refs
+        sp = eng.stats()['spec']
+        assert sp['rounds'] > 0 and sp['proposed'] > 0
+
+    def test_self_draft_accepts_and_advances_multiple(self, gpt):
+        """Draft == target: near-total acceptance, so requests finish in
+        far fewer rounds than tokens."""
+        prompts = _prompts([5, 8], seed=43)
+        refs = [_ref_generate(gpt, p, 12) for p in prompts]
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              draft_model=gpt, num_draft_tokens=4)
+        hs = eng.generate_many(
+            prompts, [SamplingParams(max_new_tokens=12,
+                                     eos_token_id=NO_EOS)] * 2)
+        assert [h.tokens for h in hs] == refs
+        sp = eng.stats()['spec']
+        assert sp['rounds'] <= 8               # vs 12+ single-token rounds
+        assert sp['acceptance_rate'] > 0.5
+        assert obs.get_registry().value(
+            'paddle_serving_spec_accepted_total') > 0
+        assert obs.get_registry().value(
+            'paddle_spec_rounds_total', source='engine') > 0
+
+    def test_sampling_rows_unaffected_by_speculation(self, gpt):
+        """Sampling requests in a speculating engine take the plain
+        per-round sampling path: same seed => same tokens, and greedy
+        neighbours still match generate()."""
+        prompt = _prompts([6], seed=45)[0]
+        sp = dict(max_new_tokens=8, strategy='sampling', temperature=1.4,
+                  top_k=24, eos_token_id=NO_EOS)
+        eng = InferenceEngine(gpt, num_slots=3, max_length=64,
+                              draft_model=gpt, num_draft_tokens=3)
+        h1 = eng.submit(prompt, SamplingParams(seed=7, **sp))
+        h2 = eng.submit(prompt, SamplingParams(seed=7, **sp))
+        h3 = eng.submit(prompt, SamplingParams(max_new_tokens=8,
+                                               eos_token_id=NO_EOS))
+        eng.run()
+        assert h1.tokens == h2.tokens
+        assert h3.tokens == _ref_generate(gpt, prompt, 8)
+
+    def test_eos_retires_mid_round(self, gpt):
+        prompt = _prompts([6], seed=47)[0]
+        ref = _ref_generate(gpt, prompt, 10)
+        eos = ref[3]
+        expected = _trim_at_eos(ref, eos)
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              draft_model=gpt, num_draft_tokens=4)
+        h = eng.submit(prompt, SamplingParams(max_new_tokens=10,
+                                              eos_token_id=eos))
+        eng.run()
+        assert h.status == FINISHED and h.tokens == expected
+        assert eng.pool.free_count == 2
+
+    def test_spec_headroom_validated_at_submit(self, gpt):
+        eng = InferenceEngine(gpt, num_slots=2, max_length=32,
+                              draft_model=gpt, num_draft_tokens=4)
+        with pytest.raises(ValueError, match='speculation headroom'):
+            eng.submit(list(range(1, 21)),
+                       SamplingParams(max_new_tokens=10))
+        # the same request fits a non-speculating engine
+        eng2 = InferenceEngine(gpt, num_slots=2, max_length=32)
+        eng2.submit(list(range(1, 21)), SamplingParams(max_new_tokens=10))
+
+    def test_spec_zero_recompiles_across_waves(self, gpt):
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              draft_model=self._draft(),
+                              num_draft_tokens=3)
+        sp = [SamplingParams(max_new_tokens=4, eos_token_id=NO_EOS)] * 3
+        eng.generate_many(_prompts([3, 9, 6], seed=49), sp)
+        traces = dict(eng.stats()['traces'])
+        compiles = obs.get_registry().value('paddle_jit_compiles_total')
+        hs = eng.generate_many(_prompts([4, 8, 5], seed=50), sp)
+        assert all(h.status == FINISHED for h in hs)
+        assert eng.stats()['traces'] == traces
+        assert obs.get_registry().value('paddle_jit_compiles_total') \
+            == compiles
+
+
+# ---------------------------------------------------------------------------
 # tier-1 bench guard: bit-identical outputs + zero recompiles + speedup
 # ---------------------------------------------------------------------------
 
@@ -456,6 +624,60 @@ def test_bench_serving_guard():
     # sanity-check both arms actually ran
     assert res['engine_tokens_per_sec'] > 0
     assert res['sequential_tokens_per_sec'] > 0
+
+
+def test_bench_prefix_guard():
+    import bench
+    res = bench.prefix_ab(num_requests=8, num_slots=10, trials=1)
+    assert res['parity'], 'prefix-cache outputs diverged from generate()'
+    assert res['recompiles_after_warmup'] == 0, \
+        'prefix-cache trace recompiled after warmup'
+    assert res['cache_hits'] > 0
+    # the shared-system-prompt trace must collapse prefill to suffixes
+    # (the >= 30% acceptance bar, with margin even at guard scale)
+    assert res['prefill_token_reduction'] >= 0.3
+
+
+def test_bench_chunked_guard():
+    import bench
+    res = bench.chunked_ab(num_short=4, long_len=48, max_length=64,
+                           num_slots=6, chunk=16, trials=1)
+    assert res['parity'], 'chunked outputs diverged from generate()'
+    assert res['recompiles_after_warmup'] == 0, \
+        'chunked trace recompiled after warmup'
+    assert res['chunk_rounds'] >= 2
+    # the p50-TTFT ratio is asserted on the full bench run where the
+    # structural gap dwarfs CI noise; here both arms must report
+    assert res['p50_short_ttft_ms_chunked'] > 0
+    assert res['p50_short_ttft_ms_unchunked'] > 0
+
+
+def test_bench_spec_guard():
+    import bench
+    res = bench.spec_ab(num_requests=4, num_slots=4, max_new=16,
+                        distill_steps=60, trials=1)
+    assert res['parity'], 'speculative outputs diverged from generate()'
+    assert res['recompiles_after_warmup'] == 0, \
+        'speculative trace recompiled after warmup'
+    assert res['acceptance_rate'] > 0
+    assert res['tokens_per_sec_spec'] > 0
+    assert res['tokens_per_sec_plain'] > 0
+
+
+def test_bench_stack_guard():
+    """The ISSUE-9 composed-stack acceptance bar: prefix cache +
+    chunked prefill + speculative decoding ALL enabled, greedy outputs
+    bit-identical to generate(), zero compiles after warmup by both
+    the python trace counters AND paddle_jit_compiles_total."""
+    import bench
+    res = bench.stack_ab(num_requests=8, num_slots=6)
+    assert res['parity'], 'composed latency stack diverged from ' \
+                          'generate()'
+    assert res['recompiles_after_warmup'] == 0
+    assert res['jit_compiles_delta'] == 0
+    assert res['completed'] == 8
+    assert res['prefix_hits'] > 0
+    assert res['chunk_rounds'] > 0
 
 
 # ---------------------------------------------------------------------------
