@@ -173,6 +173,8 @@ def main(num_requests=10, metrics_port=None, replicas=1, tenants=None,
         handles = _serve_single(model, requests,
                                 engine_kwargs=engine_kwargs)
     print(debug.observability_summary())
+    # the exit ledger: where every wall-clock second of this run went
+    print(observability.get_ledger().report_text())
     return handles
 
 

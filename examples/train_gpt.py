@@ -127,6 +127,8 @@ def main(steps=80, vocab=512, seq=64, batch=8, ckpt_dir=None, resume=None,
     # one call reports dispatch hit-rate, jit compiles, comm/offload
     # bytes, throughput, memory — and now resilience/checkpoint activity
     print(debug.observability_summary())
+    # the exit ledger: where every wall-clock second of this run went
+    print(observability.get_ledger().report_text())
     return float(loss.numpy()) if loss is not None else float('nan')
 
 
@@ -198,6 +200,8 @@ def main_elastic(steps=60, vocab=512, seq=64, batch=8, ckpt_dir=None,
                       f'exiting cleanly')
                 break
     print(debug.observability_summary())
+    # the exit ledger: where every wall-clock second of this run went
+    print(observability.get_ledger().report_text())
     return float(loss.numpy()) if loss is not None else float('nan')
 
 

@@ -203,7 +203,14 @@ def _observability_data(max_rows: int = 10) -> dict:
             'total': int(reg.value('paddle_steps_total')),
             'steps_per_sec': reg.value('paddle_steps_per_sec'),
             'tokens_per_sec': reg.value('paddle_tokens_per_sec'),
-            'loss_last': reg.value('paddle_loss_last')},
+            'loss_last': reg.value('paddle_loss_last'),
+            # trailing-window step-time percentiles off the train.step
+            # span histogram (the windowed quantile sketch — no
+            # Prometheus-side bucket math)
+            'step_time_quantiles_ms': _span_quantiles_ms(
+                reg, 'train.step') or _span_quantiles_ms(
+                    reg, 'fleet.dist_train_step')
+            or _span_quantiles_ms(reg, 'step.compute')},
         'memory': {
             'watermark_bytes': reg.value('paddle_memory_watermark_bytes')},
         'resilience': {
@@ -236,6 +243,10 @@ def _observability_data(max_rows: int = 10) -> dict:
             'tokens': int(reg.value('paddle_serving_tokens_total')),
             'ttft_avg_ms': _hist_avg_ms(reg, 'paddle_serving_ttft_seconds'),
             'tpot_avg_ms': _hist_avg_ms(reg, 'paddle_serving_tpot_seconds'),
+            'ttft_quantiles_ms': _hist_quantiles_ms(
+                reg, 'paddle_serving_ttft_seconds'),
+            'tpot_quantiles_ms': _hist_quantiles_ms(
+                reg, 'paddle_serving_tpot_seconds'),
             'prefills': int(_labeled_total(
                 reg, 'paddle_serving_prefills_total')),
             'decode_steps': int(reg.value(
@@ -265,6 +276,8 @@ def _observability_data(max_rows: int = 10) -> dict:
                     'paddle_serving_spec_accepted_total'))}},
         'router': _router_data(reg),
         'elastic': _elastic_data(reg),
+        'goodput': _obs.get_ledger().report(),
+        'roofline': _obs.roofline_summary(max_rows=max_rows),
         'programs': _obs.program_catalog().top_programs(n=max_rows),
         'program_store': _program_store_data(),
         'spans': span_rows,
@@ -385,6 +398,11 @@ def observability_summary(max_rows: int = 10, as_dict: bool = False):
         f'{st["steps_per_sec"]:.2f} steps/s  '
         f'{st["tokens_per_sec"]:.1f} tokens/s  '
         f'loss {st["loss_last"]:.4f}')
+    if st['step_time_quantiles_ms']:
+        qs = st['step_time_quantiles_ms']
+        lines.append('    step time ' + '  '.join(
+            f'p{float(q) * 100:g} {v:.2f} ms' for q, v in sorted(
+                qs.items(), key=lambda kv: float(kv[0]))))
     lines.append(
         f'  memory: watermark '
         f'{d["memory"]["watermark_bytes"] / 2**20:.1f} MiB')
@@ -411,6 +429,17 @@ def observability_summary(max_rows: int = 10, as_dict: bool = False):
         f'tpot avg {sv["tpot_avg_ms"]:.2f} ms  '
         f'{sv["prefills"]} prefills  '
         f'{sv["decode_steps"]} decode steps')
+    if sv['ttft_quantiles_ms']:
+        ttft_q = '  '.join(f'p{float(q) * 100:g} {v:.2f}'
+                           for q, v in sorted(
+                               sv['ttft_quantiles_ms'].items(),
+                               key=lambda kv: float(kv[0])))
+        tpot_q = '  '.join(f'p{float(q) * 100:g} {v:.2f}'
+                           for q, v in sorted(
+                               sv['tpot_quantiles_ms'].items(),
+                               key=lambda kv: float(kv[0])))
+        lines.append(f'    ttft ms: {ttft_q}'
+                     + (f'  |  tpot ms: {tpot_q}' if tpot_q else ''))
     px, chk, spc = sv['prefix'], sv['chunk'], sv['spec']
     hit_rate = (px['hits'] / (px['hits'] + px['misses'])
                 if px['hits'] + px['misses'] else 0.0)
@@ -442,6 +471,36 @@ def observability_summary(max_rows: int = 10, as_dict: bool = False):
         lines.append(
             f'    {h["kind"]:<7} {h["from_devices"]}->{h["to_devices"]} '
             f'devices  mesh {h["to"]}  ({h["reason"]})')
+    gp = d['goodput']
+    lines.append(
+        f'  goodput: {gp["wall_seconds"]:.1f} s wall  '
+        f'{gp["attributed_seconds"]:.1f} s attributed  '
+        f'residual {gp["fractions"]["residual"]:.1%}'
+        + (f'  (+{gp["overcount_seconds"]:.1f} s concurrent overcount)'
+           if gp['overcount_seconds'] > 0 else ''))
+    for cat, secs in gp['categories'].items():
+        if secs > 0:
+            lines.append(f'    {cat:<20}{secs:>10.3f} s '
+                         f'{gp["fractions"][cat]:>7.1%}')
+    rf = d['roofline']
+    if rf['mfu'] is not None:
+        lines.append(
+            f'  roofline: MFU {rf["mfu"]:.3f} on {rf["device_kind"]} '
+            f'(peak {rf["peak_flops"] / 1e12:.0f} TFLOP/s, '
+            f'{rf["source"]})  '
+            f'{rf["bound_counts"]["compute"]} compute-bound / '
+            f'{rf["bound_counts"]["bandwidth"]} bandwidth-bound '
+            f'programs')
+        for row in rf['programs']:
+            bound = row['bound'] or '?'
+            lines.append(f'    {row["name"][:31]:<32} mfu '
+                         f'{row["mfu"]:.3f}  {bound}-bound  '
+                         f'{row["host_seconds"]:.3f} s')
+    else:
+        lines.append(
+            f'  roofline: MFU unknown (device {rf["device_kind"]!r} '
+            f'not in the peak table; set PADDLE_PEAK_FLOPS / '
+            f'PADDLE_PEAK_HBM_GBPS)')
     ps = d['program_store']
     tier = (f'persistent @ {ps["dir"]}' if ps['persistent']
             else 'memory-only')
@@ -501,6 +560,28 @@ def _hist_avg_ms(reg, name: str) -> float:
     if child is None or not child.count:
         return 0.0
     return child.sum / child.count * 1e3
+
+
+def _hist_quantiles_ms(reg, name: str) -> dict:
+    """Windowed p50/p95/p99 of an unlabeled histogram, in ms."""
+    fam = reg.get(name)
+    if fam is None:
+        return {}
+    child = fam._children.get(())
+    if child is None:
+        return {}
+    return {q: v * 1e3 for q, v in child.window_quantiles().items()}
+
+
+def _span_quantiles_ms(reg, span_name: str) -> dict:
+    """Windowed quantiles of one `paddle_span_seconds{name=}` child."""
+    fam = reg.get('paddle_span_seconds')
+    if fam is None:
+        return {}
+    child = fam._children.get((span_name,))
+    if child is None:
+        return {}
+    return {q: v * 1e3 for q, v in child.window_quantiles().items()}
 
 
 class LossSpikeDetector:

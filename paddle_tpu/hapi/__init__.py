@@ -37,6 +37,22 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _spanned_batches(loader):
+    """Iterate `loader` with each batch fetch inside a `step.data_wait`
+    span: input-pipeline stalls land in the goodput ledger's host_wait
+    category (and the chrome-trace waterfall) instead of hiding in the
+    unattributed residual."""
+    from .. import observability as _obs
+    it = iter(loader)
+    while True:
+        with _obs.span('step.data_wait'):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+        yield batch
+
+
 def _as_loader(data, batch_size, shuffle, num_workers, drop_last):
     if data is None or isinstance(data, DataLoader):
         return data
@@ -323,7 +339,7 @@ class Model:
                 cblist.on_epoch_begin(epoch)
                 self.network.train()
                 epoch_logs = {}
-                for step, batch in enumerate(loader):
+                for step, batch in enumerate(_spanned_batches(loader)):
                     cblist.on_train_batch_begin(step)
                     if estep is not None:
                         # elastic step boundary: re-mesh over the moved

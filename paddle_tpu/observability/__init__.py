@@ -20,31 +20,41 @@ per-host snapshots over the existing collectives.
 from __future__ import annotations
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
-                      DEFAULT_BUCKETS, enable, enabled, disable,
-                      get_registry, merge_snapshots)
-from .events import EventLog, Span, emit, get_event_log, span
+                      DEFAULT_BUCKETS, QUANTILES, enable, enabled,
+                      disable, get_registry, merge_snapshots)
+from .events import (EVENT_SCHEMA, EventLog, Span, declare_event, emit,
+                     get_event_log, span)
 from .exporters import (read_jsonl, to_chrome_trace, to_jsonl,
                         to_prometheus_text)
 from .telemetry import (StepTelemetry, collective_totals,
                         device_memory_bytes, install,
                         note_jit_cache_entry)
-from .cost import (CatalogedJit, ProgramCatalog, ProgramRecord,
-                   get_catalog as program_catalog)
+from .cost import (CatalogedJit, MfuWindow, ProgramCatalog, ProgramRecord,
+                   aggregate_mfu, device_peaks, record_roofline,
+                   roofline_summary, get_catalog as program_catalog)
+from .goodput import (CATEGORIES as GOODPUT_CATEGORIES, GoodputLedger,
+                      get_ledger)
 from .flight import FlightRecorder, get_flight_recorder
 from .server import (ObservabilityServer, clear_degraded, degraded_states,
                      hang_suspected, health, note_degraded, note_progress,
                      start_server)
 from . import cost as _cost
 from . import flight as _flight
+from . import goodput as _goodput
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'DEFAULT_BUCKETS',
+    'QUANTILES',
     'enable', 'enabled', 'disable', 'get_registry', 'merge_snapshots',
-    'EventLog', 'Span', 'emit', 'get_event_log', 'span',
+    'EVENT_SCHEMA', 'EventLog', 'Span', 'declare_event', 'emit',
+    'get_event_log', 'span',
     'read_jsonl', 'to_chrome_trace', 'to_jsonl', 'to_prometheus_text',
     'StepTelemetry', 'collective_totals', 'device_memory_bytes',
     'install', 'note_jit_cache_entry',
-    'CatalogedJit', 'ProgramCatalog', 'ProgramRecord', 'program_catalog',
+    'CatalogedJit', 'MfuWindow', 'ProgramCatalog', 'ProgramRecord',
+    'program_catalog',
+    'aggregate_mfu', 'device_peaks', 'record_roofline', 'roofline_summary',
+    'GOODPUT_CATEGORIES', 'GoodputLedger', 'get_ledger',
     'FlightRecorder', 'get_flight_recorder',
     'ObservabilityServer', 'clear_degraded', 'degraded_states',
     'hang_suspected', 'health', 'note_degraded', 'note_progress',
@@ -54,7 +64,9 @@ __all__ = [
 # register the jax.monitoring listeners + dispatch collector once at
 # import; all hooks are no-ops while observability is disabled
 install()
-# program-catalog collector (paddle_program_* mirror) + the always-on
-# flight recorder's anomaly listener on the default event log
+# program-catalog collector (paddle_program_* mirror), the always-on
+# flight recorder's anomaly listener, and the always-on goodput ledger
+# on the default event log
 _cost.install()
 _flight.install()
+_goodput.install()

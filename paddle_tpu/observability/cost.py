@@ -30,11 +30,177 @@ like the `paddle_dispatch_*` metrics.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
+
+# ---------------------------------------------------------------------------
+# roofline peaks: per-device-kind peak bf16 FLOP/s + HBM bandwidth.
+# Public TPU spec-sheet numbers (same table bench.py's MFU headline
+# uses); keyed by substring of jax's `device_kind`. Override per
+# deployment with PADDLE_PEAK_FLOPS (FLOP/s) / PADDLE_PEAK_HBM_GBPS
+# (GB/s) — the only honest path on CPU or unlisted hardware, where the
+# fallback is an explicit 'unknown' (no MFU published) rather than a
+# silently-wrong guess.
+# ---------------------------------------------------------------------------
+PEAK_SPECS: Dict[str, Dict[str, float]] = {
+    'v6 lite': {'flops': 918e12, 'hbm_gbps': 1640.0},
+    'v6e': {'flops': 918e12, 'hbm_gbps': 1640.0},
+    'v5 lite': {'flops': 197e12, 'hbm_gbps': 819.0},
+    'v5e': {'flops': 197e12, 'hbm_gbps': 819.0},
+    'v5p': {'flops': 459e12, 'hbm_gbps': 2765.0},
+    'v5': {'flops': 459e12, 'hbm_gbps': 2765.0},
+    'v4': {'flops': 275e12, 'hbm_gbps': 1228.0},
+    'v3': {'flops': 123e12, 'hbm_gbps': 900.0},
+    'v2': {'flops': 45e12, 'hbm_gbps': 700.0},
+}
+
+
+def device_peaks(device=None) -> Dict[str, Any]:
+    """Resolve the roofline peaks for `device` (default: devices()[0]).
+
+    Returns {'device_kind', 'peak_flops', 'peak_hbm_bytes_per_s',
+    'source'} where source is 'env' (operator override), 'table'
+    (PEAK_SPECS match), or 'unknown' (peaks are None — MFU/roofline
+    gauges are NOT published rather than normalized against a guess)."""
+    kind = ''
+    if device is None:
+        try:
+            import jax
+            device = jax.devices()[0]
+        except Exception:
+            device = None
+    if device is not None:
+        kind = str(getattr(device, 'device_kind', '') or '')
+    env_flops = os.environ.get('PADDLE_PEAK_FLOPS')
+    env_bw = os.environ.get('PADDLE_PEAK_HBM_GBPS')
+    if env_flops:
+        try:
+            return {'device_kind': kind or 'env-override',
+                    'peak_flops': float(env_flops),
+                    'peak_hbm_bytes_per_s': (float(env_bw) * 1e9
+                                             if env_bw else None),
+                    'source': 'env'}
+        except ValueError:
+            pass   # malformed override falls through to the table
+    low = kind.lower()
+    for key, spec in PEAK_SPECS.items():
+        if key in low:
+            return {'device_kind': kind, 'peak_flops': spec['flops'],
+                    'peak_hbm_bytes_per_s': spec['hbm_gbps'] * 1e9,
+                    'source': 'table'}
+    return {'device_kind': kind or 'unknown', 'peak_flops': None,
+            'peak_hbm_bytes_per_s': None, 'source': 'unknown'}
+
+
+def _ledger_window() -> 'Tuple[Optional[float], Dict[str, int]]':
+    """The goodput ledger's measurement window: (wall seconds since the
+    ledger's last reset, per-program invocation baseline captured at
+    that reset). MFU is FLOPs-over-WALL — per-call host timing cannot
+    see device time under async dispatch (a call returns in
+    microseconds while the chip works for milliseconds), so the only
+    honest denominator is the wall clock of a window whose invocation
+    counts we also know."""
+    try:
+        from .goodput import get_ledger
+        return get_ledger().mfu_window()
+    except Exception:
+        return None, {}
+
+
+def record_roofline(rec: 'ProgramRecord',
+                    peaks: Optional[Dict[str, Any]] = None,
+                    wall_seconds: Optional[float] = None,
+                    baseline: Optional[Dict[str, int]] = None
+                    ) -> Dict[str, Any]:
+    """MFU contribution + roofline classification for one program.
+
+    mfu = (per-invocation cost_analysis FLOPs x invocations in the
+    window) / (window WALL seconds) / peak FLOP/s — the program's
+    contribution to machine utilization, PaLM-style: per-program MFUs
+    sum to the aggregate, and every overhead second (compile,
+    checkpoint, backoff — the goodput ledger's categories) shows up as
+    MFU lost, not hidden. Roofline bound compares the program's
+    arithmetic intensity (FLOPs / bytes accessed) with the machine
+    balance (peak FLOPs / peak bandwidth): below the ridge the program
+    cannot be compute-bound no matter how good the kernels are. Fields
+    are None when the record has no analysis or the device peaks are
+    unknown. The window defaults to the goodput ledger's (wall since
+    its last reset; invocation baseline captured there)."""
+    peaks = peaks or device_peaks()
+    if wall_seconds is None:
+        wall_seconds, baseline = _ledger_window()
+    baseline = baseline or {}
+    out = {'mfu': None, 'roofline_bound': None,
+           'arithmetic_intensity': None}
+    if rec.flops > 0 and rec.bytes_accessed > 0:
+        out['arithmetic_intensity'] = rec.flops / rec.bytes_accessed
+    pf, pb = peaks['peak_flops'], peaks['peak_hbm_bytes_per_s']
+    if pf and rec.flops > 0 and wall_seconds and wall_seconds > 0:
+        d_inv = rec.invocations - baseline.get(rec.name, 0)
+        if d_inv > 0:
+            out['mfu'] = rec.flops * d_inv / wall_seconds / pf
+    if pf and pb and out['arithmetic_intensity'] is not None:
+        balance = pf / pb
+        out['roofline_bound'] = ('compute'
+                                 if out['arithmetic_intensity'] >= balance
+                                 else 'bandwidth')
+    return out
+
+
+def aggregate_mfu(records: List['ProgramRecord'],
+                  peaks: Optional[Dict[str, Any]] = None,
+                  wall_seconds: Optional[float] = None,
+                  baseline: Optional[Dict[str, int]] = None
+                  ) -> Dict[str, Any]:
+    """Aggregate MFU: total model FLOPs executed in the window / window
+    WALL seconds / peak — the number bench.py's headline derives
+    analytically, here measured off XLA's own cost_analysis. Programs
+    without cost analysis contribute nothing (their time is invisible
+    to MFU, which the goodput ledger's residual makes loud instead)."""
+    peaks = peaks or device_peaks()
+    if wall_seconds is None:
+        wall_seconds, baseline = _ledger_window()
+    baseline = baseline or {}
+    flops = sum(r.flops * max(r.invocations - baseline.get(r.name, 0), 0)
+                for r in records if r.flops > 0)
+    out = {'flops_total': flops, 'wall_seconds': wall_seconds,
+           'mfu': None, 'peaks': peaks}
+    if peaks['peak_flops'] and wall_seconds and wall_seconds > 0:
+        out['mfu'] = flops / wall_seconds / peaks['peak_flops']
+    return out
+
+
+class MfuWindow:
+    """Bounded MFU measurement: wall clock + per-program invocation
+    counts snapshot at `__enter__`, deltas at `result()` — the same
+    FLOPs-over-wall estimator as `paddle_mfu`, but over exactly the
+    code between enter and result (the bench goodput phase runs its
+    timed GPT loop inside one and cross-checks the analytic MFU)."""
+
+    def __init__(self, catalog: Optional['ProgramCatalog'] = None,
+                 peaks: Optional[Dict[str, Any]] = None):
+        self._catalog = catalog or get_catalog()
+        self._peaks = peaks or device_peaks()
+        self._before: Dict[str, int] = {}
+        self._t0 = 0.0
+
+    def __enter__(self) -> 'MfuWindow':
+        self._before = {r.name: r.invocations
+                        for r in self._catalog.records()}
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+    def result(self) -> Dict[str, Any]:
+        wall = time.perf_counter() - self._t0
+        return aggregate_mfu(self._catalog.records(), self._peaks,
+                             wall_seconds=wall, baseline=self._before)
 
 
 class ProgramRecord:
@@ -281,11 +447,22 @@ class ProgramCatalog:
                      kind: Optional[str] = None) -> List[Dict[str, Any]]:
         """The attribution report: programs ranked by `sort_by`
         ('host_seconds', 'flops', 'bytes_accessed', 'invocations',
-        'compile_seconds'). Pure dict reads — never compiles."""
+        'compile_seconds', 'mfu'). Every row carries the roofline view
+        — 'mfu', 'roofline_bound' ('compute'|'bandwidth'), and
+        'arithmetic_intensity' — None where the device peaks are
+        unknown or the program has no cost analysis. Pure dict reads —
+        never compiles."""
         self._sync_dispatch()
-        rows = [r.as_dict() for r in self.records()
-                if kind is None or r.kind == kind]
-        rows.sort(key=lambda r: (-r.get(sort_by, 0.0), r['name']))
+        peaks = device_peaks()
+        wall, baseline = _ledger_window()
+        rows = []
+        for r in self.records():
+            if kind is not None and r.kind != kind:
+                continue
+            row = r.as_dict()
+            row.update(record_roofline(r, peaks, wall, baseline))
+            rows.append(row)
+        rows.sort(key=lambda r: (-(r.get(sort_by) or 0.0), r['name']))
         return rows[:n]
 
     def snapshot(self) -> Dict[str, Any]:
@@ -295,11 +472,26 @@ class ProgramCatalog:
     def report(self, max_rows: int = 12) -> str:
         """Human-readable program-attribution table."""
         rows = self.top_programs(n=max_rows)
-        lines = [f'program catalog: {len(self.records())} program(s)',
+        peaks = device_peaks()
+        wall, baseline = _ledger_window()
+        agg = aggregate_mfu(self.records(), peaks, wall, baseline)
+        head = f'program catalog: {len(self.records())} program(s)'
+        if agg['mfu'] is not None:
+            head += (f'  aggregate MFU {agg["mfu"]:.3f} '
+                     f'({peaks["device_kind"]}, peak '
+                     f'{peaks["peak_flops"] / 1e12:.0f} TFLOP/s, '
+                     f'{peaks["source"]})')
+        else:
+            head += (f'  MFU unknown (device {peaks["device_kind"]!r} '
+                     f'not in peak table; set PADDLE_PEAK_FLOPS)')
+        lines = [head,
                  f'  {"program":<28}{"kind":<10}{"calls":>8}'
                  f'{"host s":>10}{"compile s":>10}{"GFLOPs":>10}'
-                 f'{"GB moved":>10}{"peak MiB":>10}']
+                 f'{"GB moved":>10}{"peak MiB":>10}{"mfu":>7}'
+                 f'{"bound":>11}']
         for r in rows:
+            mfu = f'{r["mfu"]:.3f}' if r['mfu'] is not None else '-'
+            bound = r['roofline_bound'] or '-'
             lines.append(
                 f'  {r["name"][:27]:<28}{r["kind"]:<10}'
                 f'{r["invocations"]:>8}'
@@ -307,7 +499,8 @@ class ProgramCatalog:
                 f'{r["compile_seconds"]:>10.3f}'
                 f'{r["flops"] / 1e9:>10.3f}'
                 f'{r["bytes_accessed"] / 1e9:>10.3f}'
-                f'{r["peak_memory_bytes"] / 2**20:>10.1f}')
+                f'{r["peak_memory_bytes"] / 2**20:>10.1f}'
+                f'{mfu:>7}{bound:>11}')
         return '\n'.join(lines)
 
     def reset(self):
@@ -320,6 +513,39 @@ _catalog = ProgramCatalog()
 
 def get_catalog() -> ProgramCatalog:
     return _catalog
+
+
+def roofline_summary(max_rows: int = 5) -> Dict[str, Any]:
+    """The /summary roofline section: device peaks (+ how they were
+    resolved), aggregate MFU, per-bound program counts, and the top
+    analyzed programs by MFU-weighted host time."""
+    peaks = device_peaks()
+    wall, baseline = _ledger_window()
+    records = _catalog.records()
+    agg = aggregate_mfu(records, peaks, wall, baseline)
+    rows = []
+    for r in records:
+        roof = record_roofline(r, peaks, wall, baseline)
+        if roof['mfu'] is None:
+            continue
+        rows.append({'name': r.name, 'host_seconds': r.host_seconds,
+                     'mfu': roof['mfu'],
+                     'bound': roof['roofline_bound'],
+                     'intensity': roof['arithmetic_intensity']})
+    rows.sort(key=lambda r: -r['mfu'])
+    bounds = {'compute': 0, 'bandwidth': 0}
+    for r in rows:
+        if r['bound'] in bounds:
+            bounds[r['bound']] += 1
+    return {'device_kind': peaks['device_kind'],
+            'peak_flops': peaks['peak_flops'],
+            'peak_hbm_bytes_per_s': peaks['peak_hbm_bytes_per_s'],
+            'source': peaks['source'],
+            'mfu': agg['mfu'],
+            'flops_total': agg['flops_total'],
+            'window_wall_seconds': agg['wall_seconds'],
+            'bound_counts': bounds,
+            'programs': rows[:max_rows]}
 
 
 def note_dispatch_compile(op_name: str, seconds: float):
@@ -349,13 +575,37 @@ def _program_collector(reg: '_metrics.MetricsRegistry'):
                      ('program',))
     peak = reg.gauge('paddle_program_peak_memory_bytes',
                      'XLA memory_analysis peak bytes', ('program',))
-    for r in cat.records():
+    pmfu = reg.gauge('paddle_program_mfu',
+                     'model-FLOPs utilization per program '
+                     '(cost_analysis FLOPs / host seconds / device peak)',
+                     ('program',))
+    bound = reg.gauge(
+        'paddle_roofline_bound',
+        'programs on each side of the roofline ridge '
+        '(arithmetic intensity vs machine balance)', ('bound',))
+    agg = reg.gauge('paddle_mfu',
+                    'aggregate model-FLOPs utilization across analyzed '
+                    'programs (0 while device peaks are unknown)')
+    peaks = device_peaks()
+    wall, baseline = _ledger_window()
+    counts = {'compute': 0, 'bandwidth': 0}
+    records = cat.records()
+    for r in records:
         inv.labels(program=r.name).value = float(r.invocations)
         host.labels(program=r.name).value = float(r.host_seconds)
         comp.labels(program=r.name).value = float(r.compile_seconds)
         flops.labels(program=r.name).set(r.flops)
         byts.labels(program=r.name).set(r.bytes_accessed)
         peak.labels(program=r.name).set(r.peak_memory_bytes)
+        roof = record_roofline(r, peaks, wall, baseline)
+        if roof['mfu'] is not None:
+            pmfu.labels(program=r.name).set(roof['mfu'])
+        if roof['roofline_bound'] is not None:
+            counts[roof['roofline_bound']] += 1
+    for b, n in counts.items():
+        bound.labels(bound=b).set(n)
+    a = aggregate_mfu(records, peaks, wall, baseline)
+    agg.set(a['mfu'] if a['mfu'] is not None else 0.0)
 
 
 def install(registry: Optional['_metrics.MetricsRegistry'] = None):

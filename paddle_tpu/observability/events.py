@@ -31,13 +31,71 @@ def _now() -> float:
     return time.perf_counter() - _EPOCH
 
 
+# ---------------------------------------------------------------------------
+# declared event types: every `emit()` name the runtime may produce.
+# The schema is the contract dashboards/flight-bundle consumers parse
+# against, so drive-by event additions must land HERE first — a tier-1
+# lint walks the source tree and fails on any emit() literal missing
+# from this registry, and emit() itself counts undeclared names into
+# `paddle_events_undeclared_total` so dynamic names can't slip past the
+# static scan either. Span names are NOT events — they stay free-form
+# (profiler RecordEvent regions carry user strings).
+# ---------------------------------------------------------------------------
+EVENT_SCHEMA: Dict[str, str] = {
+    # debug / training anomalies
+    'loss_spike': 'LossSpikeDetector flagged a step loss',
+    'bad_step': 'FaultTolerantStep rolled back a NaN/spike step',
+    'skip_budget_exhausted': 'bad-step skip budget exceeded; run dies',
+    'hang_suspected': 'watchdog step deadline exceeded',
+    'retry': 'transient error re-attempted with backoff',
+    'preemption_signal': 'SIGTERM/SIGINT flagged by PreemptionHandler',
+    'preempt_save': 'forced sync checkpoint on preemption',
+    'checkpoint_corrupt': 'manifest checksum mismatch on restore',
+    # fleet / elastic
+    'fleet_init': 'mesh initialized',
+    'topology_change': 'mesh rebuilt over a new device set',
+    'topology_change_rejected': 'unusable device count; resize skipped',
+    'device_probe_failed': 'device_source poll raised',
+    # program store
+    'program_cache_hit': 'program served from memory/disk tier',
+    'program_cache_miss': 'program compiled fresh',
+    'program_cache_reject': 'stored program found but unusable',
+    'program_store_persist': 'program exported to the persistent tier',
+    'program_store_persist_skipped': 'program not persistable',
+    'program_store_preload': 'bulk preload completed',
+    'program_store_invalidate': 'fingerprint refresh dropped entries',
+    'program_store_wipe': 'persistent tier deleted on disk',
+    # serving engine / router / tenancy
+    'serving_request_failed': 'request failed; engine survives',
+    'serving_drain_begin': 'graceful drain started',
+    'serving_drain_complete': 'graceful drain finished',
+    'prefix_hit': 'radix prefix-cache hit on admission',
+    'prefix_evict': 'retained prefix slot reclaimed',
+    'request_shed': 'admission rejected under load shedding',
+    'request_promoted': 'starvation promotion across QoS classes',
+    'router_failover': 'accepted requests resubmitted to survivors',
+    'router_failover_storm': 'failover budget exhausted',
+    'breaker_open': 'replica circuit breaker opened',
+    'breaker_half_open': 'breaker cooldown elapsed; probing',
+    'breaker_closed': 'breaker probe succeeded; replica back',
+}
+
+
+def declare_event(name: str, help: str = ''):
+    """Register an event type at runtime (deployment-specific emitters,
+    fault-injection tests). Idempotent; returns the name."""
+    EVENT_SCHEMA.setdefault(name, help or name)
+    return name
+
+
 class EventLog:
     """Bounded, thread-safe ring of structured events (oldest dropped)."""
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 8192):
         self._events: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._dropped = 0
+        self._seq = 0
         self._listeners: List = []
 
     @property
@@ -50,6 +108,10 @@ class EventLog:
 
     def append(self, event: Dict[str, Any]):
         with self._lock:
+            # monotone per-log sequence: the /events?since= cursor that
+            # survives ring eviction (timestamps alone can collide)
+            self._seq += 1
+            event.setdefault('seq', self._seq)
             if len(self._events) == self._events.maxlen:
                 self._dropped += 1
             self._events.append(event)
@@ -72,9 +134,16 @@ class EventLog:
             self._listeners.remove(fn)
 
     def emit(self, name: str, **attrs):
-        """Record an instant (zero-duration) event at the current time."""
+        """Record an instant (zero-duration) event at the current time.
+        Undeclared names (missing from EVENT_SCHEMA) are still logged
+        but counted — the runtime complement of the static source lint."""
         if not _metrics.enabled():
             return
+        if name not in EVENT_SCHEMA:
+            _metrics.get_registry().counter(
+                'paddle_events_undeclared_total',
+                'emit() calls whose event type is not in EVENT_SCHEMA',
+                ('event',)).labels(event=name).inc()
         self.append({'name': name, 'ph': 'i', 'ts': _now(),
                      'tid': threading.get_ident(), 'attrs': attrs})
 
@@ -141,7 +210,10 @@ class Span:
     def __init__(self, name: str, _log: Optional[EventLog] = None, **attrs):
         self.name = name
         self.attrs = attrs
-        self._log = _log or _default_log
+        # `is None`, not truthiness: an EMPTY EventLog is falsy
+        # (__len__ == 0) and `or` would silently reroute the span to
+        # the default log
+        self._log = _default_log if _log is None else _log
         self._t0 = 0.0
         self._active = False
 

@@ -70,6 +70,24 @@ def to_prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
             else:
                 lines.append(f'{name}{_fmt_labels(s["labels"], proc)}'
                              f' {_num(s["value"])}')
+        if m['type'] == 'histogram':
+            # windowed quantiles ride a SEPARATE gauge family ({name}_wq
+            # with a `quantile` label) — a histogram family must carry
+            # only _bucket/_sum/_count samples to stay conformant, and a
+            # distinct name keeps promtool/Grafana happy while /summary
+            # and dashboards get true trailing-window percentiles
+            qlines = []
+            for s in m['samples']:
+                for q, v in sorted((s.get('quantiles') or {}).items()):
+                    qlines.append(
+                        f'{name}_wq'
+                        f'{_fmt_labels(s["labels"], {**proc, "quantile": q})}'
+                        f' {_num(v)}')
+            if qlines:
+                lines.append(f'# HELP {name}_wq trailing-window quantiles '
+                             f'of {name}')
+                lines.append(f'# TYPE {name}_wq gauge')
+                lines.extend(qlines)
     return '\n'.join(lines) + '\n'
 
 
@@ -118,7 +136,8 @@ def to_chrome_trace(event_log=None, path: Optional[str] = None
     with its actual duration; instant events ('i') keep their timestamp.
     Timestamps are microseconds on the process-wide span clock."""
     from .events import get_event_log
-    event_log = event_log or get_event_log()
+    # `is None`, not truthiness: an empty EventLog is falsy (__len__)
+    event_log = get_event_log() if event_log is None else event_log
     trace_events = []
     for e in event_log.events():
         out = {'name': e['name'], 'ph': e.get('ph', 'X'), 'pid': 0,

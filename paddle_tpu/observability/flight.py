@@ -21,6 +21,7 @@ runtime already emits (`hang_suspected`, `loss_spike`, `bad_step`,
   trace.json     the same window as a chrome trace
   metrics.json   full registry snapshot
   programs.json  ProgramCatalog snapshot (per-program cost attribution)
+  goodput.json   goodput-ledger books + roofline/MFU attribution
   prefix_cache.json  serving radix-prefix-cache state (when serving)
   summary.txt    debug.observability_summary()
 
@@ -172,6 +173,18 @@ class FlightRecorder:
                 pass
             with open(os.path.join(path, 'programs.json'), 'w') as f:
                 json.dump(programs_doc, f, indent=1, default=str)
+            try:
+                # where the seconds went INTO this incident: the ledger
+                # + roofline books are the first thing a postmortem
+                # reader wants next to the loss/memory rings
+                from .cost import roofline_summary
+                from .goodput import get_ledger
+                with open(os.path.join(path, 'goodput.json'), 'w') as f:
+                    json.dump({'goodput': get_ledger().report(),
+                               'roofline': roofline_summary()},
+                              f, indent=1, default=str)
+            except Exception:
+                pass   # partial bundle beats none mid-crash
             try:
                 # serving prefix-cache posture: what was retained /
                 # pinned when the anomaly fired (an eviction storm or a

@@ -25,6 +25,7 @@ Design rules:
 from __future__ import annotations
 
 import bisect
+import collections
 import math
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -56,6 +57,17 @@ def disable():
 # latency-shaped default buckets (seconds), Prometheus-style
 DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0,
                    5.0, 10.0, 60.0)
+
+# windowed quantile sketch: every histogram keeps its last N raw
+# observations so /summary can render true p50/p95/p99 (serving
+# TTFT/TPOT, step time) without Prometheus-side bucket interpolation
+QUANTILE_WINDOW = 256
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _q_key(q: float) -> str:
+    """Quantile label value: '0.5', '0.95', '0.99' (no float noise)."""
+    return f'{q:g}'
 
 
 def _label_key(labelnames: Sequence[str], labels: Dict[str, Any]
@@ -118,7 +130,8 @@ class Gauge:
 class Histogram:
     """Cumulative-bucket distribution (one child of a family)."""
 
-    __slots__ = ('_family', '_labels', 'bucket_counts', 'sum', 'count')
+    __slots__ = ('_family', '_labels', 'bucket_counts', 'sum', 'count',
+                 '_window')
 
     def __init__(self, family, labels: Tuple[str, ...]):
         self._family = family
@@ -126,6 +139,9 @@ class Histogram:
         self.bucket_counts = [0] * (len(family.buckets) + 1)  # +inf last
         self.sum = 0.0
         self.count = 0
+        # trailing raw observations for windowed quantiles (p50/p95/p99)
+        self._window: collections.deque = collections.deque(
+            maxlen=QUANTILE_WINDOW)
 
     def observe(self, value: float):
         v = float(value)
@@ -143,7 +159,21 @@ class Histogram:
                 self._family.buckets, v)] += 1
             self.sum += v
             self.count += 1
+            self._window.append(v)
         return self
+
+    def window_quantiles(self, qs: Sequence[float] = QUANTILES
+                         ) -> Dict[str, float]:
+        """Quantiles over the trailing observation window (nearest-rank
+        on up to QUANTILE_WINDOW raw samples). Empty dict before the
+        first observation — an absent percentile is honest, a fabricated
+        zero is not."""
+        with self._family._registry._lock:
+            vals = sorted(self._window)
+        if not vals:
+            return {}
+        n = len(vals)
+        return {_q_key(q): vals[min(int(q * n), n - 1)] for q in qs}
 
 
 _CHILD_TYPES = {'counter': Counter, 'gauge': Gauge, 'histogram': Histogram}
@@ -306,7 +336,8 @@ class MetricsRegistry:
                             'count': child.count,
                             'buckets': dict(zip(
                                 [str(b) for b in fam.buckets] + ['+Inf'],
-                                _cumulate(child.bucket_counts)))})
+                                _cumulate(child.bucket_counts))),
+                            'quantiles': child.window_quantiles()})
                     else:
                         samples.append({'labels': labels,
                                         'value': child.value})
@@ -328,6 +359,7 @@ class MetricsRegistry:
                         child.bucket_counts = [0] * len(child.bucket_counts)
                         child.sum = 0.0
                         child.count = 0
+                        child._window.clear()
                     else:
                         child.value = 0.0
 
@@ -390,6 +422,34 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                     cur['count'] += s['count']
                     for b, c in s['buckets'].items():
                         cur['buckets'][b] = cur['buckets'].get(b, 0) + c
+                    # windowed quantiles can't be re-sketched from two
+                    # windows; report the fleet-wide WORST per quantile
+                    for q, v in (s.get('quantiles') or {}).items():
+                        qd = cur.setdefault('quantiles', {})
+                        qd[q] = max(qd.get(q, v), v)
+    _recompute_goodput_fractions(merged)
     return {'processes': sorted(by_proc),
             'metrics': [{**m, 'samples': list(m['samples'].values())}
                         for m in merged.values()]}
+
+
+def _recompute_goodput_fractions(merged: Dict[str, Dict[str, Any]]):
+    """Goodput fractions are ratios, so the gauge-max merge rule is
+    wrong for them: after counters merge (per-category seconds and wall
+    seconds SUM across hosts), recompute every
+    `paddle_goodput_fraction{category}` as merged-seconds / merged-wall
+    — no double count, fractions still sum to ~1 fleet-wide."""
+    secs = merged.get('paddle_goodput_seconds_total')
+    wall_fam = merged.get('paddle_goodput_wall_seconds_total')
+    frac = merged.get('paddle_goodput_fraction')
+    if not (secs and wall_fam and frac):
+        return
+    wall = sum(s['value'] for s in wall_fam['samples'].values())
+    if wall <= 0:
+        return
+    by_cat = {dict(key).get('category'): s['value']
+              for key, s in secs['samples'].items()}
+    for key, s in frac['samples'].items():
+        cat = dict(key).get('category')
+        if cat in by_cat:
+            s['value'] = by_cat[cat] / wall

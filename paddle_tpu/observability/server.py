@@ -10,9 +10,12 @@ fleet scraper expects:
   /healthz   process liveness: 200 while steps/decodes make progress,
              503 JSON while any armed watchdog suspects a hang
   /summary   debug.observability_summary() (?format=json for the dict)
-  /events    JSONL tail of the event log (?n=200)
+  /events    JSONL tail of the event log (?n=200, bounded; ?type=a,b
+             filters by event name, ?since=SEQ or ?since=TS.S resumes
+             from a sequence number / timestamp cursor)
   /trace     chrome://tracing JSON of the event log
   /programs  ProgramCatalog report (?format=json for top_programs())
+  /goodput   goodput-ledger report (?format=json for the dict)
 
 `start_server(port)` is wired into examples/train_gpt.py and
 examples/serve_gpt.py via `--metrics-port`; port 0 binds an ephemeral
@@ -185,7 +188,7 @@ class _Handler(BaseHTTPRequestHandler):
                 '/': self._index, '/metrics': self._metrics,
                 '/healthz': self._healthz, '/summary': self._summary,
                 '/events': self._events, '/trace': self._trace,
-                '/programs': self._programs,
+                '/programs': self._programs, '/goodput': self._goodput,
             }.get(route)
             if handler is None:
                 self._send(f'unknown route {route}\n', status=404)
@@ -198,7 +201,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _index(self):
         self._send('paddle_tpu observability: /metrics /healthz /summary '
-                   '/events /trace /programs\n')
+                   '/events /trace /programs /goodput\n')
 
     def _metrics(self):
         from .exporters import to_prometheus_text
@@ -219,14 +222,38 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(debug.observability_summary() + '\n')
 
+    # /events responses are bounded no matter what the client asks for:
+    # a scraper passing n=10**9 (or a since= cursor matching the whole
+    # ring) still gets at most this many lines
+    EVENTS_MAX = 2000
+
     def _events(self):
         from .events import get_event_log
+        q = self._query()
         try:
-            n = int(self._query().get('n', 200))
+            n = int(q.get('n', 200))
         except ValueError:
             n = 200
-        events = get_event_log().events()[-max(n, 0):]
-        self._send(''.join(json.dumps(e) + '\n' for e in events),
+        n = min(max(n, 0), self.EVENTS_MAX)
+        events = get_event_log().events()
+        since = q.get('since')
+        if since:
+            try:
+                if '.' in since:
+                    ts = float(since)   # timestamp on the span clock
+                    events = [e for e in events if e.get('ts', 0.0) >= ts]
+                else:
+                    seq = int(since)    # sequence-number cursor
+                    events = [e for e in events if e.get('seq', 0) > seq]
+            except ValueError:
+                self._send(f'bad since= cursor {since!r} '
+                           f'(want SEQ or TS.S)\n', status=400)
+                return
+        types = q.get('type')
+        if types:
+            wanted = set(t for t in types.split(',') if t)
+            events = [e for e in events if e.get('name') in wanted]
+        self._send(''.join(json.dumps(e) + '\n' for e in events[-n:]),
                    content_type='application/jsonl')
 
     def _trace(self):
@@ -242,6 +269,17 @@ class _Handler(BaseHTTPRequestHandler):
                        + '\n', content_type='application/json')
         else:
             self._send(cat.report() + '\n')
+
+    def _goodput(self):
+        from .cost import roofline_summary
+        from .goodput import get_ledger
+        ledger = get_ledger()
+        if self._query().get('format') == 'json':
+            self._send(json.dumps({'goodput': ledger.report(),
+                                   'roofline': roofline_summary()})
+                       + '\n', content_type='application/json')
+        else:
+            self._send(ledger.report_text() + '\n')
 
 
 class ObservabilityServer:

@@ -26,6 +26,20 @@ from . import metrics as _metrics
 _installed = [False]
 
 
+def _synthetic_span(name: str, secs: float):
+    """Feed a completed host region straight into the goodput ledger.
+    The duration listener fires at region END on the emitting thread,
+    so begin = now - secs lands the interval on the span clock AND
+    keeps the child-before-parent ordering the ledger's nested-span
+    subtraction relies on (a compile inside a train step is credited
+    before the step span ends). Direct call, NOT an event-log append —
+    a busy dispatch cache compiles thousands of entries per session and
+    would flush the bounded event ring."""
+    from . import events as _events
+    from .goodput import get_ledger
+    get_ledger().note_span(name, _events._now() - secs, secs)
+
+
 def _on_jax_duration(name: str, secs: float, **kw):
     if not _metrics.enabled():
         return
@@ -35,9 +49,11 @@ def _on_jax_duration(name: str, secs: float, **kw):
                     'XLA backend compiles').inc()
         reg.counter('paddle_jit_compile_seconds_total',
                     'seconds spent in XLA backend compile').inc(secs)
+        _synthetic_span('jit.compile', secs)
     elif name.endswith('jaxpr_trace_duration'):
         reg.counter('paddle_jit_trace_seconds_total',
                     'seconds spent tracing python to jaxpr').inc(secs)
+        _synthetic_span('jit.trace', secs)
 
 
 def _on_jax_event(name: str, **kw):
@@ -207,6 +223,15 @@ class StepTelemetry:
             loss=self._loss.value if loss is not None else None,
             tokens_per_sec=self._tps.value, step=self._n)
         return self
+
+    def phase(self, name: str, **attrs):
+        """Step-phase waterfall sub-span: `with telemetry.phase(
+        'data_wait'): batch = next(loader)` records a `step.{name}`
+        span the goodput ledger classifies (step.data_wait ->
+        host_wait, step.compute -> step_compute, ...) and the chrome
+        trace renders as the per-step waterfall."""
+        from . import events as _events
+        return _events.span(f'step.{name}', **attrs)
 
     def update_memory_watermark(self):
         if _metrics.enabled():

@@ -198,7 +198,11 @@ def call_with_retry(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
             d = policy.delay(attempt)
             _note_retry(site, attempt, exc, d)
             if d > 0:
-                policy.sleep(d)
+                # spanned so the goodput ledger books backoff sleeps as
+                # `retry_backoff`, not unattributed residual
+                with _obs.span('resilience.backoff', site=site,
+                               attempt=attempt):
+                    policy.sleep(d)
             attempt += 1
 
 

@@ -171,7 +171,12 @@ class FaultTolerantStep:
                 _obs.emit('bad_step', loss=lv,
                           skipped=self.skipped_batches,
                           budget=self.skip_budget)
-            self._restore(self._snapshot)
+            # spanned so the restore cost books as `rollback` in the
+            # goodput ledger (which ALSO moves the bad step's compute
+            # there on the `bad_step` event emitted above)
+            with _obs.span('resilience.rollback',
+                           skipped=self.skipped_batches):
+                self._restore(self._snapshot)
             self.last_step_skipped = True
             if self.skipped_batches > self.skip_budget:
                 # flight-recorder trigger: the postmortem bundle is on

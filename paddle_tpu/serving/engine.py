@@ -709,14 +709,18 @@ class InferenceEngine:
             deadline_s = self._drain_deadline_s
         self._begin_drain()
         timed_out = False
-        while self.has_work:
-            if deadline_s is not None and \
-                    time.monotonic() - self._drain_t0 > deadline_s:
-                timed_out = True
-                self._fail_remaining(TimeoutError(
-                    f'drain deadline {deadline_s}s exceeded'))
-                break
-            self.step()
+        # the drain span books this window as `preemption_drain` in the
+        # goodput ledger — minus the nested decode/prefill spans, which
+        # stay productive serving time
+        with _obs.span('serving.drain'):
+            while self.has_work:
+                if deadline_s is not None and \
+                        time.monotonic() - self._drain_t0 > deadline_s:
+                    timed_out = True
+                    self._fail_remaining(TimeoutError(
+                        f'drain deadline {deadline_s}s exceeded'))
+                    break
+                self.step()
         _obs.emit('serving_drain_complete',
                   timed_out=timed_out,
                   seconds=round(time.monotonic() - self._drain_t0, 3))

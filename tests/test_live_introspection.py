@@ -255,6 +255,26 @@ class TestPrometheusConformance:
             pass
         assert_prometheus_conformant(obs.to_prometheus_text())
 
+    def test_windowed_quantile_exposition_conforms(self):
+        """The `{name}_wq` gauge family (windowed p50/p95/p99) rides a
+        SEPARATE name so histogram families stay bucket/sum/count-only;
+        the strict parser must accept it and the labels must carry a
+        quantile per configured point."""
+        text = obs.to_prometheus_text(self._nasty())
+        assert_prometheus_conformant(text)
+        assert '# TYPE lat_seconds_wq gauge' in text
+        wq = [ln for ln in text.splitlines()
+              if ln.startswith('lat_seconds_wq{')]
+        # one sample per (child x quantile point)
+        assert len(wq) == len(obs.QUANTILES)
+        for q in obs.QUANTILES:
+            assert any(f'quantile="{q:g}"' in ln for ln in wq), (q, wq)
+        # the nasty label value survives inside the _wq family too
+        assert all('op="x"' in ln for ln in wq)
+        # no quantile lines leak into the histogram family itself
+        assert not any('quantile=' in ln for ln in text.splitlines()
+                       if ln.startswith('lat_seconds_bucket'))
+
 
 # ---------------------------------------------------------------------------
 # tentpole: HTTP observability endpoint

@@ -1,0 +1,160 @@
+"""Metrics/events schema lint (tier-1): drive-by telemetry additions
+that skip the schema fail HERE, not in a dashboard three weeks later.
+
+Two contracts, enforced by walking the real source tree with `ast` (so
+docstrings and comments never false-positive):
+
+- every metric family literal created anywhere in `paddle_tpu/` or
+  `bench.py` is Prometheus-legal, carries the `paddle_` namespace, and
+  has a non-empty HELP string at (at least) one creation site;
+- every `emit()`ed event-type literal is declared in
+  `observability.events.EVENT_SCHEMA` (f-string names must match a
+  declared prefix), and the runtime counts undeclared emits into
+  `paddle_events_undeclared_total` so dynamic names can't slip past the
+  static scan either.
+"""
+import ast
+import pathlib
+import re
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability.events import EVENT_SCHEMA
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# Prometheus metric-name grammar, plus this repo's namespace rule
+METRIC_NAME_RE = re.compile(r'^paddle_[a-z][a-z0-9_]*$')
+EVENT_NAME_RE = re.compile(r'^[a-z][a-z0-9_]*$')
+
+_METRIC_CTORS = frozenset(('counter', 'gauge', 'histogram'))
+
+
+def _source_files():
+    files = sorted((ROOT / 'paddle_tpu').rglob('*.py'))
+    files.append(ROOT / 'bench.py')
+    return files
+
+
+def _literal(node):
+    """A plain string literal, or an f-string reduced to a template with
+    `{}` placeholders; None for anything dynamic beyond that."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append('{}')
+        return ''.join(parts)
+    return None
+
+
+def _scan():
+    """(metrics, events): metric name -> list of (file, help literal);
+    event name template -> list of files."""
+    metrics, events = {}, {}
+    for path in _source_files():
+        rel = str(path.relative_to(ROOT))
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            if attr in _METRIC_CTORS and node.args:
+                name = _literal(node.args[0])
+                if name is None:
+                    continue   # dynamic beyond f-string: can't lint
+                help_lit = _literal(node.args[1]) \
+                    if len(node.args) > 1 else None
+                metrics.setdefault(name, []).append((rel, help_lit))
+            elif attr == 'emit' and node.args:
+                name = _literal(node.args[0])
+                if name is not None:
+                    events.setdefault(name, []).append(rel)
+    assert metrics, 'metric scan found nothing — lint is broken'
+    assert events, 'event scan found nothing — lint is broken'
+    return metrics, events
+
+
+METRICS, EVENTS = _scan()
+
+
+class TestMetricLint:
+    def test_every_metric_name_is_prometheus_legal_and_namespaced(self):
+        bad = []
+        for name in METRICS:
+            # f-string names: each substituted hole must still yield a
+            # legal name — check the template with holes filled in
+            candidate = name.replace('{}', 'x')
+            if not METRIC_NAME_RE.match(candidate):
+                bad.append(name)
+        assert not bad, (
+            f'metric names violating ^paddle_[a-z][a-z0-9_]*$: {bad}')
+
+    def test_every_metric_has_nonempty_help_somewhere(self):
+        missing = []
+        for name, sites in METRICS.items():
+            if not any(h and h.strip() for _, h in sites):
+                missing.append((name, [f for f, _ in sites]))
+        assert not missing, (
+            f'metric families with no non-empty HELP at any creation '
+            f'site: {missing}')
+
+    def test_scan_sees_the_known_core_families(self):
+        # the lint is only as good as its scanner: anchor it on
+        # families that must exist
+        for known in ('paddle_steps_total', 'paddle_span_seconds',
+                      'paddle_goodput_seconds_total', 'paddle_mfu'):
+            assert known in METRICS, f'{known} not found by the scanner'
+
+
+class TestEventLint:
+    def test_every_emitted_event_is_declared(self):
+        undeclared = []
+        for name, files in EVENTS.items():
+            if '{}' in name:
+                # dynamic name: some declared event must match the
+                # static prefix (e.g. breaker_{state} -> breaker_open)
+                prefix = name.split('{}')[0]
+                if not any(k.startswith(prefix) for k in EVENT_SCHEMA):
+                    undeclared.append((name, files))
+            elif name not in EVENT_SCHEMA:
+                undeclared.append((name, files))
+        assert not undeclared, (
+            f'emit() event types missing from EVENT_SCHEMA: '
+            f'{undeclared}')
+
+    def test_schema_entries_are_wellformed(self):
+        for name, help in EVENT_SCHEMA.items():
+            assert EVENT_NAME_RE.match(name), name
+            assert help and help.strip(), f'{name} has empty help'
+
+    def test_scan_sees_the_known_events(self):
+        assert 'bad_step' in EVENTS
+        assert any('{}' in n for n in EVENTS), \
+            'no f-string emit found — scanner lost JoinedStr support'
+
+    def test_runtime_counts_undeclared_emits(self):
+        reg = obs.get_registry()
+        before = reg.value('paddle_events_undeclared_total',
+                           event='lint_probe_rogue_event')
+        obs.emit('lint_probe_rogue_event', x=1)
+        after = reg.value('paddle_events_undeclared_total',
+                          event='lint_probe_rogue_event')
+        assert after == before + 1
+        # declared emits stay uncounted
+        obs.declare_event('lint_probe_declared_event', 'probe')
+        obs.emit('lint_probe_declared_event')
+        assert reg.value('paddle_events_undeclared_total',
+                         event='lint_probe_declared_event') == 0
+
+    def test_declare_event_is_idempotent(self):
+        obs.declare_event('lint_probe_declared_event', 'probe')
+        first = EVENT_SCHEMA['lint_probe_declared_event']
+        obs.declare_event('lint_probe_declared_event', 'changed')
+        assert EVENT_SCHEMA['lint_probe_declared_event'] == first
