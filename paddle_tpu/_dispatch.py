@@ -266,7 +266,7 @@ def _note_program_compile(name, seconds):
     try:
         from .observability.cost import note_dispatch_compile
         note_dispatch_compile(name, seconds)
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- observability optional on the hot path; nothing to count into if import failed
         pass   # observability is optional here
 
 
@@ -286,7 +286,7 @@ def _guarded_vjp(raw_vjp, entry, key, vals):
             try:
                 _blacklist.add(key)
                 _cache.pop(key, None)
-            except Exception:
+            except Exception:  # paddle-lint: disable=swallowed-exception -- unhashable key cannot enter the blacklist; the very next line is the counted fallback
                 pass
             return jax.vjp(entry.canonical, *vals)[1](cotangents)
     return vjp
@@ -315,7 +315,7 @@ def run(fn, name, treedef, leaves, t_idx, vals, record
         key, sig = _build_key(name, fn, treedef, leaves, t_idx, vals)
         if key is not None and key in _blacklist:
             key = None
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- unkeyable call: key=None routes to _note_fallback right below
         key = None
     if key is None:
         _note_fallback(name)
@@ -348,7 +348,7 @@ def run(fn, name, treedef, leaves, t_idx, vals, record
                 _counters.retraces += 1
             else:
                 _seen_flavors.add(seen_key)
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- retrace telemetry bookkeeping only; dispatch result unaffected
             pass
         if record:
             def _fwd(*tvals, _c=entry.canonical):
@@ -381,7 +381,7 @@ def run(fn, name, treedef, leaves, t_idx, vals, record
             _blacklist.clear()
         try:
             _blacklist.add(key)
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- unhashable key cannot enter the blacklist; caller already counted the fallback
             pass
         return None
 
@@ -398,6 +398,6 @@ def run(fn, name, treedef, leaves, t_idx, vals, record
                 while len(_cache) > cap:
                     _cache.popitem(last=False)
                     _counters.evictions += 1
-            except Exception:
+            except Exception:  # paddle-lint: disable=swallowed-exception -- unstorable key: the computed result is still valid, next call re-traces
                 pass   # unstorable key: the result is still valid
     return out, vjp_fn, entry.primal

@@ -1,0 +1,38 @@
+"""paddle_tpu.analysis — JAX-aware static analysis over this repo's own
+source tree, enforced in tier-1.
+
+The bug classes that cost the most across the project's history were all
+statically detectable before they shipped:
+
+- the closure-over-tracer custom_vjp break (PR 1)  -> ``trace-hazard``
+- silently swallowed async-writer exceptions (PR 3) -> ``swallowed-exception``
+- the ``or``-on-falsy-``EventLog`` rerouting bug (PR 10) -> ``falsy-guard``
+
+This package is a pluggable AST-walking lint framework (`core`) plus the
+passes (`passes`).  ``python -m paddle_tpu.analysis`` runs the full suite
+over ``paddle_tpu/`` and ``bench.py``; ``tests/test_analysis.py`` wires
+the same run into tier-1, so the tree must lint clean modulo the
+committed ``baseline.json`` (grandfathered findings, each with a reason,
+shrink-only).
+
+Suppression syntax (inline, justified at the site)::
+
+    x = arr.item()  # paddle-lint: disable=host-sync -- final d2h emit
+    # paddle-lint: disable-next=falsy-guard -- operates on plain lists
+    y = maybe or default
+"""
+from .core import (  # noqa: F401
+    Finding,
+    SourceFile,
+    AnalysisResult,
+    Baseline,
+    PassRegistry,
+    registered_passes,
+    get_pass,
+    discover_files,
+    run_analysis,
+    render_text,
+    render_json,
+    DEFAULT_BASELINE_PATH,
+)
+from . import passes  # noqa: F401  (registers the built-in passes)

@@ -1,0 +1,61 @@
+"""CLI: ``python -m paddle_tpu.analysis [targets...]``.
+
+Exit-code contract (stable, scripted against by CI):
+  0  clean (no unsuppressed/un-grandfathered findings, no stale baseline)
+  1  findings (or stale baseline entries — the shrink-only rule)
+  2  internal error (bad arguments, unreadable target, broken pass)
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import traceback
+
+from . import core
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog='python -m paddle_tpu.analysis',
+        description='JAX-aware static analysis over the paddle_tpu tree')
+    p.add_argument('targets', nargs='*', default=None,
+                   help='files/dirs to lint (default: paddle_tpu/ bench.py)')
+    p.add_argument('--format', choices=('text', 'json'), default='text')
+    p.add_argument('--passes', default=None,
+                   help='comma-separated subset (default: all registered)')
+    p.add_argument('--baseline', default=str(core.DEFAULT_BASELINE_PATH),
+                   help='baseline.json path (grandfathered findings)')
+    p.add_argument('--no-baseline', action='store_true',
+                   help='report every finding, ignoring the baseline')
+    p.add_argument('--list-passes', action='store_true')
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.list_passes:
+            for name in core.registered_passes():
+                cls = core.REGISTRY._passes[name]
+                print(f'{name}: {cls.description}')
+            return 0
+        passes = None
+        if args.passes:
+            passes = [s.strip() for s in args.passes.split(',') if s.strip()]
+            for name in passes:
+                if name not in core.registered_passes():
+                    raise KeyError(f'unknown pass {name!r}; available: '
+                                   f'{core.registered_passes()}')
+        baseline = None if args.no_baseline else core.Baseline.load(args.baseline)
+        result = core.run_analysis(targets=args.targets or None,
+                                   passes=passes, baseline=baseline)
+    except Exception:
+        traceback.print_exc()
+        return 2
+    render = core.render_json if args.format == 'json' else core.render_text
+    print(render(result))
+    return 0 if result.clean else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,398 @@
+"""Framework core: file discovery, pass registry, inline suppressions,
+committed baseline, reporters.
+
+Everything here is stdlib-only AST walking — the analysis modules
+themselves never import jax or touch a device, so passes run (and fail)
+deterministically on any box. (Invoking via ``python -m
+paddle_tpu.analysis`` still executes the parent package's ``__init__``;
+the analysis itself does no runtime work beyond parsing source text.)
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+
+#: default lint targets, relative to the repo root
+DEFAULT_TARGETS = ('paddle_tpu', 'bench.py')
+
+#: committed grandfather list (shrink-only; see Baseline)
+DEFAULT_BASELINE_PATH = pathlib.Path(__file__).resolve().parent / 'baseline.json'
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*paddle-lint:\s*(disable|disable-next|disable-file)='
+    r'([a-z0-9_\-, ]+?)\s*(?:--.*)?$')
+
+
+# ---------------------------------------------------------------------------
+# source model
+# ---------------------------------------------------------------------------
+
+class SourceFile:
+    """One parsed module: path, text, lines, AST with parent links, and
+    the suppression table scraped from comments."""
+
+    def __init__(self, path: pathlib.Path, root: pathlib.Path = REPO_ROOT):
+        self.path = pathlib.Path(path)
+        try:
+            self.rel = self.path.resolve().relative_to(root).as_posix()
+        except ValueError:
+            self.rel = self.path.as_posix()
+        self.text = self.path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(self.path))
+        add_parents(self.tree)
+        self._line_suppress: Dict[int, set] = {}
+        self._file_suppress: set = set()
+        self._scan_suppressions()
+
+    def _scan_suppressions(self):
+        for i, line in enumerate(self.lines, start=1):
+            if 'paddle-lint' not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            kind = m.group(1)
+            names = {p.strip() for p in m.group(2).split(',') if p.strip()}
+            if kind == 'disable':
+                self._line_suppress.setdefault(i, set()).update(names)
+            elif kind == 'disable-next':
+                self._line_suppress.setdefault(i + 1, set()).update(names)
+            elif kind == 'disable-file':
+                self._file_suppress.update(names)
+
+    def suppressed(self, pass_name: str, line: int) -> bool:
+        if pass_name in self._file_suppress or 'all' in self._file_suppress:
+            return True
+        names = self._line_suppress.get(line, ())
+        return pass_name in names or 'all' in names
+
+
+def add_parents(tree: ast.AST):
+    """Annotate every node with a `.parent` backlink (passes walk up to
+    find the enclosing function/class)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+    return tree
+
+
+def enclosing_scope(node: ast.AST) -> str:
+    """Dotted qualname of the enclosing def/class chain, or '<module>'.
+    Line-number free on purpose: it anchors baseline keys, which must
+    survive unrelated edits above the finding."""
+    parts: List[str] = []
+    cur = getattr(node, 'parent', None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, 'parent', None)
+    return '.'.join(reversed(parts)) if parts else '<module>'
+
+
+def enclosing_function(node: ast.AST):
+    """Nearest enclosing FunctionDef/AsyncFunctionDef node, or None."""
+    cur = getattr(node, 'parent', None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, 'parent', None)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Finding:
+    pass_name: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    scope: str = '<module>'
+    #: disambiguates identical (pass, path, scope, message) findings by
+    #: source order; assigned by run_analysis
+    occurrence: int = 0
+
+    @property
+    def key(self) -> str:
+        """Baseline identity. Deliberately excludes line/col so a finding
+        keeps matching its grandfather entry when unrelated code moves it."""
+        base = f'{self.pass_name}::{self.path}::{self.scope}::{self.message}'
+        return base if self.occurrence == 0 else f'{base}::#{self.occurrence}'
+
+    def render(self) -> str:
+        return (f'{self.path}:{self.line}:{self.col}: '
+                f'[{self.pass_name}] {self.message} (in {self.scope})')
+
+    def as_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d['key'] = self.key
+        return d
+
+
+def assign_occurrences(findings: List[Finding]) -> List[Finding]:
+    """Number duplicate (pass, path, scope, message) findings in source
+    order so every key is unique."""
+    findings = sorted(findings, key=lambda f: (f.path, f.line, f.col,
+                                               f.pass_name, f.message))
+    seen: Dict[str, int] = {}
+    for f in findings:
+        base = f'{f.pass_name}::{f.path}::{f.scope}::{f.message}'
+        f.occurrence = seen.get(base, 0)
+        seen[base] = f.occurrence + 1
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+
+class PassRegistry:
+    def __init__(self):
+        self._passes: Dict[str, type] = {}
+
+    def register(self, cls):
+        name = getattr(cls, 'name', None)
+        if not name or not re.match(r'^[a-z][a-z0-9\-]*$', name):
+            raise ValueError(f'pass class {cls!r} needs a kebab-case .name')
+        if name in self._passes:
+            raise ValueError(f'duplicate pass name {name!r}')
+        self._passes[name] = cls
+        return cls
+
+    def names(self) -> List[str]:
+        return sorted(self._passes)
+
+    def create(self, name: str):
+        try:
+            return self._passes[name]()
+        except KeyError:
+            raise KeyError(
+                f'unknown pass {name!r}; available: {self.names()}') from None
+
+
+REGISTRY = PassRegistry()
+register_pass = REGISTRY.register
+
+
+def registered_passes() -> List[str]:
+    return REGISTRY.names()
+
+
+def get_pass(name: str):
+    return REGISTRY.create(name)
+
+
+class AnalysisPass:
+    """Base class: override `visit_file` for per-file passes or `run`
+    for passes needing the whole file set (cross-file aggregation)."""
+
+    name = ''
+    description = ''
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files:
+            out.extend(self.visit_file(sf))
+        return out
+
+    def visit_file(self, sf: SourceFile) -> List[Finding]:
+        return []
+
+    def finding(self, sf: SourceFile, node: ast.AST, message: str) -> Finding:
+        return Finding(pass_name=self.name, path=sf.rel,
+                       line=getattr(node, 'lineno', 0),
+                       col=getattr(node, 'col_offset', 0),
+                       message=message, scope=enclosing_scope(node))
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+class Baseline:
+    """Committed grandfather list. Contract (the "shrink-only" rule):
+
+    - every entry carries a human `reason`;
+    - the header records `entry_count`, asserted == len(entries) both
+      here and in tier-1, so growing the list is an explicit, reviewable
+      diff in two places;
+    - a baseline entry whose finding no longer exists is STALE and fails
+      the run — fixing a grandfathered finding forces deleting its entry,
+      so the list can only shrink.
+    """
+
+    def __init__(self, entries: Optional[Dict[str, str]] = None,
+                 path: Optional[pathlib.Path] = None):
+        self.entries: Dict[str, str] = dict(entries or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path=DEFAULT_BASELINE_PATH) -> 'Baseline':
+        path = pathlib.Path(path)
+        if not path.exists():
+            return cls(path=path)
+        data = json.loads(path.read_text())
+        entries = {e['key']: e.get('reason', '') for e in data.get('entries', ())}
+        declared = data.get('header', {}).get('entry_count')
+        if declared is not None and declared != len(entries):
+            raise ValueError(
+                f'baseline header entry_count={declared} but file has '
+                f'{len(entries)} unique entries — header and entries must '
+                f'be updated together ({path})')
+        missing = [k for k, r in entries.items() if not str(r).strip()]
+        if missing:
+            raise ValueError(
+                f'baseline entries without a reason: {missing[:3]}...')
+        return cls(entries, path=path)
+
+    def save(self, path: Optional[pathlib.Path] = None):
+        path = pathlib.Path(path or self.path)
+        payload = {
+            'header': {
+                'tool': 'paddle_tpu.analysis',
+                'entry_count': len(self.entries),
+                'note': ('shrink-only: entries may be removed when fixed, '
+                         'never added without review; stale entries fail '
+                         'the run'),
+            },
+            'entries': [{'key': k, 'reason': v}
+                        for k, v in sorted(self.entries.items())],
+        }
+        path.write_text(json.dumps(payload, indent=1) + '\n')
+
+    def split(self, findings: Sequence[Finding]):
+        """(new, grandfathered, stale_keys)."""
+        keys = {f.key for f in findings}
+        new = [f for f in findings if f.key not in self.entries]
+        old = [f for f in findings if f.key in self.entries]
+        stale = sorted(k for k in self.entries if k not in keys)
+        return new, old, stale
+
+
+# ---------------------------------------------------------------------------
+# discovery + driver
+# ---------------------------------------------------------------------------
+
+def discover_files(targets: Optional[Sequence] = None,
+                   root: pathlib.Path = REPO_ROOT) -> List[SourceFile]:
+    paths: List[pathlib.Path] = []
+    for t in (targets or DEFAULT_TARGETS):
+        p = pathlib.Path(t)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_dir():
+            paths.extend(sorted(q for q in p.rglob('*.py')
+                                if '__pycache__' not in q.parts))
+        elif p.exists():
+            paths.append(p)
+        else:
+            raise FileNotFoundError(f'lint target does not exist: {t}')
+    return [SourceFile(p, root=root) for p in paths]
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]            # unsuppressed, not grandfathered
+    grandfathered: List[Finding]       # matched a baseline entry
+    suppressed: List[Finding]          # silenced by an inline comment
+    stale_baseline: List[str]          # baseline keys with no live finding
+    files_scanned: int = 0
+    passes_run: Tuple[str, ...] = ()
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.pass_name] = out.get(f.pass_name, 0) + 1
+        return out
+
+
+def run_analysis(targets: Optional[Sequence] = None,
+                 passes: Optional[Sequence[str]] = None,
+                 baseline: Optional[Baseline] = None,
+                 root: pathlib.Path = REPO_ROOT,
+                 files: Optional[Sequence[SourceFile]] = None) -> AnalysisResult:
+    """Drive the configured passes over the target files and reconcile
+    against the baseline. `baseline=None` means no grandfathering."""
+    if files is None:
+        files = discover_files(targets, root=root)
+    pass_names = list(passes) if passes is not None else registered_passes()
+    raw: List[Finding] = []
+    for name in pass_names:
+        raw.extend(REGISTRY.create(name).run(files))
+    raw = assign_occurrences(raw)
+
+    by_rel = {sf.rel: sf for sf in files}
+    live, suppressed = [], []
+    for f in raw:
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f.pass_name, f.line):
+            suppressed.append(f)
+        else:
+            live.append(f)
+
+    if baseline is None:
+        new, old, stale = live, [], []
+    else:
+        new, old, stale = baseline.split(live)
+    return AnalysisResult(findings=new, grandfathered=old,
+                          suppressed=suppressed, stale_baseline=stale,
+                          files_scanned=len(files),
+                          passes_run=tuple(pass_names))
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+def render_text(result: AnalysisResult) -> str:
+    lines = []
+    for f in sorted(result.findings, key=lambda f: (f.path, f.line, f.col)):
+        lines.append(f.render())
+    for key in result.stale_baseline:
+        lines.append(f'STALE-BASELINE: {key} — the finding was fixed; '
+                     f'delete its baseline entry (shrink-only)')
+    counts = result.counts()
+    summary = ', '.join(f'{k}={v}' for k, v in sorted(counts.items())) or 'clean'
+    lines.append(
+        f'paddle-lint: {len(result.findings)} finding(s) [{summary}], '
+        f'{len(result.grandfathered)} grandfathered, '
+        f'{len(result.suppressed)} suppressed, '
+        f'{len(result.stale_baseline)} stale baseline entr(ies), '
+        f'{result.files_scanned} files, '
+        f'passes: {", ".join(result.passes_run)}')
+    return '\n'.join(lines)
+
+
+def render_json(result: AnalysisResult) -> str:
+    return json.dumps({
+        'findings': [f.as_dict() for f in sorted(
+            result.findings, key=lambda f: (f.path, f.line, f.col))],
+        'grandfathered': [f.as_dict() for f in result.grandfathered],
+        'suppressed': [f.as_dict() for f in result.suppressed],
+        'stale_baseline': list(result.stale_baseline),
+        'summary': {
+            'finding_count': len(result.findings),
+            'per_pass': result.counts(),
+            'grandfathered': len(result.grandfathered),
+            'suppressed': len(result.suppressed),
+            'stale_baseline': len(result.stale_baseline),
+            'files_scanned': result.files_scanned,
+            'passes_run': list(result.passes_run),
+            'clean': result.clean,
+        },
+    }, indent=1)

@@ -1,0 +1,155 @@
+"""Shared AST helpers for the built-in passes."""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+#: attribute accesses that are compile-time constants under jax tracing —
+#: `x.shape[0] == 2` is a static check, not a trace hazard
+STATIC_ATTRS = frozenset(('shape', 'ndim', 'dtype', 'size', 'sharding',
+                          'aval', 'weak_type'))
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); 'jit' for Name('jit');
+    None for anything not a plain dotted path."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def last_segment(name: Optional[str]) -> Optional[str]:
+    return name.rsplit('.', 1)[-1] if name else None
+
+
+def const_value(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return None
+
+
+def param_names(fn: ast.AST, skip_self: bool = True) -> List[str]:
+    """Positional + kwonly parameter names (no *args/**kwargs)."""
+    a = fn.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if skip_self and names and names[0] in ('self', 'cls'):
+        names = names[1:]
+    return names
+
+
+def params_without_defaults(fn: ast.AST, skip_self: bool = True) -> List[str]:
+    """Positional params that have no default — for op-style signatures
+    (`def mean(x, axis=None, keepdim=False)`) these are the array args;
+    defaulted trailing params are Python-level statics."""
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    n_defaults = len(a.defaults)
+    no_default = pos[:len(pos) - n_defaults] if n_defaults else pos
+    names = [p.arg for p in no_default]
+    if skip_self and names and names[0] in ('self', 'cls'):
+        names = names[1:]
+    return names
+
+
+def value_names(expr: ast.AST) -> Set[str]:
+    """Root names used *as values* in `expr`, excluding names that only
+    appear under a static attribute (`x.shape`, `x.ndim`, ...), inside
+    `len(...)`, or as `isinstance`/`hasattr`/`callable` subjects."""
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Name) or not isinstance(node.ctx, ast.Load):
+            continue
+        if _in_static_context(node, stop=expr):
+            continue
+        out.add(node.id)
+    return out
+
+
+def _in_static_context(name: ast.Name, stop: ast.AST) -> bool:
+    cur: ast.AST = name
+    parent = getattr(cur, 'parent', None)
+    while parent is not None:
+        if isinstance(parent, ast.Attribute) and parent.value is cur \
+                and parent.attr in STATIC_ATTRS:
+            return True
+        if isinstance(parent, ast.Call):
+            fname = last_segment(call_name(parent))
+            if fname in ('len', 'isinstance', 'hasattr', 'callable',
+                         'getattr', 'type', 'id', 'repr') \
+                    and cur in parent.args:
+                return True
+        if parent is stop:
+            return False
+        cur, parent = parent, getattr(parent, 'parent', None)
+    return False
+
+
+def assigned_attr_names(node: ast.AST) -> List[str]:
+    """For Assign/AugAssign/AnnAssign: the `self.X` attribute names being
+    written (empty for non-self targets)."""
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    out = []
+    for t in targets:
+        for el in _flatten_target(t):
+            if isinstance(el, ast.Attribute) and \
+                    isinstance(el.value, ast.Name) and el.value.id == 'self':
+                out.append(el.attr)
+    return out
+
+
+def _flatten_target(t: ast.AST):
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for el in t.elts:
+            yield from _flatten_target(el)
+    else:
+        yield t
+
+
+def decorator_names(fn: ast.AST) -> List[str]:
+    """Dotted names of decorators; for `partial(f, ...)` the inner f."""
+    out = []
+    for d in fn.decorator_list:
+        if isinstance(d, ast.Call):
+            name = call_name(d)
+            if last_segment(name) == 'partial' and d.args:
+                inner = dotted_name(d.args[0])
+                if inner:
+                    out.append(inner)
+                    continue
+            if name:
+                out.append(name)
+        else:
+            name = dotted_name(d)
+            if name:
+                out.append(name)
+    return out
+
+
+def decorator_call(fn: ast.AST, segment: str) -> Optional[ast.Call]:
+    """The decorator Call whose (possibly partial-wrapped) target's last
+    segment matches, e.g. decorator_call(fn, 'jit') finds both
+    `@jax.jit` -> None (not a Call) and `@partial(jax.jit, ...)`."""
+    for d in fn.decorator_list:
+        if not isinstance(d, ast.Call):
+            continue
+        name = call_name(d)
+        if last_segment(name) == segment:
+            return d
+        if last_segment(name) == 'partial' and d.args:
+            if last_segment(dotted_name(d.args[0])) == segment:
+                return d
+    return None

@@ -1,0 +1,126 @@
+"""falsy-guard: `x or default` on framework types that are falsy when
+empty.
+
+The PR 10 bug class: `Span(_log=log)` and `to_chrome_trace(log)` used
+`log or _default_log` — but `EventLog.__len__` makes an *empty* log
+falsy, so spans recorded into a fresh log were silently rerouted to the
+default one. The fix (and the contract this pass enforces) is
+`x if x is not None else default` for every framework type that bears
+`__len__` or may grow it: EventLog, MetricsRegistry, SlotPool,
+ProgramCatalog, GoodputLedger, ReplicaSet.
+
+Two triggers:
+
+- the guarded name's type is inferred as one of the protected types
+  (parameter annotation, or a visible `x = EventLog(...)` assignment);
+- the `or`-default is a protected constructor/factory call
+  (`registry or get_registry()`): whatever the left side is, the intent
+  is "registry-typed", so truthiness is the wrong check.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import AnalysisPass, Finding, SourceFile, register_pass
+from . import _util
+
+#: framework types where `or` on an instance is a latent empty-object bug
+FALSY_TYPES = frozenset((
+    'EventLog', 'MetricsRegistry', 'SlotPool', 'ProgramCatalog',
+    'GoodputLedger', 'ReplicaSet',
+))
+
+#: factory -> type it returns (module-level singletons)
+FACTORIES = {
+    'get_event_log': 'EventLog',
+    'get_registry': 'MetricsRegistry',
+    'get_catalog': 'ProgramCatalog',
+    'program_catalog': 'ProgramCatalog',
+    'get_ledger': 'GoodputLedger',
+}
+
+
+def _annotation_type(ann: Optional[ast.AST]) -> Optional[str]:
+    if ann is None:
+        return None
+    try:
+        text = ast.unparse(ann)
+    except (ValueError, TypeError, AttributeError):
+        return None
+    for t in FALSY_TYPES:
+        if t in text:
+            return t
+    return None
+
+
+def _producer_type(expr: ast.AST) -> Optional[str]:
+    """Type of a constructor/factory call expression, if protected."""
+    if not isinstance(expr, ast.Call):
+        return None
+    seg = _util.last_segment(_util.call_name(expr))
+    if seg in FALSY_TYPES:
+        return seg
+    return FACTORIES.get(seg or '')
+
+
+@register_pass
+class FalsyGuardPass(AnalysisPass):
+    name = 'falsy-guard'
+    description = ('`x or default` where x is a __len__-bearing framework '
+                   'type (EventLog/MetricsRegistry/SlotPool/...): an empty '
+                   'instance is falsy and gets silently replaced; use '
+                   '`is None`')
+
+    def visit_file(self, sf: SourceFile) -> List[Finding]:
+        types = self._infer_types(sf.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.BoolOp) or \
+                    not isinstance(node.op, ast.Or):
+                continue
+            guarded = node.values[0]
+            gname = _util.dotted_name(guarded)
+            gtype = types.get(gname) if gname else None
+            default_type = None
+            for v in node.values[1:]:
+                default_type = _producer_type(v)
+                if default_type:
+                    break
+            t = gtype or default_type
+            if t is None:
+                continue
+            label = gname or '<expr>'
+            findings.append(self.finding(
+                sf, node,
+                f'`{label} or ...` guards a {t} — an EMPTY {t} is falsy '
+                f'(`__len__`) and `or` silently replaces it (the PR 10 '
+                f'EventLog rerouting bug); use '
+                f'`{label} if {label} is not None else ...`'))
+        return findings
+
+    def _infer_types(self, tree: ast.AST) -> Dict[str, str]:
+        """name / 'self.attr' -> protected type, from annotations and
+        visible constructor/factory assignments."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                for p in a.posonlyargs + a.args + a.kwonlyargs:
+                    t = _annotation_type(p.annotation)
+                    if t:
+                        out[p.arg] = t
+            elif isinstance(node, ast.AnnAssign):
+                t = _annotation_type(node.annotation)
+                name = _util.dotted_name(node.target)
+                if t and name:
+                    out[name] = t
+            elif isinstance(node, ast.Assign) and node.value is not None:
+                t = _producer_type(node.value)
+                if not t:
+                    continue
+                for tgt in node.targets:
+                    name = _util.dotted_name(tgt)
+                    if name:
+                        out[name] = t
+        return out

@@ -1,0 +1,211 @@
+"""lock-order: lock-acquisition cycles and fields written both with and
+without their lock.
+
+The runtime takes real locks on real threads: the serving engine step
+loop, the router's failover path, the watchdog, the flight recorder's
+listener, the program store's persist path. A deadlock here doesn't
+crash — it hangs a replica until the watchdog's 503 fires, which is
+exactly the failure mode that is miserable to reproduce and trivial to
+prevent statically.
+
+Per class, this pass:
+
+- collects lock attributes (`self.X = threading.Lock()/RLock()/
+  Condition()`);
+- builds the acquisition graph from `with self.X:` blocks — a nested
+  `with self.Y:` adds edge X->Y, and a call to `self.m()` inside the
+  block adds X->Z for every lock Z that method `m` acquires (one-hop
+  interprocedural);
+- flags cycles in that graph (two code paths taking the same pair of
+  locks in opposite orders) and re-entry on a non-reentrant Lock;
+- flags attributes written BOTH inside a `with self.X` block and
+  outside any lock (outside ``__init__``) — the shape of "someone
+  forgot the lock on one path".
+
+Nested function bodies are treated as separate execution contexts (a
+closure may run on another thread), so a lock held at definition site
+is not assumed held inside them.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import AnalysisPass, Finding, SourceFile, register_pass
+from . import _util
+
+_LOCK_CTORS = frozenset(('Lock', 'RLock', 'Condition'))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == 'self':
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.locks: Dict[str, str] = {}        # attr -> ctor kind
+        # (held_lock, acquired_lock) -> witness node
+        self.edges: Dict[Tuple[str, str], ast.AST] = {}
+        self.reentry: List[Tuple[str, ast.AST]] = []
+        # method -> set of locks it acquires anywhere
+        self.method_locks: Dict[str, Set[str]] = {}
+        # (held_locks, callee, witness) deferred for one-hop resolution
+        self.calls_under_lock: List[Tuple[Tuple[str, ...], str, ast.AST]] = []
+        # attr -> list of (held_locks frozenset, method, witness)
+        self.writes: Dict[str, List[Tuple[frozenset, str, ast.AST]]] = {}
+
+
+@register_pass
+class LockOrderPass(AnalysisPass):
+    name = 'lock-order'
+    description = ('lock-acquisition cycles across `with self._lock` '
+                   'sites, re-entry on non-reentrant locks, and fields '
+                   'written both with and without their lock')
+
+    def visit_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                info = self._analyze_class(node)
+                if info.locks:
+                    findings.extend(self._report(sf, info))
+        return findings
+
+    # -- per-class analysis -------------------------------------------------
+
+    def _analyze_class(self, cls: ast.ClassDef) -> _ClassInfo:
+        info = _ClassInfo(cls)
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for m in methods:
+            for n in ast.walk(m):
+                attrs = _util.assigned_attr_names(n)
+                if not attrs or not isinstance(n, ast.Assign):
+                    continue
+                seg = _util.last_segment(
+                    _util.call_name(n.value)) \
+                    if isinstance(n.value, ast.Call) else None
+                if seg in _LOCK_CTORS:
+                    for a in attrs:
+                        info.locks[a] = seg
+        if not info.locks:
+            return info
+        for m in methods:
+            acquired: Set[str] = set()
+            self._walk_method(info, m, m.body, (), acquired,
+                              in_init=(m.name == '__init__'))
+            info.method_locks[m.name] = acquired
+        # one-hop interprocedural: call under lock -> callee's locks
+        for held, callee, witness in info.calls_under_lock:
+            for lk in info.method_locks.get(callee, ()):
+                for h in held:
+                    if h != lk:
+                        info.edges.setdefault((h, lk), witness)
+                    elif info.locks.get(lk) == 'Lock':
+                        info.reentry.append((lk, witness))
+        return info
+
+    def _walk_method(self, info: _ClassInfo, method, body,
+                     held: Tuple[str, ...], acquired: Set[str],
+                     in_init: bool):
+        for node in body:
+            self._walk_stmt(info, method, node, held, acquired, in_init)
+
+    def _walk_stmt(self, info: _ClassInfo, method, node,
+                   held: Tuple[str, ...], acquired: Set[str],
+                   in_init: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # separate execution context: no lock assumed held
+            self._walk_method(info, method, node.body, (), acquired,
+                              in_init)
+            return
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr in info.locks:
+                    acquired.add(attr)
+                    if attr in new_held and info.locks[attr] == 'Lock':
+                        info.reentry.append((attr, node))
+                    for h in new_held:
+                        if h != attr:
+                            info.edges.setdefault((h, attr), node)
+                    new_held = new_held + (attr,)
+            self._walk_method(info, method, node.body, new_held, acquired,
+                              in_init)
+            return
+        # record attr writes + calls, then recurse through control flow
+        if not in_init:
+            for a in _util.assigned_attr_names(node):
+                if a not in info.locks:
+                    info.writes.setdefault(a, []).append(
+                        (frozenset(held), method.name, node))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call) and held:
+                func = child.func
+                if isinstance(func, ast.Attribute):
+                    callee_self = _self_attr(func)
+                    if callee_self:
+                        info.calls_under_lock.append(
+                            (held, callee_self, child))
+            self._walk_stmt(info, method, child, held, acquired, in_init)
+
+    # -- reporting ----------------------------------------------------------
+
+    def _report(self, sf: SourceFile, info: _ClassInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        cls = info.node.name
+        for cycle, witness in self._find_cycles(info.edges):
+            pretty = ' -> '.join(cycle + (cycle[0],))
+            findings.append(self.finding(
+                sf, witness,
+                f'lock-order cycle in {cls}: {pretty} — two paths take '
+                f'these locks in opposite orders; pick one global order '
+                f'or collapse to a single lock'))
+        for lk, witness in info.reentry:
+            findings.append(self.finding(
+                sf, witness,
+                f're-entry on non-reentrant {cls}.{lk} '
+                f'(threading.Lock) — self-deadlock; use RLock or '
+                f'restructure'))
+        for attr, writes in sorted(info.writes.items()):
+            locked = {lk for held, _, _ in writes for lk in held}
+            unlocked = [(m, w) for held, m, w in writes if not held]
+            if locked and unlocked:
+                m, w = unlocked[0]
+                findings.append(self.finding(
+                    sf, w,
+                    f'{cls}.{attr} is written under '
+                    f'{sorted(locked)} elsewhere but without a lock in '
+                    f'`{m}` — torn/racy writes; take the lock on every '
+                    f'write path'))
+        return findings
+
+    def _find_cycles(self, edges: Dict[Tuple[str, str], ast.AST]):
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        cycles: List[Tuple[Tuple[str, ...], ast.AST]] = []
+        seen_canon: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, cur: str, path: Tuple[str, ...]):
+            for nxt in sorted(graph.get(cur, ())):
+                if nxt == start:
+                    cyc = path
+                    # canonical rotation so each cycle reports once
+                    i = cyc.index(min(cyc))
+                    canon = cyc[i:] + cyc[:i]
+                    if canon not in seen_canon:
+                        seen_canon.add(canon)
+                        cycles.append(
+                            (canon, edges[(cur, start)]))
+                elif nxt not in path:
+                    dfs(start, nxt, path + (nxt,))
+
+        for node in sorted(graph):
+            dfs(node, node, (node,))
+        return cycles

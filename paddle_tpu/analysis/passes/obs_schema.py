@@ -1,0 +1,168 @@
+"""obs-schema: metrics/events schema lint (the PR 10 AST lint, grown
+into a framework pass).
+
+Contracts (unchanged from tests/test_obs_schema_lint.py, which now
+drives this pass):
+
+- every metric family literal created anywhere in the scanned tree is
+  Prometheus-legal and carries the `paddle_` namespace;
+- every metric family has a non-empty HELP string at (at least) one
+  creation site — tree-wide aggregation, so a bare `counter('x')`
+  re-reference is fine as long as SOME site documents it;
+- every `emit()`ed event-type literal is declared — either a key of the
+  `EVENT_SCHEMA = {...}` dict literal (observability/events.py) or a
+  module-level `declare_event('name', ...)` call; f-string names must
+  match a declared prefix;
+- EVENT_SCHEMA entries themselves are well-formed (legal name, non-empty
+  help).
+
+The runtime complement (undeclared emits counted into
+`paddle_events_undeclared_total`) stays a runtime test — a static pass
+cannot see dynamic names.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core import AnalysisPass, Finding, SourceFile, register_pass
+from . import _util
+
+METRIC_NAME_RE = re.compile(r'^paddle_[a-z][a-z0-9_]*$')
+EVENT_NAME_RE = re.compile(r'^[a-z][a-z0-9_]*$')
+
+_METRIC_CTORS = frozenset(('counter', 'gauge', 'histogram'))
+
+
+def literal_template(node: ast.AST) -> Optional[str]:
+    """A plain string literal, or an f-string reduced to a template with
+    `{}` placeholders; None for anything dynamic beyond that."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append('{}')
+        return ''.join(parts)
+    return None
+
+
+def scan_schema(files: Sequence[SourceFile]) -> Dict[str, Tuple]:
+    """Declared event names -> (help, witness sf, witness node): the
+    EVENT_SCHEMA dict literal plus declare_event('name', ...) calls."""
+    declared: Dict[str, Tuple] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            if targets and \
+                    any(isinstance(t, ast.Name) and t.id == 'EVENT_SCHEMA'
+                        for t in targets) and \
+                    isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    name = literal_template(k) if k is not None else None
+                    if name is not None:
+                        declared[name] = (literal_template(v), sf, k)
+            elif isinstance(node, ast.Call) and \
+                    _util.last_segment(_util.call_name(node)) == \
+                    'declare_event' and node.args:
+                name = literal_template(node.args[0])
+                if name is not None and name not in declared:
+                    help_lit = literal_template(node.args[1]) \
+                        if len(node.args) > 1 else name
+                    declared[name] = (help_lit, sf, node)
+    return declared
+
+
+def scan_metrics(files: Sequence[SourceFile]):
+    """metric template -> list of (sf, node, help literal)."""
+    metrics: Dict[str, List] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr in _METRIC_CTORS and node.args:
+                name = literal_template(node.args[0])
+                if name is None:
+                    continue    # dynamic beyond f-string: can't lint
+                help_lit = literal_template(node.args[1]) \
+                    if len(node.args) > 1 else None
+                metrics.setdefault(name, []).append((sf, node, help_lit))
+    return metrics
+
+
+def scan_emits(files: Sequence[SourceFile]):
+    """emitted event template -> list of (sf, node)."""
+    emits: Dict[str, List] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and \
+                    _util.last_segment(_util.call_name(node)) == 'emit' \
+                    and node.args:
+                name = literal_template(node.args[0])
+                if name is not None:
+                    emits.setdefault(name, []).append((sf, node))
+    return emits
+
+
+@register_pass
+class ObsSchemaPass(AnalysisPass):
+    name = 'obs-schema'
+    description = ('metric names Prometheus-legal + paddle_-namespaced '
+                   'with HELP somewhere; every emit() literal declared in '
+                   'EVENT_SCHEMA/declare_event')
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        metrics = scan_metrics(files)
+        emits = scan_emits(files)
+        declared = scan_schema(files)
+
+        for name, sites in sorted(metrics.items()):
+            candidate = name.replace('{}', 'x')
+            if not METRIC_NAME_RE.match(candidate):
+                for sf, node, _ in sites:
+                    findings.append(self.finding(
+                        sf, node,
+                        f'metric name {name!r} violates '
+                        f'^paddle_[a-z][a-z0-9_]*$'))
+            if not any(h and h.strip() for _, _, h in sites):
+                sf, node, _ = sites[0]
+                findings.append(self.finding(
+                    sf, node,
+                    f'metric family {name!r} has no non-empty HELP at '
+                    f'any creation site'))
+
+        for name, sites in sorted(emits.items()):
+            if '{}' in name:
+                prefix = name.split('{}')[0]
+                ok = any(k.startswith(prefix) for k in declared)
+            else:
+                ok = name in declared
+            if not ok:
+                for sf, node in sites:
+                    findings.append(self.finding(
+                        sf, node,
+                        f'emit() event type {name!r} is not declared in '
+                        f'EVENT_SCHEMA (observability/events.py) or via '
+                        f'declare_event'))
+
+        for name, (help_lit, sf, node) in sorted(declared.items()):
+            if not EVENT_NAME_RE.match(name.replace('{}', 'x')):
+                findings.append(self.finding(
+                    sf, node,
+                    f'EVENT_SCHEMA entry {name!r} violates '
+                    f'^[a-z][a-z0-9_]*$'))
+            if not (help_lit and str(help_lit).strip()):
+                findings.append(self.finding(
+                    sf, node,
+                    f'EVENT_SCHEMA entry {name!r} has empty help'))
+        return findings

@@ -1,0 +1,99 @@
+"""swallowed-exception: broad handlers that eat errors without a trace.
+
+The PR 3 bug class: `CheckpointManager`'s async writer thread wrapped its
+body in `except Exception: pass` — a failed checkpoint save surfaced
+*fourteen runs later* as a restore from a step that was never written.
+The rule: a bare/broad except (`except:`, `except Exception`,
+`except BaseException`) must do at least one of
+
+- re-raise (bare ``raise`` or ``raise X``),
+- *use* the caught exception object (stored for a later re-raise,
+  attached to a handle, classified, returned as a value...),
+- log it (`warnings.warn`, `logging`-style `.warning/.error/
+  .exception(...)`, `print`),
+- emit a typed event (`emit(...)` / `*.emit(...)`),
+- count it (`...inc(...)`, `count_suppressed(site)` — the
+  `paddle_suppressed_errors_total{site}` counter).
+
+Narrow handlers (`except KeyError:`) are not this pass's business —
+catching a specific expected error silently is a normal control-flow
+idiom. Intentional broad swallows carry
+`# paddle-lint: disable=swallowed-exception -- <why>` at the handler.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import AnalysisPass, Finding, SourceFile, register_pass
+from . import _util
+
+_BROAD = frozenset(('Exception', 'BaseException'))
+
+#: call last-segments that count as "the error left a trace"
+_HANDLING_CALLS = frozenset((
+    'warn', 'warning', 'error', 'exception', 'critical', 'info', 'debug',
+    'log', 'print', 'print_exc', 'emit', 'inc', 'observe',
+    'count_suppressed', 'note_fallback', 'declare_event',
+    'record_exception', 'fail', 'set_exception',
+))
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(_util.last_segment(_util.dotted_name(el)) in _BROAD
+                   for el in t.elts)
+    return _util.last_segment(_util.dotted_name(t)) in _BROAD
+
+
+def _handled(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            # the exception object flows somewhere: stored, classified,
+            # re-raised later, attached to a result — not swallowed
+            return True
+        if isinstance(node, ast.Call):
+            # attr lookup directly: `reg.counter(...).inc()` has a Call,
+            # not a Name, at the root of its attribute chain
+            seg = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else (node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            if seg and seg.lstrip('_') in _HANDLING_CALLS:
+                return True
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            # `failures += 1` in a handler is a hand-rolled error counter
+            return True
+    return False
+
+
+@register_pass
+class SwallowedExceptionPass(AnalysisPass):
+    name = 'swallowed-exception'
+    description = ('bare/broad except blocks that neither re-raise, use '
+                   'the exception, log, emit an event, nor increment a '
+                   'counter — errors must leave a trace')
+
+    def visit_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _handled(node):
+                continue
+            what = ('bare `except:`' if node.type is None else
+                    f'`except '
+                    f'{_util.last_segment(_util.dotted_name(node.type)) if not isinstance(node.type, ast.Tuple) else "(...broad...)"}`')
+            findings.append(self.finding(
+                sf, node,
+                f'{what} swallows the error silently — re-raise, log, '
+                f'emit a typed event, or count it into '
+                f'paddle_suppressed_errors_total{{site}} '
+                f'(obs.count_suppressed); silent `pass` hid a failed '
+                f'checkpoint writer for 14 runs (the PR 3 bug class)'))
+        return findings

@@ -1,0 +1,287 @@
+"""trace-hazard: Python-level control flow / concretization on traced
+values, and vjp rules that close over tracers.
+
+The PR 1 bug class: `_fused_softmax_ce_xla`'s custom_vjp originally
+closed over `labels`/`valid` from the enclosing scope instead of passing
+them through residuals — fine under plain tracing, broken the moment the
+fwd/bwd split runs in separate traces. Same family: `if x:` /
+`while x:` / `bool(x)` / `int(x)` / `.item()` on a traced value raises
+`TracerBoolConversionError` at best, silently bakes in a constant at
+worst, and `np.asarray(tracer)` is a concretization error.
+
+What counts as a traced function here:
+
+- decorated ``@jax.jit`` / ``@partial(jax.jit, ...)`` (minus
+  static_argnums/static_argnames), ``@to_static``,
+  ``@jax.custom_vjp`` / ``@jax.custom_jvp`` (minus nondiff_argnums);
+- decorated ``@defop`` — the repo's op convention: params without
+  defaults are the array args, trailing defaulted params are statics;
+- registered via ``f.defvjp(fwd, bwd)`` (both rules, all params);
+- wrapped via ``jax.jit(fn)`` or ``store.wrap_jit(fn)`` /
+  ``wrap_jit(self._method)`` — the ProgramStore path every production
+  program compiles through (no statics: wrap_jit traces every arg).
+
+Shape/dtype reads (`x.shape`, `x.ndim`, `x.dtype`), `len(x)`,
+`isinstance(...)` and `is None` checks are static under tracing and
+never flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import AnalysisPass, Finding, SourceFile, enclosing_function, \
+    register_pass
+from . import _util
+
+_JIT_NAMES = frozenset(('jax.jit', 'jit'))
+_NP_ROOTS = frozenset(('np', 'numpy', 'onp'))
+_CONCRETIZE_BUILTINS = frozenset(('bool', 'int', 'float', 'complex'))
+_CONCRETIZE_METHODS = frozenset(('item', 'tolist'))
+
+
+def _statics_from_call(call: Optional[ast.Call],
+                       params: List[str]) -> Set[str]:
+    """static_argnums / static_argnames / nondiff_argnums -> param names."""
+    out: Set[str] = set()
+    if call is None:
+        return out
+    for kw in call.keywords:
+        if kw.arg in ('static_argnums', 'nondiff_argnums'):
+            v = _util.const_value(kw.value)
+            idxs = v if isinstance(v, (tuple, list)) else [v]
+            for i in idxs:
+                if isinstance(i, int) and 0 <= i < len(params):
+                    out.add(params[i])
+        elif kw.arg == 'static_argnames':
+            v = _util.const_value(kw.value)
+            names = v if isinstance(v, (tuple, list)) else [v]
+            out.update(n for n in names if isinstance(n, str))
+    return out
+
+
+class _TracedFn:
+    __slots__ = ('node', 'kind', 'traced', 'is_vjp_rule')
+
+    def __init__(self, node, kind: str, traced: Set[str],
+                 is_vjp_rule: bool = False):
+        self.node = node
+        self.kind = kind
+        self.traced = traced
+        self.is_vjp_rule = is_vjp_rule
+
+
+@register_pass
+class TraceHazardPass(AnalysisPass):
+    name = 'trace-hazard'
+    description = ('Python control flow / bool()/int()/.item() on traced '
+                   'values, and custom_vjp rules closing over tracers, '
+                   'inside @jit/@defop/wrap_jit/defvjp functions')
+
+    def visit_file(self, sf: SourceFile) -> List[Finding]:
+        traced = self._collect_traced(sf.tree)
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, int, str]] = set()
+        traced_nodes = {id(t.node) for t in traced}
+        for t in traced:
+            for f in self._check(sf, t, traced_nodes):
+                sig = (f.line, f.col, f.message)
+                if sig not in seen:
+                    seen.add(sig)
+                    findings.append(f)
+        return findings
+
+    # -- discovery ----------------------------------------------------------
+
+    def _collect_traced(self, tree: ast.AST) -> List[_TracedFn]:
+        by_name: Dict[str, List[ast.AST]] = {}
+        fns = [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in fns:
+            by_name.setdefault(fn.name, []).append(fn)
+
+        out: List[_TracedFn] = []
+        marked: Set[int] = set()
+
+        def mark(fn, kind, statics: Set[str], is_vjp_rule=False):
+            if id(fn) in marked:
+                return
+            marked.add(id(fn))
+            params = _util.param_names(fn)
+            out.append(_TracedFn(fn, kind,
+                                 set(params) - statics, is_vjp_rule))
+
+        for fn in fns:
+            decos = _util.decorator_names(fn)
+            segs = {_util.last_segment(d) for d in decos}
+            params = _util.param_names(fn)
+            if any(d in _JIT_NAMES for d in decos):
+                mark(fn, 'jit',
+                     _statics_from_call(_util.decorator_call(fn, 'jit'),
+                                        params))
+            elif 'to_static' in segs:
+                mark(fn, 'to_static', set())
+            elif 'custom_vjp' in segs or 'custom_jvp' in segs:
+                seg = 'custom_vjp' if 'custom_vjp' in segs else 'custom_jvp'
+                mark(fn, seg,
+                     _statics_from_call(_util.decorator_call(fn, seg),
+                                        params))
+            elif 'defop' in segs:
+                # repo convention: defaulted trailing params are statics
+                mark(fn, 'defop',
+                     set(params) - set(_util.params_without_defaults(fn)))
+
+        for call in (n for n in ast.walk(tree) if isinstance(n, ast.Call)):
+            seg = _util.last_segment(_util.call_name(call))
+            if seg == 'defvjp':
+                for arg in call.args[:2]:
+                    name = None
+                    if isinstance(arg, ast.Name):
+                        name = arg.id
+                    for fn in by_name.get(name, ()):
+                        mark(fn, 'defvjp', set(), is_vjp_rule=True)
+            elif seg == 'wrap_jit' or _util.call_name(call) in _JIT_NAMES:
+                if not call.args:
+                    continue
+                arg0 = call.args[0]
+                target = None
+                if isinstance(arg0, ast.Name):
+                    target = arg0.id
+                elif isinstance(arg0, ast.Attribute) and \
+                        isinstance(arg0.value, ast.Name) and \
+                        arg0.value.id == 'self':
+                    target = arg0.attr
+                if target is None:
+                    continue
+                params_of = by_name.get(target, ())
+                statics_call = call if seg != 'wrap_jit' else None
+                for fn in params_of:
+                    mark(fn, 'wrap_jit' if seg == 'wrap_jit' else 'jit',
+                         _statics_from_call(statics_call,
+                                            _util.param_names(fn)))
+        return out
+
+    # -- checks -------------------------------------------------------------
+
+    def _check(self, sf: SourceFile, t: _TracedFn,
+               traced_nodes: Set[int]) -> List[Finding]:
+        findings: List[Finding] = []
+        traced = set(t.traced)
+
+        # nested defs run in the same trace (scan/cond bodies): their
+        # non-defaulted params are traced values too — but a nested def
+        # that is itself a registered traced fn is checked separately.
+        def walk(node, traced: Set[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    if id(child) in traced_nodes and child is not t.node:
+                        continue
+                    inner = traced | set(_util.params_without_defaults(child))
+                    walk(child, inner)
+                    continue
+                self._check_node(sf, t, child, traced, findings)
+                walk(child, traced)
+
+        walk(t.node, traced)
+
+        if t.is_vjp_rule:
+            findings.extend(self._check_vjp_closure(sf, t))
+        return findings
+
+    def _check_node(self, sf: SourceFile, t: _TracedFn, node: ast.AST,
+                    traced: Set[str], findings: List[Finding]):
+        if isinstance(node, (ast.If, ast.While)):
+            hot = self._truthiness_names(node.test) & traced
+            if hot:
+                kw = 'while' if isinstance(node, ast.While) else 'if'
+                findings.append(self.finding(
+                    sf, node,
+                    f'`{kw}` on traced value(s) {sorted(hot)} inside '
+                    f'{t.kind}-traced `{t.node.name}` — data-dependent '
+                    f'Python control flow fails or bakes in a constant '
+                    f'under tracing; use lax.cond/jnp.where or hoist to '
+                    f'a static'))
+        elif isinstance(node, ast.Call):
+            seg = _util.last_segment(_util.call_name(node))
+            full = _util.call_name(node) or ''
+            root = full.split('.', 1)[0]
+            is_concretize = (
+                (seg in _CONCRETIZE_BUILTINS and full == seg) or
+                (seg in ('asarray', 'array') and root in _NP_ROOTS) or
+                full == 'jax.device_get')
+            if is_concretize and node.args:
+                hot = set()
+                for a in node.args:
+                    hot |= _util.value_names(a) & traced
+                if hot:
+                    findings.append(self.finding(
+                        sf, node,
+                        f'`{seg}()` concretizes traced value(s) '
+                        f'{sorted(hot)} inside {t.kind}-traced '
+                        f'`{t.node.name}` — host round-trip breaks under '
+                        f'tracing; keep it a jnp array or make the arg '
+                        f'static'))
+            elif seg in _CONCRETIZE_METHODS and \
+                    isinstance(node.func, ast.Attribute):
+                hot = _util.value_names(node.func.value) & traced
+                if hot:
+                    findings.append(self.finding(
+                        sf, node,
+                        f'`.{seg}()` on traced value(s) {sorted(hot)} '
+                        f'inside {t.kind}-traced `{t.node.name}` — '
+                        f'device sync cannot run under tracing'))
+
+    def _truthiness_names(self, test: ast.AST) -> Set[str]:
+        """Names whose runtime truthiness/comparison the test depends on;
+        `is`/`is not` comparisons and static-attr reads excluded."""
+        if isinstance(test, ast.BoolOp):
+            out: Set[str] = set()
+            for v in test.values:
+                out |= self._truthiness_names(v)
+            return out
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._truthiness_names(test.operand)
+        if isinstance(test, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return set()
+            out = _util.value_names(test.left)
+            for c in test.comparators:
+                out |= _util.value_names(c)
+            return out
+        return _util.value_names(test)
+
+    def _check_vjp_closure(self, sf: SourceFile,
+                           t: _TracedFn) -> List[Finding]:
+        """A defvjp-registered rule nested in another function must not
+        read that function's (likely-tracer) arguments — the rule runs in
+        its own trace; tracers must flow through residuals (PR 1)."""
+        enclosing = enclosing_function(t.node)
+        if enclosing is None:
+            return []
+        outer_traced: Set[str] = set()
+        cur = enclosing
+        while cur is not None:
+            outer_traced |= set(_util.params_without_defaults(cur))
+            cur = enclosing_function(cur)
+        bound = set(_util.param_names(t.node, skip_self=False))
+        for n in ast.walk(t.node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                bound.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n is not t.node:
+                bound.add(n.name)
+                bound.update(_util.param_names(n, skip_self=False))
+        free_hot = set()
+        for n in ast.walk(t.node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in outer_traced and n.id not in bound:
+                free_hot.add(n.id)
+        if not free_hot:
+            return []
+        return [self.finding(
+            sf, t.node,
+            f'custom_vjp rule `{t.node.name}` closes over '
+            f'{sorted(free_hot)} from the enclosing scope — a tracer '
+            f'captured at registration time breaks the fwd/bwd split; '
+            f'pass it through residuals (the PR 1 bug class)')]

@@ -293,7 +293,7 @@ def _program_store_data() -> dict:
     try:
         from .programs import get_store
         return get_store().stats()
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- summary section degrades to an explicit empty-store posture dict
         return {'persistent': False, 'dir': None, 'memory_entries': 0,
                 'programs': 0, 'loaded_from_disk': 0, 'hits_memory': 0,
                 'hits_disk': 0, 'misses': 0, 'rejects': 0,
@@ -348,7 +348,7 @@ def _elastic_data(reg) -> dict:
         history = fleet.resize_history()
         devices = int(env.get_mesh(auto_init=False).size) \
             if env.has_mesh() else 0
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- summary section degrades to devices=0; report must render without a mesh
         history, devices = [], 0
     return {'devices': devices, 'resizes': len(history),
             'history': history}

@@ -45,7 +45,7 @@ def _note_collective(op: str, axis: str, v):
         return
     try:
         nbytes = int(np.prod(np.shape(v))) * np.dtype(v.dtype).itemsize
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- payload-size probe on an abstract value; bytes=0 is the honest answer
         nbytes = 0
     reg = _obs.get_registry()
     labels = dict(op=op, axis=axis)

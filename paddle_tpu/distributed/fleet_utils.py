@@ -101,7 +101,8 @@ def gather_registry(group=None, registry=None):
     """
     from .. import observability as obs
     from . import collective
-    snap = (registry or obs.get_registry()).snapshot()
+    reg = registry if registry is not None else obs.get_registry()
+    snap = reg.snapshot()
     snaps: list = []
     collective.all_gather_object(snaps, snap, group=group)
     return obs.merge_snapshots(snaps)

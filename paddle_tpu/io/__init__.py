@@ -426,6 +426,11 @@ class DataLoader:
                 self._native = _NativeCollator(
                     self.num_workers, slot_bytes=64 << 20)
             except Exception:
+                # native collator unavailable: python collation is the
+                # supported fallback, but count it — a fleet silently
+                # running the slow path is a perf bug, not a preference
+                from ..observability import count_suppressed
+                count_suppressed('io.native_collator')
                 self._native = None
         # mid-epoch resume cursor (SURVEY §5 "dataloader epoch/seed
         # state"): epochs are deterministically seeded via set_epoch, so

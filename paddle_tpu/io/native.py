@@ -92,7 +92,7 @@ def get_lib():
             if _stale():
                 _build()
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- optional native lib gate; absence is a supported config surfaced via available()
             _lib = None
         return _lib
 
@@ -150,7 +150,7 @@ class StagingBuffer:
             if self._h:
                 self._lib.staging_destroy(self._h)
                 self._h = None
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- destructor path: interpreter/library may already be tearing down
             pass
 
 
@@ -190,5 +190,5 @@ class DecoderPool:
             if self._h:
                 self._lib.pool_destroy(self._h)
                 self._h = None
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- destructor path: interpreter/library may already be tearing down
             pass

@@ -1025,38 +1025,45 @@ def _fused_softmax_ce(logits2d, safe_labels, valid):
     return _fused_softmax_ce_xla(logits2d, safe_labels, valid)
 
 
+# labels/valid are explicit non-differentiated args and ride the
+# RESIDUALS, never a closure: a closure would capture trace-local
+# tracers, which breaks any caller that jits the vjp-forward and
+# invokes the pullback outside the trace (the eager dispatch cache's
+# reusable-VJP split does exactly that). Module-level so the
+# custom_vjp object is created ONCE — a per-call `@jax.custom_vjp`
+# inside the wrapper gave every call a fresh fn identity, defeating
+# identity-keyed tracing caches.
+@jax.custom_vjp
+def _ce_xla(x, safe_labels, valid):
+    return _ce_xla_fwd(x, safe_labels, valid)[0]
+
+
+def _ce_xla_fwd(x, safe_labels, valid):
+    xf = x.astype(jnp.float32)
+    m = jnp.max(xf, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(xf - m[:, None]), axis=-1))
+    tgt = jnp.take_along_axis(xf, safe_labels[:, None], 1)[:, 0]
+    return jnp.where(valid, lse - tgt, 0.0), (x, lse, safe_labels, valid)
+
+
+def _ce_xla_bwd(res, g):
+    x, lse, labels_r, valid_r = res
+    xf = x.astype(jnp.float32)
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    p = jnp.exp(xf - lse[:, None])
+    onehot = (cols == labels_r[:, None]).astype(jnp.float32)
+    dx = (p - onehot) * jnp.where(valid_r, g, 0.0)[:, None]
+    return (dx.astype(x.dtype), None, None)
+
+
+_ce_xla.defvjp(_ce_xla_fwd, _ce_xla_bwd)
+
+
 def _fused_softmax_ce_xla(logits2d, safe_labels, valid):
     """The XLA custom_vjp arm of _fused_softmax_ce (importable on its
     own so the bench races the pallas kernel against the ACTUAL
     fallback implementation, not a strawman)."""
-
-    # labels/valid ride the RESIDUALS, never the bwd closure: a closure
-    # would capture trace-local tracers, which breaks any caller that
-    # jits the vjp-forward and invokes the pullback outside the trace
-    # (the eager dispatch cache's reusable-VJP split does exactly that)
-    @jax.custom_vjp
-    def ce(x):
-        return _ce_fwd(x)[0]
-
-    def _ce_fwd(x):
-        xf = x.astype(jnp.float32)
-        m = jnp.max(xf, axis=-1)
-        lse = m + jnp.log(jnp.sum(jnp.exp(xf - m[:, None]), axis=-1))
-        tgt = jnp.take_along_axis(xf, safe_labels[:, None], 1)[:, 0]
-        return jnp.where(valid, lse - tgt, 0.0), (x, lse, safe_labels,
-                                                  valid)
-
-    def _ce_bwd(res, g):
-        x, lse, labels_r, valid_r = res
-        xf = x.astype(jnp.float32)
-        cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
-        p = jnp.exp(xf - lse[:, None])
-        onehot = (cols == labels_r[:, None]).astype(jnp.float32)
-        dx = (p - onehot) * jnp.where(valid_r, g, 0.0)[:, None]
-        return (dx.astype(x.dtype),)
-
-    ce.defvjp(_ce_fwd, _ce_bwd)
-    return ce(logits2d)
+    return _ce_xla(logits2d, safe_labels, valid)
 
 
 def cross_entropy(input, label, weight=None, ignore_index=-100,

@@ -71,7 +71,7 @@ def device_peaks(device=None) -> Dict[str, Any]:
         try:
             import jax
             device = jax.devices()[0]
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- device probe; kind stays unknown and no MFU gauge is published against a guess
             device = None
     if device is not None:
         kind = str(getattr(device, 'device_kind', '') or '')
@@ -107,7 +107,7 @@ def _ledger_window() -> 'Tuple[Optional[float], Dict[str, int]]':
     try:
         from .goodput import get_ledger
         return get_ledger().mfu_window()
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- ledger optional; (None, {}) window disables MFU rather than faking it
         return None, {}
 
 
@@ -183,7 +183,8 @@ class MfuWindow:
 
     def __init__(self, catalog: Optional['ProgramCatalog'] = None,
                  peaks: Optional[Dict[str, Any]] = None):
-        self._catalog = catalog or get_catalog()
+        # `is None`: an empty ProgramCatalog must not be swapped out
+        self._catalog = catalog if catalog is not None else get_catalog()
         self._peaks = peaks or device_peaks()
         self._before: Dict[str, int] = {}
         self._t0 = 0.0
@@ -257,7 +258,7 @@ def _read_analysis(compiled, record: ProgramRecord):
             record.bytes_accessed = max(
                 record.bytes_accessed, float(ca.get('bytes accessed', 0.0)))
             record.analyzed = True
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- cost_analysis unavailable on this backend; record.analyzed stays False and the report marks it
         pass
     try:
         ma = compiled.memory_analysis()
@@ -275,7 +276,7 @@ def _read_analysis(compiled, record: ProgramRecord):
             record.argument_bytes = max(record.argument_bytes, arg)
             record.output_bytes = max(record.output_bytes, out)
             record.temp_bytes = max(record.temp_bytes, tmp)
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- memory_analysis unavailable on this backend; record fields stay 0 and the report marks it
         pass
 
 
@@ -325,7 +326,7 @@ class CatalogedJit:
         else:
             try:
                 name = self._name_fn(args)
-            except Exception:
+            except Exception:  # paddle-lint: disable=swallowed-exception -- naming must never fail a call; kind:unnamed IS the visible trace
                 name = f'{self._kind}:unnamed'   # naming must never fail a call
         record = self._catalog.record(name, kind=self._kind)
         call = self._fn
@@ -339,7 +340,7 @@ class CatalogedJit:
                     record.compile_seconds += dt
                 _read_analysis(compiled, record)
                 call = compiled
-            except Exception:
+            except Exception:  # paddle-lint: disable=swallowed-exception -- AOT path unavailable; record.note=aot_unavailable carries the posture into every report
                 # AOT path unavailable here: serve through the plain
                 # jitted call — counts still accumulate, analysis stays
                 # empty and the report marks it
@@ -351,6 +352,7 @@ class CatalogedJit:
         try:
             key = self._signature(args)
         except Exception:
+            _metrics.count_suppressed('catalog.signature')
             key = None
         entry = self._entries.get(key) if key is not None else None
         t0 = time.perf_counter()
@@ -431,7 +433,7 @@ class ProgramCatalog:
         try:
             from .. import _dispatch
             per_op = _dispatch.stats()['per_op']
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- dispatch cache absent: nothing to mirror at scrape time
             return
         with self._lock:
             for op, row in per_op.items():
@@ -610,5 +612,5 @@ def _program_collector(reg: '_metrics.MetricsRegistry'):
 
 def install(registry: Optional['_metrics.MetricsRegistry'] = None):
     """Idempotent: register the scrape-time program collector."""
-    (registry or _metrics.get_registry()).register_collector(
-        _program_collector)
+    reg = registry if registry is not None else _metrics.get_registry()
+    reg.register_collector(_program_collector)

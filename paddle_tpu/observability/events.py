@@ -121,7 +121,11 @@ class EventLog:
             try:
                 fn(event)
             except Exception:
-                pass   # a broken listener must not break emit sites
+                # a broken listener must not break emit sites — but it
+                # must not break them SILENTLY either (a dead flight
+                # recorder or goodput ledger looks exactly like "no
+                # anomalies" otherwise)
+                _metrics.count_suppressed('event_listener')
 
     def add_listener(self, fn):
         """`fn(event)` runs after every append (anomaly triggers)."""

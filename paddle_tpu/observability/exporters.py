@@ -48,7 +48,7 @@ def to_prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     """Prometheus exposition format (text/plain; version 0.0.4). Every
     sample carries a `process` label with the host's process index so
     multi-host scrapes aggregate cleanly."""
-    registry = registry or get_registry()
+    registry = registry if registry is not None else get_registry()
     snap = registry.snapshot()
     proc = {'process': str(snap['process_index'])}
     lines = []
@@ -95,7 +95,7 @@ def to_jsonl(registry: Optional[MetricsRegistry] = None,
              path: Optional[str] = None) -> str:
     """One JSON line per sample: {name, type, labels, process, value |
     sum/count/buckets} — the plain-file surface per-host fleet logs use."""
-    registry = registry or get_registry()
+    registry = registry if registry is not None else get_registry()
     snap = registry.snapshot()
     lines = []
     for m in snap['metrics']:

@@ -106,7 +106,10 @@ class FlightRecorder:
         try:
             self.dump(reason=event.get('name'), trigger=event)
         except Exception:
-            pass   # a failed postmortem must never kill the run
+            # a failed postmortem must never kill the run — but a
+            # recorder that silently stopped dumping is a postmortem
+            # with no body; count it
+            _metrics.count_suppressed('flight.dump')
 
     # -- the postmortem bundle ----------------------------------------------
     def _headline_counters(self, reg) -> Dict[str, float]:
@@ -170,7 +173,7 @@ class FlightRecorder:
                 # process serving warm-loaded or freshly-compiled code?
                 programs_doc['store'] = get_store().stats()
             except Exception:
-                pass
+                _metrics.count_suppressed('flight.bundle_section')
             with open(os.path.join(path, 'programs.json'), 'w') as f:
                 json.dump(programs_doc, f, indent=1, default=str)
             try:
@@ -184,7 +187,8 @@ class FlightRecorder:
                                'roofline': roofline_summary()},
                               f, indent=1, default=str)
             except Exception:
-                pass   # partial bundle beats none mid-crash
+                _metrics.count_suppressed('flight.bundle_section')
+                # partial bundle beats none mid-crash
             try:
                 # serving prefix-cache posture: what was retained /
                 # pinned when the anomaly fired (an eviction storm or a
@@ -192,6 +196,7 @@ class FlightRecorder:
                 from ..serving.prefix_cache import snapshot_all
                 caches = snapshot_all()
             except Exception:
+                _metrics.count_suppressed('flight.bundle_section')
                 caches = []
             if caches:
                 with open(os.path.join(path, 'prefix_cache.json'),
@@ -201,6 +206,7 @@ class FlightRecorder:
                 from .. import debug
                 summary = debug.observability_summary() + '\n'
             except Exception:
+                _metrics.count_suppressed('flight.bundle_section')
                 summary = ''   # partial bundle beats none mid-crash
             with open(os.path.join(path, 'summary.txt'), 'w') as f:
                 f.write(summary + cat.report() + '\n')

@@ -155,7 +155,7 @@ class GoodputLedger:
             from .cost import get_catalog
             baseline = {r.name: r.invocations
                         for r in get_catalog().records()}
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- catalog optional at reset; empty baseline just disables per-program MFU deltas
             baseline = {}
         with self._lock:
             self._seconds = {c: 0.0 for c in CATEGORIES}

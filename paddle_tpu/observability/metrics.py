@@ -294,7 +294,11 @@ class MetricsRegistry:
                     try:
                         fn(self)
                     except Exception:
-                        pass   # a broken collector must not kill a scrape
+                        # a broken collector must not kill a scrape, but
+                        # its absence from the exposition must be
+                        # countable (RLock: safe to create the family
+                        # mid-collect)
+                        count_suppressed('metrics_collector', self)
             finally:
                 self._in_collect = False
 
@@ -305,7 +309,7 @@ class MetricsRegistry:
         try:
             import jax
             return int(jax.process_index())
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- jax absent/pre-init: process 0 is the single-host answer
             return 0
 
     def get(self, name) -> Optional[_Family]:
@@ -386,6 +390,26 @@ _default_registry = MetricsRegistry()
 
 def get_registry() -> MetricsRegistry:
     return _default_registry
+
+
+def count_suppressed(site: str, registry: Optional[MetricsRegistry] = None):
+    """Record an intentionally-swallowed error at a best-effort site into
+    `paddle_suppressed_errors_total{site}`. This is the static-analysis
+    contract for broad except blocks (the swallowed-exception pass): an
+    error may be survivable, but it must never be *invisible* — a
+    fallback that silently fires on every call shows up here instead of
+    in a profile three weeks later. Never raises."""
+    try:
+        if not enabled():
+            return
+        reg = registry if registry is not None else _default_registry
+        reg.counter(
+            'paddle_suppressed_errors_total',
+            'errors intentionally swallowed at best-effort sites '
+            '(fallback taken); site names the swallow location',
+            ('site',)).labels(site=site).inc()
+    except Exception:  # paddle-lint: disable=swallowed-exception -- the error sink itself must never throw
+        pass
 
 
 def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:

@@ -106,7 +106,7 @@ def install():
         _mon.register_event_duration_secs_listener(_on_jax_duration)
         _mon.register_event_listener(_on_jax_event)
         _installed[0] = True
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- jax without monitoring hooks: compile metrics stay at zero, documented
         pass   # jax without monitoring: compile metrics stay at zero
 
 
@@ -126,7 +126,7 @@ def collective_totals(reg: Optional['_metrics.MetricsRegistry'] = None
     """Sum the per-(op, axis) collective counters into totals plus a
     per-label breakdown: {'calls', 'bytes', 'per_op': {(op, axis):
     {'calls', 'bytes'}}}."""
-    reg = reg or _metrics.get_registry()
+    reg = reg if reg is not None else _metrics.get_registry()
     out = {'calls': 0.0, 'bytes': 0.0, 'per_op': {}}
     for metric, field in (('paddle_collective_calls_total', 'calls'),
                           ('paddle_collective_bytes_total', 'bytes')):
@@ -147,7 +147,7 @@ def device_memory_bytes() -> int:
     import jax
     try:
         stats = jax.local_devices()[0].memory_stats()
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- memory_stats unsupported on this backend; live-array fallback below
         stats = None
     if stats:
         for key in ('peak_bytes_in_use', 'bytes_in_use'):
@@ -155,7 +155,7 @@ def device_memory_bytes() -> int:
                 return int(stats[key])
     try:
         return int(sum(a.nbytes for a in jax.live_arrays()))
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- live-array sum is the last-resort probe; 0 means unknown
         return 0
 
 
@@ -170,7 +170,7 @@ class StepTelemetry:
 
     def __init__(self, registry: Optional['_metrics.MetricsRegistry'] = None,
                  window: int = 20, memory_every: int = 1):
-        reg = registry or _metrics.get_registry()
+        reg = registry if registry is not None else _metrics.get_registry()
         self._steps = reg.counter('paddle_steps_total',
                                   'optimizer steps taken')
         self._tokens = reg.counter('paddle_tokens_total',

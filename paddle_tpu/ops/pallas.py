@@ -26,7 +26,7 @@ def _pallas_enabled() -> bool:
         return False
     try:
         return jax.default_backend() == 'tpu'
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- backend probe during import; no backend means no TPU
         return False
 
 
@@ -97,6 +97,10 @@ def flash_attention(q, k, v, mask=None, causal=False, dropout_p=0.0,
             from . import pallas_kernels
             return pallas_kernels.flash_attention(q, k, v, causal=causal)
         except Exception:
-            pass  # fall back to XLA on any kernel/shape issue
+            # fall back to XLA on any kernel/shape issue — counted, so a
+            # bench that thinks it raced the pallas kernel can prove the
+            # kernel actually ran
+            from ..observability import count_suppressed
+            count_suppressed('pallas.flash_fallback')
     return _attention_xla(q, k, v, mask=mask, causal=causal,
                          dropout_p=dropout_p, dropout_key=dropout_key)

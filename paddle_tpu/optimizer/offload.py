@@ -49,7 +49,7 @@ def _host_sharding(device=None):
     # exposes it as 'unpinned_host' — take whichever this device has
     try:
         kinds = {m.kind for m in device.addressable_memories()}
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- memory-kind probe; unpinned_host fallback is the documented CPU behavior
         kinds = ()
     kind = 'pinned_host' if 'pinned_host' in kinds else 'unpinned_host'
     return SingleDeviceSharding(device, memory_kind=kind)
@@ -62,7 +62,7 @@ def _device_sharding(device=None):
     # correct (if pointless) host<->host stream there
     try:
         kind = device.default_memory().kind
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- default_memory probe; device kind fallback documented for CPU
         kind = 'device'
     return SingleDeviceSharding(device, memory_kind=kind)
 

@@ -88,7 +88,7 @@ class RecordEvent:
         try:
             self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
             self._jax_ctx.__enter__()
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- jax profiler annotation optional; host timing still recorded
             self._jax_ctx = None
         return self
 
@@ -157,7 +157,7 @@ class Profiler:
             try:
                 jax.profiler.start_trace(self.trace_dir)
                 self._tracing = True
-            except Exception:
+            except Exception:  # paddle-lint: disable=swallowed-exception -- jax trace backend optional; _tracing=False records the posture
                 self._tracing = False
         return self
 

@@ -139,7 +139,7 @@ def code_token(fn, _depth: int = 0) -> str:
     try:
         import inspect
         blob = inspect.getsource(target)
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- source unavailable (REPL/frozen); bytecode/qualname fallbacks below
         code = getattr(target, '__code__', None)
         if code is not None:
             blob = code.co_code.hex() + repr(code.co_consts)
@@ -188,7 +188,7 @@ def describe_statics(obj, _depth: int = 0) -> str:
             return (f'{type(obj).__qualname__}'
                     f'({describe_statics(scalars, _depth + 1)})')
         return type(obj).__qualname__
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- statics token must never raise; class name is the degraded token
         return type(obj).__name__
 
 
@@ -201,7 +201,7 @@ def _leaf_sig(leaf):
             if s is not None and type(s).__name__ not in (
                     'SingleDeviceSharding',):
                 shard = str(s)
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- sharding probe; empty token means single-device layout
             pass
         return (tuple(getattr(leaf, 'shape', ())), str(dt),
                 bool(getattr(leaf, 'weak_type', False)), shard)
@@ -219,7 +219,7 @@ def _mesh_token() -> str:
         if mesh is None:
             return ''
         return repr(tuple(zip(mesh.axis_names, mesh.devices.shape)))
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- mesh token probe; empty token means no mesh
         return ''
 
 
@@ -329,7 +329,9 @@ class ProgramStore:
 
     def __init__(self, catalog: Optional[_cost.ProgramCatalog] = None,
                  directory: Optional[str] = None):
-        self.catalog = catalog or _cost.get_catalog()
+        # `is None`, not truthiness: these framework objects are falsy
+        # when empty (the PR 10 EventLog rerouting bug class)
+        self.catalog = catalog if catalog is not None else _cost.get_catalog()
         self._lock = threading.RLock()
         self._mem: Dict[str, _StoreEntry] = {}
         self._dir = directory
@@ -383,7 +385,7 @@ class ProgramStore:
             # the (re)configured directory.
             from jax._src import compilation_cache as _cc
             _cc.reset_cache()
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- older jax without cc reset knobs still gets the stablehlo tier
             pass   # an older jax without these knobs still gets the
             # stablehlo tier (warm restarts then skip tracing only)
         return self
@@ -610,7 +612,7 @@ class ProgramStore:
         if compiled is None:
             try:
                 compiled = compile_fn()
-            except Exception:
+            except Exception:  # paddle-lint: disable=swallowed-exception -- no AOT path for this callable; caller serves the plain jitted call which surfaces any real error
                 return None   # no AOT path; caller serves the plain call
             if persisting:
                 record.note = 'aot_noexport'
@@ -659,7 +661,7 @@ class ProgramStore:
                             if match not in str(json.load(f).get('name')):
                                 stats['skipped'] += 1
                                 continue
-                    except Exception:
+                    except Exception:  # paddle-lint: disable=swallowed-exception -- unreadable manifest: _load_disk rejects it with a counted program_cache_reject
                         pass   # unreadable manifest: let _load_disk reject
                 ent = self._load_disk(key)
                 if ent is None:
@@ -679,7 +681,7 @@ class ProgramStore:
             from ..observability import server as _srv
             self._coldstart_s = round(
                 time.monotonic() - _srv._START, 4)
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- server module optional; coldstart gauge just stays unset
             self._coldstart_s = None
         with self._lock:
             self._preload = dict(stats)
@@ -859,7 +861,7 @@ class StoredJit:
         else:
             try:
                 name = self._name_fn(args)
-            except Exception:
+            except Exception:  # paddle-lint: disable=swallowed-exception -- naming must never fail a call; kind:unnamed IS the visible trace
                 name = f'{self._kind}:unnamed'   # naming must never fail
         record = self._store.catalog.record(name, kind=self._kind)
         call = self._fn
@@ -868,6 +870,9 @@ class StoredJit:
                 skey = store_key(name, self._fn_token,
                                  self._statics_token, args)
             except Exception:
+                # unkeyable statics: this program silently loses
+                # persistence — make "silently" false
+                _obs.count_suppressed('program_store.key')
                 skey = None
             got = None
             if skey is not None and bool(_flags.flag('FLAGS_program_store')):
@@ -886,7 +891,7 @@ class StoredJit:
                         record.compile_count += 1
                         record.compile_seconds += dt
                     _cost._read_analysis(got, record)
-                except Exception:
+                except Exception:  # paddle-lint: disable=swallowed-exception -- AOT re-analysis failed post-acquire; record.note=aot_unavailable carries the posture
                     got = None
             if got is not None:
                 call = got
@@ -899,6 +904,10 @@ class StoredJit:
         try:
             key = self._signature(args)
         except Exception:
+            # an unkeyable signature re-resolves the program EVERY call
+            # — survivable, but it must be visible when it happens per
+            # step instead of once
+            _obs.count_suppressed('program_store.signature')
             key = None
         entry = self._entries.get(key) if key is not None else None
         t0 = time.perf_counter()

@@ -268,7 +268,10 @@ class ElasticTrainStep:
                     from .. import programs as _programs
                     _programs.get_store().refresh_fingerprint()
                 except Exception:
-                    pass   # store trouble must never fail a re-mesh
+                    # store trouble must never fail a re-mesh — but a
+                    # store serving stale-fingerprint programs after a
+                    # resize is a silent wrong-answer risk; count it
+                    _obs.count_suppressed('elastic.store_refresh')
                 self._inner = None
                 if restore_fn is not None:
                     restore_fn()
@@ -297,7 +300,8 @@ class ElasticTrainStep:
                                        'from_devices': old_n,
                                        'to_devices': new_n}})
             except Exception:
-                pass   # a failed bundle must not kill the transition
+                # a failed bundle must not kill the transition
+                _obs.count_suppressed('elastic.flight_bundle')
         finally:
             _obs.clear_degraded('resizing')
 

@@ -758,7 +758,8 @@ class InferenceEngine:
         for slot, h in list(self._slot_req.items()):
             if not self._active[slot]:
                 continue            # mid-chunked-prefill: no tokens yet
-            c = self.decode_block if counts is None else int(counts[slot])
+            c = self.decode_block if counts is None else \
+                int(counts[slot])  # paddle-lint: disable=host-sync -- spec accept counts gate the emission loop; one d2h per round, already materialized by toks
             if self.draft_model is not None and self._greedy[slot]:
                 self._counts['spec_proposed'] += self.spec_k
                 self._counts['spec_accepted'] += c - 1
@@ -773,7 +774,7 @@ class InferenceEngine:
             emitted = 0
             first = not h.tokens
             for j in range(c):
-                t = int(toks[slot, j])
+                t = int(toks[slot, j])  # paddle-lint: disable=host-sync -- THE emission d2h: tokens must reach the client; one blocking read per round for all slots
                 h._emit(t, now)
                 emitted += 1
                 if (len(h.tokens) >= h.params.max_new_tokens
@@ -1057,7 +1058,7 @@ class InferenceEngine:
         if self.draft_model is not None:
             self._draft_prefill(slot, h)
         greedy = p.strategy == GREEDY
-        key = (np.zeros(2, np.uint32) if greedy else np.asarray(
+        key = (np.zeros(2, np.uint32) if greedy else np.asarray(  # paddle-lint: disable=host-sync -- once per admission, not per round: seeds the per-slot sampling key row
             jax.random.PRNGKey(h.request_id if p.seed is None
                                else p.seed), np.uint32))
         self._tok[slot] = h.prompt_tokens[-1]

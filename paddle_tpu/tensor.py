@@ -98,7 +98,7 @@ class Tensor:
             kind = 'tpu' if plat in ('tpu', 'axon') else plat
             cls = framework.TPUPlace if kind == 'tpu' else framework.CPUPlace
             return cls(getattr(dev, 'id', 0))
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- place probe on traced/abstract values; default place is correct there
             return framework.get_place()
 
     @property
@@ -262,7 +262,7 @@ class Tensor:
                                    threshold=_PRINT_OPTIONS['threshold'],
                                    edgeitems=_PRINT_OPTIONS['edgeitems'],
                                    max_line_width=_PRINT_OPTIONS['linewidth'])
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- repr must never raise; <traced> is the honest rendering under tracing
             body = '<traced>'
         return (f'Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}, '
                 f'place={self.place}, stop_gradient={self.stop_gradient},\n'

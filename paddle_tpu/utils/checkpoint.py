@@ -59,7 +59,7 @@ def _try_orbax():
     try:
         import orbax.checkpoint as ocp
         return ocp
-    except Exception:
+    except Exception:  # paddle-lint: disable=swallowed-exception -- orbax is an optional backend; None routes to the native npz path
         return None
 
 

@@ -74,7 +74,7 @@ def get_lib():
             if _stale():
                 _build()
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
-        except Exception:
+        except Exception:  # paddle-lint: disable=swallowed-exception -- optional native lib gate; absence is a supported config surfaced via available()
             _lib = None
         return _lib
 

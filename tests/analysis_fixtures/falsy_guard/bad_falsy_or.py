@@ -1,0 +1,31 @@
+"""True-positive fixtures for falsy-guard (parsed only)."""
+from typing import Optional
+
+from paddle_tpu.observability.events import EventLog, get_event_log
+from paddle_tpu.observability.metrics import MetricsRegistry, get_registry
+
+
+# snippet 1: the PR 10 bug verbatim — an EMPTY EventLog is falsy, so
+# `or` silently reroutes to the default log
+class Span:
+    def __init__(self, name: str, _log: Optional[EventLog] = None):
+        self._log = _log or get_event_log()
+
+
+# snippet 2: factory default — whatever `registry` is, the intent is
+# registry-typed, so truthiness is the wrong check
+def to_text(registry=None):
+    registry = registry or get_registry()
+    return registry
+
+
+# snippet 3: constructor-assigned local guarded by `or`
+def merge(other=None):
+    log = EventLog(capacity=16)
+    merged = log or EventLog()
+    return merged, other
+
+
+# snippet 4: annotated parameter of a protected type
+def export(reg: MetricsRegistry = None, default=None):
+    return reg or default

@@ -1,0 +1,30 @@
+"""True-negative fixtures for falsy-guard: `is None` idioms and `or` on
+plain values where truthiness is exactly what is meant."""
+from typing import Optional
+
+from paddle_tpu.observability.events import EventLog, get_event_log
+from paddle_tpu.observability.metrics import get_registry
+
+
+# snippet 1: the fixed PR 10 pattern
+class Span:
+    def __init__(self, name: str, _log: Optional[EventLog] = None):
+        self._log = get_event_log() if _log is None else _log
+
+
+# snippet 2: explicit is-None guard for a factory default
+def to_text(registry=None):
+    registry = registry if registry is not None else get_registry()
+    return registry
+
+
+# snippet 3: `or` on plain strings/dicts/lists is normal python
+def label(name=None, attrs=None, items=None):
+    name = name or 'unnamed'
+    attrs = attrs or {}
+    return name, attrs, items or []
+
+
+# snippet 4: truthiness on a NUMBER default is intended behavior
+def capacity(n=0):
+    return n or 4096

@@ -1,0 +1,28 @@
+"""True-positive fixtures for host-sync (parsed only, never imported).
+The file path mirrors the real hot-scope config
+(`paddle_tpu/serving/engine.py` + InferenceEngine step-loop methods) so
+the pass's scope matching is exercised end to end."""
+import numpy as np
+import jax
+
+
+class InferenceEngine:
+    def step(self):
+        toks = self._last_tokens
+        # snippet 1: unannotated per-element d2h read in the step loop
+        t = int(toks[0, 0])
+        # snippet 2: unannotated blocking sync
+        toks.block_until_ready()
+        return t
+
+    def _decode_round(self):
+        out = self._decode_jit(self._pool)
+        # snippet 3: unannotated whole-array device->host copy
+        host = np.asarray(out)
+        # snippet 4: unannotated .tolist() materialization
+        return host, out.tolist()
+
+    def _activate(self, slot, h):
+        # snippet 5: jax.device_get is a sync however it is spelled
+        row = jax.device_get(self._pool[slot])
+        return row

@@ -1,0 +1,24 @@
+"""True-negative fixtures for host-sync: annotated syncs inside hot
+scopes, syncs outside hot scopes, and non-sync host work."""
+import numpy as np
+
+
+class InferenceEngine:
+    def step(self):
+        toks = self._last_tokens
+        # snippet 1: the SAME sync, annotated with a justification
+        t = int(toks[0, 0])  # paddle-lint: disable=host-sync -- token emission d2h; one read per round
+        # snippet 2: int() on a plain python value is not a sync
+        n = int(self.decode_block)
+        # snippet 3: pure-jnp work stays on device
+        self._pos = self._pos + 1
+        return t + n
+
+    def submit(self, prompt):
+        # snippet 4: NOT a hot scope — admission-side host work is fine
+        ids = np.asarray(prompt, dtype=np.int32)
+        return ids.tolist()
+
+    def stats(self):
+        # snippet 5: reporting path, not the step loop
+        return {'occupancy': float(np.asarray(self._occupancy))}

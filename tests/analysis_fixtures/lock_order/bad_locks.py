@@ -1,0 +1,69 @@
+"""True-positive fixtures for lock-order (parsed only)."""
+import threading
+
+
+# snippet 1: classic AB/BA deadlock — two methods take the same pair of
+# locks in opposite orders
+class Deadlocker:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def path_one(self):
+        with self._alock:
+            with self._block:
+                return 1
+
+    def path_two(self):
+        with self._block:
+            with self._alock:
+                return 2
+
+
+# snippet 2: re-entry on a non-reentrant Lock (self-deadlock)
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:
+                return 1
+
+
+# snippet 3: a field written both with and without its lock
+class TornWrite:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def locked_inc(self):
+        with self._lock:
+            self._count += 1
+
+    def racy_reset(self):
+        self._count = 0        # BAD: same field, no lock
+
+
+# snippet 4: interprocedural cycle — calling a method that takes the
+# other lock while holding yours, in both directions
+class IndirectCycle:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def _takes_b(self):
+        with self._block:
+            return 1
+
+    def _takes_a(self):
+        with self._alock:
+            return 2
+
+    def a_then_b(self):
+        with self._alock:
+            return self._takes_b()
+
+    def b_then_a(self):
+        with self._block:
+            return self._takes_a()

@@ -1,0 +1,70 @@
+"""True-negative fixtures for lock-order: disciplined locking that must
+not be flagged."""
+import threading
+
+
+# snippet 1: one global order (A before B) on every path — no cycle
+class Ordered:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def path_one(self):
+        with self._alock:
+            with self._block:
+                return 1
+
+    def path_two(self):
+        with self._alock:
+            with self._block:
+                return 2
+
+
+# snippet 2: RLock re-entry is legal by construction
+class ReentrantOk:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            return self.inner()
+
+    def inner(self):
+        with self._lock:
+            return 1
+
+
+# snippet 3: every write path takes the lock; __init__ writes are setup,
+# not races
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._unguarded_config = 'set-once-before-threads'
+
+    def inc(self):
+        with self._lock:
+            self._count += 1
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+
+# snippet 4: a closure that takes the lock itself when it runs — lock
+# state never leaks across the nested-function boundary in either
+# direction
+class ClosureOk:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0
+
+    def locked_set(self, v):
+        with self._lock:
+            self._state = v
+
+    def make_setter(self):
+        def setter(v):
+            with self._lock:
+                self._state = v
+        return setter

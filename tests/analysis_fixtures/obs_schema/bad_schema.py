@@ -1,0 +1,20 @@
+"""True-positive fixtures for obs-schema (parsed only)."""
+from paddle_tpu.observability import emit, get_registry
+
+reg = get_registry()
+
+# snippet 1: metric name outside the paddle_ namespace
+reg.counter('requests_total', 'requests served')
+
+# snippet 2: illegal characters / casing in the name
+reg.gauge('paddle_QueueDepth', 'queue depth')
+
+# snippet 3: family with no HELP at any creation site
+reg.counter('paddle_fixture_undocumented_total')
+
+# snippet 4: emitted event type never declared anywhere
+emit('fixture_rogue_event', x=1)
+
+# snippet 5: f-string emit whose prefix matches no declared event
+def note(kind):
+    emit(f'fixture_dyn_{kind}', kind=kind)

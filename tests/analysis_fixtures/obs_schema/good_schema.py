@@ -1,0 +1,22 @@
+"""True-negative fixtures for obs-schema: namespaced documented
+metrics and declared events."""
+from paddle_tpu.observability import declare_event, emit, get_registry
+
+reg = get_registry()
+
+# snippet 1: namespaced + documented
+reg.counter('paddle_fixture_requests_total', 'requests served')
+
+# snippet 2: HELP at one site covers bare re-references of the family
+reg.gauge('paddle_fixture_depth', 'queue depth at admission')
+reg.gauge('paddle_fixture_depth')
+
+# snippet 3: declared instant event
+declare_event('fixture_declared_event', 'a declared fixture event')
+emit('fixture_declared_event', x=1)
+
+
+# snippet 4: f-string emit matching a declared prefix
+declare_event('fixture_phase_begin', 'phase transition')
+def note(kind):
+    emit(f'fixture_phase_{kind}', kind=kind)

@@ -1,0 +1,36 @@
+"""True-positive fixtures for swallowed-exception (parsed only)."""
+
+
+# snippet 1: the PR 3 bug class — a background worker eating its errors
+def writer_loop(queue):
+    while True:
+        item = queue.get()
+        try:
+            item.flush()
+        except Exception:
+            pass          # BAD: a failed write vanishes
+
+
+# snippet 2: bare except, silently returning a default
+def read_config(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except:               # noqa: E722
+        return None       # BAD: unreadable config looks like "no config"
+
+
+# snippet 3: broad tuple including Exception, body does cleanup only
+def close_quietly(handle, fallback):
+    try:
+        handle.close()
+    except (OSError, Exception):
+        handle = fallback  # BAD: the error itself leaves no trace
+
+
+# snippet 4: except BaseException without using the error
+def run_step(step):
+    try:
+        return step()
+    except BaseException:
+        return 0          # BAD: even KeyboardInterrupt becomes a zero

@@ -1,0 +1,67 @@
+"""True-negative fixtures for swallowed-exception: every broad handler
+leaves a trace (or is narrow, which is not this pass's business)."""
+import warnings
+
+from paddle_tpu.observability import count_suppressed, emit, get_registry
+
+
+# snippet 1: counted into the suppressed-errors counter
+def writer_loop(queue):
+    while True:
+        item = queue.get()
+        try:
+            item.flush()
+        except Exception:
+            count_suppressed('fixture.writer')
+
+
+# snippet 2: the exception object is captured for a later re-raise
+class AsyncWriter:
+    def run(self, item):
+        try:
+            item.flush()
+        except Exception as e:
+            self._pending_exc = e
+
+
+# snippet 3: logged / warned / emitted all count as handling
+def load(path):
+    try:
+        return open(path).read()
+    except Exception as e:
+        warnings.warn(f'load failed: {e}')
+        return None
+
+
+def probe():
+    try:
+        return 1
+    except Exception:
+        emit('serving_request_failed', where='probe')
+        return 0
+
+
+def scrape():
+    try:
+        return 1
+    except Exception:
+        get_registry().counter('paddle_fixture_errors_total',
+                               'fixture').inc()
+        return 0
+
+
+# snippet 4: re-raise after cleanup
+def transactional(conn):
+    try:
+        conn.commit()
+    except Exception:
+        conn.rollback()
+        raise
+
+
+# snippet 5: NARROW excepts are ordinary control flow, not findings
+def get_or_default(d, k):
+    try:
+        return d[k]
+    except KeyError:
+        return None

@@ -1,0 +1,65 @@
+"""True-positive fixtures for the trace-hazard pass (never imported —
+parsed only). Each snippet below must produce exactly one finding."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+from paddle_tpu.ops._helpers import defop
+
+
+# snippet 1: python `if` on a traced value inside @jax.jit
+@jax.jit
+def relu_or_zero(x):
+    if x > 0:            # BAD: data-dependent control flow under trace
+        return x
+    return jnp.zeros_like(x)
+
+
+# snippet 2: .item() on a traced value inside a defop op body
+@defop
+def mean_scalar(x, axis=None):
+    return x.mean(axis).item()   # BAD: device sync under trace
+
+
+# snippet 3: np.asarray concretizes a traced value inside @jit
+@partial(jax.jit, static_argnames=('scale',))
+def to_host_np(x, scale=1.0):
+    return np.asarray(x) * scale   # BAD: concretization error
+
+
+# snippet 4: the PR 1 bug class — a defvjp rule nested in a function,
+# closing over the enclosing function's (tracer) argument
+def build_scaled(x, w):
+    @jax.custom_vjp
+    def f(a):
+        return a * w
+
+    def f_fwd(a):
+        return a * w, (a,)
+
+    def f_bwd(res, g):
+        (a,) = res
+        return (g * w,)      # BAD: w captured from enclosing scope
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(x)
+
+
+# snippet 5: `while` on a traced value inside @jax.jit
+@jax.jit
+def count_down(x):
+    while x > 0:          # BAD: python loop on tracer
+        x = x - 1
+    return x
+
+
+# snippet 6: bool() on a traced arg of a wrap_jit-compiled method
+class Engine:
+    def __init__(self, store):
+        self._decode_jit = store.wrap_jit(self._decode_fn, name='decode')
+
+    def _decode_fn(self, pool, active):
+        if bool(active):       # BAD: concretizes the active mask
+            return pool
+        return pool

@@ -1,0 +1,77 @@
+"""True-negative fixtures for the trace-hazard pass: all static-under-
+tracing idioms that must NOT be flagged."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+from paddle_tpu.ops._helpers import defop
+
+
+# snippet 1: shape/ndim/dtype checks are static under tracing
+@jax.jit
+def normalize(x):
+    if x.ndim == 2:
+        return x / jnp.linalg.norm(x, axis=-1, keepdims=True)
+    assert x.shape[0] > 0
+    return x / jnp.linalg.norm(x)
+
+
+# snippet 2: defop statics (defaulted trailing params) drive control flow
+@defop
+def reduce_maybe(x, axis=None, keepdim=False):
+    if axis is None:
+        axis = tuple(range(x.ndim))
+    if keepdim:
+        return jnp.sum(x, axis=axis, keepdims=True)
+    return jnp.sum(x, axis=axis)
+
+
+# snippet 3: static_argnames args are concrete — int()/if are fine
+@partial(jax.jit, static_argnames=('n', 'mode'))
+def tile_n(x, n, mode='wrap'):
+    if mode == 'wrap':
+        return jnp.tile(x, int(n))
+    return jnp.repeat(x, int(n), axis=0)
+
+
+# snippet 4: defvjp rules at module level passing tracers via residuals
+@jax.custom_vjp
+def scaled(a, w):
+    return a * w
+
+
+def scaled_fwd(a, w):
+    return a * w, (a, w)
+
+
+def scaled_bwd(res, g):
+    a, w = res
+    return (g * w, g * a)
+
+
+scaled.defvjp(scaled_fwd, scaled_bwd)
+
+
+# snippet 5: lax control flow on traced values is the correct idiom
+@jax.jit
+def relu_lax(x):
+    return jnp.where(x > 0, x, jnp.zeros_like(x))
+
+
+# snippet 6: np.asarray on a NON-traced module constant is fine
+_TABLE = (1.0, 2.0, 4.0)
+
+
+@jax.jit
+def lookup(x):
+    table = jnp.asarray(np.asarray(_TABLE))
+    return x * table[0]
+
+
+# snippet 7: `is None` checks on traced args never concretize
+@jax.jit
+def masked_sum(x, mask=None):
+    if mask is None:
+        return jnp.sum(x)
+    return jnp.sum(jnp.where(mask, x, 0))

@@ -1,142 +1,74 @@
 """Metrics/events schema lint (tier-1): drive-by telemetry additions
 that skip the schema fail HERE, not in a dashboard three weeks later.
 
-Two contracts, enforced by walking the real source tree with `ast` (so
-docstrings and comments never false-positive):
-
-- every metric family literal created anywhere in `paddle_tpu/` or
-  `bench.py` is Prometheus-legal, carries the `paddle_` namespace, and
-  has a non-empty HELP string at (at least) one creation site;
-- every `emit()`ed event-type literal is declared in
-  `observability.events.EVENT_SCHEMA` (f-string names must match a
-  declared prefix), and the runtime counts undeclared emits into
-  `paddle_events_undeclared_total` so dynamic names can't slip past the
-  static scan either.
+Since PR 11 the AST scan lives in the static-analysis framework as the
+`obs-schema` pass (paddle_tpu/analysis/passes/obs_schema.py) — this file
+drives that pass over the real tree and keeps the runtime complement
+(undeclared emits counted into `paddle_events_undeclared_total`, schema
+well-formedness of the LIVE dict including runtime declare_event calls)
+that a static scan cannot see. Every assertion of the pre-framework
+version survives; none were relaxed in the migration.
 """
-import ast
-import pathlib
 import re
 
 import pytest
 
 from paddle_tpu import observability as obs
 from paddle_tpu.observability.events import EVENT_SCHEMA
+from paddle_tpu.analysis import core
+from paddle_tpu.analysis.passes import obs_schema
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
-
-# Prometheus metric-name grammar, plus this repo's namespace rule
-METRIC_NAME_RE = re.compile(r'^paddle_[a-z][a-z0-9_]*$')
 EVENT_NAME_RE = re.compile(r'^[a-z][a-z0-9_]*$')
 
-_METRIC_CTORS = frozenset(('counter', 'gauge', 'histogram'))
 
-
-def _source_files():
-    files = sorted((ROOT / 'paddle_tpu').rglob('*.py'))
-    files.append(ROOT / 'bench.py')
+@pytest.fixture(scope='module')
+def tree_files():
+    files = core.discover_files()   # paddle_tpu/ + bench.py
+    assert files, 'discovery found nothing — lint is broken'
     return files
 
 
-def _literal(node):
-    """A plain string literal, or an f-string reduced to a template with
-    `{}` placeholders; None for anything dynamic beyond that."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for v in node.values:
-            if isinstance(v, ast.Constant):
-                parts.append(str(v.value))
-            else:
-                parts.append('{}')
-        return ''.join(parts)
-    return None
-
-
-def _scan():
-    """(metrics, events): metric name -> list of (file, help literal);
-    event name template -> list of files."""
-    metrics, events = {}, {}
-    for path in _source_files():
-        rel = str(path.relative_to(ROOT))
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or \
-                    not isinstance(node.func, ast.Attribute):
-                continue
-            attr = node.func.attr
-            if attr in _METRIC_CTORS and node.args:
-                name = _literal(node.args[0])
-                if name is None:
-                    continue   # dynamic beyond f-string: can't lint
-                help_lit = _literal(node.args[1]) \
-                    if len(node.args) > 1 else None
-                metrics.setdefault(name, []).append((rel, help_lit))
-            elif attr == 'emit' and node.args:
-                name = _literal(node.args[0])
-                if name is not None:
-                    events.setdefault(name, []).append(rel)
-    assert metrics, 'metric scan found nothing — lint is broken'
-    assert events, 'event scan found nothing — lint is broken'
-    return metrics, events
-
-
-METRICS, EVENTS = _scan()
+@pytest.fixture(scope='module')
+def pass_findings(tree_files):
+    return core.get_pass('obs-schema').run(tree_files)
 
 
 class TestMetricLint:
-    def test_every_metric_name_is_prometheus_legal_and_namespaced(self):
-        bad = []
-        for name in METRICS:
-            # f-string names: each substituted hole must still yield a
-            # legal name — check the template with holes filled in
-            candidate = name.replace('{}', 'x')
-            if not METRIC_NAME_RE.match(candidate):
-                bad.append(name)
-        assert not bad, (
-            f'metric names violating ^paddle_[a-z][a-z0-9_]*$: {bad}')
+    def test_every_metric_name_is_prometheus_legal_and_namespaced(
+            self, pass_findings):
+        bad = [f.render() for f in pass_findings if 'violates' in f.message
+               and 'metric name' in f.message]
+        assert not bad, bad
 
-    def test_every_metric_has_nonempty_help_somewhere(self):
-        missing = []
-        for name, sites in METRICS.items():
-            if not any(h and h.strip() for _, h in sites):
-                missing.append((name, [f for f, _ in sites]))
-        assert not missing, (
-            f'metric families with no non-empty HELP at any creation '
-            f'site: {missing}')
+    def test_every_metric_has_nonempty_help_somewhere(self, pass_findings):
+        missing = [f.render() for f in pass_findings
+                   if 'no non-empty HELP' in f.message]
+        assert not missing, missing
 
-    def test_scan_sees_the_known_core_families(self):
+    def test_scan_sees_the_known_core_families(self, tree_files):
         # the lint is only as good as its scanner: anchor it on
         # families that must exist
+        metrics = obs_schema.scan_metrics(tree_files)
         for known in ('paddle_steps_total', 'paddle_span_seconds',
                       'paddle_goodput_seconds_total', 'paddle_mfu'):
-            assert known in METRICS, f'{known} not found by the scanner'
+            assert known in metrics, f'{known} not found by the scanner'
 
 
 class TestEventLint:
-    def test_every_emitted_event_is_declared(self):
-        undeclared = []
-        for name, files in EVENTS.items():
-            if '{}' in name:
-                # dynamic name: some declared event must match the
-                # static prefix (e.g. breaker_{state} -> breaker_open)
-                prefix = name.split('{}')[0]
-                if not any(k.startswith(prefix) for k in EVENT_SCHEMA):
-                    undeclared.append((name, files))
-            elif name not in EVENT_SCHEMA:
-                undeclared.append((name, files))
-        assert not undeclared, (
-            f'emit() event types missing from EVENT_SCHEMA: '
-            f'{undeclared}')
+    def test_every_emitted_event_is_declared(self, pass_findings):
+        undeclared = [f.render() for f in pass_findings
+                      if 'not declared' in f.message]
+        assert not undeclared, undeclared
 
     def test_schema_entries_are_wellformed(self):
         for name, help in EVENT_SCHEMA.items():
             assert EVENT_NAME_RE.match(name), name
             assert help and help.strip(), f'{name} has empty help'
 
-    def test_scan_sees_the_known_events(self):
-        assert 'bad_step' in EVENTS
-        assert any('{}' in n for n in EVENTS), \
+    def test_scan_sees_the_known_events(self, tree_files):
+        events = obs_schema.scan_emits(tree_files)
+        assert 'bad_step' in events
+        assert any('{}' in n for n in events), \
             'no f-string emit found — scanner lost JoinedStr support'
 
     def test_runtime_counts_undeclared_emits(self):
