@@ -1,0 +1,133 @@
+"""RLHF-shaped post-training loop: the trainer→serving circle, live.
+
+The composed scenario the whole stack exists for: a serving fleet
+(Router over 2 replicas) generates rollouts, a reward function scores
+them, the trainer fine-tunes on the best (best-of-n / rejection
+sampling), and the fresh weights HOT-SWAP back into the running
+replicas — versioned, sha256-manifested, health-gated, zero downtime,
+zero dropped requests, zero XLA recompiles. The next iteration's
+rollouts come from the weights the previous iteration just learned.
+
+The toy objective: reward = fraction of response tokens equal to a
+TARGET token. A few best-of-n iterations visibly push the policy
+toward emitting it — watch `mean_reward` climb while
+`paddle_router_weight_version` ticks up in lockstep on both replicas:
+
+    JAX_PLATFORMS=cpu python examples/rlhf_loop.py
+    JAX_PLATFORMS=cpu python examples/rlhf_loop.py --metrics-port 8000
+    # curl :8000/healthz   -> weight_versions per replica
+    # curl :8000/goodput   -> weight_swap as a first-class category
+"""
+import argparse
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import debug, observability
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.loop import RolloutLoop, response_lm_loss
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import (ReplicaSet, ReplicaUpdater, Router,
+                                WeightPublisher, WeightStore)
+
+TARGET = 7          # the token the reward function loves
+VOCAB = 32
+PROMPT_LEN = 6
+MAX_NEW = 8
+
+
+def reward_fn(prompt, response):
+    """Fraction of response tokens equal to TARGET."""
+    if not response:
+        return 0.0
+    return float(np.mean([t == TARGET for t in response]))
+
+
+def make_prompt_fn(n_per_iter):
+    def prompt_fn(i):
+        rng = np.random.RandomState(1000 + i)
+        return [rng.randint(1, VOCAB, (PROMPT_LEN,)).tolist()
+                for _ in range(n_per_iter)]
+    return prompt_fn
+
+
+def main(iters=8, store_dir=None, publish_every=2, metrics_port=None):
+    paddle.seed(0)
+    server = None
+    if metrics_port is not None:
+        server = observability.start_server(metrics_port)
+        print(f'observability endpoint at {server.url}')
+    if store_dir is None:
+        store_dir = tempfile.mkdtemp(prefix='rlhf_weights_')
+
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=48,
+                    num_hidden_layers=1, num_attention_heads=4,
+                    intermediate_size=96, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    train_model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                 parameters=train_model.parameters())
+    train_step = TrainStep(train_model, response_lm_loss(VOCAB), opt)
+
+    # the storage hop: versioned, sha256-manifested weight snapshots
+    store = WeightStore(store_dir, keep_versions=4)
+    publisher = WeightPublisher(train_model, store,
+                                interval_steps=publish_every)
+    v1 = publisher.publish(step=0)      # the fleet's starting weights
+
+    # the serving fleet: its OWN model instance, aligned to v1 through
+    # the store — the only coupling between trainer and servers
+    serve_model = GPTForCausalLM(cfg).eval()
+    serve_model.set_state_dict(store.load(v1))
+    router = Router(ReplicaSet(serve_model, 2, num_slots=4,
+                               max_length=64, decode_block=4,
+                               weight_version=v1))
+    updater = ReplicaUpdater(router, store)
+
+    loop = RolloutLoop(
+        train_step=train_step, router=router, publisher=publisher,
+        updater=updater, prompt_fn=make_prompt_fn(8),
+        reward_fn=reward_fn, rollouts_per_iter=8, keep_best=4,
+        max_new_tokens=MAX_NEW, temperature=1.0, train_passes=2)
+
+    print(f'weight store at {store.directory}; fleet starts at v{v1}')
+    for _ in range(iters):
+        s = loop.iteration()
+        swap = s['swap']
+        print(f"iter {s['iteration']}: mean_reward={s['mean_reward']:.3f}"
+              f" best={s['best_reward']:.3f} loss={s['loss']:.3f}"
+              f" step={s['global_step']}"
+              + (f" published=v{s['published_version']}"
+                 if s['published_version'] else '')
+              + (f" swap->v{swap['version']} ({swap['outcome']})"
+                 if swap else '')
+              + f" fleet=v{s['fleet_version']}")
+
+    print()
+    print(f'fleet converged on v{updater.fleet_version} '
+          f'(store: {store.stats()["versions"]})')
+    r = router.stats()
+    print(f"router: {r['completed']} completed / {r['failed']} failed; "
+          f"replica versions "
+          f"{[p['weight_version'] for p in r['replicas']]}")
+    print()
+    print(observability.get_ledger().report_text())
+    if server is not None:
+        server.stop()
+    return loop.history
+
+
+if __name__ == '__main__':
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--iters', type=int, default=8)
+    ap.add_argument('--store-dir', default=None,
+                    help='weight store directory (default: tmpdir)')
+    ap.add_argument('--publish-every', type=int, default=2,
+                    help='trainer steps between published versions')
+    ap.add_argument('--metrics-port', type=int, default=None)
+    args = ap.parse_args()
+    main(iters=args.iters, store_dir=args.store_dir,
+         publish_every=args.publish_every,
+         metrics_port=args.metrics_port)

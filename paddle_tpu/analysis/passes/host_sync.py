@@ -35,6 +35,17 @@ HOT_SCOPES = {
         'InferenceEngine._draft_prefill', 'InferenceEngine._retire',
     ),
     'paddle_tpu/jit/__init__.py': ('TrainStep.__call__',),
+    # the hot-swap path runs INTERLEAVED with live decode rounds (the
+    # drain keeps the fleet serving), so a stray sync here stalls the
+    # same pipeline the engine scopes protect; the publisher's snapshot
+    # is the one sanctioned bulk d2h and must say so
+    'paddle_tpu/serving/hotswap.py': (
+        'WeightStore.publish', 'WeightPublisher.', 'ReplicaUpdater.',
+        'CanaryGate.__call__', 'finite_weights_gate', '_host_tree',
+    ),
+    'paddle_tpu/loop/rollout.py': (
+        'RolloutLoop.', 'RolloutBatch.', 'Rollout.',
+    ),
 }
 
 _NP_ROOTS = frozenset(('np', 'numpy', 'onp'))

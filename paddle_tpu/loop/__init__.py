@@ -1,0 +1,21 @@
+"""paddle_tpu.loop — post-training loops that close the
+trainer→serving circle (ISSUE 12).
+
+Training (`jit.TrainStep` / `resilience.elastic`) and serving
+(`serving.Router` over a `ReplicaSet`) each stand alone; this package
+drives them AS ONE SYSTEM: the serving fleet generates rollouts, a
+reward function scores them, the trainer consumes the best of them, and
+the freshly trained weights stream back into the very replicas that
+generated the rollouts via the hot-swap subsystem
+(`serving.hotswap`) — versioned, health-gated, zero-downtime,
+zero-recompile. That is the RLHF-shaped composed scenario the whole
+stack exists for (`examples/rlhf_loop.py` demos it end to end).
+
+    from paddle_tpu.loop import RolloutLoop, response_lm_loss
+"""
+from __future__ import annotations
+
+from .rollout import (Rollout, RolloutBatch, RolloutLoop,
+                      response_lm_loss)
+
+__all__ = ['Rollout', 'RolloutBatch', 'RolloutLoop', 'response_lm_loss']

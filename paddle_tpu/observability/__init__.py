@@ -37,7 +37,7 @@ from .goodput import (CATEGORIES as GOODPUT_CATEGORIES, GoodputLedger,
 from .flight import FlightRecorder, get_flight_recorder
 from .server import (ObservabilityServer, clear_degraded, degraded_states,
                      hang_suspected, health, note_degraded, note_progress,
-                     start_server)
+                     note_weight_version, start_server, weight_versions)
 from . import cost as _cost
 from . import flight as _flight
 from . import goodput as _goodput
@@ -58,7 +58,7 @@ __all__ = [
     'FlightRecorder', 'get_flight_recorder',
     'ObservabilityServer', 'clear_degraded', 'degraded_states',
     'hang_suspected', 'health', 'note_degraded', 'note_progress',
-    'start_server',
+    'note_weight_version', 'start_server', 'weight_versions',
 ]
 
 # register the jax.monitoring listeners + dispatch collector once at

@@ -78,6 +78,16 @@ EVENT_SCHEMA: Dict[str, str] = {
     'breaker_open': 'replica circuit breaker opened',
     'breaker_half_open': 'breaker cooldown elapsed; probing',
     'breaker_closed': 'breaker probe succeeded; replica back',
+    # online weight updates (trainer→serving hot-swap)
+    'weight_publish': 'trainer published a weight version to the store',
+    'weight_swap_begin': 'replica drain for a weight hot-swap started',
+    'weight_swap_complete': 'replica rejoined on the new weight version',
+    'weight_swap_failed': 'swap health gate failed; replica reverted',
+    'weight_rollback': 'replica restored its previous weight version',
+    'weight_version_quarantined':
+        'weight version quarantined after a failed gate or load',
+    'rollout_iteration':
+        'one serve→score→train→publish→swap turn of the rollout loop',
 }
 
 

@@ -57,6 +57,9 @@ CATEGORIES = (
     'rollback',            # wasted bad-step compute + snapshot restore
     'remesh',              # elastic shrink/grow transitions
     'preemption_drain',    # serving graceful-drain surplus
+    'weight_swap',         # trainer→serving hot-swap (drain/load/
+                           # verify/rejoin surplus; nested decode keeps
+                           # serving while a replica drains)
     'serving_prefill',
     'serving_decode',
     'host_wait',           # data-loader / input-pipeline wait
@@ -79,6 +82,15 @@ SPAN_CATEGORIES: Dict[str, str] = {
     'resilience.rollback': 'rollback',
     'elastic.resize': 'remesh',
     'serving.drain': 'preemption_drain',
+    # the rolling weight swap: sub-spans (drain wait, store load+verify,
+    # health gate, rejoin) all book as weight_swap; decode rounds nested
+    # inside the drain wait stay serving_decode — the fleet kept serving
+    'hotswap.swap': 'weight_swap',
+    'hotswap.drain': 'weight_swap',
+    'hotswap.load': 'weight_swap',
+    'hotswap.verify': 'weight_swap',
+    'hotswap.rejoin': 'weight_swap',
+    'hotswap.rollback': 'weight_swap',
     'serving.prefill': 'serving_prefill',
     'serving.prefill_chunk': 'serving_prefill',
     'serving.draft_prefill': 'serving_prefill',

@@ -49,6 +49,11 @@ _hangs: Dict[int, Dict[str, Any]] = {}  # watchdog id -> hang info
 # router tags each replica's engine) so a fleet router can tell WHICH
 # replica is degraded; scope None is the process itself.
 _degraded: Dict[Tuple[Optional[str], str], Dict[str, Any]] = {}
+# scope -> live weight version (None scope = the process/trainer view);
+# serving engines report through note_weight_version on every hot swap
+# so /healthz answers "which weights is this replica serving" without
+# touching the engine
+_weight_versions: Dict[Optional[str], int] = {}
 _START = time.monotonic()
 
 
@@ -109,6 +114,24 @@ def clear_degraded(state: str, scope: Optional[str] = None,
             del _degraded[(scope, state)]
 
 
+def note_weight_version(version: int, scope: Optional[str] = None):
+    """Record the weight version `scope` (a replica's engine, or the
+    process itself when None) is currently serving/training; shows up
+    in the /healthz payload as `weight_versions` so a mixed-version
+    fleet is observable from the outside during a rolling swap."""
+    with _live_lock:
+        _weight_versions[scope] = int(version)
+
+
+def weight_versions() -> Dict[str, int]:
+    """Live weight versions by scope ('process' for the scope-None
+    entry) — the /healthz `weight_versions` payload."""
+    with _live_lock:
+        return {(sc if sc is not None else 'process'): v
+                for sc, v in sorted(_weight_versions.items(),
+                                    key=lambda kv: kv[0] or '')}
+
+
 def degraded_states(scope: Optional[str] = '*') -> Dict[str, Dict[str, Any]]:
     """Active degraded states: `scope='*'` merges every scope, `None`
     returns only process-global states, any other string returns that
@@ -156,6 +179,7 @@ def health() -> Dict[str, Any]:
         'hangs': hangs,
         'states': states,
         'degraded': degraded,
+        'weight_versions': weight_versions(),
     }
 
 

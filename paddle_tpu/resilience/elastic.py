@@ -346,6 +346,12 @@ class ElasticTrainLoop:
             whose cursor rides every committed checkpoint.
         resume: 'auto' restores the latest committed step (fresh run if
             none); an int restores that exact step.
+        publisher: optional `serving.hotswap.WeightPublisher` — its
+            `maybe_publish(global_step)` runs after every optimizer
+            step, so a LIVE elastic run streams weight versions into a
+            serving fleet on the publisher's interval, through shrinks
+            and grows (the topology-independent host capture is exactly
+            what the publisher snapshots).
     """
 
     def __init__(self, model, loss_fn, optimizer, *, ckpt_dir,
@@ -354,7 +360,7 @@ class ElasticTrainLoop:
                  device_source: Optional[Callable[[], Sequence]] = None,
                  min_devices: int = 1,
                  retry_policy: Optional[RetryPolicy] = None,
-                 dataloader=None, resume=None):
+                 dataloader=None, resume=None, publisher=None):
         from ..utils.checkpoint import CheckpointManager
         if isinstance(ckpt_dir, CheckpointManager):
             self.mgr = ckpt_dir
@@ -367,6 +373,12 @@ class ElasticTrainLoop:
             device_source=device_source, min_devices=min_devices,
             retry_policy=retry_policy)
         self.dataloader = dataloader
+        self.publisher = publisher
+        if publisher is not None and publisher.source is model:
+            # the elastic step's capture is the topology-independent
+            # snapshot; point a model-sourced publisher at it so a
+            # publish during/after a re-mesh never reads torn placements
+            publisher.source = self.elastic
         self.global_step = 0
         if resume == 'auto':
             target = self.mgr.latest_step()
@@ -416,12 +428,14 @@ class ElasticTrainLoop:
 
     def step(self, inputs, labels):
         """One elastic optimizer step: poll/transition, step, checkpoint
-        on the interval."""
+        on the interval, publish weights on the publisher's interval."""
         self.maybe_resize()
         loss = self.elastic(inputs, labels)
         self.global_step += 1
         if self.mgr.should_save(self.global_step):
             self.save()
+        if self.publisher is not None:
+            self.publisher.maybe_publish(self.global_step)
         return loss
 
     def run(self, batch_fn: Callable[[int], Any], steps: int,

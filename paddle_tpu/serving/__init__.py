@@ -45,6 +45,21 @@ fast-fail load shedding):
                     tenants='paid:priority=high;free:priority=low,rate=2',
                     shed_queue_depth=64)
     h = router.submit(prompt_ids, tenant='paid')
+
+Online weight updates (`hotswap.py`, ISSUE 12): a trainer-side
+`WeightPublisher` streams versioned, sha256-manifested snapshots into a
+`WeightStore`; a `ReplicaUpdater` rolls them across the router's
+replicas one at a time (drain → swap → health-gate → rejoin) with zero
+dropped requests, zero XLA recompiles, version-tagged responses, and
+automatic rollback + quarantine on a failed gate:
+
+    from paddle_tpu.serving import (WeightStore, WeightPublisher,
+                                    ReplicaUpdater)
+    store = WeightStore('/ckpt/weights')
+    publisher = WeightPublisher(train_model, store, interval_steps=50)
+    updater = ReplicaUpdater(router, store)
+    ...                      # trainer: publisher.maybe_publish(step)
+    updater.poll()           # server: swap when a new version lands
 """
 from __future__ import annotations
 
@@ -52,6 +67,9 @@ from .api import (FAILED, FINISHED, GREEDY, PRIORITY_HIGH, PRIORITY_LOW,
                   PRIORITY_NAMES, PRIORITY_NORMAL, QUEUED, RUNNING,
                   SAMPLING, RequestHandle, SamplingParams)
 from .engine import InferenceEngine, sample_rows
+from .hotswap import (CanaryGate, ReplicaUpdater, SwapFailed,
+                      WeightLoadError, WeightPublisher, WeightStore,
+                      finite_weights_gate)
 from .kv_pool import SlotPool, default_buckets
 from .prefix_cache import RadixPrefixCache
 from .router import (CircuitBreaker, Replica, ReplicaFailure, ReplicaSet,
@@ -70,4 +88,6 @@ __all__ = [
     'Router', 'RouterHandle',
     'AdmissionRejected', 'Tenant', 'TenantRegistry', 'TokenBucket',
     'parse_tenant_spec', 'prefill_rounds', 'estimate_queue_rounds',
+    'CanaryGate', 'ReplicaUpdater', 'SwapFailed', 'WeightLoadError',
+    'WeightPublisher', 'WeightStore', 'finite_weights_gate',
 ]

@@ -85,6 +85,12 @@ class RequestHandle:
     request-level failure never kills the engine). Latency marks:
     ``ttft`` (submit -> first token) and ``tpot`` (mean inter-token
     time after the first) are available once the request finishes.
+
+    ``weight_version`` is stamped at ADMISSION with the engine's live
+    weight version — and because a hot swap only lands on a drained
+    replica, every token of the response was decoded under that single
+    version (the no-mixed-version-within-a-request guarantee the
+    hotswap tests assert).
     """
 
     def __init__(self, prompt_tokens: List[int], params: SamplingParams,
@@ -110,6 +116,9 @@ class RequestHandle:
         # copied KV covered
         self._prefix_node = None
         self._prefix_len = 0
+        # the weight version this request decodes under (stamped at
+        # admission; None while still queued)
+        self.weight_version: Optional[int] = None
 
     @property
     def trace_id(self) -> int:

@@ -160,7 +160,8 @@ class InferenceEngine:
                  max_wait_s: Optional[float] = None,
                  prefix_cache=None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 draft_model=None, num_draft_tokens: int = 4):
+                 draft_model=None, num_draft_tokens: int = 4,
+                 weight_version: int = 0):
         cfg = getattr(model, 'config', None)
         max_pos = getattr(cfg, 'max_position_embeddings', None)
         if max_pos is not None and max_length > max_pos:
@@ -172,6 +173,10 @@ class InferenceEngine:
         model.eval()
         self.model = model
         self._params, self._frozen, self._buffers = functional_state(model)
+        # monotone weight-version tag: bumped by swap_weights (the
+        # trainer→serving hot-swap path); every request is stamped with
+        # the version it decodes under at admission
+        self.weight_version = int(weight_version)
         self.eos_token_id = int(
             getattr(cfg, 'eos_token_id', -1) if eos_token_id is None
             else eos_token_id)
@@ -198,6 +203,8 @@ class InferenceEngine:
                 'prefix cache budget rounds to zero slots; raise the '
                 'fraction or the slot count (retention must leave at '
                 'least one slot for decode)')
+        if self.prefix_cache is not None:
+            self.prefix_cache.set_version(self.weight_version)
         self.draft_model = draft_model
         self.spec_k = int(num_draft_tokens)
         if draft_model is not None:
@@ -727,6 +734,103 @@ class InferenceEngine:
         return not timed_out
 
     # ------------------------------------------------------------------
+    # online weight updates (trainer→serving hot-swap, ISSUE 12)
+    # ------------------------------------------------------------------
+    def swap_weights(self, state, *, version: int, strict: bool = True):
+        """Replace the engine's weights IN PLACE with a published
+        host-canonical snapshot (``{name: array}`` as produced by
+        ``Layer.state_dict()`` / ``hotswap.WeightStore.load``), without
+        touching a single compiled program: every staged leaf must match
+        the live leaf's shape and is cast to its dtype, so the decode /
+        prefill avals — and therefore the ProgramStore keys — are
+        bit-identical before and after (zero XLA recompiles on swap,
+        tier-1-guarded).
+
+        Requires a DRAINED engine (no queued or in-flight requests):
+        that is what makes the per-request ``weight_version`` stamp a
+        whole-response guarantee. The `ReplicaUpdater` drains through
+        the router first; direct callers get a loud error instead of a
+        torn batch.
+
+        `strict=True` (default) demands every live param present in
+        `state`; buffers may be absent (non-persistable buffers never
+        travel through `state_dict`) and keep their current values.
+
+        Returns the PREVIOUS weight state — an opaque token for
+        `restore_weights`, which the updater holds for the rollback
+        path (the old device arrays stay alive by reference, so a
+        revert is a pointer swap, not a reload)."""
+        if self._slot_req or self.scheduler.queue_depth > 0:
+            raise RuntimeError(
+                f'swap_weights requires a drained engine, but '
+                f'{len(self._slot_req)} slot(s) are decoding and '
+                f'{self.scheduler.queue_depth} request(s) are queued '
+                f'(drain through the router/updater first)')
+        prev = (self._params, self._frozen, self._buffers,
+                self.weight_version)
+        self._params = self._stage_swap(self._params, state,
+                                        'parameter', strict)
+        self._frozen = self._stage_swap(self._frozen, state,
+                                        'frozen parameter', strict)
+        self._buffers = self._stage_swap(self._buffers, state,
+                                         'buffer', False)
+        self._set_weight_version(version)
+        return prev
+
+    def restore_weights(self, prev):
+        """Roll back to a weight state captured by `swap_weights` (the
+        failed-health-gate path). Same drained-engine requirement; the
+        prefix cache's entries for the restored version re-validate for
+        free (they were never flushed, only version-shadowed)."""
+        if self._slot_req or self.scheduler.queue_depth > 0:
+            raise RuntimeError(
+                'restore_weights requires a drained engine')
+        self._params, self._frozen, self._buffers, version = prev
+        self._set_weight_version(version)
+
+    def _set_weight_version(self, version: int):
+        self.weight_version = int(version)
+        if self.prefix_cache is not None:
+            # no flush: entries from other versions go stale and are
+            # lazily reclaimed; this version's survivors serve again
+            self.prefix_cache.set_version(self.weight_version)
+        _obs.note_weight_version(self.weight_version,
+                                 scope=self.obs_scope)
+        if _obs.enabled():
+            _obs.get_registry().gauge(
+                'paddle_weight_version',
+                'live weight version per serving scope',
+                ('scope',)).labels(
+                    scope=self.obs_scope or 'engine').set(
+                        self.weight_version)
+
+    @staticmethod
+    def _stage_swap(old_dict, state, kind: str, strict: bool):
+        """Stage one functional-state dict from a published snapshot:
+        shape-checked against the live aval (a mismatch means the
+        checkpoint is structurally different — fail the SWAP, loudly,
+        before any program could retrace) and cast to the live dtype so
+        the program key cannot move."""
+        new = {}
+        for name, old in old_dict.items():
+            if name not in state:
+                if strict:
+                    raise KeyError(
+                        f'published weights missing {kind} {name!r}: '
+                        f'refusing a partial swap')
+                new[name] = old
+                continue
+            arr = np.asarray(getattr(state[name], 'value', state[name]))
+            if tuple(arr.shape) != tuple(old.shape):
+                raise ValueError(
+                    f'{kind} {name!r} shape {tuple(arr.shape)} does not '
+                    f'match the live aval {tuple(old.shape)}: swapping '
+                    f'it would change the program key and force a '
+                    f'recompile')
+            new[name] = jnp.asarray(arr, dtype=old.dtype)
+        return new
+
+    # ------------------------------------------------------------------
     # the iteration loop
     # ------------------------------------------------------------------
     @property
@@ -940,6 +1044,10 @@ class InferenceEngine:
             h._queue_span = None
         self._slot_req[slot] = h
         h.status = RUNNING
+        # the no-mixed-version guarantee: stamped ONCE, here — a hot
+        # swap requires a drained engine, so every token this request
+        # emits decodes under this version
+        h.weight_version = self.weight_version
         cursor = 0
         src = slot
         if self.prefix_cache is not None:
@@ -1129,6 +1237,7 @@ class InferenceEngine:
             'chunk_rounds': self._counts['chunk_rounds'],
             'queue_depth': self.scheduler.queue_depth,
             'active_slots': len(self._slot_req),
+            'weight_version': self.weight_version,
             'traces': dict(self._trace_counts),
             'pool': self.pool.stats(),
         }

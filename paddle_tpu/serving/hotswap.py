@@ -1,0 +1,557 @@
+"""Online weight updates: trainer→serving hot-swap with versioned
+rollouts (ISSUE 12).
+
+Training (`resilience/elastic.py`) and serving (`router.py`) are both
+production-hardened, but nothing connects them — continuous fine-tuning
+and RLHF-style post-training need the weights a trainer just produced
+to reach a live fleet WITHOUT a restart, a dropped request, or an XLA
+recompile. This module is that link, three pieces composed from
+machinery the repo already trusts:
+
+- `WeightStore`: a versioned, sha256-manifested snapshot store reusing
+  the PR-6 checkpoint integrity format (atomic-rename commit, per-file
+  checksums in the `_COMMITTED` manifest, corrupt payloads rejected
+  never restored). Versions are monotone; the last K are retained for
+  rollback; a version that fails its health gate or its checksum is
+  QUARANTINED (marker file + event) so no later poll re-offers it.
+- `WeightPublisher`: the trainer side. Snapshots host-canonical params
+  every N steps — from a bare `Layer`, an `ElasticTrainStep`'s
+  topology-independent `capture_host_state`, or any callable — and
+  publishes them under the next `weight_version`.
+- `ReplicaUpdater`: the serving side. Rolls a new version across the
+  Router's replicas ONE AT A TIME through the existing health/drain
+  machinery: cordon (scoped `weight_swap` degraded state excludes the
+  replica from placement while /healthz shows why) → drain (router
+  steps keep serving; the victim's accepted requests finish — zero
+  drops) → swap (`engine.swap_weights`: aval-checked, so the
+  ProgramStore keys cannot move — zero recompiles, verified against
+  the store's key set and the compile counters) → health gate (default:
+  reject non-finite weights; `CanaryGate` optionally decodes a probe)
+  → rejoin. A failed gate auto-reverts the replica to its previous
+  weights (a pointer swap — the old device arrays were never dropped),
+  quarantines the version, and ABORTS the rollout so no further
+  replica ever sees it.
+
+Every phase is a `hotswap.*` span classified as the first-class
+`weight_swap` goodput category (decode rounds nested inside the drain
+stay `serving_decode`: the fleet kept serving), and every transition
+emits `weight_*` events + `paddle_swap_*` / `paddle_weight_*` metrics.
+Responses carry the single `weight_version` they were decoded under
+(stamped at admission; swaps only land on drained replicas).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import observability as _obs
+from .. import serialization
+from ..utils.checkpoint import CheckpointManager
+
+
+class WeightLoadError(RuntimeError):
+    """A published version could not be loaded (missing, quarantined,
+    or failed its sha256 manifest)."""
+
+
+class SwapFailed(RuntimeError):
+    """A rolling swap could not complete on a replica (drain timeout /
+    unexpected engine failure). Gate failures do NOT raise — they roll
+    back and quarantine."""
+
+    def __init__(self, version: int, replica_id: int, msg: str):
+        self.version = int(version)
+        self.replica_id = int(replica_id)
+        super().__init__(msg)
+
+
+def _host_tree(state: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Materialize a {name: Tensor|array} state as host numpy arrays."""
+    return {n: np.asarray(getattr(t, 'value', t))  # paddle-lint: disable=host-sync -- the publish/rollback snapshot IS the sanctioned bulk d2h: weights must reach host storage
+            for n, t in dict(state).items()}
+
+
+# ---------------------------------------------------------------------------
+# the versioned store
+# ---------------------------------------------------------------------------
+
+class WeightStore:
+    """Versioned weight snapshots with the PR-6 checkpoint integrity
+    format: each version is a committed `step_<v>` directory (atomic
+    rename, npz payload, per-file sha256 in `_COMMITTED`) managed by a
+    `CheckpointManager`, plus quarantine semantics on top — a version
+    that fails a health gate or a checksum gets a `_QUARANTINED`
+    marker and stops being offered by `latest_version()`/`load()`,
+    while version numbering stays monotone past it.
+
+    Args:
+        directory: store root (shared between trainer and servers —
+            a filesystem both can reach is the transport).
+        keep_versions: retention depth; rollback needs >= 2.
+    """
+
+    _MARKER = '_QUARANTINED'
+
+    def __init__(self, directory: str, keep_versions: int = 4,
+                 retry_policy=None):
+        if keep_versions < 2:
+            raise ValueError('keep_versions must be >= 2 (rollback '
+                             'needs the previous version retained)')
+        self.mgr = CheckpointManager(
+            directory, backend='npz', max_to_keep=int(keep_versions),
+            save_interval_steps=1, retry_policy=retry_policy)
+        self.directory = self.mgr.directory
+        reg = _obs.get_registry()
+        self._m_published = reg.counter(
+            'paddle_weight_publish_total', 'weight versions published')
+        self._m_publish_bytes = reg.counter(
+            'paddle_weight_publish_bytes_total',
+            'host payload bytes published to the weight store')
+        self._m_published_version = reg.gauge(
+            'paddle_weight_published_version',
+            'latest committed (non-quarantined) weight version')
+        self._m_quarantined = reg.counter(
+            'paddle_swap_quarantined_total',
+            'weight versions quarantined (failed gate or load)')
+
+    # -- bookkeeping --------------------------------------------------------
+    def _dir(self, version: int) -> str:
+        return self.mgr._step_dir(int(version))
+
+    def all_versions(self) -> List[int]:
+        """Every committed version, quarantined included (numbering)."""
+        return self.mgr.all_steps()
+
+    def versions(self) -> List[int]:
+        """Committed, servable (non-quarantined) versions, ascending."""
+        return [v for v in self.mgr.all_steps()
+                if not self.is_quarantined(v)]
+
+    def latest_version(self) -> Optional[int]:
+        vs = self.versions()
+        return vs[-1] if vs else None
+
+    def next_version(self) -> int:
+        vs = self.all_versions()
+        return (vs[-1] + 1) if vs else 1
+
+    def is_quarantined(self, version: int) -> bool:
+        return os.path.exists(os.path.join(self._dir(version),
+                                           self._MARKER))
+
+    def quarantined(self) -> List[int]:
+        return [v for v in self.mgr.all_steps() if self.is_quarantined(v)]
+
+    # -- publish / load -----------------------------------------------------
+    def publish(self, state: Dict[str, Any], version: Optional[int] = None,
+                meta: Optional[Dict[str, Any]] = None) -> int:
+        """Commit `state` ({name: array} model weights) as a new
+        version. Versions are strictly monotone: an explicit `version`
+        at or below the max ever seen is a caller bug."""
+        host = _host_tree(state)
+        if version is None:
+            version = self.next_version()
+        else:
+            version = int(version)
+            vs = self.all_versions()
+            if vs and version <= vs[-1]:
+                raise ValueError(
+                    f'weight versions are monotone: {version} <= '
+                    f'latest committed {vs[-1]}')
+        nbytes = sum(int(a.nbytes) for a in host.values()
+                     if hasattr(a, 'nbytes'))
+        self.mgr.save(version, {'model': host, 'weight_version': version,
+                                'meta': dict(meta or {})}, force=True)
+        _obs.emit('weight_publish', version=version, bytes=nbytes,
+                  **{k: v for k, v in (meta or {}).items()
+                     if isinstance(v, (int, float, str))})
+        if _obs.enabled():
+            self._m_published.inc()
+            self._m_publish_bytes.inc(nbytes)
+            self._m_published_version.set(version)
+        return version
+
+    def load(self, version: int) -> Dict[str, np.ndarray]:
+        """Strict read of one exact version's weights: committed, not
+        quarantined, and every payload file matching its sha256
+        manifest — otherwise `WeightLoadError` (the updater quarantines
+        on it). Deliberately NOT `CheckpointManager.restore`: no
+        fall-back-to-previous (a swap must never silently apply a
+        different version than it announced) and no
+        `checkpoint_restore` span (swap time books as `weight_swap`,
+        under the caller's `hotswap.load` span)."""
+        version = int(version)
+        d = self._dir(version)
+        if version not in self.mgr.all_steps():
+            raise WeightLoadError(f'weight version {version} is not '
+                                  f'committed under {self.directory}')
+        if self.is_quarantined(version):
+            raise WeightLoadError(f'weight version {version} is '
+                                  f'quarantined')
+        if not self.mgr.verify(version):
+            raise WeightLoadError(
+                f'weight version {version} failed its sha256 manifest '
+                f'(torn write or bit rot)')
+        tree = serialization.load(os.path.join(d, 'tree.npz'),
+                                  return_numpy=True)
+        return dict(tree['model'])
+
+    def meta(self, version: int) -> Dict[str, Any]:
+        tree = serialization.load(os.path.join(self._dir(int(version)),
+                                               'tree.npz'),
+                                  return_numpy=True)
+        return dict(tree.get('meta', {}))
+
+    # -- quarantine ---------------------------------------------------------
+    def quarantine(self, version: int, reason: str = ''):
+        """Mark `version` unservable (failed health gate / bad payload):
+        `latest_version()`/`load()` stop offering it, retention still
+        ages it out. Idempotent."""
+        version = int(version)
+        d = self._dir(version)
+        already = self.is_quarantined(version)
+        if os.path.isdir(d) and not already:
+            with open(os.path.join(d, self._MARKER), 'w') as f:
+                json.dump({'version': version, 'reason': str(reason),
+                           'at': time.time()}, f)
+        if not already:
+            _obs.emit('weight_version_quarantined', version=version,
+                      reason=str(reason))
+            if _obs.enabled():
+                self._m_quarantined.inc()
+                latest = self.latest_version()
+                if latest is not None:
+                    self._m_published_version.set(latest)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            'directory': self.directory,
+            'versions': self.versions(),
+            'latest': self.latest_version(),
+            'quarantined': self.quarantined(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# trainer side
+# ---------------------------------------------------------------------------
+
+class WeightPublisher:
+    """Streams a live training run's weights into a `WeightStore`
+    every `interval_steps` optimizer steps.
+
+    `source` is what to snapshot:
+    - a `Layer` (its `state_dict()`, host-materialized),
+    - anything with `capture_host_state()` (an `ElasticTrainStep`: the
+      topology-independent snapshot — its 'model' tree — so an elastic
+      run publishes through a re-mesh unchanged),
+    - a zero-arg callable returning `{name: array}`.
+    """
+
+    def __init__(self, source, store: WeightStore,
+                 interval_steps: int = 1,
+                 meta_fn: Optional[Callable[[int], Dict[str, Any]]] = None):
+        if interval_steps < 1:
+            raise ValueError('interval_steps must be >= 1')
+        self.source = source
+        self.store = store
+        self.interval_steps = int(interval_steps)
+        self.meta_fn = meta_fn
+        self.last_published_version: Optional[int] = None
+        self.last_published_step: Optional[int] = None
+
+    def capture(self) -> Dict[str, np.ndarray]:
+        """One host-canonical snapshot of the source's weights. The
+        per-leaf `np.asarray` is the publisher's one device→host
+        moment; it rides the trainer's cadence, never the decode path."""
+        src = self.source
+        if callable(src) and not hasattr(src, 'state_dict') \
+                and not hasattr(src, 'capture_host_state'):
+            return _host_tree(src())  # paddle-lint: disable=host-sync -- the publish snapshot IS the d2h: weights must reach the store
+        if hasattr(src, 'capture_host_state'):
+            return dict(src.capture_host_state()['model'])
+        return _host_tree(src.state_dict())  # paddle-lint: disable=host-sync -- the publish snapshot IS the d2h: weights must reach the store
+
+    def publish(self, step: Optional[int] = None) -> int:
+        """Snapshot + commit now; returns the new weight version."""
+        meta: Dict[str, Any] = {'step': int(step)} if step is not None \
+            else {}
+        if self.meta_fn is not None:
+            meta.update(self.meta_fn(step))
+        version = self.store.publish(self.capture(), meta=meta)
+        self.last_published_version = version
+        self.last_published_step = step
+        return version
+
+    def maybe_publish(self, step: int) -> Optional[int]:
+        """Publish when `step` lands on the interval (each step at most
+        once); returns the version or None."""
+        step = int(step)
+        if step % self.interval_steps != 0:
+            return None
+        if self.last_published_step == step:
+            return None
+        return self.publish(step)
+
+
+# ---------------------------------------------------------------------------
+# health gates
+# ---------------------------------------------------------------------------
+
+def finite_weights_gate(engine, version: int,
+                        tree: Dict[str, np.ndarray]) -> Tuple[bool, str]:
+    """Default gate: every floating leaf of the published tree is
+    finite. Pure host-side numpy on the already-loaded snapshot —
+    catches the classic bad checkpoint (NaN/Inf from a diverged or torn
+    step) without touching the device, so the swap's zero-compile
+    accounting stays exact."""
+    for name, leaf in tree.items():
+        a = np.asarray(leaf)  # paddle-lint: disable=host-sync -- the gate reads the ALREADY-host npz tree (no device copy); staying on host is what keeps the swap's zero-compile accounting exact
+        if np.issubdtype(a.dtype, np.floating) \
+                and not bool(np.isfinite(a).all()):
+            return False, f'non-finite values in {name!r}'
+    return True, ''
+
+
+class CanaryGate:
+    """Opt-in post-swap probe: decode `max_new_tokens` greedily from
+    `prompt` ON the freshly swapped (cordoned, drained) engine and
+    require it to finish — optionally bit-matching `expect`. The canary
+    uses the engine's own compiled programs, so its first run may
+    compile a prefill bucket the live traffic never used; pair it with
+    traffic-shaped prompts when the zero-compile guarantee matters."""
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int = 4,
+                 expect: Optional[Sequence[int]] = None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.expect = None if expect is None else [int(t) for t in expect]
+
+    def __call__(self, engine, version: int, tree) -> Tuple[bool, str]:
+        from .api import SamplingParams
+        h = engine.submit(self.prompt, SamplingParams(
+            max_new_tokens=self.max_new_tokens, eos_token_id=-1))
+        toks = h.result()
+        if self.expect is not None and list(toks) != self.expect:
+            return False, (f'canary mismatch: got {list(toks)}, '
+                           f'expected {self.expect}')
+        if not toks:
+            return False, 'canary produced no tokens'
+        return True, ''
+
+
+# ---------------------------------------------------------------------------
+# serving side
+# ---------------------------------------------------------------------------
+
+class ReplicaUpdater:
+    """Rolls published weight versions across a `Router`'s replicas,
+    one at a time, with zero dropped requests and zero recompiles.
+
+    Args:
+        router: the live `Router` (its replicas are the swap targets;
+            its `step()` keeps the WHOLE fleet serving while one
+            replica drains).
+        store: the `WeightStore` the trainer publishes into.
+        gates: health-gate callables `(engine, version, tree) ->
+            (ok, detail)` run after the swap, before rejoin; the first
+            failure reverts the replica and quarantines the version.
+            Default: `[finite_weights_gate]`.
+        max_drain_rounds: router rounds to wait for a replica to go
+            idle before declaring the swap stuck (`SwapFailed`).
+        traffic_pump: optional zero-arg callable invoked once per drain
+            round — the hook tests (and request-generating callers) use
+            to keep submitting traffic WHILE a swap is in flight.
+    """
+
+    def __init__(self, router, store: WeightStore, *,
+                 gates: Optional[Sequence[Callable]] = None,
+                 max_drain_rounds: int = 100000,
+                 traffic_pump: Optional[Callable[[], None]] = None):
+        self.router = router
+        self.store = store
+        self.gates = list(gates) if gates is not None \
+            else [finite_weights_gate]
+        self.max_drain_rounds = int(max_drain_rounds)
+        self.traffic_pump = traffic_pump
+        reg = _obs.get_registry()
+        self._m_swaps = reg.counter(
+            'paddle_swap_total', 'per-replica weight swaps by outcome',
+            ('outcome',))
+        self._m_rollbacks = reg.counter(
+            'paddle_swap_rollbacks_total',
+            'replicas reverted to their previous weights after a '
+            'failed health gate')
+        self._m_seconds = reg.histogram(
+            'paddle_swap_seconds',
+            'per-replica drain+swap+verify+rejoin wall time')
+
+    # -- introspection ------------------------------------------------------
+    def current_versions(self) -> Dict[int, int]:
+        return {r.id: r.engine.weight_version
+                for r in self.router.replicas}
+
+    @property
+    def fleet_version(self) -> Optional[int]:
+        """The single version every replica serves, or None while the
+        fleet is mixed (mid-rollout)."""
+        vs = set(self.current_versions().values())
+        return vs.pop() if len(vs) == 1 else None
+
+    # -- the rolling swap ---------------------------------------------------
+    def poll(self) -> Optional[Dict[str, Any]]:
+        """Swap to the store's latest servable version when any replica
+        is behind it; returns the `update_to` result or None."""
+        latest = self.store.latest_version()
+        if latest is None:
+            return None
+        if all(r.engine.weight_version >= latest
+               for r in self.router.replicas):
+            return None
+        return self.update_to(latest)
+
+    def update_to(self, version: int) -> Dict[str, Any]:
+        """Roll `version` across the fleet. One replica at a time; a
+        gate failure quarantines the version and ABORTS the rollout —
+        replicas not yet swapped never see a version another replica
+        just rejected."""
+        version = int(version)
+        result: Dict[str, Any] = {'version': version,
+                                  'outcome': 'completed', 'replicas': []}
+        with _obs.span('hotswap.swap', version=version):
+            with _obs.span('hotswap.load', version=version):
+                try:
+                    tree = self.store.load(version)
+                except Exception as exc:
+                    # a version that cannot even load is quarantined the
+                    # same as one that fails its gate — no replica was
+                    # touched, nothing to roll back
+                    self.store.quarantine(version,
+                                          f'load failed: {exc}')
+                    if _obs.enabled():
+                        self._m_swaps.labels(outcome='load_failed').inc()
+                    result['outcome'] = 'load_failed'
+                    result['error'] = f'{type(exc).__name__}: {exc}'
+                    return result
+            for replica in list(self.router.replicas):
+                r = self._swap_replica(replica, version, tree)
+                result['replicas'].append(r)
+                if r['outcome'] == 'rolled_back':
+                    result['outcome'] = 'aborted'
+                    break
+        return result
+
+    def _drive_drain(self, engine) -> int:
+        rounds = 0
+        while engine.has_work:
+            if self.traffic_pump is not None:
+                self.traffic_pump()
+            self.router.step()
+            rounds += 1
+            if rounds > self.max_drain_rounds:
+                raise SwapFailed(
+                    -1, -1, f'replica did not drain within '
+                            f'{self.max_drain_rounds} router rounds')
+        return rounds
+
+    def _swap_replica(self, replica, version: int,
+                      tree: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        from .. import programs as _programs
+        eng = replica.engine
+        from_version = eng.weight_version
+        res: Dict[str, Any] = {
+            'replica': replica.id, 'from_version': from_version,
+            'to_version': version, 'outcome': 'completed',
+            'drain_rounds': 0, 'new_program_keys': 0, 'real_compiles': 0,
+        }
+        if from_version == version:
+            res['outcome'] = 'already_current'
+            return res
+        _obs.emit('weight_swap_begin', replica=replica.id,
+                  from_version=from_version, to_version=version)
+        # cordon: the scoped degraded state takes this replica out of
+        # placement through the SAME machinery /healthz and the router
+        # already share — in-flight and queued work keeps decoding
+        _obs.note_degraded('weight_swap',
+                           {'from_version': from_version,
+                            'to_version': version}, scope=replica.scope)
+        t0 = time.perf_counter()
+        cleared = False
+        try:
+            with _obs.span('hotswap.drain', replica=replica.id,
+                           version=version):
+                try:
+                    res['drain_rounds'] = self._drive_drain(eng)
+                except SwapFailed as exc:
+                    raise SwapFailed(version, replica.id,
+                                     str(exc)) from None
+            store = _programs.get_store()
+            reg = _obs.get_registry()
+            keys0 = {e['key'] for e in store.entries()}
+            compiles0 = reg.value('paddle_jit_compiles_total')
+            hits0 = reg.value('paddle_jit_cache_hits_total')
+            with _obs.span('hotswap.load', replica=replica.id,
+                           version=version):
+                prev = eng.swap_weights(tree, version=version)
+            ok, detail = True, ''
+            with _obs.span('hotswap.verify', replica=replica.id,
+                           version=version):
+                for gate in self.gates:
+                    try:
+                        verdict = gate(eng, version, tree)
+                        ok, detail = (verdict if isinstance(verdict,
+                                                            tuple)
+                                      else (bool(verdict), ''))
+                    except Exception as exc:
+                        ok = False
+                        detail = f'{type(exc).__name__}: {exc}'
+                    if not ok:
+                        break
+                # ProgramStore-verified zero recompiles: same avals and
+                # shardings ⇒ same program keys, so the swap (gates
+                # included) must not mint keys or real compiles
+                new_keys = ({e['key'] for e in store.entries()}
+                            - keys0)
+                real = ((reg.value('paddle_jit_compiles_total')
+                         - compiles0)
+                        - (reg.value('paddle_jit_cache_hits_total')
+                           - hits0))
+                res['new_program_keys'] = len(new_keys)
+                res['real_compiles'] = int(real)
+            if ok:
+                with _obs.span('hotswap.rejoin', replica=replica.id,
+                               version=version):
+                    _obs.clear_degraded('weight_swap',
+                                        scope=replica.scope)
+                    cleared = True
+                dt = time.perf_counter() - t0
+                _obs.emit('weight_swap_complete', replica=replica.id,
+                          from_version=from_version, to_version=version,
+                          drain_rounds=res['drain_rounds'],
+                          seconds=round(dt, 4))
+                if _obs.enabled():
+                    self._m_swaps.labels(outcome='completed').inc()
+                    self._m_seconds.observe(dt)
+            else:
+                with _obs.span('hotswap.rollback', replica=replica.id,
+                               version=version):
+                    eng.restore_weights(prev)
+                self.store.quarantine(version, detail)
+                _obs.emit('weight_swap_failed', replica=replica.id,
+                          version=version, reason=detail)
+                _obs.emit('weight_rollback', replica=replica.id,
+                          to_version=from_version)
+                if _obs.enabled():
+                    self._m_swaps.labels(outcome='rolled_back').inc()
+                    self._m_rollbacks.inc()
+                    self._m_seconds.observe(time.perf_counter() - t0)
+                res['outcome'] = 'rolled_back'
+                res['reason'] = detail
+        finally:
+            if not cleared:
+                _obs.clear_degraded('weight_swap', scope=replica.scope)
+        return res

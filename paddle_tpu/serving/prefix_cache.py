@@ -32,6 +32,15 @@ Lifecycle:
 Sampling-params independence is by construction: the key is the token
 prefix alone — prefill KV does not depend on temperature/top-k/top-p,
 so greedy and sampled requests share entries.
+
+Weight versioning (ISSUE 12): prefill KV is a function of the WEIGHTS,
+so a hot weight swap invalidates every retained prefix. Entries are
+tagged with the `weight_version` that produced them; `set_version`
+moves the cache forward WITHOUT flushing — stale entries simply stop
+matching lookups and are evicted lazily (on the lookup path that walks
+past them, and preferentially under eviction pressure), never
+wholesale mid-traffic. A rollback to the previous version re-validates
+its surviving entries for free.
 """
 from __future__ import annotations
 
@@ -51,10 +60,12 @@ def snapshot_all() -> List[dict]:
 
 class _Node:
     """One radix-trie node. `edge` is the token run from the parent;
-    `slot`/`kv_len` are set only on owning nodes (kv_len == depth)."""
+    `slot`/`kv_len`/`version` are set only on owning nodes
+    (kv_len == depth; version is the weight version whose prefill KV
+    the retained slot holds)."""
 
     __slots__ = ('edge', 'children', 'parent', 'slot', 'kv_len', 'refs',
-                 'last_use')
+                 'last_use', 'version')
 
     def __init__(self, edge: Tuple[int, ...], parent: Optional['_Node']):
         self.edge = edge
@@ -64,6 +75,7 @@ class _Node:
         self.kv_len = 0
         self.refs = 0
         self.last_use = 0
+        self.version = 0
 
 
 def _common(a: Tuple[int, ...], b: List[int], off: int) -> int:
@@ -99,8 +111,12 @@ class RadixPrefixCache:
         self._root = _Node((), None)
         self._owners: set = set()
         self._tick = 0
+        # the weight version CURRENT entries belong to; owners tagged
+        # with any other version are stale (never served, lazily freed)
+        self.version = 0
         self._counts = {'hits': 0, 'misses': 0, 'inserts': 0,
-                        'evictions': 0, 'tokens_reused': 0}
+                        'evictions': 0, 'tokens_reused': 0,
+                        'stale_evictions': 0}
         self._init_metrics()
         _live_caches.add(self)
 
@@ -121,6 +137,9 @@ class RadixPrefixCache:
         self._m_evictions = reg.counter(
             'paddle_serving_prefix_evictions_total',
             'retained prefixes evicted (LRU / pool pressure)')
+        self._m_stale_evictions = reg.counter(
+            'paddle_serving_prefix_stale_evictions_total',
+            'old-weight-version prefixes lazily reclaimed after a swap')
         self._m_retained = reg.gauge(
             'paddle_serving_prefix_retained_slots',
             'pool slots currently pinned by cached prefixes')
@@ -141,20 +160,47 @@ class RadixPrefixCache:
         self._tick += 1
         node.last_use = self._tick
 
+    # -- weight versioning --------------------------------------------------
+    def set_version(self, version: int):
+        """Move the cache to a new weight version (the engine calls this
+        from `swap_weights`). NO flush happens here: entries tagged with
+        other versions become stale — unmatchable by lookups — and are
+        reclaimed lazily (lookup walks, eviction pressure), so a swap
+        never stalls live traffic behind a wholesale invalidation. A
+        later `set_version` back to a previous version (rollback)
+        re-validates that version's surviving entries."""
+        self.version = int(version)
+
+    @property
+    def stale_count(self) -> int:
+        """Retained entries whose version is not current (pending lazy
+        reclamation; they never serve lookups)."""
+        return sum(1 for n in self._owners if n.version != self.version)
+
     # -- lookup -------------------------------------------------------------
-    @staticmethod
-    def _subtree_owner(node: _Node) -> Optional[_Node]:
-        """Most-recently-used slot-owning node at/under `node`. Any such
-        node works: its retained KV rows cover its whole root path, so
-        the first `matched` of them are exactly the querying prompt's
-        prefix KV."""
-        best, stack = None, [node]
+    def _subtree_owner(self, node: _Node,
+                       reclaim_stale: bool = False) -> Optional[_Node]:
+        """Most-recently-used CURRENT-version slot-owning node at/under
+        `node`. Any such node works: its retained KV rows cover its
+        whole root path, so the first `matched` of them are exactly the
+        querying prompt's prefix KV. With `reclaim_stale`, unpinned
+        stale (old-weight-version) owners found on the walk are freed —
+        the lazy swap-invalidation path: no wholesale flush, the trie
+        sheds old-version KV as traffic actually touches its subtrees
+        (a full-miss lookup sweeps nothing, so a later rollback still
+        finds its survivors)."""
+        best, stack, stale = None, [node], []
         while stack:
             n = stack.pop()
-            if n.slot is not None and (best is None
-                                       or n.last_use > best.last_use):
-                best = n
+            if n.slot is not None:
+                if n.version != self.version:
+                    if reclaim_stale and n.refs == 0:
+                        stale.append(n)
+                elif best is None or n.last_use > best.last_use:
+                    best = n
             stack.extend(n.children.values())
+        for n in stale:
+            self._evict_node(n, stale=True)
         return best
 
     def lookup(self, tokens) -> Tuple[Optional[_Node], int]:
@@ -181,9 +227,10 @@ class RadixPrefixCache:
             depth += m
             node = child
             deepest, deepest_len = node, depth
-            if node.slot is not None:
+            if node.slot is not None and node.version == self.version:
                 best_exact = (node, depth)
-        hit = self._subtree_owner(deepest)
+        hit = self._subtree_owner(deepest,
+                                  reclaim_stale=deepest_len > 0)
         if hit is not None and deepest_len > best_exact[1]:
             best = (hit, deepest_len)
         else:
@@ -241,11 +288,19 @@ class RadixPrefixCache:
             child.edge = child.edge[m:]
             child.parent = mid
             node, depth = mid, depth + m
+        if node.slot is not None and node.version != self.version:
+            # this exact prefix is retained under an OLD weight version:
+            # the fresh KV supersedes it (a pinned stale owner — a
+            # pre-swap request still decoding off it — keeps its slot;
+            # the caller keeps the new one)
+            if node.refs > 0:
+                return False
+            self._evict_node(node, stale=True, prune=False)
         covering = self._subtree_owner(node)
         if covering is not None:
-            # some retained entry already extends (or equals) this
-            # prompt, so its rows already serve this prefix: refresh it
-            # rather than spending a second slot
+            # some retained CURRENT-version entry already extends (or
+            # equals) this prompt, so its rows already serve this
+            # prefix: refresh it rather than spending a second slot
             self._touch(covering)
             return False
         while len(self._owners) >= self.budget_slots:
@@ -253,6 +308,7 @@ class RadixPrefixCache:
                 return False        # everything is pinned
         node.slot = int(slot)
         node.kv_len = len(tokens)
+        node.version = self.version
         self._owners.add(node)
         self._touch(node)
         self._counts['inserts'] += 1
@@ -262,31 +318,46 @@ class RadixPrefixCache:
         return True
 
     # -- eviction -----------------------------------------------------------
-    def evict_lru(self) -> bool:
-        """Free the least-recently-used ZERO-REF retained prefix back
-        into the pool. False when every entry is pinned (or empty)."""
-        cands = [n for n in self._owners if n.refs == 0]
-        if not cands:
-            return False
-        victim = min(cands, key=lambda n: n.last_use)
+    def _evict_node(self, victim: _Node, stale: bool = False,
+                    prune: bool = True) -> None:
+        """Free `victim`'s retained slot back into the pool and drop it
+        from the owner set. `prune=False` keeps the (now structural)
+        node in the trie — the insert path re-owns it in place."""
         slot, kv_len = victim.slot, victim.kv_len
         self.pool.free(victim.slot)
         victim.slot = None
         victim.kv_len = 0
         self._owners.discard(victim)
-        # prune now-empty leaves upward (structural nodes with children
-        # stay: they still route longer retained paths)
-        n = victim
-        while (n.parent is not None and n.slot is None
-               and not n.children):
-            del n.parent.children[n.edge[0]]
-            n = n.parent
+        if prune:
+            # prune now-empty leaves upward (structural nodes with
+            # children stay: they still route longer retained paths)
+            n = victim
+            while (n.parent is not None and n.slot is None
+                   and not n.children):
+                del n.parent.children[n.edge[0]]
+                n = n.parent
         self._counts['evictions'] += 1
+        if stale:
+            self._counts['stale_evictions'] += 1
         if _obs.enabled():
             self._m_evictions.inc()
+            if stale:
+                self._m_stale_evictions.inc()
             self._m_retained.set(len(self._owners))
-        _obs.emit('prefix_evict', slot=slot, kv_len=kv_len,
+        _obs.emit('prefix_evict', slot=slot, kv_len=kv_len, stale=stale,
                   retained=len(self._owners))
+
+    def evict_lru(self) -> bool:
+        """Free one ZERO-REF retained prefix back into the pool: stale
+        (old-weight-version) entries go first — they can never serve a
+        lookup again — then least-recently-used current entries. False
+        when every entry is pinned (or empty)."""
+        cands = [n for n in self._owners if n.refs == 0]
+        if not cands:
+            return False
+        victim = min(cands, key=lambda n: (n.version == self.version,
+                                           n.last_use))
+        self._evict_node(victim, stale=victim.version != self.version)
         return True
 
     def clear(self):
@@ -309,6 +380,8 @@ class RadixPrefixCache:
             'retained_slots': len(self._owners),
             'pinned': sum(1 for n in self._owners if n.refs > 0),
             'nodes': self._node_count(),
+            'weight_version': self.version,
+            'stale_slots': self.stale_count,
             **self._counts,
         }
 
@@ -320,6 +393,7 @@ class RadixPrefixCache:
             **self.stats(),
             'entries': sorted(
                 ({'kv_len': n.kv_len, 'slot': n.slot, 'refs': n.refs,
-                  'last_use': n.last_use} for n in self._owners),
+                  'last_use': n.last_use, 'version': n.version}
+                 for n in self._owners),
                 key=lambda e: -e['last_use']),
         }

@@ -259,6 +259,16 @@ class RouterHandle:
         return self.inner.tokens if self.inner is not None else []
 
     @property
+    def weight_version(self) -> Optional[int]:
+        """The single weight version this response decodes under
+        (stamped at engine admission). Failover replaces the inner
+        handle and RE-decodes the whole response on the target replica,
+        so the tag — like the tokens — is always the live attempt's:
+        never mixed within one response."""
+        return (self.inner.weight_version if self.inner is not None
+                else None)
+
+    @property
     def status(self) -> str:
         if self._error is not None:
             return FAILED
@@ -417,6 +427,10 @@ class Router:
             'paddle_router_breaker_state',
             'breaker state per replica (0 closed, 1 half-open, 2 open)',
             ('replica',))
+        self._m_weight_version = reg.gauge(
+            'paddle_router_weight_version',
+            'weight version each replica is serving (mixed values = '
+            'rolling swap in flight)', ('replica',))
         if _obs.enabled():
             self._m_replicas.set(len(self.replicas))
             self._refresh_gauges()
@@ -434,6 +448,8 @@ class Router:
                 r.outstanding_tokens())
             self._m_breaker.labels(replica=r.id).set(
                 _BREAKER_GAUGE[r.breaker.state])
+            self._m_weight_version.labels(replica=r.id).set(
+                r.engine.weight_version)
         self._m_available.set(avail)
         self._m_queue.set(depth)
 
@@ -765,6 +781,7 @@ class Router:
                 'queued': r.engine.scheduler.queue_depth,
                 'active_slots': len(r.engine._slot_req),
                 'failures': r.failures,
+                'weight_version': r.engine.weight_version,
             })
         return {
             'accepted': self._counts['accepted'],

@@ -1,0 +1,29 @@
+"""True-positive fixtures for host-sync over the hot-swap scopes
+(parsed only, never imported). The file path mirrors the real
+hot-scope config (`paddle_tpu/serving/hotswap.py` + the publisher /
+updater / gate scopes) so swap-path syncs need justification too."""
+import numpy as np
+import jax
+
+
+class WeightPublisher:
+    def capture(self):
+        # snippet 1: unannotated bulk d2h of every weight leaf
+        return {n: np.asarray(t) for n, t in self.source.items()}
+
+
+class ReplicaUpdater:
+    def _swap_replica(self, replica, version, tree):
+        eng = replica.engine
+        # snippet 2: unannotated blocking sync mid-swap
+        eng._params['head'].block_until_ready()
+        # snippet 3: unannotated per-element read while draining
+        pending = int(eng._tok[0])
+        # snippet 4: device_get is a sync however it is spelled
+        row = jax.device_get(eng._params['embed'])
+        return pending, row
+
+
+def finite_weights_gate(engine, version, tree):
+    # snippet 5: unannotated .item() materialization in the gate
+    return tree['head'].sum().item()

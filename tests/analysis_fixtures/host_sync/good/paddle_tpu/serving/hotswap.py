@@ -1,0 +1,32 @@
+"""True-negative fixtures for host-sync over the hot-swap scopes:
+annotated syncs inside hot scopes, host-only work, and syncs outside
+the configured scope set."""
+import numpy as np
+
+
+class WeightPublisher:
+    def capture(self):
+        # snippet 1: the SAME bulk d2h, annotated with a justification
+        return {n: np.asarray(t)  # paddle-lint: disable=host-sync -- the publish snapshot IS the d2h: weights must reach the store
+                for n, t in self.source.items()}
+
+
+class ReplicaUpdater:
+    def _swap_replica(self, replica, version, tree):
+        eng = replica.engine
+        # snippet 2: plain python bookkeeping is not a sync
+        rounds = int(self.max_drain_rounds)
+        # snippet 3: shape/dtype reads never touch the device
+        shapes = {n: a.shape for n, a in eng._params.items()}
+        return rounds, shapes
+
+
+class WeightStore:
+    def stats(self):
+        # snippet 4: NOT a hot scope — reporting-path host work is fine
+        return {'bytes': float(np.asarray(self._nbytes))}
+
+
+def _outside_helper(tree):
+    # snippet 5: not in any configured scope prefix
+    return {n: np.asarray(a).nbytes for n, a in tree.items()}

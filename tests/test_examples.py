@@ -114,3 +114,23 @@ def test_train_gpt_elastic_demo_resizes_and_learns():
         fleet_mod._fleet.initialized = False
         fleet_mod._fleet.strategy = None
         fleet_mod._resize_history.clear()
+
+
+@pytest.mark.slow
+def test_rlhf_loop_example_improves_reward_and_hot_swaps(capsys):
+    mod = runpy.run_path(f'{EX}/rlhf_loop.py')
+    hist = mod['main'](iters=6)
+    assert len(hist) == 6
+    # best-of-n fine-tuning visibly pushes the policy toward the
+    # rewarded token: late iterations beat the first
+    early = hist[0]['mean_reward']
+    late = max(h['mean_reward'] for h in hist[-3:])
+    assert late > early, [h['mean_reward'] for h in hist]
+    # every iteration's publish hot-swapped into the serving fleet
+    assert all(h['swap'] is not None
+               and h['swap']['outcome'] == 'completed'
+               for h in hist)
+    assert hist[-1]['fleet_version'] == hist[-1]['published_version']
+    out = capsys.readouterr().out
+    assert 'weight_swap' in out          # the goodput ledger shows it
+    assert 'fleet converged' in out

@@ -620,3 +620,88 @@ class TestWindowQuantiles:
         # contract under test is percentile KEYS + positive ms values
         assert {'0.5', '0.95', '0.99'} <= set(q)
         assert all(v > 0 for v in q.values())
+
+
+# ---------------------------------------------------------------------------
+# weight_swap (ISSUE 12): swap time is a first-class category with
+# drain/load/verify/rejoin sub-spans, and the books still close on a
+# swap-heavy serving run
+# ---------------------------------------------------------------------------
+
+class TestWeightSwapLedger:
+    def test_sub_spans_book_as_weight_swap_nested_decode_stays_serving(
+            self):
+        """Unit-level: every hotswap.* span maps to weight_swap, and a
+        decode round nested inside the drain wait stays serving_decode
+        (the fleet kept serving — that time is productive)."""
+        log, led = _fresh_ledger()
+        with obs.Span('hotswap.swap', _log=log):
+            with obs.Span('hotswap.drain', _log=log):
+                _sleep_span(log, 'serving.decode_round', 0.02)
+                time.sleep(0.01)
+            _sleep_span(log, 'hotswap.load', 0.01)
+            _sleep_span(log, 'hotswap.verify', 0.01)
+            _sleep_span(log, 'hotswap.rejoin', 0.005)
+        r = led.report()
+        assert r['categories']['weight_swap'] >= 0.03
+        assert r['categories']['serving_decode'] >= 0.015
+        # the nested decode was NOT double counted under weight_swap
+        assert r['categories']['weight_swap'] <= 0.05
+        total = sum(r['categories'].values()) + r['residual_seconds']
+        assert total == pytest.approx(r['wall_seconds'], rel=0.01)
+
+    def test_swap_heavy_run_closes_within_1pct(self, tmp_path):
+        """Acceptance (ISSUE-12 satellite): a real 2-replica router
+        under traffic takes TWO rolling hot-swaps; the default ledger's
+        books close within 1% and weight_swap holds real seconds
+        instead of leaking into the residual."""
+        from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+        from paddle_tpu.serving import (ReplicaSet, ReplicaUpdater,
+                                        Router, SamplingParams,
+                                        WeightStore)
+        paddle.seed(7)
+        gpt = GPTForCausalLM(GPTConfig.tiny()).eval()
+        paddle.seed(1234)
+        other = GPTForCausalLM(GPTConfig.tiny()).eval()
+        state_a = {n: np.asarray(t.value)
+                   for n, t in gpt.state_dict().items()}
+        state_b = {n: np.asarray(t.value)
+                   for n, t in other.state_dict().items()}
+        store = WeightStore(tmp_path / 'w')
+        v1 = store.publish(state_a)
+        router = Router(ReplicaSet(gpt, 2, num_slots=2, max_length=64,
+                                   decode_block=2, weight_version=v1))
+        updater = ReplicaUpdater(router, store)
+        rng = np.random.RandomState(0)
+        sp = SamplingParams(max_new_tokens=6, eos_token_id=-1)
+
+        def traffic(seed):
+            r = np.random.RandomState(seed)
+            hs = [router.submit(r.randint(1, 128, (s,)).tolist(), sp)
+                  for s in (3, 9, 5)]
+            router.run()
+            return hs
+
+        traffic(1)                       # warm every program first
+        led = obs.get_ledger()
+        led.start(reset=True)
+        traffic(2)
+        r1 = updater.update_to(store.publish(state_b))
+        traffic(3)
+        r2 = updater.update_to(store.publish(state_a))
+        traffic(4)
+        assert r1['outcome'] == r2['outcome'] == 'completed'
+        r = led.report()
+        cats = r['categories']
+        total = sum(cats.values()) + r['residual_seconds']
+        assert abs(total - r['wall_seconds']) \
+            <= 0.01 * r['wall_seconds'], (total, r['wall_seconds'])
+        assert cats['weight_swap'] > 0.0, cats
+        assert cats['serving_decode'] > 0.0, cats
+        # mirrored at scrape under the category label
+        snap = obs.get_registry().snapshot()
+        by_name = {m['name']: m for m in snap['metrics']}
+        secs = {s['labels']['category']: s['value']
+                for s in by_name['paddle_goodput_seconds_total'][
+                    'samples']}
+        assert secs['weight_swap'] > 0.0

@@ -271,3 +271,107 @@ class TestEngineIntegration:
             caches = json.load(f)
         assert any(c['retained_slots'] >= 1 for c in caches)
         assert all('entries' in c for c in caches)
+
+
+# ---------------------------------------------------------------------------
+# weight-version invalidation (ISSUE 12): a hot swap must stale every
+# retained prefix — lazily, never as a wholesale mid-traffic flush
+# ---------------------------------------------------------------------------
+
+class TestWeightVersionInvalidation:
+    def test_stale_entries_never_match_and_reclaim_lazily(self, gpt):
+        pool = _pool(gpt)
+        cache = RadixPrefixCache(pool, fraction=0.75)
+        s = pool.alloc()
+        assert cache.insert([1, 2, 3, 4], s)
+        cache.set_version(2)                  # the swap
+        assert cache.stale_count == 1
+        assert pool.used_count == 1           # NOT flushed eagerly
+        # the stale entry never serves, and the lookup that walked past
+        # it reclaims the slot back into the pool
+        assert cache.lookup([1, 2, 3, 4]) == (None, 0)
+        assert cache.retained_count == 0
+        assert pool.used_count == 0
+        assert cache.stats()['stale_evictions'] == 1
+
+    def test_fresh_insert_supersedes_stale_same_prefix(self, gpt):
+        pool = _pool(gpt)
+        cache = RadixPrefixCache(pool, fraction=0.75)
+        s1 = pool.alloc()
+        assert cache.insert([1, 2, 3, 4], s1)
+        cache.set_version(2)
+        s2 = pool.alloc()
+        assert cache.insert([1, 2, 3, 4], s2)   # new-version KV wins
+        node, matched = cache.lookup([1, 2, 3, 4])
+        assert node.slot == s2 and matched == 4
+        assert cache.retained_count == 1        # old slot went home
+        assert pool.used_count == 1
+
+    def test_rollback_revalidates_surviving_entries(self, gpt):
+        """set_version back to the previous version (the rollback path)
+        makes its surviving entries serve again — tagging, not
+        flushing, is what buys this."""
+        pool = _pool(gpt)
+        cache = RadixPrefixCache(pool, fraction=0.75)
+        cache.set_version(1)
+        cache.insert([1, 2, 3, 4], pool.alloc())
+        cache.set_version(2)                  # swap...
+        assert cache.lookup([9, 9]) == (None, 0)   # untouched subtree
+        cache.set_version(1)                  # ...rolled back
+        node, matched = cache.lookup([1, 2, 3, 4])
+        assert node is not None and matched == 4
+
+    def test_eviction_pressure_prefers_stale(self, gpt):
+        pool = _pool(gpt)
+        cache = RadixPrefixCache(pool, fraction=0.75)
+        cache.insert([1, 2, 3, 4], pool.alloc())
+        cache.set_version(2)
+        s = pool.alloc()
+        cache.insert([5, 6, 7, 8], s)         # fresh entry
+        assert cache.evict_lru()              # pressure: stale dies first
+        node, matched = cache.lookup([5, 6, 7, 8])
+        assert node is not None and node.slot == s
+        assert cache.stats()['stale_evictions'] == 1
+
+    def test_pinned_stale_entry_survives_until_released(self, gpt):
+        """A request admitted off a prefix pre-swap keeps decoding; its
+        pinned node must not be reclaimed under it even once stale."""
+        pool = _pool(gpt)
+        cache = RadixPrefixCache(pool, fraction=0.75)
+        s = pool.alloc()
+        cache.insert([1, 2, 3, 4], s)
+        node, _ = cache.lookup([1, 2, 3, 4])
+        cache.acquire(node)
+        cache.set_version(2)
+        assert cache.lookup([1, 2, 3, 4]) == (None, 0)  # never served
+        assert cache.retained_count == 1                # but alive
+        assert not cache.evict_lru()                    # and unevictable
+        cache.release(node)
+        assert cache.evict_lru()
+        assert pool.used_count == 0
+
+    def test_engine_swap_invalidates_served_prefixes(self, gpt):
+        """Through the engine: a retained prefix serves before a swap,
+        stops serving after it (outputs equal a cold engine on the new
+        weights), and the stats surface versions + staleness."""
+        paddle.seed(1234)
+        other = GPTForCausalLM(GPTConfig.tiny()).eval()
+        new_state = {n: np.asarray(t.value)
+                     for n, t in other.state_dict().items()}
+        prompt = list(range(1, 9))
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              decode_block=2, prefix_cache=True)
+        sp = SamplingParams(max_new_tokens=5, eos_token_id=NO_EOS)
+        h1 = eng.submit(prompt, sp)
+        eng.run()
+        assert eng.prefix_cache.retained_count == 1
+        eng.swap_weights(new_state, version=1)
+        assert eng.prefix_cache.stats()['weight_version'] == 1
+        assert eng.prefix_cache.stale_count == 1
+        h2 = eng.submit(prompt, sp)           # must NOT reuse old KV
+        eng.run()
+        assert h2.tokens == _ref_generate(other, prompt, 5)
+        assert h1.tokens != h2.tokens
+        # retirement re-retained the prompt under the NEW version
+        assert eng.prefix_cache.stale_count == 0
+        assert eng.prefix_cache.retained_count == 1

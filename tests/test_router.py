@@ -301,6 +301,69 @@ class TestChaosGauntlet:
         router.run()
         assert h.tokens == _ref_generate(gpt, prompts[0], 4)
 
+    def test_replica_killed_mid_swap_serves_on_and_quarantines(
+            self, gpt, tmp_path):
+        """ISSUE-12 chaos: a replica dies (transient) WHILE the updater
+        is draining it for a weight hot-swap, and the version being
+        rolled out is a bad (NaN) checkpoint on top. The router must
+        keep serving uninterrupted from the survivors (failover,
+        bit-identical greedy), the victim must come back on its
+        PREVIOUS weight version (gate fails -> rollback), and the bad
+        version must be quarantined with events."""
+        from paddle_tpu.serving import ReplicaUpdater, WeightStore
+        store = WeightStore(tmp_path / 'w')
+        state = {n: np.asarray(t.value)
+                 for n, t in gpt.state_dict().items()}
+        v1 = store.publish(state)
+        router = _router(gpt, 2, weight_version=v1)
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+
+        bad = dict(state)
+        name = next(n for n, a in bad.items()
+                    if np.issubdtype(np.asarray(a).dtype, np.floating))
+        bad[name] = np.full_like(np.asarray(bad[name]), np.nan)
+        v2 = store.publish(bad)
+
+        prompts = _prompts([3, 9, 5, 14], seed=31)
+        hs = [router.submit(p, _sp(6)) for p in prompts]
+        for _ in range(2):
+            router.step()
+        updater = ReplicaUpdater(router, store)
+        inj = FaultInjector(nth=1, exc=TransientError(
+            'UNAVAILABLE: injected mid-swap device loss'))
+        with inj.patch(router._by_id[0].engine, 'step'):
+            res = updater.update_to(v2)
+        router.run()
+        assert inj.fired == 1
+
+        # uninterrupted service: every accepted request finished, the
+        # victim's orphans failed over and re-decoded bit-identically
+        _assert_none_dangle(hs)
+        for h, p in zip(hs, prompts):
+            assert h.status == FINISHED
+            assert h.tokens == _ref_generate(gpt, p, 6)
+        names = [e['name'] for e in log.events()[ev0:]]
+        assert 'router_failover' in names
+
+        # the victim rolled back to its previous version; the bad
+        # version is quarantined with events and never reached the
+        # survivor
+        assert res['outcome'] == 'aborted'
+        assert res['replicas'][0]['outcome'] == 'rolled_back'
+        assert [r.engine.weight_version
+                for r in router.replicas] == [v1, v1]
+        assert store.quarantined() == [v2]
+        assert 'weight_version_quarantined' in names
+        assert 'weight_rollback' in names
+        assert updater.poll() is None     # v2 is never re-offered
+
+        # the fleet keeps serving afterwards, still on v1
+        h = router.submit(prompts[0], _sp(4))
+        router.run()
+        assert h.tokens == _ref_generate(gpt, prompts[0], 4)
+        assert h.weight_version == v1
+
     def test_fatal_replica_failure_fails_typed_not_failed_over(self, gpt):
         """A FATAL root cause must not be resubmitted: the classifier
         walks the ReplicaFailure chain, sees FatalError, and the
