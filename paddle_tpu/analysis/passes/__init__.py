@@ -8,3 +8,4 @@ from . import falsy_guard     # noqa: F401
 from . import lock_order      # noqa: F401
 from . import swallowed_exception  # noqa: F401
 from . import obs_schema      # noqa: F401
+from . import donation_path   # noqa: F401

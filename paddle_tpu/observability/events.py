@@ -65,6 +65,17 @@ EVENT_SCHEMA: Dict[str, str] = {
     'program_store_preload': 'bulk preload completed',
     'program_store_invalidate': 'fingerprint refresh dropped entries',
     'program_store_wipe': 'persistent tier deleted on disk',
+    # donation gauntlet (programs/donation.py)
+    'donation_probe_ok': 'subprocess probe classified the runtime '
+                         'donation-safe',
+    'donation_probe_failed': 'probe found corruption/crash; store runs '
+                             'undonated',
+    'donation_enabled': 'store-served programs re-apply donate_argnums '
+                        '(sentinel-guarded)',
+    'donation_quarantined': 'corruption sentinel tripped; donation off '
+                            'for this fingerprint',
+    'serving_pool_recovered': 'donated decode failed mid-call; pool '
+                              'rows rebuilt',
     # serving engine / router / tenancy
     'serving_request_failed': 'request failed; engine survives',
     'serving_drain_begin': 'graceful drain started',
@@ -86,6 +97,8 @@ EVENT_SCHEMA: Dict[str, str] = {
     'weight_rollback': 'replica restored its previous weight version',
     'weight_version_quarantined':
         'weight version quarantined after a failed gate or load',
+    'weight_writer_stale':
+        'dead mid-commit weight publisher detected; marker+tmp swept',
     'rollout_iteration':
         'one serve→score→train→publish→swap turn of the rollout loop',
 }

@@ -46,7 +46,7 @@ from . import metrics as _metrics
 TRIGGER_EVENTS = frozenset((
     'hang_suspected', 'loss_spike', 'bad_step', 'skip_budget_exhausted',
     'serving_request_failed', 'checkpoint_corrupt',
-    'router_failover_storm',
+    'router_failover_storm', 'donation_quarantined',
 ))
 
 

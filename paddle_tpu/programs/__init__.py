@@ -14,6 +14,7 @@ Enable persistence with `programs.configure('/path/to/store')`, the
 matching entries at startup (Model.fit and ReplicaSet do this
 automatically when the store is persistent).
 """
+from . import donation
 from .store import (ProgramDeserializeError, ProgramStore, StoredJit,
                     backend_fingerprint, code_token, configure,
                     describe_statics, get_store, store_key)
@@ -21,5 +22,5 @@ from .store import (ProgramDeserializeError, ProgramStore, StoredJit,
 __all__ = [
     'ProgramDeserializeError', 'ProgramStore', 'StoredJit',
     'backend_fingerprint', 'code_token', 'configure', 'describe_statics',
-    'get_store', 'store_key',
+    'donation', 'get_store', 'store_key',
 ]

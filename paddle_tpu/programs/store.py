@@ -38,6 +38,18 @@ executables intermittently corrupt the heap on this jaxlib. The
 export+cache pair reaches the same zero-compile warm restart through
 two independently hardened upstream paths.)
 
+Donation: whether a store-served program re-applies its recorded
+`donate_argnums` is decided by the donation gauntlet (donation.py) —
+a subprocess-isolated probe of the installed runtime run at store
+init, manifest-recorded per backend fingerprint. On a 'safe' verdict
+donated programs alias their buffers again (no transient 2x train
+state); the first K invocations of each donated executable run under
+a corruption sentinel, and a trip quarantines donation for this
+fingerprint and recompiles undonated — mid-call, without surfacing
+the garbage value. The DIRECT path (in-process `lower().compile()` of
+the caller's own jit, no serialization) donates unconditionally: PR 8
+established that only the export/deserialize path corrupts.
+
 Crash safety (the robustness contract, fault-injection-tested in
 tests/test_programs.py): entries are written payload-first with atomic
 renames and committed by their manifest, every manifest carries a
@@ -64,6 +76,7 @@ import jax
 from .. import flags as _flags
 from .. import observability as _obs
 from ..observability import cost as _cost
+from . import donation as _donation
 
 _MANIFEST_VERSION = 1
 
@@ -262,7 +275,7 @@ def _export_program(jitted, args):
     return _jex.export(jitted, platforms=tuple(sorted(plats)))(*abstract)
 
 
-def _compile_exported(exported, donate_argnums=()):
+def _compile_exported(exported, donate_argnums=(), donated=False):
     """AOT-compile an exported program from its own recorded in_avals.
 
     No Python tracing of the original function; the backend compile of
@@ -270,24 +283,26 @@ def _compile_exported(exported, donate_argnums=()):
     restarts (same module bytes -> same cache key), so it costs a disk
     read, not an XLA compile.
 
-    Donation is deliberately NOT applied: donation does not survive the
-    export round trip on this jax version, and re-applying it on the
-    wrapper jit intermittently corrupts the heap under real train-step
-    programs (fault-injection gauntlet caught segfaults/garbage losses
-    ~50% of runs; stable 12/12 without). Store-served programs
-    therefore trade transient double-buffering of donated state for
-    memory safety — `donate_argnums` still rides the manifest so a
-    future jax can restore the aliasing. Processes that need donation's
-    HBM headroom more than warm restarts can leave the store
-    unconfigured (the direct donated path is untouched)."""
-    del donate_argnums
+    Donation: re-applying `donate_argnums` on the wrapper jit here is
+    the exact operation that intermittently corrupts the heap on jaxlib
+    0.4.36 (PR 8's fault-injection gauntlet: segfaults/garbage losses
+    ~50% of runs; stable 12/12 without) — so it happens ONLY when the
+    donation gauntlet classified the installed runtime 'safe'
+    (`donated=True`, probe-verified or operator-forced, and sentinel-
+    guarded by the caller for its first K invocations). Otherwise the
+    program compiles undonated and `donate_argnums` just rides the
+    manifest for a runtime that passes the probe."""
     specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
              for a in exported.in_avals]
     args, kwargs = jax.tree_util.tree_unflatten(exported.in_tree, specs)
-    return jax.jit(exported.call).lower(*args, **kwargs).compile()
+    donate = tuple(donate_argnums) if donated else ()
+    jitted = jax.jit(exported.call, donate_argnums=donate) if donate \
+        else jax.jit(exported.call)
+    return jitted.lower(*args, **kwargs).compile()
 
 
-def _load_stablehlo(payload: bytes, path: str, donate_argnums=()):
+def _load_stablehlo(payload: bytes, path: str, donate_argnums=(),
+                    donated=False):
     """Deserialize exported StableHLO and AOT-compile it — the warm
     half of the restart path."""
     from jax import export as _jex
@@ -297,7 +312,7 @@ def _load_stablehlo(payload: bytes, path: str, donate_argnums=()):
         raise ProgramDeserializeError(
             path, f'{type(exc).__name__}: {exc}') from exc
     try:
-        return _compile_exported(exported, donate_argnums)
+        return _compile_exported(exported, donate_argnums, donated)
     except Exception as exc:
         raise ProgramDeserializeError(
             path, f'aot compile of deserialized program failed: '
@@ -310,9 +325,10 @@ def _load_stablehlo(payload: bytes, path: str, donate_argnums=()):
 
 class _StoreEntry:
     __slots__ = ('key', 'name', 'kind', 'callable', 'source', 'format',
-                 'fingerprint')
+                 'fingerprint', 'donated', 'donate')
 
-    def __init__(self, key, name, kind, call, source, fmt, fingerprint):
+    def __init__(self, key, name, kind, call, source, fmt, fingerprint,
+                 donated=False, donate=()):
         self.key = key
         self.name = name
         self.kind = kind
@@ -320,6 +336,12 @@ class _StoreEntry:
         self.source = source          # 'compile' | 'disk'
         self.format = fmt             # 'stablehlo' | '' (unpersisted)
         self.fingerprint = fingerprint
+        # donate: the RECORDED donate_argnums (what the program wants);
+        # donated: whether this executable was actually compiled with
+        # them re-applied (export path + gauntlet-enabled at the time).
+        # A posture change invalidates entries where the two disagree.
+        self.donated = bool(donated)
+        self.donate = tuple(donate)
 
 
 class ProgramStore:
@@ -345,6 +367,17 @@ class ProgramStore:
         self._invalidated = 0
         self._preload: Optional[Dict[str, Any]] = None
         self._coldstart_s: Optional[float] = None
+        # donation gauntlet state: posture dict from
+        # donation.resolve_posture, a generation counter bumped on
+        # quarantine (wrappers holding donated executables re-resolve),
+        # and per-key sentinel budgets for the guarded first-K window
+        self._donation: Dict[str, Any] = {'enabled': False,
+                                          'posture': 'off',
+                                          'verdict': None, 'reason': '',
+                                          'source': 'init', 'token': ''}
+        self._donation_gen = 0
+        self._sentinel: Dict[str, int] = {}
+        self._resolve_donation()
 
     # -- configuration -------------------------------------------------------
     @property
@@ -388,6 +421,7 @@ class ProgramStore:
         except Exception:  # paddle-lint: disable=swallowed-exception -- older jax without cc reset knobs still gets the stablehlo tier
             pass   # an older jax without these knobs still gets the
             # stablehlo tier (warm restarts then skip tracing only)
+        self._resolve_donation()
         return self
 
     def refresh_fingerprint(self):
@@ -405,6 +439,126 @@ class ProgramStore:
         if stale:
             _obs.emit('program_store_invalidate', entries=len(stale),
                       reason='fingerprint_change')
+        # a new fingerprint is a new runtime: its donation verdict may
+        # differ (and a quarantine recorded for the OLD runtime no
+        # longer applies)
+        self._resolve_donation()
+        return len(stale)
+
+    # -- donation gauntlet ---------------------------------------------------
+    def _resolve_donation(self) -> Dict[str, Any]:
+        """(Re)run the gauntlet's decision procedure for the current
+        directory + fingerprint (probing in a subprocess when 'auto'
+        finds no recorded verdict — see donation.resolve_posture)."""
+        posture = _donation.resolve_posture(self.directory,
+                                            self._fingerprint)
+        with self._lock:
+            flipped = bool(posture.get('enabled')) \
+                != bool(self._donation.get('enabled'))
+            self._donation = posture
+            if flipped:
+                # entries compiled under the OTHER posture stop being
+                # served: an undonated executable under 'on' silently
+                # loses the aliasing, a donated one under 'off' is the
+                # exact hazard the gauntlet exists to prevent
+                stale = [k for k, e in self._mem.items()
+                         if e.donate
+                         and e.donated != bool(posture.get('enabled'))]
+                for k in stale:
+                    del self._mem[k]
+                    self._sentinel.pop(k, None)
+                self._donation_gen += 1
+        return posture
+
+    @property
+    def donation_enabled(self) -> bool:
+        """True when store-served programs re-apply their recorded
+        donate_argnums (probe-verified safe, or operator-forced)."""
+        return bool(self._donation.get('enabled'))
+
+    @property
+    def donation_gen(self) -> int:
+        """Bumped on quarantine; wrappers caching donated executables
+        compare it to know their callable was invalidated."""
+        return self._donation_gen
+
+    def donation_state(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._donation)
+            out['donated_entries'] = sum(1 for e in self._mem.values()
+                                         if e.donated)
+            out['sentinel_pending'] = sum(self._sentinel.values())
+        return out
+
+    def _arm_sentinel(self, key: str):
+        n = _donation.sentinel_budget()
+        if n > 0:
+            with self._lock:
+                self._sentinel[key] = n
+
+    def sentinel_remaining(self, key: str) -> int:
+        with self._lock:
+            return self._sentinel.get(key, 0)
+
+    def sentinel_call(self, key: str, name: str, call, args):
+        """One guarded invocation inside the post-enablement window:
+        the donated executable consumes snapshot COPIES of the args (so
+        the originals survive for an undonated re-run), and the outputs
+        pass a finiteness sentinel before anything sees them. Returns
+        ``(out, ok)`` — on ``ok=False`` donation has been QUARANTINED
+        and the caller must recompile undonated and re-run; the corrupt
+        value is never returned."""
+        snap = _donation.snapshot_args(args)
+        detail = ''
+        try:
+            out = call(*snap)
+            ok = _donation.outputs_ok(out)
+            if not ok:
+                detail = 'non-finite output'
+        except Exception as exc:
+            # the donated executable blowing up inside the guard window
+            # is a trip, not a crash: the snapshots absorbed the damage
+            out, ok = None, False
+            detail = f'{type(exc).__name__}: {exc}'
+        if _obs.enabled():
+            _obs.get_registry().counter(
+                'paddle_donation_sentinel_checks_total',
+                'sentinel-guarded invocations of donated programs').inc()
+        if ok:
+            with self._lock:
+                left = self._sentinel.get(key, 0) - 1
+                if left <= 0:
+                    self._sentinel.pop(key, None)
+                else:
+                    self._sentinel[key] = left
+            return out, True
+        self.quarantine_donation(f'sentinel tripped on {name}: {detail}')
+        return None, False
+
+    def quarantine_donation(self, reason: str) -> int:
+        """Donation corrupted on this runtime: flip the posture off,
+        drop every donated executable from the memory tier (the next
+        acquire recompiles undonated from the SAME payload), bump the
+        generation so wrappers re-resolve, and record the quarantine —
+        verdict manifest + `donation_quarantined` event (a flight-
+        recorder trigger). Idempotent once quarantined."""
+        with self._lock:
+            if self._donation.get('posture') == 'quarantined':
+                return 0
+            self._donation = {
+                'enabled': False, 'posture': 'quarantined',
+                'verdict': 'quarantined', 'reason': str(reason),
+                'source': 'sentinel',
+                'token': self._donation.get('token', ''),
+            }
+            self._donation_gen += 1
+            stale = [k for k, e in self._mem.items() if e.donated]
+            for k in stale:
+                del self._mem[k]
+            self._sentinel.clear()
+        # outside the store lock: quarantine() emits the event that
+        # triggers a flight bundle, whose listeners read other locks
+        _donation.quarantine(self.directory, self._fingerprint, reason)
         return len(stale)
 
     # -- metrics/events helpers ---------------------------------------------
@@ -540,11 +694,12 @@ class ProgramStore:
             self._note_reject(name, bin_path, 'checksum')
             return None
         fmt = manifest.get('format', '')
+        donate = tuple(manifest.get('donate_argnums') or ())
+        donated = bool(donate) and self.donation_enabled
         try:
             if fmt == 'stablehlo':
-                call = _load_stablehlo(
-                    payload, bin_path,
-                    tuple(manifest.get('donate_argnums') or ()))
+                call = _load_stablehlo(payload, bin_path, donate,
+                                       donated=donated)
             else:
                 self._note_reject(name, bin_path, 'format', fmt)
                 return None
@@ -555,8 +710,11 @@ class ProgramStore:
             self._note_reject(name, bin_path, 'deserialize',
                               type(exc).__name__)
             return None
+        if donated:
+            self._arm_sentinel(key)
         return _StoreEntry(key, name, str(manifest.get('kind', 'jit')),
-                           call, 'disk', fmt, self._fingerprint)
+                           call, 'disk', fmt, self._fingerprint,
+                           donated=donated, donate=donate)
 
     # -- the acquisition path ------------------------------------------------
     def acquire(self, key: str, name: str, kind: str,
@@ -573,15 +731,16 @@ class ProgramStore:
         process will deserialize — the XLA persistent cache then serves
         the warm compile from disk. Export failures fall back to the
         plain direct compile (memory tier only, note='aot_noexport').
-        Returns None when no AOT path works at all — callers fall back
-        to their plain jitted call."""
+        Returns the resolved `_StoreEntry` (callable + donation flag),
+        or None when no AOT path works at all — callers fall back to
+        their plain jitted call."""
         with self._lock:
             ent = self._mem.get(key)
         if ent is not None:
             self._note_hit(name, 'memory', ent.format)
             if ent.source == 'disk':
                 record.note = record.note or f'loaded:{ent.format}'
-            return ent.callable
+            return ent
         ent = self._load_disk(key)
         if ent is not None:
             t0 = time.perf_counter()
@@ -592,7 +751,7 @@ class ProgramStore:
             with self.catalog._lock:
                 record.compile_seconds += time.perf_counter() - t0
             self._note_hit(name, 'disk', ent.format)
-            return ent.callable
+            return ent
         # cold: compile fresh
         persisting = (persist and self.persistent
                       and bool(_flags.flag('FLAGS_program_store'))
@@ -600,13 +759,17 @@ class ProgramStore:
         t0 = time.perf_counter()
         compiled = payload = None
         fmt = ''
+        donated = False
         if persisting:
             try:
                 exported = _export_program(jitted, args)
                 payload = exported.serialize()
-                compiled = _compile_exported(exported, donate_argnums)
+                donated = bool(donate_argnums) and self.donation_enabled
+                compiled = _compile_exported(exported, donate_argnums,
+                                             donated=donated)
                 fmt = 'stablehlo'
             except Exception as exc:
+                donated = False
                 _obs.emit('program_store_persist_skipped', program=name,
                           error=type(exc).__name__)
         if compiled is None:
@@ -623,13 +786,16 @@ class ProgramStore:
         _cost._read_analysis(compiled, record)
         self._note_miss(name)
         ent = _StoreEntry(key, name, kind, compiled, 'compile', fmt,
-                          self._fingerprint)
+                          self._fingerprint, donated=donated,
+                          donate=donate_argnums)
+        if donated:
+            self._arm_sentinel(key)
         with self._lock:
             self._mem[key] = ent
         if payload is not None:
             self._save_disk(key, name, kind, payload,
                             donate_argnums=donate_argnums)
-        return compiled
+        return ent
 
     # -- warm restart --------------------------------------------------------
     def preload(self, match: Optional[str] = None) -> Dict[str, Any]:
@@ -725,7 +891,8 @@ class ProgramStore:
     def entries(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [{'key': e.key, 'name': e.name, 'kind': e.kind,
-                     'source': e.source, 'format': e.format}
+                     'source': e.source, 'format': e.format,
+                     'donated': e.donated}
                     for e in self._mem.values()]
 
     def disk_entries(self) -> int:
@@ -780,6 +947,7 @@ class ProgramStore:
                 'coldstart_seconds': self._coldstart_s,
             }
         out['disk_entries'] = self.disk_entries()
+        out['donation'] = self.donation_state()
         return out
 
     def verify_catalog_consistency(self) -> Dict[str, Any]:
@@ -831,15 +999,33 @@ class StoredJit:
         if name is None and name_fn is None:
             raise ValueError('StoredJit needs name= or name_fn=')
         self._store = store
-        self._fn = fn
         self._name = name
         self._name_fn = name_fn
         self._kind = kind
         self._persist = persist
         self._donate = tuple(donate_argnums)
+        # the store is the donation owner: callers pass the RAW function
+        # plus its donate_argnums and the wrapper jits it here — the
+        # DIRECT path donates as declared (in-process compile, the
+        # PR-8-safe case), while the export path re-applies donation
+        # only on a gauntlet-safe verdict. Already-jitted callables are
+        # still accepted (their donation is whatever they baked in),
+        # and OPAQUE callables (class instances without .lower) are
+        # deliberately NOT auto-jitted — they keep the plain-call
+        # 'aot_unavailable' fallback, since tracing an arbitrary
+        # callable can change its semantics.
+        import types
+        if hasattr(fn, 'lower'):
+            self._fn = fn
+        elif isinstance(fn, (types.FunctionType, types.MethodType)):
+            self._fn = jax.jit(fn, donate_argnums=self._donate) \
+                if self._donate else jax.jit(fn)
+        else:
+            self._fn = fn
         self._fn_token = code_token(fn)
         self._statics_token = describe_statics(statics)
-        self._entries: Dict[Any, Any] = {}   # sig -> (record, callable)
+        # sig -> (record, callable, store_key, donated, donation_gen)
+        self._entries: Dict[Any, Any] = {}
 
     def _signature(self, args):
         leaves, treedef = jax.tree_util.tree_flatten(args)
@@ -865,6 +1051,8 @@ class StoredJit:
                 name = f'{self._kind}:unnamed'   # naming must never fail
         record = self._store.catalog.record(name, kind=self._kind)
         call = self._fn
+        skey = None
+        donated = False
         if key is not None:
             try:
                 skey = store_key(name, self._fn_token,
@@ -876,11 +1064,14 @@ class StoredJit:
                 skey = None
             got = None
             if skey is not None and bool(_flags.flag('FLAGS_program_store')):
-                got = self._store.acquire(
+                ent = self._store.acquire(
                     skey, name, self._kind, record,
                     compile_fn=lambda: self._fn.lower(*args).compile(),
                     jitted=self._fn, args=args, persist=self._persist,
                     donate_argnums=self._donate)
+                if ent is not None:
+                    got = ent.callable
+                    donated = ent.donated
             else:
                 # store bypassed: keep the plain AOT-compile behavior
                 t0 = time.perf_counter()
@@ -897,8 +1088,11 @@ class StoredJit:
                 call = got
             else:
                 record.note = 'aot_unavailable'
-            self._entries[key] = (record, call)
-        return record, call
+            entry = (record, call, skey, donated,
+                     self._store.donation_gen)
+            self._entries[key] = entry
+            return entry
+        return (record, call, skey, donated, self._store.donation_gen)
 
     def __call__(self, *args):
         try:
@@ -912,10 +1106,28 @@ class StoredJit:
         entry = self._entries.get(key) if key is not None else None
         t0 = time.perf_counter()
         if entry is None:
-            record, call = self._build(key, args)
+            entry = self._build(key, args)
+        record, call, skey, donated, gen = entry
+        if self._donate and gen != self._store.donation_gen:
+            # the donation posture moved since this executable was
+            # resolved (quarantine, or a flag/verdict flip at
+            # re-configure): drop it and re-resolve under the current
+            # posture
+            self._entries.pop(key, None)
+            record, call, skey, donated, gen = self._build(key, args)
+        if donated and skey is not None \
+                and self._store.sentinel_remaining(skey) > 0:
+            out, ok = self._store.sentinel_call(skey, record.name, call,
+                                                args)
+            if not ok:
+                # sentinel tripped → donation quarantined; recompile
+                # undonated and serve the SAME call from the original
+                # (never-donated) args — garbage never surfaces
+                self._entries.pop(key, None)
+                record, call, skey, donated, gen = self._build(key, args)
+                out = call(*args)
         else:
-            record, call = entry
-        out = call(*args)
+            out = call(*args)
         dt = time.perf_counter() - t0
         with self._store.catalog._lock:
             record.invocations += 1

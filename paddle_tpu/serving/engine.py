@@ -18,13 +18,24 @@ Compiled-program inventory (asserted by the zero-recompile tests):
   lands above the live position where the slot-causal mask hides it
   until the slot's own decode overwrites it — the stale-slot argument
   speculative decoding already relies on),
-- the slot-pool writer and row copier,
 and, when the latency stack is enabled (ISSUE 9):
 - one chunk-prefill program per chunk bucket (chunked prefill AND
   prefix-cache suffix prefill — `start`/`slot`/`src` are traced),
 - one speculation round per k (draft + verify; replaces the decode
   block when a draft model is configured),
 - one draft prefill program per bucket.
+
+Copy surface (ISSUE 13): the pool lives as PER-SLOT rows
+(kv_pool.SlotPool), so prefill/chunk programs take and return one row —
+the old jitted pool writer/copier and their full-pool round trips are
+gone. The decode block stacks the rows inside the program and splits
+its output back; when the donation gauntlet (programs/donation.py)
+allows it, the pool rows are DONATED so even that round trip aliases
+in place. Donation never changes values, and the engine guards the
+failure mode it introduces: a donated decode program dying mid-call
+invalidates its input rows, so the engine rebuilds zero rows and
+force-clears the prefix cache before re-raising (`_recover_pool`) —
+the error still fails over normally, but the engine stays serviceable.
 
 Greedy requests take the raw argmax exactly like `generate()`, so their
 outputs are token-for-token identical to a per-request generate() call
@@ -51,7 +62,7 @@ from ..nlp.generation import _NEG_INF, cached_forward
 from ..resilience import RetryPolicy, call_with_retry
 from ..tensor import Tensor
 from .api import GREEDY, RUNNING, RequestHandle, SamplingParams
-from .kv_pool import SlotPool
+from .kv_pool import SlotPool, split_rows, stack_rows
 from .prefix_cache import RadixPrefixCache
 from .scheduler import FCFSScheduler
 
@@ -146,6 +157,12 @@ class InferenceEngine:
             KV lives in a parallel SlotPool. Sampling requests in the
             same engine simply decode one token per round.
         num_draft_tokens: draft proposals per speculation round (k).
+        donate_pool: donate the KV rows into the decode/spec programs
+            so the pool aliases in place instead of round-tripping
+            (value-neutral; the store-served variant additionally
+            requires a donation-gauntlet-safe verdict and runs
+            sentinel-guarded). Default True; the bench donation phase
+            A/Bs False against it.
 
     Not thread-safe: one engine is one event loop; drive it with
     `step()`, `run()`, `stream()`, or `generate_many()`.
@@ -161,7 +178,8 @@ class InferenceEngine:
                  prefix_cache=None,
                  prefill_chunk_tokens: Optional[int] = None,
                  draft_model=None, num_draft_tokens: int = 4,
-                 weight_version: int = 0):
+                 weight_version: int = 0,
+                 donate_pool: Optional[bool] = None):
         cfg = getattr(model, 'config', None)
         max_pos = getattr(cfg, 'max_position_embeddings', None)
         if max_pos is not None and max_length > max_pos:
@@ -267,6 +285,15 @@ class InferenceEngine:
         # (or load) each program once.
         from .. import programs as _programs
         store = _programs.get_store()
+        # pool donation (the "kill the copy" half the gauntlet governs):
+        # the decode/spec programs DONATE their row inputs so the pool
+        # aliases in place. Direct in-process compiles donate as
+        # declared (PR-8-safe); the store's export path re-applies the
+        # recorded argnums only on a gauntlet-safe verdict, sentinel-
+        # guarded. donate_pool rides the statics: a donated and an
+        # undonated engine must never share one store key.
+        self._donate_pool = True if donate_pool is None else bool(
+            donate_pool)
         engine_statics = {
             'model': type(model).__qualname__,
             'model_src': _programs.code_token(type(model)),
@@ -274,18 +301,20 @@ class InferenceEngine:
             'num_slots': self.pool.num_slots,
             'max_length': self.pool.max_length,
             'decode_block': self.decode_block,
+            'donate_pool': self._donate_pool,
         }
         self._decode_jit = store.wrap_jit(
-            jax.jit(self._decode_block_fn), name='serving.decode_block',
-            kind='serving', statics=engine_statics)
+            self._decode_block_fn, name='serving.decode_block',
+            kind='serving', statics=engine_statics,
+            donate_argnums=(3,) if self._donate_pool else ())
         self._prefill_jit = store.wrap_jit(   # 1 trace per bucket
-            jax.jit(self._prefill_fn),
-            name_fn=lambda args: f'serving.prefill_{args[5].shape[1]}',
+            self._prefill_fn,
+            name_fn=lambda args: f'serving.prefill_{args[3].shape[1]}',
             kind='serving', statics=engine_statics)
         self._chunk_prefill_jit = store.wrap_jit(  # 1 per chunk bucket
-            jax.jit(self._chunk_prefill_fn),
+            self._chunk_prefill_fn,
             name_fn=lambda args: f'serving.chunk_prefill_'
-                                 f'{args[5].shape[1]}',
+                                 f'{args[4].shape[1]}',
             kind='serving', statics=engine_statics)
         if draft_model is not None:
             spec_statics = dict(
@@ -299,13 +328,14 @@ class InferenceEngine:
             # shapes are internal, invisible in any input aval, so k
             # MUST ride the statics
             self._spec_jit = store.wrap_jit(
-                jax.jit(self._spec_decode_fn),
+                self._spec_decode_fn,
                 name=f'serving.spec_decode_k{self.spec_k}',
-                kind='serving', statics=spec_statics)
+                kind='serving', statics=spec_statics,
+                donate_argnums=(3, 7) if self._donate_pool else ())
             self._draft_prefill_jit = store.wrap_jit(
-                jax.jit(self._draft_prefill_fn),
+                self._draft_prefill_fn,
                 name_fn=lambda args: f'serving.draft_prefill_'
-                                     f'{args[5].shape[1]}',
+                                     f'{args[3].shape[1]}',
                 kind='serving', statics=spec_statics)
         self._init_metrics()
         if store.persistent:
@@ -394,11 +424,15 @@ class InferenceEngine:
     def _decode_block_fn(self, params, frozen, buffers, pool, tok, pos,
                          steps, active, temp, topk, topp, greedy, keys):
         """One compiled program: `decode_block` single-token steps over
-        ALL slots (lax.scan), per-slot positions/masks/sampling."""
+        ALL slots (lax.scan), per-slot positions/masks/sampling. `pool`
+        arrives as the tuple of per-slot rows and is stacked/split
+        inside the program (bit-identical math); with `donate_pool` the
+        row inputs are donated so the round trip aliases in place."""
         self._trace_counts['decode_step'] += 1   # python-level trace count
         fwd = cached_forward(self.model, params, frozen, buffers)
         max_len = self.pool.max_length
         k_slot = jnp.arange(max_len, dtype=jnp.int32)
+        pool = stack_rows(pool)
 
         def sub(carry, _):
             tok, pos, steps, pool = carry
@@ -414,67 +448,55 @@ class InferenceEngine:
 
         (tok, pos, steps, pool), toks = jax.lax.scan(
             sub, (tok, pos, steps, pool), None, length=self.decode_block)
-        return jnp.transpose(toks), pool         # [num_slots, block]
+        # [num_slots, block] tokens + the pool back as per-slot rows
+        return jnp.transpose(toks), split_rows(pool, self.pool.num_slots)
 
-    def _prefill_fn(self, params, frozen, buffers, pool, slot, ids):
+    def _prefill_fn(self, params, frozen, buffers, ids):
         """Prefill ONE request (batch-1, right-padded to its bucket) and
-        scatter the resulting KV slab into the pool row `slot`. KV-only
-        and fully async: no logits leave the device — the request's
-        FIRST token falls out of the next decode block, which re-forwards
-        the last prompt token at position s-1 (an identical overwrite of
-        its KV slot) and samples from the same last-position logits the
-        prefill computed. One compile per bucket (ids.shape), everything
-        else traced."""
+        return the resulting KV ROW — the host stores it as the slot's
+        row, so the undonated copy surface is one row, never the pool.
+        KV-only and fully async: no logits leave the device — the
+        request's FIRST token falls out of the next decode block, which
+        re-forwards the last prompt token at position s-1 (an identical
+        overwrite of its KV slot) and samples from the same
+        last-position logits the prefill computed. One compile per
+        bucket (ids.shape), everything else traced."""
         self._trace_counts[f'prefill_{ids.shape[1]}'] += 1
         fwd = cached_forward(self.model, params, frozen, buffers)
         slab = jax.tree_util.tree_map(
-            lambda c: jnp.zeros((1,) + c.shape[1:], c.dtype), pool)
+            lambda s: jnp.zeros(s.shape, s.dtype), self.pool.row_spec)
         _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
-        return jax.tree_util.tree_map(
-            lambda c, s: jax.lax.dynamic_update_slice(
-                c, s.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1)),
-            pool, slab)
+        return slab
 
-    def _chunk_prefill_fn(self, params, frozen, buffers, pool, slot, ids,
-                          start, src):
-        """Prefill ONE chunk of ONE request's prompt, writing slot `slot`
-        at positions [start, start+chunk): the shared program behind
-        both chunked prefill and prefix-cache suffix prefill. Unlike
-        `_prefill_fn` it forwards against an EXISTING row — gathered
-        from `src`, which is the slot itself for follow-up chunks but
-        the RETAINED slot on a prefix-cache hit's first chunk (fusing
-        the prefix copy into the chunk, so a hit costs exactly one
-        pool update, never copy + prefill) — with an explicit
-        slot-causal mask because `start` is traced. One compile per
-        chunk bucket (ids.shape); `start`/`slot`/`src` traced."""
+    def _chunk_prefill_fn(self, params, frozen, buffers, row, ids, start):
+        """Prefill ONE chunk of ONE request's prompt at positions
+        [start, start+chunk): the shared program behind both chunked
+        prefill and prefix-cache suffix prefill. Forwards against an
+        EXISTING row — the slot's own row for follow-up chunks, the
+        RETAINED row on a prefix-cache hit's first chunk (the prefix
+        copy IS the row input, so a hit costs exactly one row write,
+        never copy + prefill) — with an explicit slot-causal mask
+        because `start` is traced. Takes and returns ONE row; one
+        compile per chunk bucket (ids.shape); `start` traced."""
         self._trace_counts[f'chunk_prefill_{ids.shape[1]}'] += 1
         fwd = cached_forward(self.model, params, frozen, buffers)
-        row = jax.tree_util.tree_map(
-            lambda c: jax.lax.dynamic_slice(
-                c, (src,) + (0,) * (c.ndim - 1), (1,) + c.shape[1:]),
-            pool)
         b = ids.shape[1]
         k_slot = jnp.arange(self.pool.max_length, dtype=jnp.int32)
         q_pos = start + jnp.arange(b, dtype=jnp.int32)
         mask = (k_slot[None, :] <= q_pos[:, None])[None, None]
         _, row = fwd(ids, row, start, start, mask)
-        return jax.tree_util.tree_map(
-            lambda c, s: jax.lax.dynamic_update_slice(
-                c, s.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1)),
-            pool, row)
+        return row
 
-    def _draft_prefill_fn(self, params, frozen, buffers, pool, slot, ids):
+    def _draft_prefill_fn(self, params, frozen, buffers, ids):
         """`_prefill_fn` for the DRAFT model/pool: the draft needs its
         own prompt KV before it can propose. One compile per bucket."""
         self._trace_counts[f'draft_prefill_{ids.shape[1]}'] += 1
         fwd = cached_forward(self.draft_model, params, frozen, buffers)
         slab = jax.tree_util.tree_map(
-            lambda c: jnp.zeros((1,) + c.shape[1:], c.dtype), pool)
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            self.draft_pool.row_spec)
         _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
-        return jax.tree_util.tree_map(
-            lambda c, s: jax.lax.dynamic_update_slice(
-                c, s.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1)),
-            pool, slab)
+        return slab
 
     def _spec_decode_fn(self, params, frozen, buffers, pool,
                         d_params, d_frozen, d_buffers, d_pool,
@@ -498,6 +520,8 @@ class InferenceEngine:
         fwd_t = cached_forward(self.model, params, frozen, buffers)
         fwd_d = cached_forward(self.draft_model, d_params, d_frozen,
                                d_buffers)
+        pool = stack_rows(pool)
+        d_pool = stack_rows(d_pool)
         max_len = self.pool.max_length
         k_slot = jnp.arange(max_len, dtype=jnp.int32)
         n = tok.shape[0]
@@ -539,7 +563,8 @@ class InferenceEngine:
                          jnp.where(j == a[:, None], v_new[:, None], 0))
         toks = jnp.where(active[:, None], toks, 0).astype(jnp.int32)
         counts = jnp.where(active, a + 1, 0).astype(jnp.int32)
-        return toks, counts, pool, d_pool
+        return (toks, counts, split_rows(pool, n),
+                split_rows(d_pool, self.draft_pool.num_slots))
 
     # ------------------------------------------------------------------
     # submission
@@ -898,6 +923,21 @@ class InferenceEngine:
                 self._steps[slot] += (1 if counts is not None else c)
         return n
 
+    def _recover_pool(self):
+        """A DONATED decode/spec program failed mid-call: its input rows
+        may already be invalidated, so every retained buffer is suspect.
+        Rebuild zero rows and force-clear the prefix cache (its KV
+        floors are gone) BEFORE re-raising — the error still classifies
+        and fails over normally, but the engine itself stays
+        serviceable for the next admission."""
+        self.pool.reset_rows()
+        if self.draft_pool is not None:
+            self.draft_pool.reset_rows()
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear(force=True)
+        _obs.emit('serving_pool_recovered',
+                  slots=self.pool.num_slots)
+
     def _decode_round(self):
         """The plain compiled decode block (no draft model): every
         active slot advances `decode_block` tokens."""
@@ -905,10 +945,16 @@ class InferenceEngine:
                        slots=len(self._slot_req),
                        requests=[h.request_id
                                  for h in self._slot_req.values()]):
-            toks_dev, new_pool = self._decode_jit(
-                self._params, self._frozen, self._buffers, self.pool.cache,
-                self._tok, self._pos, self._steps, self._active, self._temp,
-                self._topk, self._topp, self._greedy, self._keys)
+            try:
+                toks_dev, new_pool = self._decode_jit(
+                    self._params, self._frozen, self._buffers,
+                    self.pool.cache, self._tok, self._pos, self._steps,
+                    self._active, self._temp, self._topk, self._topp,
+                    self._greedy, self._keys)
+            except Exception:
+                if self._donate_pool:
+                    self._recover_pool()
+                raise
             self.pool.cache = new_pool
             toks = call_with_retry(_from_device, toks_dev,
                                    policy=self._retry, site='serving.d2h')
@@ -927,12 +973,19 @@ class InferenceEngine:
                        slots=len(self._slot_req), k=self.spec_k,
                        requests=[h.request_id
                                  for h in self._slot_req.values()]):
-            toks_dev, counts_dev, new_pool, new_d_pool = self._spec_jit(
-                self._params, self._frozen, self._buffers, self.pool.cache,
-                d_params, d_frozen, d_buffers, self.draft_pool.cache,
-                self._tok, self._pos, self._steps, self._active,
-                self._temp, self._topk, self._topp, self._greedy,
-                self._keys, self._eos_arr)
+            try:
+                toks_dev, counts_dev, new_pool, new_d_pool = \
+                    self._spec_jit(
+                        self._params, self._frozen, self._buffers,
+                        self.pool.cache, d_params, d_frozen, d_buffers,
+                        self.draft_pool.cache, self._tok, self._pos,
+                        self._steps, self._active, self._temp,
+                        self._topk, self._topp, self._greedy,
+                        self._keys, self._eos_arr)
+            except Exception:
+                if self._donate_pool:
+                    self._recover_pool()
+                raise
             self.pool.cache = new_pool
             self.draft_pool.cache = new_d_pool
             toks = call_with_retry(_from_device, toks_dev,
@@ -1092,9 +1145,9 @@ class InferenceEngine:
             ids[0, :s] = h.prompt_tokens
             ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
                                       site='serving.h2d')
-            self.pool.cache = self._prefill_jit(
-                self._params, self._frozen, self._buffers, self.pool.cache,
-                jnp.int32(slot), ids_dev)
+            # row in, row out: the undonated copy surface is pool/N
+            self.pool.set_row(slot, self._prefill_jit(
+                self._params, self._frozen, self._buffers, ids_dev))
         self._counts['prefills'] += 1
         self._counts['prefill_tokens'] += s
         if _obs.enabled():
@@ -1138,10 +1191,12 @@ class InferenceEngine:
             ids[0, :len(window)] = window
             ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
                                       site='serving.h2d')
-            self.pool.cache = self._chunk_prefill_jit(
-                self._params, self._frozen, self._buffers, self.pool.cache,
-                jnp.int32(slot), ids_dev, jnp.int32(start),
-                jnp.int32(src))
+            # forwards against the src ROW (the retained row on a
+            # prefix hit's first chunk, the slot's own row after);
+            # returns the slot's new row — one-row surface either way
+            self.pool.set_row(slot, self._chunk_prefill_jit(
+                self._params, self._frozen, self._buffers,
+                self.pool.row(src), ids_dev, jnp.int32(start)))
         new_cursor = min(start + bucket, s)
         self._prefilling[slot][1] = new_cursor
         self._prefilling[slot][2] = slot   # later chunks extend own row
@@ -1194,9 +1249,8 @@ class InferenceEngine:
             ids[0, :s] = h.prompt_tokens
             ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
                                       site='serving.h2d')
-            self.draft_pool.cache = self._draft_prefill_jit(
-                d_params, d_frozen, d_buffers, self.draft_pool.cache,
-                jnp.int32(slot), ids_dev)
+            self.draft_pool.set_row(slot, self._draft_prefill_jit(
+                d_params, d_frozen, d_buffers, ids_dev))
 
     def _retire(self, slot: int, h: RequestHandle, now: float):
         h._finish(now)
@@ -1238,6 +1292,7 @@ class InferenceEngine:
             'queue_depth': self.scheduler.queue_depth,
             'active_slots': len(self._slot_req),
             'weight_version': self.weight_version,
+            'donate_pool': self._donate_pool,
             'traces': dict(self._trace_counts),
             'pool': self.pool.stats(),
         }

@@ -88,16 +88,31 @@ class WeightStore:
     marker and stops being offered by `latest_version()`/`load()`,
     while version numbering stays monotone past it.
 
+    Cross-process safety (the PR-12 stretch): `publish` claims a
+    `_WRITER.json` marker (pid + start time, atomic rename) for the
+    duration of the commit. A publisher KILLED mid-commit leaves the
+    marker and possibly a half-written `step_*.tmp` dir behind; because
+    commits are atomic-rename the torn version is never offered by
+    `latest_version()`/`load()` — readers are safe unconditionally —
+    and the NEXT publisher detects the stale marker (dead pid, or
+    `stale_writer_s` elapsed for cross-host mounts), sweeps the marker
+    plus orphan tmp dirs, emits `weight_writer_stale`, and proceeds. A
+    marker whose pid is still alive is a concurrent publisher: a
+    loud error, not a silent last-writer-wins.
+
     Args:
         directory: store root (shared between trainer and servers —
             a filesystem both can reach is the transport).
         keep_versions: retention depth; rollback needs >= 2.
+        stale_writer_s: age past which a writer marker is presumed
+            dead even when its pid cannot be probed (another host).
     """
 
     _MARKER = '_QUARANTINED'
+    _WRITER = '_WRITER.json'
 
     def __init__(self, directory: str, keep_versions: int = 4,
-                 retry_policy=None):
+                 retry_policy=None, stale_writer_s: float = 300.0):
         if keep_versions < 2:
             raise ValueError('keep_versions must be >= 2 (rollback '
                              'needs the previous version retained)')
@@ -105,6 +120,7 @@ class WeightStore:
             directory, backend='npz', max_to_keep=int(keep_versions),
             save_interval_steps=1, retry_policy=retry_policy)
         self.directory = self.mgr.directory
+        self.stale_writer_s = float(stale_writer_s)
         reg = _obs.get_registry()
         self._m_published = reg.counter(
             'paddle_weight_publish_total', 'weight versions published')
@@ -146,12 +162,114 @@ class WeightStore:
     def quarantined(self) -> List[int]:
         return [v for v in self.mgr.all_steps() if self.is_quarantined(v)]
 
+    # -- stale-writer detection ---------------------------------------------
+    def _writer_path(self) -> str:
+        return os.path.join(self.directory, self._WRITER)
+
+    def writer_marker(self) -> Optional[Dict[str, Any]]:
+        """The live writer marker, or None. Unreadable/garbage markers
+        (a torn marker write) read as stale-shaped: {} with age 0 —
+        the claim path sweeps them like any dead writer's."""
+        path = self._writer_path()
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except Exception:  # paddle-lint: disable=swallowed-exception -- a torn/garbage marker reads as stale-shaped ({}); the claim path sweeps it like any dead writer's
+            return {}
+
+    @staticmethod
+    def _pid_alive(pid) -> Optional[bool]:
+        """True/False when the pid can be probed on THIS host, None when
+        it cannot (another host shares the mount) — age decides then."""
+        try:
+            pid = int(pid)
+        except (TypeError, ValueError):
+            return False
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True      # exists, owned by someone else
+        except OSError:
+            return None
+
+    def _sweep_stale_writer(self, marker: Dict[str, Any]):
+        """Remove a dead publisher's droppings: the marker and any
+        orphan step tmp dirs. Committed versions are untouched — the
+        atomic-rename commit means a killed writer can only ever leave
+        UNcommitted state behind."""
+        import shutil
+        swept = []
+        for name in sorted(os.listdir(self.directory)):
+            if name.startswith('step_') and name.endswith('.tmp'):
+                try:
+                    shutil.rmtree(os.path.join(self.directory, name))
+                    swept.append(name)
+                except OSError:
+                    pass
+        try:
+            os.unlink(self._writer_path())
+        except OSError:
+            pass
+        _obs.emit('weight_writer_stale', pid=marker.get('pid'),
+                  started=marker.get('started'), swept_tmp=len(swept))
+        if _obs.enabled():
+            _obs.get_registry().counter(
+                'paddle_weight_writer_stale_total',
+                'dead mid-commit publishers detected and swept').inc()
+
+    def _claim_writer(self, version: int):
+        """Take the writer marker for this commit. A stale marker (dead
+        pid, or older than stale_writer_s where the pid is unprobeable)
+        is swept; a LIVE marker is a concurrent publisher and raises."""
+        marker = self.writer_marker()
+        if marker is not None:
+            age = time.time() - float(marker.get('started', 0) or 0)
+            alive = self._pid_alive(marker.get('pid'))
+            same_host = marker.get('host', '') == os.uname().nodename
+            if same_host and alive is not None:
+                # pid probe is authoritative on this host
+                stale = not alive
+            else:
+                # another host (pid numbers don't travel) or an
+                # unprobeable pid: age decides
+                stale = age > self.stale_writer_s
+            if not stale:
+                raise RuntimeError(
+                    f'weight store writer marker {self._writer_path()} '
+                    f'belongs to a live publisher (pid '
+                    f'{marker.get("pid")}, host '
+                    f'{marker.get("host", "?")}, age {age:.0f}s); two '
+                    f'live publishers on one store is a deployment bug '
+                    f'— or raise stale_writer_s if this is a wedged '
+                    f'remote writer')
+            self._sweep_stale_writer(marker)
+        tmp = f'{self._writer_path()}.{os.getpid()}.tmp'
+        with open(tmp, 'w') as f:
+            json.dump({'pid': os.getpid(), 'started': time.time(),
+                       'host': os.uname().nodename,
+                       'version': int(version)}, f)
+        os.replace(tmp, self._writer_path())
+
+    def _release_writer(self):
+        try:
+            os.unlink(self._writer_path())
+        except OSError:
+            pass
+
     # -- publish / load -----------------------------------------------------
     def publish(self, state: Dict[str, Any], version: Optional[int] = None,
                 meta: Optional[Dict[str, Any]] = None) -> int:
         """Commit `state` ({name: array} model weights) as a new
         version. Versions are strictly monotone: an explicit `version`
-        at or below the max ever seen is a caller bug."""
+        at or below the max ever seen is a caller bug. The commit runs
+        under the `_WRITER` marker (see the class docstring): a
+        publisher killed anywhere inside leaves only a stale marker and
+        an uncommitted tmp dir — never a half-offered version."""
         host = _host_tree(state)
         if version is None:
             version = self.next_version()
@@ -164,8 +282,13 @@ class WeightStore:
                     f'latest committed {vs[-1]}')
         nbytes = sum(int(a.nbytes) for a in host.values()
                      if hasattr(a, 'nbytes'))
-        self.mgr.save(version, {'model': host, 'weight_version': version,
-                                'meta': dict(meta or {})}, force=True)
+        self._claim_writer(version)
+        try:
+            self.mgr.save(version,
+                          {'model': host, 'weight_version': version,
+                           'meta': dict(meta or {})}, force=True)
+        finally:
+            self._release_writer()
         _obs.emit('weight_publish', version=version, bytes=nbytes,
                   **{k: v for k, v in (meta or {}).items()
                      if isinstance(v, (int, float, str))})
@@ -233,6 +356,7 @@ class WeightStore:
             'versions': self.versions(),
             'latest': self.latest_version(),
             'quarantined': self.quarantined(),
+            'writer': self.writer_marker(),
         }
 
 

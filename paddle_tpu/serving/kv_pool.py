@@ -1,15 +1,34 @@
-"""Slot-pooled KV cache: N fixed slots x max_length, allocated ONCE.
+"""Slot-pooled KV cache: N fixed slots x max_length, allocated ONCE —
+held as PER-SLOT sub-buffers so single-slot writes never round-trip the
+whole pool.
 
 vLLM's PagedAttention (Kwon et al. SOSP'23) pools KV memory in small
 blocks behind an address-translation step; on TPU the same "requests
 share one preallocated cache" idea wants STATIC shapes, so the pool here
-is the coarser fixed-slot variant: one [num_slots, max_length, H_kv, D]
-cache per layer (exactly the model's own `init_cache` layout with the
+is the coarser fixed-slot variant: one [1, max_length, H_kv, D] row per
+slot per layer (exactly the model's own `init_cache` layout with the
 batch dim reinterpreted as slots). A slot is the unit of admission:
 alloc on prefill, free on retirement, and the decode step runs over ALL
 slots every iteration with per-slot positions — freed slots are simply
 masked until a new request overwrites them, so admission never
 recompiles anything.
+
+Representation (the ISSUE-13 copy-surface shrink): the cache is a
+LIST of per-slot row pytrees, not one stacked [N, ...] buffer. Under
+the PR-8 jaxlib constraint store-served programs run undonated, so any
+program that takes the stacked pool and returns it materializes a full
+pool copy — an ~18ms/program floor that bounded chunked prefill's win.
+With per-slot rows:
+
+- prefill / chunk-prefill programs take and return ONE row — the
+  undonated copy surface shrinks from O(pool) to O(row) = pool/N;
+- `write_slot` / `copy_slot` are host-side row replacements (a pointer
+  assignment and a one-row device copy respectively) — the jitted
+  full-pool writer and copier are GONE, along with their compiles;
+- the decode block stacks the rows inside the program
+  (`stack_rows`) and splits its output back (`split_rows`) —
+  bit-identical math, and when the donation gauntlet enables donation
+  the row inputs alias the outputs so even that round trip vanishes.
 
 Prefill shapes are length-bucketed: a prompt of length s runs at the
 smallest bucket >= s (right-padded; pad KV lands above the live
@@ -21,10 +40,12 @@ O(len(buckets)), not O(distinct prompt lengths).
 from __future__ import annotations
 
 import bisect
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+_tree = jax.tree_util
 
 
 def default_buckets(max_length: int, smallest: int = 8) -> Tuple[int, ...]:
@@ -39,12 +60,36 @@ def default_buckets(max_length: int, smallest: int = 8) -> Tuple[int, ...]:
     return tuple(out)
 
 
-class SlotPool:
-    """Owns the pooled cache pytree + the slot free list.
+def stack_rows(rows):
+    """Stack a sequence of per-slot row pytrees (leaves [1, ...]) into
+    the decode-facing pool pytree (leaves [N, ...]). Traced inside the
+    decode program — the math downstream is bit-identical to the old
+    stacked representation."""
+    return _tree.tree_map(lambda *ls: jnp.concatenate(ls, axis=0), *rows)
 
-    The cache is whatever `model.init_cache(num_slots, max_length)`
-    returns (per-layer (K, V) pairs for every causal-LM family here), so
-    the pool works for any model honoring the init_cache contract.
+
+def split_rows(stacked, n: int):
+    """Inverse of `stack_rows`: the decode program's output pool back
+    into n per-slot rows (the host-side list representation)."""
+    return tuple(
+        _tree.tree_map(
+            lambda c: jax.lax.dynamic_slice_in_dim(c, i, 1, axis=0),
+            stacked)
+        for i in range(n))
+
+
+def _leaf_bytes(tree) -> int:
+    return sum(int(getattr(leaf, 'nbytes', 0) or
+                   leaf.size * leaf.dtype.itemsize)
+               for leaf in _tree.tree_leaves(tree))
+
+
+class SlotPool:
+    """Owns the per-slot KV rows + the slot free list.
+
+    Each row is whatever `model.init_cache(1, max_length)` returns
+    (per-layer (K, V) pairs for every causal-LM family here), so the
+    pool works for any model honoring the init_cache contract.
     """
 
     def __init__(self, model, num_slots: int, max_length: int,
@@ -55,8 +100,17 @@ class SlotPool:
             raise ValueError('max_length must be >= 2')
         self.num_slots = int(num_slots)
         self.max_length = int(max_length)
-        self.cache = model.init_cache(self.num_slots, self.max_length,
-                                      dtype)
+        base = model.init_cache(self.num_slots, self.max_length, dtype)
+        # split ONCE into per-slot rows (a one-time device slice); the
+        # base stacked buffer is dropped
+        self.rows: List[Any] = [
+            _tree.tree_map(lambda c: c[i:i + 1], base)
+            for i in range(self.num_slots)]
+        self.row_spec = _tree.tree_map(
+            lambda c: jax.ShapeDtypeStruct((1,) + tuple(c.shape[1:]),
+                                           c.dtype), base)
+        self.row_bytes = _leaf_bytes(self.rows[0])
+        self.pool_bytes = self.row_bytes * self.num_slots
         self.buckets = tuple(sorted(set(
             int(b) for b in (buckets or default_buckets(self.max_length))
             if int(b) <= self.max_length)))
@@ -66,10 +120,11 @@ class SlotPool:
         # chunked-prefill config rides the pool so stats()/debuggers see
         # the full prefill geometry in one place (the engine sets it)
         self.prefill_chunk_tokens: Optional[int] = None
-        self._write_traces = 0
-        self._copy_traces = 0
-        self._write_jit = jax.jit(self._write_fn)
-        self._copy_jit = jax.jit(self._copy_fn)
+        # copy-surface accounting (the bench donation phase reports the
+        # bytes delta vs the old full-pool round trips)
+        self._row_writes = 0
+        self._row_copies = 0
+        self._copied_bytes = 0
 
     # -- slot lifecycle ----------------------------------------------------
     @property
@@ -113,48 +168,73 @@ class SlotPool:
                 f'{self.max_length})')
         return self.buckets[i]
 
-    # -- pooled-cache writes -----------------------------------------------
-    def _write_fn(self, pool, slab, slot):
-        # one compile total: `slot` is traced, shapes are static
-        self._write_traces += 1
-        return jax.tree_util.tree_map(
-            lambda c, s: jax.lax.dynamic_update_slice(
-                c, s.astype(c.dtype),
-                (slot,) + (0,) * (c.ndim - 1)),
-            pool, slab)
+    # -- the cache pytree (decode-facing view) -----------------------------
+    @property
+    def cache(self):
+        """The decode program's pool argument: a tuple of per-slot row
+        pytrees (jax flattens it as one input tree)."""
+        return tuple(self.rows)
+
+    @cache.setter
+    def cache(self, new_rows):
+        """Accepts the decode program's output (a sequence of N row
+        pytrees) — a host-side pointer swap per slot, no device work."""
+        new_rows = list(new_rows)
+        if len(new_rows) != self.num_slots:
+            raise ValueError(
+                f'pool update has {len(new_rows)} rows, expected '
+                f'{self.num_slots}')
+        self.rows = new_rows
+
+    def row(self, slot: int):
+        return self.rows[slot]
+
+    def set_row(self, slot: int, row):
+        """Replace one slot's row (dtype-cast against the row spec so a
+        float32 slab lands in a bf16 pool without moving any OTHER
+        slot). THE single-slot write surface: O(row), never O(pool)."""
+        self.rows[slot] = _tree.tree_map(
+            lambda spec, leaf: leaf if leaf.dtype == spec.dtype
+            else leaf.astype(spec.dtype),
+            self.row_spec, row)
+        self._row_writes += 1
 
     def write_slot(self, slot: int, slab):
-        """Scatter a batch-1 prefill cache (leaves [1, max_length, ...])
-        into the pool's row `slot` — the hand-off from prefill to the
-        pooled decode step."""
-        self.cache = self._write_jit(self.cache, slab,
-                                     jnp.int32(slot))
-
-    def _copy_fn(self, pool, src, dst):
-        # one compile total: src/dst are traced, shapes are static
-        self._copy_traces += 1
-        return jax.tree_util.tree_map(
-            lambda c: jax.lax.dynamic_update_slice(
-                c,
-                jax.lax.dynamic_slice(
-                    c, (src,) + (0,) * (c.ndim - 1),
-                    (1,) + c.shape[1:]),
-                (dst,) + (0,) * (c.ndim - 1)),
-            pool)
+        """Store a batch-1 prefill cache (leaves [1, max_length, ...])
+        as the pool's row `slot` — the hand-off from prefill to the
+        pooled decode step. A host-side row replacement (plus an astype
+        when the dtypes differ): the old jitted full-pool scatter — and
+        its full-pool output copy — is gone."""
+        self.set_row(slot, slab)
 
     def copy_slot(self, src: int, dst: int):
-        """Copy row `src` into row `dst` across the whole cache pytree
-        (the prefix-cache hit path: a retained prefix row becomes the
-        new request's KV floor; stale positions above the prefix are
-        masked until the request's own prefill/decode overwrites them).
-        One compiled program regardless of src/dst."""
-        self.cache = self._copy_jit(self.cache, jnp.int32(src),
-                                    jnp.int32(dst))
+        """Copy row `src` into row `dst` (the prefix-cache hit path: a
+        retained prefix row becomes the new request's KV floor; stale
+        positions above the prefix are masked until the request's own
+        prefill/decode overwrites them). A ONE-row device copy — a real
+        copy, not an alias, so a donated decode round can never see the
+        same buffer twice."""
+        self.rows[dst] = _tree.tree_map(jnp.array, self.rows[src])
+        self._row_copies += 1
+        self._copied_bytes += self.row_bytes
+
+    def reset_rows(self):
+        """Re-zero every row (fresh buffers). The donation-failure
+        recovery path: if a DONATED decode program dies mid-call its
+        input rows may already be invalidated, so the engine rebuilds
+        the pool rather than risk stacking dead buffers."""
+        self.rows = [
+            _tree.tree_map(lambda s: jnp.zeros(s.shape, s.dtype),
+                           self.row_spec)
+            for _ in range(self.num_slots)]
 
     def stats(self) -> dict:
         return {'num_slots': self.num_slots, 'max_length': self.max_length,
                 'used': self.used_count, 'free': self.free_count,
                 'buckets': list(self.buckets),
                 'prefill_chunk_tokens': self.prefill_chunk_tokens,
-                'write_traces': self._write_traces,
-                'copy_traces': self._copy_traces}
+                'row_bytes': self.row_bytes,
+                'pool_bytes': self.pool_bytes,
+                'row_writes': self._row_writes,
+                'row_copies': self._row_copies,
+                'copied_bytes': self._copied_bytes}

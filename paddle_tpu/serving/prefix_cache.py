@@ -360,8 +360,17 @@ class RadixPrefixCache:
         self._evict_node(victim, stale=victim.version != self.version)
         return True
 
-    def clear(self):
-        """Evict every unpinned entry (tests / manual reset)."""
+    def clear(self, force: bool = False):
+        """Evict every unpinned entry (tests / manual reset).
+        `force=True` drops PINNED entries too — the pool-recovery path
+        after a donated decode program failed mid-call, where every
+        retained row is already invalid. Pins are left intact: the
+        failing requests release them during their own teardown, and a
+        released node that was force-evicted is simply unowned."""
+        if force:
+            for n in list(self._owners):
+                self._evict_node(n)
+            return
         while self.evict_lru():
             pass
 

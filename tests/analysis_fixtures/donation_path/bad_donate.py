@@ -1,0 +1,26 @@
+"""Fixture: raw donation outside the gauntlet-gated store path.
+
+Every donate_argnums/donate_argnames keyword below bakes donation into
+a jitted object the gauntlet can neither withhold nor quarantine —
+planted true positives for the donation-path pass (>= 3)."""
+import jax
+
+
+def make_step(fn):
+    # TP 1: raw jax.jit donation at module function level
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+class Trainer:
+    def __init__(self, step_fn):
+        # TP 2: raw donation on a method-built jit
+        self._jitted = jax.jit(step_fn, donate_argnums=(0,))
+        # TP 3: donate_argnames is the same bypass by another spelling
+        self._named = jax.jit(step_fn, donate_argnames=('state',))
+
+
+def wrapped_but_still_raw(store, fn):
+    # TP 4: a donated jit handed TO wrap_jit still bakes the donation
+    # where the store cannot govern it — declare it to wrap_jit instead
+    return store.wrap_jit(jax.jit(fn, donate_argnums=(2,)),
+                          name='step')

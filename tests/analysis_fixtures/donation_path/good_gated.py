@@ -1,0 +1,26 @@
+"""Fixture: donation routed through the gauntlet-gated store path (and
+plain undonated jits) — true negatives for the donation-path pass."""
+import jax
+
+
+def enroll(store, fn):
+    # TN 1: the gated spelling — donate_argnums declared to wrap_jit,
+    # where the direct path donates and the export path obeys the probe
+    return store.wrap_jit(fn, name='train_step',
+                          donate_argnums=(0, 1, 2))
+
+
+def enroll_via_factory(get_store, fn):
+    # TN 2: gated through a factory-call receiver
+    return get_store().wrap_jit(fn, name='decode',
+                                donate_argnums=(3,))
+
+
+def plain_jit(fn):
+    # TN 3: an undonated jit has nothing to gate
+    return jax.jit(fn, static_argnums=(1,))
+
+
+def bare_wrap(wrap_jit, fn):
+    # TN 4: bare-name gated call (imported helper)
+    return wrap_jit(fn, name='x', donate_argnums=(1,))

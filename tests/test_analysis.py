@@ -30,8 +30,8 @@ from paddle_tpu.analysis.passes import obs_schema
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 FIXTURES = ROOT / 'tests' / 'analysis_fixtures'
 
-ALL_PASSES = ('falsy-guard', 'host-sync', 'lock-order', 'obs-schema',
-              'swallowed-exception', 'trace-hazard')
+ALL_PASSES = ('donation-path', 'falsy-guard', 'host-sync', 'lock-order',
+              'obs-schema', 'swallowed-exception', 'trace-hazard')
 
 
 def run_on(path, passes, baseline=None):
@@ -51,7 +51,7 @@ def write_module(tmp_path, text, name='scratch.py'):
 # ---------------------------------------------------------------------------
 
 class TestTreeCleanliness:
-    def test_registry_has_the_six_passes(self):
+    def test_registry_has_the_seven_passes(self):
         assert set(core.registered_passes()) == set(ALL_PASSES)
 
     def test_full_tree_lints_clean_modulo_baseline(self):
@@ -111,6 +111,8 @@ FIXTURE_SPECS = [
     ('swallowed-exception', 'swallowed_exception/bad_swallows.py',
      'swallowed_exception/good_handled.py'),
     ('obs-schema', 'obs_schema/bad_schema.py', 'obs_schema/good_schema.py'),
+    ('donation-path', 'donation_path/bad_donate.py',
+     'donation_path/good_gated.py'),
 ]
 
 

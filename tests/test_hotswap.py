@@ -129,6 +129,148 @@ class TestWeightStore:
 
 
 # ---------------------------------------------------------------------------
+# stale-writer detection (ISSUE 13 satellite: the PR-12 cross-process
+# stretch — trainer and servers in SEPARATE processes over one store)
+# ---------------------------------------------------------------------------
+
+_STORE_CHILD = r'''
+import json, os, sys
+import numpy as np
+from paddle_tpu.serving.hotswap import WeightStore
+
+d, action = sys.argv[1], sys.argv[2]
+store = WeightStore(d, stale_writer_s=3600.0)
+fill = float(sys.argv[3]) if len(sys.argv) > 3 else 1.0
+state = {'w': np.full((4, 4), fill, 'float32')}
+if action == 'publish':
+    print(json.dumps({'published': store.publish(state),
+                      'writer_left': store.writer_marker() is not None}))
+elif action == 'publish_killed_mid_commit':
+    # die between the tmp dir completing and the atomic commit rename —
+    # the exact torn state a SIGKILLed trainer leaves: a _WRITER marker
+    # and an uncommitted step_*.tmp, but never a half-offered version
+    real_replace = os.replace
+
+    def dying(src, dst):
+        if os.path.basename(dst).startswith('step_'):
+            os._exit(17)
+        return real_replace(src, dst)
+
+    os.replace = dying
+    store.publish(state)
+elif action == 'serve':
+    latest = store.latest_version()
+    tree = store.load(latest) if latest is not None else None
+    print(json.dumps({
+        'latest': latest,
+        'w0': None if tree is None else float(tree['w'].flat[0]),
+        'writer_marker': store.writer_marker() is not None,
+        'tmp_dirs': sorted(n for n in os.listdir(d)
+                           if n.endswith('.tmp')),
+    }))
+'''
+
+
+def _run_store_child(tmp_path, action, fill=None, timeout=240):
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    args = [sys.executable, '-c', _STORE_CHILD,
+            str(tmp_path / 'wstore'), action]
+    if fill is not None:
+        args.append(str(fill))
+    env = dict(os.environ, JAX_PLATFORMS='cpu', FLAGS_donation='off')
+    proc = subprocess.run(args, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() \
+        else ''
+    return proc.returncode, (_json.loads(line) if line else None), \
+        proc.stderr
+
+
+class TestStaleWriterDetection:
+    def test_trainer_server_smoke_with_mid_commit_kill(self, tmp_path):
+        """Subprocess-driven trainer→server flow: publish, die
+        mid-commit, serve the last committed version anyway, recover,
+        publish again, serve the new version."""
+        # trainer publishes v1 cleanly (and releases its marker)
+        rc, out, err = _run_store_child(tmp_path, 'publish', fill=1.0)
+        assert rc == 0, err
+        assert out == {'published': 1, 'writer_left': False}
+        # a second trainer dies BETWEEN tmp completion and commit
+        rc, _, err = _run_store_child(tmp_path,
+                                      'publish_killed_mid_commit',
+                                      fill=2.0)
+        assert rc == 17, err
+        # the server still gets v1 — the torn v2 is invisible; only the
+        # dead writer's marker and tmp dir remain
+        rc, srv, err = _run_store_child(tmp_path, 'serve')
+        assert rc == 0, err
+        assert srv['latest'] == 1 and srv['w0'] == 1.0
+        assert srv['writer_marker'] is True
+        assert srv['tmp_dirs'] == ['step_2.tmp']
+        # a RESTARTED trainer detects the stale marker (dead pid),
+        # sweeps marker + tmp orphans, and publishes v2 for real
+        rc, out, err = _run_store_child(tmp_path, 'publish', fill=3.0)
+        assert rc == 0, err
+        assert out['published'] == 2
+        rc, srv, err = _run_store_child(tmp_path, 'serve')
+        assert rc == 0, err
+        assert srv['latest'] == 2 and srv['w0'] == 3.0
+        assert srv['writer_marker'] is False
+        assert srv['tmp_dirs'] == []
+
+    def test_live_concurrent_publisher_is_a_loud_error(self, tmp_path):
+        store = WeightStore(tmp_path / 'w')
+        store._claim_writer(1)      # this live process holds the marker
+        other = WeightStore(tmp_path / 'w')
+        with pytest.raises(RuntimeError, match='live publisher'):
+            other.publish({'w': np.ones((2, 2), 'float32')})
+        store._release_writer()
+        assert other.publish({'w': np.ones((2, 2), 'float32')}) == 1
+
+    def test_dead_pid_marker_swept_in_process(self, tmp_path):
+        import json as _json
+        store = WeightStore(tmp_path / 'w')
+        # a marker from a pid that cannot exist, same host
+        import os as _os
+        with open(store._writer_path(), 'w') as f:
+            _json.dump({'pid': 2 ** 22 + 12345, 'started': 0,
+                        'host': _os.uname().nodename}, f)
+        (tmp_path / 'w' / 'step_9.tmp').mkdir()
+        log0 = len(obs.get_event_log().events())
+        v = store.publish({'w': np.ones((2, 2), 'float32')})
+        assert v == 1
+        assert not (tmp_path / 'w' / 'step_9.tmp').exists()
+        names = [e['name'] for e in obs.get_event_log().events()[log0:]]
+        assert 'weight_writer_stale' in names
+
+    def test_foreign_host_marker_ages_out(self, tmp_path):
+        import json as _json
+        import time as _time
+        store = WeightStore(tmp_path / 'w', stale_writer_s=5.0)
+        with open(store._writer_path(), 'w') as f:
+            _json.dump({'pid': 1, 'started': _time.time(),
+                        'host': 'some-other-host'}, f)
+        # young foreign marker: treated as live (pid probes don't
+        # travel across hosts; age is the only signal)
+        with pytest.raises(RuntimeError, match='live publisher'):
+            store.publish({'w': np.ones((2, 2), 'float32')})
+        with open(store._writer_path(), 'w') as f:
+            _json.dump({'pid': 1, 'started': _time.time() - 60.0,
+                        'host': 'some-other-host'}, f)
+        assert store.publish({'w': np.ones((2, 2), 'float32')}) == 1
+
+    def test_stats_surface_writer_marker(self, tmp_path):
+        store = WeightStore(tmp_path / 'w')
+        assert store.stats()['writer'] is None
+        store._claim_writer(3)
+        assert store.stats()['writer']['version'] == 3
+        store._release_writer()
+
+
+# ---------------------------------------------------------------------------
 # the trainer side
 # ---------------------------------------------------------------------------
 

@@ -154,17 +154,60 @@ def test_slot_pool_bucket_for(gpt):
         pool.bucket_for(65)
 
 
-def test_slot_pool_write_slot_scatters_one_row(gpt):
+def test_slot_pool_write_slot_touches_one_row(gpt):
+    """ISSUE-13 copy-surface contract: a write replaces ONE per-slot
+    row (host-side, zero compiled programs) and never touches the
+    other slots' buffers."""
     pool = SlotPool(gpt, num_slots=3, max_length=16)
+    before = [jax.tree_util.tree_leaves(pool.row(i))[0]
+              for i in range(3)]
     slab = jax.tree_util.tree_map(
         lambda c: jnp.ones((1,) + c.shape[1:], c.dtype),
         gpt.init_cache(1, 16))
     pool.write_slot(1, slab)
-    k0 = np.asarray(pool.cache[0][0])
-    assert (k0[1] == 1).all() and (k0[0] == 0).all() and (k0[2] == 0).all()
-    assert pool.stats()['write_traces'] == 1
-    pool.write_slot(2, slab)              # second write: no retrace
-    assert pool.stats()['write_traces'] == 1
+    k1 = np.asarray(jax.tree_util.tree_leaves(pool.row(1))[0])
+    assert (k1 == 1).all()
+    # untouched slots keep their ORIGINAL buffers (pointer-identical:
+    # nothing round-tripped the rest of the pool)
+    assert jax.tree_util.tree_leaves(pool.row(0))[0] is before[0]
+    assert jax.tree_util.tree_leaves(pool.row(2))[0] is before[2]
+    assert pool.stats()['row_writes'] == 1
+    pool.write_slot(2, slab)
+    assert pool.stats()['row_writes'] == 2
+
+
+def test_slot_pool_copy_slot_is_one_row_and_independent(gpt):
+    pool = SlotPool(gpt, num_slots=3, max_length=16)
+    slab = jax.tree_util.tree_map(
+        lambda c: jnp.ones((1,) + c.shape[1:], c.dtype),
+        gpt.init_cache(1, 16))
+    pool.write_slot(0, slab)
+    pool.copy_slot(0, 2)
+    k2 = np.asarray(jax.tree_util.tree_leaves(pool.row(2))[0])
+    assert (k2 == 1).all()
+    # a REAL copy, not an alias: a donated decode round must never see
+    # the same buffer behind two row inputs
+    assert jax.tree_util.tree_leaves(pool.row(2))[0] is not \
+        jax.tree_util.tree_leaves(pool.row(0))[0]
+    st = pool.stats()
+    assert st['row_copies'] == 1
+    assert st['copied_bytes'] == st['row_bytes']
+    assert st['pool_bytes'] == 3 * st['row_bytes']
+
+
+def test_slot_pool_stack_split_roundtrip(gpt):
+    from paddle_tpu.serving.kv_pool import split_rows, stack_rows
+    pool = SlotPool(gpt, num_slots=3, max_length=16)
+    slab = jax.tree_util.tree_map(
+        lambda c: jnp.full((1,) + c.shape[1:], 2.0, c.dtype),
+        gpt.init_cache(1, 16))
+    pool.write_slot(1, slab)
+    stacked = stack_rows(pool.cache)
+    back = split_rows(stacked, 3)
+    for i in range(3):
+        for a, b in zip(jax.tree_util.tree_leaves(pool.row(i)),
+                        jax.tree_util.tree_leaves(back[i])):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
 # ---------------------------------------------------------------------------
