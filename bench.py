@@ -1974,6 +1974,192 @@ def _phase_donation():
     return out
 
 
+def _autoscale_schedule(pattern, duration_s, rate):
+    """The three traffic shapes of the autoscale A/B, all peaking at
+    `rate` req/s so the static comparison fleet is sized once."""
+    from paddle_tpu import loadgen
+    if pattern == 'poisson':
+        return loadgen.PoissonSchedule(rate)
+    if pattern == 'diurnal':
+        # one full cycle: quiet -> peak (mid-trace) -> quiet, trough at
+        # a fifth of the peak — the day/night swing scale-down feeds on
+        return loadgen.DiurnalSchedule(rate / 5.0, rate,
+                                       period_s=duration_s)
+    if pattern == 'burst':
+        # flash crowd: a fifth of the trace's volume lands inside 50 ms
+        # mid-trace — arrival concentration beats any box's drain rate,
+        # so the backlog (and the autoscaler's reaction to it) is real
+        # on fast hardware too, unlike a merely-elevated rate
+        herd = max(rate * duration_s * 0.2, 8.0)
+        return loadgen.BurstSchedule(rate / 4.0, herd / 0.05,
+                                     burst_start_s=duration_s * 0.4,
+                                     burst_len_s=0.05)
+    raise ValueError(f'unknown traffic pattern {pattern!r}')
+
+
+def autoscale_arm(model, trace, *, autoscaled, replicas, max_replicas,
+                  slo_ttft_s, eng_kw, time_scale=1.0, max_wall_s=120.0,
+                  signal_window_s=3.0, cooldown_s=0.5,
+                  down_stable_s=1.0):
+    """Replay ONE trace against a fresh fleet and close the goodput
+    books around it (also imported by the tier-1 guards).
+
+    Static arm: `replicas` engines for the whole trace. Autoscaled
+    arm: start at 1, let the `Autoscaler` (forced on, flag-independent
+    — this IS the A/B) grow to `max_replicas` and shrink back on the
+    windowed signals. Both arms report the user-felt numbers (p99-TTFT
+    SLO attainment, replica-seconds, attainment per replica-hour) plus
+    the ledger's verdict on what the machinery cost: scale_up /
+    scale_down seconds, their fraction of wall, and closure — the
+    books must still sum to wall within 1% with the new categories in
+    play."""
+    from paddle_tpu import loadgen, observability as obs
+    from paddle_tpu.serving import (Autoscaler, AutoscalerConfig,
+                                    InferenceEngine, ReplicaSet, Router)
+
+    router = Router(ReplicaSet(model, 1 if autoscaled else replicas,
+                               **eng_kw),
+                    signal_window_s=signal_window_s)
+    scaler = None
+    if autoscaled:
+        scaler = Autoscaler(
+            router, lambda: InferenceEngine(model, **eng_kw),
+            AutoscalerConfig(min_replicas=1, max_replicas=max_replicas,
+                             slo_ttft_s=slo_ttft_s,
+                             cooldown_s=cooldown_s,
+                             down_stable_s=down_stable_s),
+            force=True)
+    ledger = obs.get_ledger()
+    was_running = ledger.running
+    ledger.start(reset=True)
+    report = loadgen.LoadReplayer(router, trace, autoscaler=scaler,
+                                  time_scale=time_scale,
+                                  max_wall_s=max_wall_s).run()
+    books = ledger.report()
+    if not was_running:
+        ledger.stop()
+    wall = books['wall_seconds']
+    closure = abs(sum(books['categories'].values())
+                  + books['residual_seconds'] - wall)
+    cats = books['categories']
+    out = report.report(slo_ttft_s)
+    out.update({
+        'autoscaled': bool(autoscaled),
+        'replicas_start': 1 if autoscaled else replicas,
+        'replicas_final': len(router.replicas),
+        'ledger': {
+            'wall_s': round(wall, 3),
+            'closure_err_pct': round(100.0 * closure / wall, 4)
+            if wall else 0.0,
+            'scale_up_s': round(cats.get('scale_up', 0.0), 4),
+            'scale_down_s': round(cats.get('scale_down', 0.0), 4),
+            'machinery_pct': round(
+                100.0 * (cats.get('scale_up', 0.0)
+                         + cats.get('scale_down', 0.0)) / wall, 3)
+            if wall else 0.0,
+            'serving_decode_s': round(cats.get('serving_decode', 0.0), 3),
+        },
+    })
+    if scaler is not None:
+        s = scaler.stats()
+        out['autoscaler'] = {'decisions': s['decisions'],
+                             'provision_ema_s': s['provision_ema_s']}
+    return out
+
+
+def autoscale_ab(duration_s=10.0, rate=60.0, seed=1234, slo_ttft_s=2.0,
+                 max_replicas=3, patterns=('poisson', 'diurnal', 'burst')):
+    """The ISSUE-14 headline: p99-TTFT SLO attainment per replica-hour,
+    static peak-sized fleet vs autoscaled, across the three traffic
+    patterns — with the goodput ledger proving the autoscaling
+    machinery costs <3% of wall and the books still close within 1%.
+
+    The static arm runs `max_replicas` engines for the whole trace
+    (the 'provision for the peak' posture); the autoscaled arm starts
+    at one replica and follows the windowed signals. Same seed ⇒ both
+    arms replay bit-identical traces."""
+    import paddle_tpu as paddle
+    from paddle_tpu import loadgen
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig.tiny()).eval()
+    eng_kw = dict(num_slots=4, max_length=64, decode_block=4)
+    # warm every prefill bucket + the decode block OUTSIDE the arms:
+    # arms run sequentially in one process and share the in-memory
+    # program store, so whichever arm ran first would otherwise eat the
+    # compiles and bias the comparison
+    from paddle_tpu.serving import InferenceEngine, SamplingParams
+    warm_rng = np.random.RandomState(0)
+    InferenceEngine(model, **eng_kw).generate_many(
+        [warm_rng.randint(1, 64, (l,)).tolist() for l in (4, 8, 16, 32)],
+        [SamplingParams(max_new_tokens=6, eos_token_id=-1)] * 4)
+    out = {'slo_ttft_s': slo_ttft_s, 'max_replicas': max_replicas,
+           'duration_s': duration_s, 'peak_rate': rate}
+    for pattern in patterns:
+        trace = loadgen.make_trace(
+            _autoscale_schedule(pattern, duration_s, rate), duration_s,
+            seed=seed,
+            prompt_lengths=loadgen.LognormalLengths(10, 0.5, 4, 32),
+            output_lengths=loadgen.FixedLength(6),
+            tenants=[loadgen.TenantClass('paid', 1, 0),
+                     loadgen.TenantClass('free', 2, 2)],
+            vocab_size=min(getattr(model.config, 'vocab_size', 128), 128))
+        loadgen.validate_trace(trace, eng_kw['max_length'])
+        arms = {}
+        for name, autoscaled in (('static', False), ('autoscaled', True)):
+            arms[name] = autoscale_arm(
+                model, trace, autoscaled=autoscaled,
+                replicas=max_replicas, max_replicas=max_replicas,
+                slo_ttft_s=slo_ttft_s, eng_kw=eng_kw,
+                max_wall_s=6.0 * duration_s)
+        st, au = arms['static'], arms['autoscaled']
+        arms['trace'] = loadgen.trace_stats(trace)
+        arms['replica_seconds_saved_pct'] = round(
+            100.0 * (1.0 - au['replica_seconds']
+                     / st['replica_seconds']), 2) \
+            if st['replica_seconds'] else 0.0
+        out[pattern] = arms
+    return out
+
+
+def autoscale_smoke(duration_s=5.0, rate=60.0, seed=77):
+    """Tier-1 smoke (`bench.py autoscale --smoke`): a 5-second
+    deterministic Poisson trace on CPU through the autoscaled arm
+    only. The guard asserts the SLO-attainment JSON is produced
+    (offered/attainment/replica-seconds all present), zero requests
+    dropped, and the goodput ledger — with the scale_up/scale_down
+    categories live — closes within 1%."""
+    res = autoscale_ab(duration_s=duration_s, rate=rate, seed=seed,
+                       patterns=('poisson',), max_replicas=2)
+    arm = res['poisson']['autoscaled']
+    return {
+        'pattern': 'poisson', 'duration_s': duration_s, 'seed': seed,
+        'offered': arm['offered'],
+        'completed': arm['completed'],
+        'dropped': arm['dropped'],
+        'slo_attainment': arm['slo_attainment'],
+        'ttft_p99_s': arm['ttft_p99_s'],
+        'replica_seconds': arm['replica_seconds'],
+        'attainment_per_replica_hour': arm['attainment_per_replica_hour'],
+        'ledger_closure_err_pct': arm['ledger']['closure_err_pct'],
+        'machinery_pct': arm['ledger']['machinery_pct'],
+        'decisions': arm.get('autoscaler', {}).get('decisions', {}),
+    }
+
+
+def _phase_autoscale():
+    """Autoscaling phase: the three-pattern static-vs-autoscaled A/B
+    (tier-1 guards ride the smoke variant + the diurnal acceptance
+    test in tests/test_autoscaler.py)."""
+    try:
+        return {'autoscale': autoscale_ab()}
+    except Exception as e:
+        print(f'# autoscale bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        return {'autoscale': {'error': type(e).__name__}}
+
+
 def _bench_eager_dispatch():
     """Eager dispatch fast path A/B: the same DyGraph MLP train loop with
     the dispatch cache on vs off (per-call re-tracing), reporting ops/sec
@@ -2130,6 +2316,7 @@ PHASES = {
     'coldstart': _phase_coldstart,
     'goodput': _phase_goodput,
     'donation': _phase_donation,
+    'autoscale': _phase_autoscale,
 }
 
 
@@ -2168,7 +2355,8 @@ def _cpu_phase_plan():
     regression test runs a single fast phase."""
     plan = [('headline', 1500), ('eager', 600), ('obs', 600),
             ('resilience', 600), ('serving', 900), ('router', 900),
-            ('coldstart', 900), ('goodput', 600), ('donation', 600)]
+            ('coldstart', 900), ('goodput', 600), ('donation', 600),
+            ('autoscale', 600)]
     only = os.environ.get('BENCH_CPU_PHASES')
     if only:
         wanted = {p.strip() for p in only.split(',') if p.strip()}
@@ -2182,6 +2370,15 @@ def main():
     # and sets its flags explicitly in-process. An operator exporting
     # FLAGS_donation still wins.
     os.environ.setdefault('FLAGS_donation', 'off')
+    if len(sys.argv) >= 2 and sys.argv[1] == 'autoscale':
+        # `bench.py autoscale [--smoke]`: the tier-1 CI entry point —
+        # --smoke is the 5-second deterministic Poisson trace whose
+        # SLO-attainment JSON + ledger closure the guard asserts
+        if '--smoke' in sys.argv[2:]:
+            print(json.dumps({'autoscale_smoke': autoscale_smoke()}))
+        else:
+            print(json.dumps(_phase_autoscale()))
+        return 0
     if len(sys.argv) >= 3 and sys.argv[1] == '--coldstart-child':
         if os.environ.get('BENCH_FORCE_CPU'):
             import jax
@@ -2248,6 +2445,7 @@ def main():
     out.update(_run_phase_subprocess('router', 900))
     out.update(_run_phase_subprocess('coldstart', 900))
     out.update(_run_phase_subprocess('donation', 600))
+    out.update(_run_phase_subprocess('autoscale', 600))
     print(json.dumps(out))
     return 0
 

@@ -46,6 +46,12 @@ HOT_SCOPES = {
     'paddle_tpu/loop/rollout.py': (
         'RolloutLoop.', 'RolloutBatch.', 'Rollout.',
     ),
+    # the autoscaler's poll loop and the loadgen replayer both run
+    # INTERLEAVED with decode rounds (one poll/submit pass per router
+    # step) — a stray sync in either stalls the same pipeline the
+    # engine scopes protect
+    'paddle_tpu/serving/autoscaler.py': ('Autoscaler.',),
+    'paddle_tpu/loadgen/replay.py': ('LoadReplayer.',),
 }
 
 _NP_ROOTS = frozenset(('np', 'numpy', 'onp'))

@@ -20,8 +20,9 @@ per-host snapshots over the existing collectives.
 from __future__ import annotations
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
-                      DEFAULT_BUCKETS, QUANTILES, count_suppressed, enable,
-                      enabled, disable, get_registry, merge_snapshots)
+                      DEFAULT_BUCKETS, QUANTILES, SlidingWindow,
+                      count_suppressed, enable, enabled, disable,
+                      get_registry, merge_snapshots)
 from .events import (EVENT_SCHEMA, EventLog, Span, declare_event, emit,
                      get_event_log, span)
 from .exporters import (read_jsonl, to_chrome_trace, to_jsonl,
@@ -44,7 +45,7 @@ from . import goodput as _goodput
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'DEFAULT_BUCKETS',
-    'QUANTILES',
+    'QUANTILES', 'SlidingWindow',
     'enable', 'enabled', 'disable', 'get_registry', 'merge_snapshots',
     'EVENT_SCHEMA', 'EventLog', 'Span', 'declare_event', 'emit',
     'get_event_log', 'span',

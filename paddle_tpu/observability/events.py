@@ -101,6 +101,13 @@ EVENT_SCHEMA: Dict[str, str] = {
         'dead mid-commit weight publisher detected; marker+tmp swept',
     'rollout_iteration':
         'one serve→score→train→publish→swap turn of the rollout loop',
+    # goodput-driven autoscaling (serving/autoscaler.py)
+    'autoscale_up': 'autoscaler provisioned a replica (warm '
+                    'program-store path) and joined it to the fleet',
+    'autoscale_down_begin': 'autoscaler cordoned a replica; graceful '
+                            'drain toward removal started',
+    'autoscale_down_complete': 'drained replica removed from the '
+                               'fleet; no request dropped',
 }
 
 

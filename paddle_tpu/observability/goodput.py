@@ -60,6 +60,10 @@ CATEGORIES = (
     'weight_swap',         # trainer→serving hot-swap (drain/load/
                            # verify/rejoin surplus; nested decode keeps
                            # serving while a replica drains)
+    'scale_up',            # autoscaler replica provisioning (engine
+                           # build + program-store warm load)
+    'scale_down',          # autoscaler cordon/removal surplus (nested
+                           # decode during the drain stays serving)
     'serving_prefill',
     'serving_decode',
     'host_wait',           # data-loader / input-pipeline wait
@@ -91,6 +95,12 @@ SPAN_CATEGORIES: Dict[str, str] = {
     'hotswap.verify': 'weight_swap',
     'hotswap.rejoin': 'weight_swap',
     'hotswap.rollback': 'weight_swap',
+    # autoscaling: provisioning books as scale_up; the cordon/removal
+    # bookkeeping as scale_down — the drain itself is NOT wrapped, so
+    # decode rounds finishing the victim's work stay serving_decode
+    # (the fleet kept serving; only the machinery is overhead)
+    'autoscale.provision': 'scale_up',
+    'autoscale.retire': 'scale_down',
     'serving.prefill': 'serving_prefill',
     'serving.prefill_chunk': 'serving_prefill',
     'serving.draft_prefill': 'serving_prefill',

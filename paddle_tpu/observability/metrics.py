@@ -28,6 +28,7 @@ import bisect
 import collections
 import math
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import flags as _flags
@@ -174,6 +175,91 @@ class Histogram:
             return {}
         n = len(vals)
         return {_q_key(q): vals[min(int(q * n), n - 1)] for q in qs}
+
+
+class SlidingWindow:
+    """Time-windowed observation buffer: quantiles, count, and rate over
+    the trailing `window_s` seconds of real time.
+
+    The Histogram's quantile sketch is COUNT-windowed (last 256
+    observations) — fine for "what did recent steps look like", useless
+    for a control loop: after a burst ends, those 256 stale samples keep
+    reporting the burst for however long traffic stays quiet. An
+    autoscaler needs signals that age out by the clock, so this buffer
+    keeps (timestamp, value) pairs and prunes everything older than the
+    window on every read and write. `maxlen` bounds memory under
+    pathological observation rates (oldest drop first — under that much
+    traffic the window is saturated anyway); `clock` is injectable for
+    deterministic tests.
+
+    Thread-safe; cheap enough for per-request/per-round observation
+    (one deque append + amortized prune)."""
+
+    __slots__ = ('window_s', '_clock', '_obs', '_lock')
+
+    def __init__(self, window_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 maxlen: int = 8192):
+        if window_s <= 0:
+            raise ValueError('window_s must be positive')
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._obs: collections.deque = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def _prune(self, now: float):
+        cutoff = now - self.window_s
+        obs = self._obs
+        while obs and obs[0][0] < cutoff:
+            obs.popleft()
+
+    def observe(self, value: float):
+        now = self._clock()
+        with self._lock:
+            self._prune(now)
+            self._obs.append((now, float(value)))
+        return self
+
+    def mark(self):
+        """Record an occurrence (value 1.0) — the rate()-only use case
+        (shed events, admissions)."""
+        return self.observe(1.0)
+
+    def values(self) -> List[float]:
+        with self._lock:
+            self._prune(self._clock())
+            return [v for _, v in self._obs]
+
+    def count(self) -> int:
+        with self._lock:
+            self._prune(self._clock())
+            return len(self._obs)
+
+    def rate(self) -> float:
+        """Observations per second over the window."""
+        return self.count() / self.window_s
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile over the in-window values, or None when
+        the window is empty — an absent percentile is honest, a
+        fabricated zero is not (same contract as window_quantiles)."""
+        vals = sorted(self.values())
+        if not vals:
+            return None
+        n = len(vals)
+        return vals[min(int(q * n), n - 1)]
+
+    def quantiles(self, qs: Sequence[float] = QUANTILES
+                  ) -> Dict[str, float]:
+        vals = sorted(self.values())
+        if not vals:
+            return {}
+        n = len(vals)
+        return {_q_key(q): vals[min(int(q * n), n - 1)] for q in qs}
+
+    def mean(self) -> Optional[float]:
+        vals = self.values()
+        return sum(vals) / len(vals) if vals else None
 
 
 _CHILD_TYPES = {'counter': Counter, 'gauge': Gauge, 'histogram': Histogram}

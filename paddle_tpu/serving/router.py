@@ -350,6 +350,13 @@ class Router:
         storm_threshold/storm_window_s: failover-storm detector — this
             many failovers inside the window emits
             `router_failover_storm` (a flight-recorder trigger).
+        signal_window_s: width of the sliding signal windows (TTFT
+            quantiles, queue depth, shed rate) behind
+            `window_signals()` and the `paddle_ttft_p99_window`-family
+            gauges — the autoscaler's control inputs. Cumulative
+            per-request TTFT can't drive a control loop (an hour of
+            history swamps the last 30 seconds); these age out by the
+            clock.
     """
 
     def __init__(self, replicas, tenants=None, max_failovers: int = 2,
@@ -358,7 +365,8 @@ class Router:
                  ttft_budget_s: Optional[float] = None,
                  shed_priority: int = PRIORITY_LOW,
                  retry_after_s: float = 1.0,
-                 storm_threshold: int = 3, storm_window_s: float = 60.0):
+                 storm_threshold: int = 3, storm_window_s: float = 60.0,
+                 signal_window_s: float = 30.0):
         if isinstance(replicas, ReplicaSet):
             self.replicas = list(replicas)
         else:
@@ -389,6 +397,22 @@ class Router:
             maxlen=max(self.storm_threshold, 8))
         self._last_storm_t: Optional[float] = None
         self._counts = collections.Counter()
+        # replica ids are NEVER reused: a removed replica's scoped
+        # degraded states ('replica:N' draining) must not bleed onto a
+        # later arrival that would otherwise inherit its id
+        self._next_rid = max(r.id for r in self.replicas) + 1
+        # sliding signal windows (autoscaler inputs + *_window gauges)
+        self.signal_window_s = float(signal_window_s)
+        self._win_ttft = _obs.SlidingWindow(self.signal_window_s)
+        self._win_queue = _obs.SlidingWindow(self.signal_window_s)
+        self._win_shed = _obs.SlidingWindow(self.signal_window_s)
+        self._win_accept = _obs.SlidingWindow(self.signal_window_s)
+        # queue-depth samples must be uniform in TIME, not per step: an
+        # idle router steps thousands of times a second while a
+        # backlogged one steps tens, so per-step sampling drowns the
+        # backlog in idle zeros and the window quantiles lie
+        self._queue_sample_interval = self.signal_window_s / 128.0
+        self._last_queue_sample = float('-inf')
         self._init_metrics()
 
     # ------------------------------------------------------------------
@@ -431,6 +455,25 @@ class Router:
             'paddle_router_weight_version',
             'weight version each replica is serving (mixed values = '
             'rolling swap in flight)', ('replica',))
+        # sliding-window signal gauges: what the cumulative families
+        # above cannot say — "what does traffic look like RIGHT NOW" —
+        # published so an autoscaler (or a dashboard alarm) can act on
+        # /metrics alone
+        self._m_ttft_p50_w = reg.gauge(
+            'paddle_ttft_p50_window',
+            'router TTFT p50 (seconds) over the sliding signal window')
+        self._m_ttft_p99_w = reg.gauge(
+            'paddle_ttft_p99_window',
+            'router TTFT p99 (seconds) over the sliding signal window')
+        self._m_queue_p50_w = reg.gauge(
+            'paddle_queue_depth_p50_window',
+            'fleet queue-depth p50 over the sliding signal window')
+        self._m_queue_p99_w = reg.gauge(
+            'paddle_queue_depth_p99_window',
+            'fleet queue-depth p99 over the sliding signal window')
+        self._m_shed_window = reg.gauge(
+            'paddle_shed_rate_window',
+            'admissions shed per second over the sliding signal window')
         if _obs.enabled():
             self._m_replicas.set(len(self.replicas))
             self._refresh_gauges()
@@ -452,6 +495,14 @@ class Router:
                 r.engine.weight_version)
         self._m_available.set(avail)
         self._m_queue.set(depth)
+        sig = self.window_signals()
+        if sig['ttft_p50'] is not None:
+            self._m_ttft_p50_w.set(sig['ttft_p50'])
+            self._m_ttft_p99_w.set(sig['ttft_p99'])
+        if sig['queue_p50'] is not None:
+            self._m_queue_p50_w.set(sig['queue_p50'])
+            self._m_queue_p99_w.set(sig['queue_p99'])
+        self._m_shed_window.set(sig['shed_rate'])
 
     # ------------------------------------------------------------------
     # admission
@@ -483,8 +534,27 @@ class Router:
         return (rounds / serving + 1) * self._ema_round_s
 
     def _reject(self, tenant: str, reason: str,
-                retry_after: Optional[float], detail: str = ''):
+                retry_after: Optional[float], detail: str = '',
+                depth_guard: Optional[int] = None):
         self._counts[f'rejected_{reason}'] += 1
+        # shed-accounting invariant (ISSUE 14): a request rejected at
+        # admission was never handed to any engine, so the fleet
+        # queue-depth signal — which the autoscaler reads as DEMAND —
+        # must be exactly what it was when this submission arrived.
+        # Double-counting rejected work as demand would make a burst
+        # that is being correctly shed look like a reason to scale up.
+        if depth_guard is not None:
+            depth_now = self.queue_depth
+            assert depth_now == depth_guard, (
+                f'shed accounting violated: queue depth moved '
+                f'{depth_guard} -> {depth_now} while rejecting '
+                f'({reason}) — a rejected request leaked into a '
+                f'replica queue')
+        if reason in ('shed', 'no_healthy_replica'):
+            # capacity sheds (not per-tenant policy rejects like
+            # rate_limited): the windowed shed-rate signal feeds the
+            # autoscaler's scale-up decision
+            self._win_shed.mark()
         if _obs.enabled():
             self._m_requests.labels(tenant=tenant, outcome=reason).inc()
             self._m_shed.labels(tenant=tenant, reason=reason).inc()
@@ -506,11 +576,15 @@ class Router:
                             'not both')
         t = self.tenants.get(tenant)
         prio = int(priority) if priority is not None else t.priority
+        # snapshot for the shed-accounting invariant: any rejection
+        # below must leave the fleet queue depth exactly here
+        depth0 = self.queue_depth
 
         # 1. per-tenant token-bucket rate
         if t.bucket is not None and not t.bucket.try_acquire():
             self._reject(t.name, 'rate_limited', t.bucket.retry_after(),
-                         f'rate {t.bucket.rate}/s exceeded')
+                         f'rate {t.bucket.rate}/s exceeded',
+                         depth_guard=depth0)
         # 2. per-tenant concurrency cap
         if (t.max_concurrency is not None
                 and t.in_flight >= t.max_concurrency):
@@ -518,7 +592,8 @@ class Router:
             self._reject(t.name, 'concurrency',
                          est if est is not None else self.retry_after_s,
                          f'{t.in_flight} in flight >= cap '
-                         f'{t.max_concurrency}')
+                         f'{t.max_concurrency}',
+                         depth_guard=depth0)
         # 3. load shedding: overload rejects sheddable work FAST
         if prio >= self.shed_priority:
             est = self._estimated_ttft_s()
@@ -543,18 +618,34 @@ class Router:
                 self._reject(
                     t.name, 'shed',
                     est if est is not None else self.retry_after_s,
-                    '; '.join(reason_bits))
+                    '; '.join(reason_bits), depth_guard=depth0)
         # 4. placement on the least-loaded healthy replica
         replica = self._pick_replica()
         if replica is None:
             self._reject(t.name, 'no_healthy_replica',
                          self.retry_after_s,
-                         'every replica is degraded or circuit-broken')
+                         'every replica is degraded or circuit-broken',
+                         depth_guard=depth0)
 
         rh = RouterHandle(self, InferenceEngine._normalize_prompt(prompt),
                           params, t.name, prio)
-        self._place(rh, replica)
+        try:
+            self._place(rh, replica)
+        except RuntimeError:
+            # the pick->place race: the chosen replica began draining
+            # (autoscaler scale-down, preemption) after the health check.
+            # One re-pick excluding it; if nobody else is healthy the
+            # caller gets the TYPED rejection every other capacity path
+            # produces, never a bare engine RuntimeError.
+            replica = self._pick_replica(exclude=(replica,))
+            if replica is None:
+                self._reject(t.name, 'no_healthy_replica',
+                             self.retry_after_s,
+                             'every replica is degraded, draining, or '
+                             'circuit-broken', depth_guard=depth0)
+            self._place(rh, replica)
         t.in_flight += 1
+        self._win_accept.mark()
         self._live.append(rh)
         self._counts['accepted'] += 1
         if _obs.enabled():
@@ -607,6 +698,14 @@ class Router:
                                  else 0.8 * self._ema_round_s + 0.2 * dt)
         self._reap()
         self._rounds += 1
+        # windowed demand sample: ACCEPTED queued work only, observed
+        # after admission/reaping — never inside the submit path, so a
+        # burst of shed submissions cannot pump the demand signal —
+        # and throttled to a time-uniform cadence (see __init__)
+        now_m = time.monotonic()
+        if now_m - self._last_queue_sample >= self._queue_sample_interval:
+            self._last_queue_sample = now_m
+            self._win_queue.observe(self.queue_depth)
         # gauges are monitoring, not control flow: refreshing every 8th
         # round keeps the per-round router cost out of the decode path
         # (submit/finalize still refresh immediately where it matters)
@@ -641,6 +740,7 @@ class Router:
             if (rh._t_first is None and rh.inner is not None
                     and rh.inner.tokens):
                 rh._t_first = now
+                self._win_ttft.observe(now - rh._t_submit)
             replica = self._by_id.get(rh.replica_id)
             if rh._error is not None:
                 self._finalize(rh, 'failed')
@@ -744,8 +844,84 @@ class Router:
                   window_s=round(window, 3))
 
     # ------------------------------------------------------------------
+    # windowed signals (the autoscaler's control inputs)
+    # ------------------------------------------------------------------
+    def serving_replica_count(self) -> int:
+        """Replicas currently accepting placements (healthy, breaker
+        not open). Draining replicas still DRIVE their work but count
+        as leaving capacity."""
+        return sum(1 for r in self.replicas
+                   if not r.health_states()
+                   and r.breaker.state != BREAKER_OPEN)
+
+    def window_signals(self) -> dict:
+        """One consistent snapshot of the sliding-window control
+        signals: TTFT p50/p99 (None before the first in-window first
+        token), fleet queue-depth p50/p99 over the per-step samples
+        (None before the first routed step), capacity-shed rate and
+        accept rate (requests/second), and the serving replica count.
+        This — not the cumulative `paddle_router_*` families — is what
+        the autoscaler polls: every value ages out of the window by the
+        clock, so a burst that ended a minute ago stops arguing for
+        more replicas."""
+        return {
+            'window_s': self.signal_window_s,
+            'ttft_p50': self._win_ttft.quantile(0.50),
+            'ttft_p99': self._win_ttft.quantile(0.99),
+            'queue_p50': self._win_queue.quantile(0.50),
+            'queue_p99': self._win_queue.quantile(0.99),
+            'shed_rate': self._win_shed.rate(),
+            'accept_rate': self._win_accept.rate(),
+            'serving_replicas': self.serving_replica_count(),
+        }
+
+    # ------------------------------------------------------------------
     # operations
     # ------------------------------------------------------------------
+    def add_replica(self, engine: InferenceEngine,
+                    breaker_kwargs: Optional[dict] = None) -> Replica:
+        """Join a freshly provisioned engine to the fleet under a new —
+        never recycled — replica id (a removed replica's scoped
+        degraded states must not bleed onto a later arrival). The
+        engine should come from the same weights/geometry as its
+        siblings so it resolves the identical ProgramStore keys (the
+        warm scale-up path: it loads, not compiles). Returns the new
+        Replica, immediately eligible for placement."""
+        rid = self._next_rid
+        self._next_rid += 1
+        r = Replica(rid, engine,
+                    CircuitBreaker(name=str(rid), **(breaker_kwargs or {})))
+        self.replicas.append(r)
+        self._by_id[rid] = r
+        if _obs.enabled():
+            self._m_replicas.set(len(self.replicas))
+            self._refresh_gauges()
+        return r
+
+    def remove_replica(self, rid: int) -> Replica:
+        """Detach a DRAINED replica from the fleet (the scale-down
+        endpoint: `drain_replica` first, keep stepping until its engine
+        has no work, then remove). Refuses while the engine still holds
+        accepted work — removal must never drop a request — and clears
+        the replica's scoped `draining` health state so /healthz
+        converges once the replica is gone."""
+        r = self._by_id[rid]
+        if r.engine.has_work:
+            raise RuntimeError(
+                f'replica {rid} still holds accepted work '
+                f'(queued={r.engine.scheduler.queue_depth}, '
+                f'in_flight={len(r.engine._slot_req)}); drain it before '
+                f'removing')
+        if len(self.replicas) <= 1:
+            raise RuntimeError('refusing to remove the last replica')
+        del self._by_id[rid]
+        self.replicas.remove(r)
+        _obs.clear_degraded('draining', scope=r.scope, force=True)
+        if _obs.enabled():
+            self._m_replicas.set(len(self.replicas))
+            self._refresh_gauges()
+        return r
+
     def drain_replica(self, rid: int):
         """Take replica `rid` out of rotation NOW (runbook: rolling
         restart / eviction). Its scoped `draining` state excludes it

@@ -1,0 +1,31 @@
+"""True-positive fixtures for host-sync over the autoscaler scopes
+(parsed only, never imported). The file path mirrors the real
+hot-scope config (`paddle_tpu/serving/autoscaler.py` + the
+`Autoscaler.` scope prefix): the poll loop runs between decode rounds,
+so unannotated syncs here stall the serving pipeline."""
+import numpy as np
+import jax
+
+
+class Autoscaler:
+    def poll(self):
+        # snippet 1: unannotated bulk d2h while deciding
+        sizes = {n: np.asarray(t).nbytes
+                 for n, t in self.router.replicas[0].engine._params.items()}
+        return sizes
+
+    def _wants_scale_up(self, sig):
+        eng = self.router.replicas[0].engine
+        # snippet 2: unannotated blocking sync on the decision path
+        eng._params['head'].block_until_ready()
+        # snippet 3: unannotated per-element device read per poll
+        pending = int(eng._tok[0])
+        return pending > 0
+
+    def _scale_up(self, now):
+        # snippet 4: .item() materialization inside the policy loop
+        return self.router.replicas[0].engine._params['embed'].sum().item()
+
+    def _advance_drains(self, now):
+        # snippet 5: device_get is a sync however it is spelled
+        return jax.device_get(self._draining)

@@ -1,0 +1,33 @@
+"""True-negative fixtures for host-sync over the autoscaler scopes:
+host-only bookkeeping, annotated syncs, and syncs outside the
+configured scope prefix."""
+import numpy as np
+
+
+class Autoscaler:
+    def poll(self):
+        # snippet 1: plain python bookkeeping is not a sync
+        now = float(self._clock())
+        replicas = len(self.router.replicas)
+        return now, replicas
+
+    def _wants_scale_up(self, sig):
+        # snippet 2: reading the window-signal dict never touches the
+        # device (the router materialized it off hot path)
+        return sig['queue_p99'] is not None and sig['queue_p99'] > 4
+
+    def _scale_up(self, now):
+        # snippet 3: the SAME d2h, annotated with a justification
+        probe = np.asarray(self.router.replicas[0].engine._tok[:1])  # paddle-lint: disable=host-sync -- one-element warm-probe read at provision time, once per scale-up
+        return probe
+
+
+class AutoscalerConfig:
+    def validate(self):
+        # snippet 4: NOT a hot scope — config validation is setup-time
+        return {k: float(np.asarray(v)) for k, v in self.raw.items()}
+
+
+def _outside_helper(tree):
+    # snippet 5: not in any configured scope prefix
+    return {n: np.asarray(a).nbytes for n, a in tree.items()}

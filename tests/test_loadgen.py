@@ -1,0 +1,236 @@
+"""paddle_tpu.loadgen: deterministic arrival-process load generation
+(ISSUE 14) — schedules, length distributions, trace construction, and
+the router replayer.
+
+The load generator is the instrument the autoscaling bench measures
+with, so ITS contracts get tier-1 teeth: bit-identical traces from one
+seed, arrival processes that actually modulate (diurnal peak vs
+trough, burst window vs baseline), length distributions that respect
+their bounds/histograms, and a replayer whose report accounts for
+every offered request (completed + shed + failed + dropped == offered)
+with the replica-second integral the per-hardware SLO math divides by.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import loadgen
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import ReplicaSet, Router
+
+NO_EOS = -1
+
+
+@pytest.fixture(scope='module')
+def gpt():
+    paddle.seed(7)
+    return GPTForCausalLM(GPTConfig.tiny()).eval()
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+# ---------------------------------------------------------------------------
+# arrival schedules
+# ---------------------------------------------------------------------------
+
+class TestArrivals:
+    def test_poisson_times_sorted_in_range_and_near_rate(self):
+        sched = loadgen.PoissonSchedule(20.0)
+        times = loadgen.arrival_times(sched, 50.0, _rng(3))
+        assert times == sorted(times)
+        assert all(0.0 <= t < 50.0 for t in times)
+        # 1000 expected; fixed seed makes the draw deterministic, the
+        # loose band just documents it is the right order of magnitude
+        assert 800 <= len(times) <= 1200, len(times)
+
+    def test_diurnal_peak_carries_more_than_trough(self):
+        # phase=0: trough at t=0, peak at period/2
+        sched = loadgen.DiurnalSchedule(1.0, 30.0, period_s=40.0)
+        assert sched.rate_at(0.0) == pytest.approx(1.0)
+        assert sched.rate_at(20.0) == pytest.approx(30.0)
+        times = loadgen.arrival_times(sched, 40.0, _rng(5))
+        trough = sum(1 for t in times if t < 10.0 or t >= 30.0)
+        peak = sum(1 for t in times if 10.0 <= t < 30.0)
+        assert peak > 3 * trough, (peak, trough)
+
+    def test_burst_window_concentrates_arrivals(self):
+        sched = loadgen.BurstSchedule(2.0, 100.0, burst_start_s=4.0,
+                                      burst_len_s=2.0)
+        times = loadgen.arrival_times(sched, 10.0, _rng(9))
+        inside = sum(1 for t in times if 4.0 <= t < 6.0)
+        outside = len(times) - inside
+        # 200 expected inside vs 16 outside
+        assert inside > 5 * outside, (inside, outside)
+
+    def test_thinning_is_deterministic_per_rng_state(self):
+        sched = loadgen.DiurnalSchedule(1.0, 10.0, period_s=8.0)
+        a = loadgen.arrival_times(sched, 8.0, _rng(11))
+        b = loadgen.arrival_times(sched, 8.0, _rng(11))
+        assert a == b
+
+    def test_schedule_validation(self):
+        with pytest.raises(ValueError):
+            loadgen.PoissonSchedule(0.0)
+        with pytest.raises(ValueError):
+            loadgen.DiurnalSchedule(5.0, 2.0, period_s=10.0)  # peak < base
+        with pytest.raises(ValueError):
+            loadgen.BurstSchedule(2.0, 1.0, 0.0, 1.0)  # burst < base
+
+
+# ---------------------------------------------------------------------------
+# length distributions
+# ---------------------------------------------------------------------------
+
+class TestLengths:
+    def test_lognormal_respects_bounds_and_center(self):
+        d = loadgen.LognormalLengths(median=16, sigma=0.8, lo=4, hi=64)
+        rng = _rng(1)
+        vals = [d.sample(rng) for _ in range(2000)]
+        assert all(4 <= v <= 64 for v in vals)
+        assert d.bounds() == (4, 64)
+        med = sorted(vals)[len(vals) // 2]
+        assert 10 <= med <= 24, med   # near the configured median
+
+    def test_empirical_histogram_replays_support_and_weights(self):
+        d = loadgen.EmpiricalLengths({8: 1.0, 16: 2.0, 64: 1.0})
+        rng = _rng(2)
+        vals = [d.sample(rng) for _ in range(4000)]
+        assert set(vals) <= {8, 16, 64}
+        frac16 = vals.count(16) / len(vals)
+        assert 0.42 <= frac16 <= 0.58, frac16   # weight 2 of 4
+        assert d.bounds() == (8, 64)
+
+    def test_fixed_and_validation(self):
+        assert loadgen.FixedLength(5).sample(_rng(0)) == 5
+        with pytest.raises(ValueError):
+            loadgen.FixedLength(0)
+        with pytest.raises(ValueError):
+            loadgen.EmpiricalLengths({})
+        with pytest.raises(ValueError):
+            loadgen.EmpiricalLengths({4: -1.0})
+        with pytest.raises(ValueError):
+            loadgen.LognormalLengths(0, 0.5, 1, 8)
+
+
+# ---------------------------------------------------------------------------
+# trace construction
+# ---------------------------------------------------------------------------
+
+def _mixed_trace(seed=42, duration=6.0, rate=15.0, vocab=96):
+    return loadgen.make_trace(
+        loadgen.PoissonSchedule(rate), duration, seed=seed,
+        prompt_lengths=loadgen.LognormalLengths(8, 0.5, 2, 24),
+        output_lengths=loadgen.EmpiricalLengths({2: 1, 4: 2, 6: 1}),
+        tenants=[loadgen.TenantClass('paid', 1.0, 0),
+                 loadgen.TenantClass('free', 3.0, 2)],
+        vocab_size=vocab)
+
+
+class TestTrace:
+    def test_same_seed_bit_identical_different_seed_differs(self):
+        a, b, c = _mixed_trace(7), _mixed_trace(7), _mixed_trace(8)
+        assert a == b                 # the replay-bit-identically contract
+        assert a != c
+        assert len(a) > 30
+
+    def test_requests_are_well_formed(self):
+        tr = _mixed_trace()
+        assert [r.index for r in tr] == list(range(len(tr)))
+        assert all(tr[i].arrival_s <= tr[i + 1].arrival_s
+                   for i in range(len(tr) - 1))
+        for r in tr:
+            assert 2 <= len(r.prompt_tokens) <= 24
+            assert all(1 <= t < 96 for t in r.prompt_tokens)
+            assert r.max_new_tokens in (2, 4, 6)
+            assert r.tenant in ('paid', 'free')
+            assert r.priority == (0 if r.tenant == 'paid' else 2)
+
+    def test_tenant_mix_follows_weights(self):
+        tr = _mixed_trace(duration=30.0)
+        frac_free = sum(1 for r in tr if r.tenant == 'free') / len(tr)
+        assert 0.6 <= frac_free <= 0.9, frac_free   # weight 3 of 4
+
+    def test_validate_trace_flags_oversized_requests(self):
+        tr = _mixed_trace()
+        loadgen.validate_trace(tr, max_length=64)
+        with pytest.raises(ValueError):
+            loadgen.validate_trace(tr, max_length=8)
+        # speculation headroom tightens the bound
+        with pytest.raises(ValueError):
+            loadgen.validate_trace(tr, max_length=30, headroom=16)
+
+    def test_trace_stats_shape(self):
+        s = loadgen.trace_stats(_mixed_trace())
+        assert s['requests'] > 0
+        assert s['prompt_tokens'] > 0 and s['output_tokens'] > 0
+        assert set(s['by_tenant']) <= {'paid', 'free'}
+        assert loadgen.trace_stats([]) == {'requests': 0}
+
+    def test_unique_tenant_names_enforced(self):
+        with pytest.raises(ValueError):
+            loadgen.make_trace(
+                loadgen.PoissonSchedule(5.0), 1.0, seed=0,
+                prompt_lengths=loadgen.FixedLength(4),
+                tenants=[loadgen.TenantClass('a'),
+                         loadgen.TenantClass('a')])
+
+
+# ---------------------------------------------------------------------------
+# replay against a real fleet
+# ---------------------------------------------------------------------------
+
+class TestReplay:
+    def test_replay_accounts_for_every_offered_request(self, gpt):
+        trace = loadgen.make_trace(
+            loadgen.PoissonSchedule(30.0), 1.0, seed=3,
+            prompt_lengths=loadgen.FixedLength(6),
+            output_lengths=loadgen.FixedLength(4), vocab_size=96)
+        loadgen.validate_trace(trace, 64)
+        router = Router(ReplicaSet(gpt, 2, num_slots=2, max_length=64,
+                                   decode_block=2))
+        rep = loadgen.LoadReplayer(router, trace, time_scale=0.5,
+                                   max_wall_s=60.0).run()
+        r = rep.report(slo_ttft_s=30.0)
+        assert r['offered'] == len(trace)
+        assert (r['completed'] + r['shed'] + r['failed']
+                + r['dropped']) == r['offered']
+        assert r['dropped'] == 0
+        assert r['completed'] == len(trace)   # nothing shed: no limits set
+        assert r['tokens'] == 4 * len(trace)
+        # with the giant SLO every completion attains
+        assert r['slo_attainment'] == 1.0
+        assert r['attainment_per_replica_hour'] > 0
+        # two replicas attached throughout: the occupancy integral is
+        # wall * 2 (loose band: scheduling jitter)
+        assert r['replica_seconds'] == pytest.approx(2 * r['wall_s'],
+                                                     rel=0.15)
+
+    def test_replay_records_shed_typed_not_lost(self, gpt):
+        # a thundering herd the 1-replica fleet must shed (depth cap
+        # 3): ~40 arrivals inside 5 ms — concentration beats any box's
+        # drain rate, so the queue cap is hit even on warm, fast CI
+        trace = loadgen.make_trace(
+            loadgen.BurstSchedule(1.0, 40 / 0.005, 0.0, 0.005), 0.1,
+            seed=5,
+            prompt_lengths=loadgen.FixedLength(4),
+            output_lengths=loadgen.FixedLength(2), vocab_size=96)
+        assert len(trace) > 10
+        router = Router(ReplicaSet(gpt, 1, num_slots=2, max_length=64,
+                                   decode_block=2),
+                        shed_queue_depth=3, shed_priority=0)
+        rep = loadgen.LoadReplayer(router, trace,
+                                   max_wall_s=60.0).run()
+        r = rep.report(slo_ttft_s=30.0)
+        assert r['shed'] > 0
+        assert r['dropped'] == 0
+        assert r['completed'] + r['shed'] == r['offered']
+        shed = [o for o in rep.outcomes if o.outcome == 'shed']
+        assert all(o.reason == 'shed' for o in shed)
+
+    def test_replay_rejects_bad_time_scale(self, gpt):
+        router = Router(ReplicaSet(gpt, 1, num_slots=2, max_length=64,
+                                   decode_block=2))
+        with pytest.raises(ValueError):
+            loadgen.LoadReplayer(router, [], time_scale=0.0)
