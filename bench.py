@@ -599,11 +599,48 @@ def scrape_overhead_ab(steps=30, trials=3, hz=4.0):
         srv.stop()
 
 
+def sanitizer_overhead_ab(steps=30, trials=3):
+    """Concurrency-sanitizer report-mode vs off A/B on the instrumented
+    eager MLP loop (also imported by the tier-1 <3% overhead guard).
+    Both arms run the SAME instrumentation — spans and StepTelemetry
+    take the registry/event-log locks every step — so the ratio
+    isolates what the sanitizer's held-stack + acquisition-graph
+    tracking costs a lock-heavy hot path. Report-only mode is the
+    production posture this guard protects; STRICT mode is reserved
+    for tests (the chaos gauntlets), where raising beats speed.
+    Min-of-adjacent-pair ratios, same estimator as the scrape guard."""
+    from paddle_tpu.analysis import runtime as _rt
+
+    prev = _rt.mode()
+    ratios = []
+    best_on = best_off = 0.0
+    try:
+        for _ in range(trials):
+            _rt.disable()
+            off = eager_mlp_loop(steps=steps, instrument=True)
+            _rt.enable('report')
+            on = eager_mlp_loop(steps=steps, instrument=True)
+            best_off = max(best_off, off['steps_per_sec'])
+            best_on = max(best_on, on['steps_per_sec'])
+            if on['steps_per_sec']:
+                ratios.append(off['steps_per_sec'] / on['steps_per_sec'])
+    finally:
+        _rt.enable(prev)
+    overhead = min(ratios) - 1 if ratios else float('inf')
+    return {
+        'sanitized_steps_per_sec': best_on,
+        'plain_steps_per_sec': best_off,
+        'overhead_pct': round(overhead * 100, 2),
+        'mode': 'report',
+        'lock_classes_observed': _rt.stats()['lock_classes'],
+    }
+
+
 def _phase_obs():
     """Observability overhead phase: instrumentation on vs off on the
-    eager hot path, plus the /metrics scrape-under-load A/B; the JSON
-    carries both measured ratios (the tier-1 guards pin each under 3%
-    on CPU)."""
+    eager hot path, the /metrics scrape-under-load A/B, and the
+    concurrency-sanitizer report-mode A/B; the JSON carries the
+    measured ratios (the tier-1 guards pin each under 3% on CPU)."""
     out = {}
     try:
         out['obs_overhead'] = obs_overhead_ab()
@@ -617,6 +654,12 @@ def _phase_obs():
         print(f'# scrape bench failed: {type(e).__name__}: {e}',
               file=sys.stderr)
         out['scrape_overhead'] = {'error': type(e).__name__}
+    try:
+        out['sanitizer_overhead'] = sanitizer_overhead_ab()
+    except Exception as e:
+        print(f'# sanitizer bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        out['sanitizer_overhead'] = {'error': type(e).__name__}
     return out
 
 

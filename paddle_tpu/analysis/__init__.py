@@ -9,11 +9,15 @@ statically detectable before they shipped:
 - the ``or``-on-falsy-``EventLog`` rerouting bug (PR 10) -> ``falsy-guard``
 
 This package is a pluggable AST-walking lint framework (`core`) plus the
-passes (`passes`).  ``python -m paddle_tpu.analysis`` runs the full suite
-over ``paddle_tpu/`` and ``bench.py``; ``tests/test_analysis.py`` wires
-the same run into tier-1, so the tree must lint clean modulo the
-committed ``baseline.json`` (grandfathered findings, each with a reason,
-shrink-only).
+passes (`passes`) plus runtime sanitizers (`runtime` — the concurrency
+sanitizer's lock wrappers and `guarded_by` lockset checker, whose
+observed acquisition edges feed back into the static ``lock-order``
+pass via ``--runtime-edges``).  ``python -m paddle_tpu.analysis`` runs
+the full suite over ``paddle_tpu/`` and ``bench.py`` (``--stats`` adds
+per-pass accounting + the stale-suppression audit);
+``tests/test_analysis.py`` wires the same run into tier-1, so the tree
+must lint clean modulo the committed ``baseline.json`` (grandfathered
+findings, each with a reason, shrink-only).
 
 Suppression syntax (inline, justified at the site)::
 

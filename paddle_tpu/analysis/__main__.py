@@ -28,6 +28,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument('--no-baseline', action='store_true',
                    help='report every finding, ignoring the baseline')
     p.add_argument('--list-passes', action='store_true')
+    p.add_argument('--stats', action='store_true',
+                   help='per-pass finding/suppression/baseline counts + '
+                        'stale-suppression audit (an inline disable '
+                        'whose pass no longer fires there fails the '
+                        'run, mirroring the shrink-only baseline)')
+    p.add_argument('--runtime-edges', default=None, metavar='JSON',
+                   help='runtime-observed lock-acquisition edges '
+                        '(analysis.runtime.concurrency.export_edges '
+                        'artifact) merged into the static lock-order '
+                        'graph; PADDLE_LINT_RUNTIME_EDGES is the env '
+                        'equivalent')
     return p
 
 
@@ -46,12 +57,26 @@ def main(argv=None) -> int:
                 if name not in core.registered_passes():
                     raise KeyError(f'unknown pass {name!r}; available: '
                                    f'{core.registered_passes()}')
+        if args.runtime_edges:
+            from .passes import lock_order
+            lock_order.set_runtime_edges_path(args.runtime_edges)
         baseline = None if args.no_baseline else core.Baseline.load(args.baseline)
-        result = core.run_analysis(targets=args.targets or None,
-                                   passes=passes, baseline=baseline)
+        files = core.discover_files(args.targets or None)
+        result = core.run_analysis(passes=passes, baseline=baseline,
+                                   files=files)
+        if args.stats:
+            stale = core.audit_suppressions(files, result)
+            stats = core.compute_stats(result, stale, baseline)
     except Exception:
         traceback.print_exc()
         return 2
+    if args.stats:
+        if args.format == 'json':
+            import json
+            print(json.dumps(stats, indent=1))
+        else:
+            print(core.render_stats_text(stats))
+        return 0 if stats['clean'] else 1
     render = core.render_json if args.format == 'json' else core.render_text
     print(render(result))
     return 0 if result.clean else 1

@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import json
 import pathlib
 import re
+import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
@@ -49,10 +51,28 @@ class SourceFile:
         add_parents(self.tree)
         self._line_suppress: Dict[int, set] = {}
         self._file_suppress: set = set()
+        #: every inline suppression comment as written: the --stats
+        #: stale-suppression audit needs the SITE (which comment, which
+        #: passes), not just the merged line->passes table
+        self.suppress_sites: List[Dict] = []
         self._scan_suppressions()
 
+    def _comment_lines(self):
+        """(line, comment-text) for every REAL comment token. A
+        suppression example inside a docstring must neither silence
+        findings on its line nor count as a stale annotation in the
+        --stats audit; tokenizing is the only way to tell them apart."""
+        try:
+            return [(tok.start[0], tok.string) for tok in
+                    tokenize.generate_tokens(io.StringIO(self.text).readline)
+                    if tok.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError):
+            # unparseable tail (ast.parse already succeeded, so this is
+            # theoretical): fall back to the line scan
+            return list(enumerate(self.lines, start=1))
+
     def _scan_suppressions(self):
-        for i, line in enumerate(self.lines, start=1):
+        for i, line in self._comment_lines():
             if 'paddle-lint' not in line:
                 continue
             m = _SUPPRESS_RE.search(line)
@@ -62,10 +82,16 @@ class SourceFile:
             names = {p.strip() for p in m.group(2).split(',') if p.strip()}
             if kind == 'disable':
                 self._line_suppress.setdefault(i, set()).update(names)
+                effective = i
             elif kind == 'disable-next':
                 self._line_suppress.setdefault(i + 1, set()).update(names)
+                effective = i + 1
             elif kind == 'disable-file':
                 self._file_suppress.update(names)
+                effective = None
+            self.suppress_sites.append(
+                {'comment_line': i, 'kind': kind,
+                 'names': sorted(names), 'effective_line': effective})
 
     def suppressed(self, pass_name: str, line: int) -> bool:
         if pass_name in self._file_suppress or 'all' in self._file_suppress:
@@ -353,6 +379,113 @@ def run_analysis(targets: Optional[Sequence] = None,
                           suppressed=suppressed, stale_baseline=stale,
                           files_scanned=len(files),
                           passes_run=tuple(pass_names))
+
+
+# ---------------------------------------------------------------------------
+# suppression audit + stats (the --stats subcommand)
+# ---------------------------------------------------------------------------
+
+def audit_suppressions(files: Sequence[SourceFile],
+                       result: AnalysisResult) -> List[Dict]:
+    """Stale inline suppressions: a ``# paddle-lint: disable[-next|-file]``
+    comment whose pass no longer fires at that site. The inline mirror
+    of the baseline's shrink-only rule — fixing a suppressed finding
+    forces deleting its annotation, so the suppression surface can only
+    shrink. A suppression naming a pass that does not exist is flagged
+    too (a typo'd annotation silences nothing and lies to the reader).
+    Passes that did not run this invocation are skipped (cannot judge).
+    """
+    ran = set(result.passes_run)
+    known = set(registered_passes())
+    used_line = {(f.path, f.pass_name, f.line) for f in result.suppressed}
+    used_file = {(f.path, f.pass_name) for f in result.suppressed}
+    stale: List[Dict] = []
+    for sf in files:
+        for site in sf.suppress_sites:
+            for name in site['names']:
+                if name == 'all':
+                    passes = sorted(ran)
+                elif name not in known:
+                    stale.append({'path': sf.rel,
+                                  'line': site['comment_line'],
+                                  'pass': name, 'kind': site['kind'],
+                                  'reason': 'unknown-pass'})
+                    continue
+                elif name not in ran:
+                    continue
+                else:
+                    passes = [name]
+                if site['effective_line'] is None:
+                    live = any((sf.rel, p) in used_file for p in passes)
+                else:
+                    live = any(
+                        (sf.rel, p, site['effective_line']) in used_line
+                        for p in passes)
+                if not live:
+                    stale.append({'path': sf.rel,
+                                  'line': site['comment_line'],
+                                  'pass': name, 'kind': site['kind'],
+                                  'reason': 'no-finding'})
+    return stale
+
+
+def compute_stats(result: AnalysisResult,
+                  stale_suppressions: Sequence[Dict],
+                  baseline: Optional[Baseline] = None) -> Dict:
+    """Per-pass finding/suppression/baseline accounting (the --stats
+    payload). `clean` here is stricter than AnalysisResult.clean: stale
+    suppressions fail the run the same way stale baseline entries do."""
+    def _per_pass(findings: Iterable[Finding]) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in findings:
+            out[f.pass_name] = out.get(f.pass_name, 0) + 1
+        return out
+
+    baseline_per_pass: Dict[str, int] = {}
+    for key in (baseline.entries if baseline else {}):
+        p = key.split('::', 1)[0]
+        baseline_per_pass[p] = baseline_per_pass.get(p, 0) + 1
+    passes = {}
+    for name in result.passes_run:
+        passes[name] = {
+            'findings': result.counts().get(name, 0),
+            'grandfathered': _per_pass(result.grandfathered).get(name, 0),
+            'suppressed': _per_pass(result.suppressed).get(name, 0),
+            'baseline_entries': baseline_per_pass.get(name, 0),
+            'stale_suppressions': sum(
+                1 for s in stale_suppressions if s['pass'] == name),
+        }
+    return {
+        'passes': passes,
+        'files_scanned': result.files_scanned,
+        'stale_suppressions': list(stale_suppressions),
+        'stale_baseline': list(result.stale_baseline),
+        'clean': result.clean and not stale_suppressions,
+    }
+
+
+def render_stats_text(stats: Dict) -> str:
+    lines = ['pass                     findings  grandfathered  '
+             'suppressed  baseline  stale-suppr']
+    for name, row in sorted(stats['passes'].items()):
+        lines.append(
+            f'{name:<24} {row["findings"]:>8}  {row["grandfathered"]:>13}'
+            f'  {row["suppressed"]:>10}  {row["baseline_entries"]:>8}'
+            f'  {row["stale_suppressions"]:>11}')
+    for s in stats['stale_suppressions']:
+        why = ('names unknown pass' if s['reason'] == 'unknown-pass'
+               else 'its pass no longer fires here')
+        lines.append(
+            f'STALE-SUPPRESSION: {s["path"]}:{s["line"]} '
+            f'[{s["pass"]}] — {why}; delete the annotation '
+            f'(shrink-only, same contract as the baseline)')
+    for key in stats['stale_baseline']:
+        lines.append(f'STALE-BASELINE: {key}')
+    lines.append(
+        f'paddle-lint --stats: {stats["files_scanned"]} files, '
+        f'{len(stats["stale_suppressions"])} stale suppression(s), '
+        f'{"CLEAN" if stats["clean"] else "NOT CLEAN"}')
+    return '\n'.join(lines)
 
 
 # ---------------------------------------------------------------------------

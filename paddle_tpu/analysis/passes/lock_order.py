@@ -1,5 +1,5 @@
-"""lock-order: lock-acquisition cycles and fields written both with and
-without their lock.
+"""lock-order: whole-program lock-acquisition graph — cycles, re-entry,
+and fields written both with and without their lock.
 
 The runtime takes real locks on real threads: the serving engine step
 loop, the router's failover path, the watchdog, the flight recorder's
@@ -8,33 +8,75 @@ crash — it hangs a replica until the watchdog's 503 fires, which is
 exactly the failure mode that is miserable to reproduce and trivial to
 prevent statically.
 
-Per class, this pass:
+This pass builds ONE acquisition graph across the whole lint target set
+(the PR-11 version was per-class and one-hop):
 
-- collects lock attributes (`self.X = threading.Lock()/RLock()/
-  Condition()`);
-- builds the acquisition graph from `with self.X:` blocks — a nested
-  `with self.Y:` adds edge X->Y, and a call to `self.m()` inside the
-  block adds X->Z for every lock Z that method `m` acquires (one-hop
-  interprocedural);
-- flags cycles in that graph (two code paths taking the same pair of
-  locks in opposite orders) and re-entry on a non-reentrant Lock;
-- flags attributes written BOTH inside a `with self.X` block and
-  outside any lock (outside ``__init__``) — the shape of "someone
-  forgot the lock on one path".
+- lock nodes are `Class.attr` for instance locks (`self.X =
+  threading.Lock()/RLock()/Condition()` — the sanitized wrappers from
+  `analysis.runtime.concurrency` keep the same constructor names) and
+  `module.var` for module-level locks, matching the names the runtime
+  sanitizer stamps on its observed edges;
+- `with self.X:` / `with module_lock:` under other held locks adds
+  graph edges; a call under a held lock adds edges to every lock the
+  callee may TRANSITIVELY acquire (fixed-point closure over the
+  program's call graph — call targets resolve by `self.m()` within the
+  class, bare names within/through `from x import y` imports, and
+  `obj.m()` by unique-name match program-wide, skipping builtin
+  container/primitive method names so `self._events.append(...)` never
+  aliases `EventLog.append`);
+- a runtime-edges artifact (`analysis.runtime.concurrency.export_edges`
+  → ``--runtime-edges`` / ``PADDLE_LINT_RUNTIME_EDGES``) merges
+  observed edges the AST cannot see (attribute-chained locks, callback
+  indirection) into the same graph before cycle detection;
+- reported: directed cycles (two code paths take the same locks in
+  opposite orders — the witness is the static acquire that closes the
+  cycle, or the artifact itself for runtime-only cycles), re-entry on a
+  non-reentrant Lock (direct or via self-call chains), and fields
+  written BOTH inside a `with self.X` block and outside any lock
+  (outside ``__init__``) — the shape of "someone forgot the lock on
+  one path".
 
 Nested function bodies are treated as separate execution contexts (a
-closure may run on another thread), so a lock held at definition site
-is not assumed held inside them.
+closure may run on another thread), so a lock held at definition site is
+not assumed held inside them, and a closure's own acquisitions are not
+attributed to the function that merely defines it.
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set, Tuple
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..core import AnalysisPass, Finding, SourceFile, register_pass
 from . import _util
 
 _LOCK_CTORS = frozenset(('Lock', 'RLock', 'Condition'))
+
+#: method names never used for unique-name call resolution: builtin
+#: container / primitive / file-ish methods shadow real methods
+#: constantly (`self._events.append` is a deque, not EventLog.append)
+_ATTR_SKIP = frozenset(
+    [n for t in (list, dict, set, frozenset, str, bytes, tuple)
+     for n in dir(t)]
+    + ['append', 'appendleft', 'popleft', 'acquire', 'release', 'wait',
+       'wait_for', 'notify', 'notify_all', 'locked', 'put', 'get_nowait',
+       'write', 'read', 'close', 'flush', 'start', 'cancel', 'set',
+       'is_set', 'submit', 'step', 'run', 'stop', 'stats', 'snapshot',
+       'emit', 'observe', 'inc', 'dec', 'labels', 'value', 'mark'])
+
+# -- runtime-edge artifact wiring (CLI --runtime-edges / env var) -----------
+_runtime_edges_path: List[Optional[str]] = [None]
+
+
+def set_runtime_edges_path(path: Optional[str]):
+    """CLI hook: point the pass at an `export_edges` JSON artifact."""
+    _runtime_edges_path[0] = path
+
+
+def runtime_edges_path() -> Optional[str]:
+    if _runtime_edges_path[0]:
+        return _runtime_edges_path[0]
+    return os.environ.get('PADDLE_LINT_RUNTIME_EDGES') or None
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -44,41 +86,88 @@ def _self_attr(node: ast.AST) -> Optional[str]:
     return None
 
 
-class _ClassInfo:
-    def __init__(self, node: ast.ClassDef):
+class _Func:
+    """One analyzed function/method and what it does with locks."""
+
+    __slots__ = ('module', 'cls', 'name', 'sf', 'node', 'acquires',
+                 'acq_under', 'calls', 'calls_under', 'locks_all')
+
+    def __init__(self, module: str, cls: Optional[str], name: str,
+                 sf: SourceFile, node: ast.AST):
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.sf = sf
         self.node = node
-        self.locks: Dict[str, str] = {}        # attr -> ctor kind
-        # (held_lock, acquired_lock) -> witness node
-        self.edges: Dict[Tuple[str, str], ast.AST] = {}
-        self.reentry: List[Tuple[str, ast.AST]] = []
-        # method -> set of locks it acquires anywhere
-        self.method_locks: Dict[str, Set[str]] = {}
-        # (held_locks, callee, witness) deferred for one-hop resolution
-        self.calls_under_lock: List[Tuple[Tuple[str, ...], str, ast.AST]] = []
-        # attr -> list of (held_locks frozenset, method, witness)
+        self.acquires: Set[str] = set()          # direct, own body only
+        # (held tuple, lock node, With node)
+        self.acq_under: List[Tuple[Tuple[str, ...], str, ast.AST]] = []
+        self.calls: List[Tuple[str, str]] = []   # (kind, name)
+        # (held tuple, (kind, name), Call node)
+        self.calls_under: List[
+            Tuple[Tuple[str, ...], Tuple[str, str], ast.AST]] = []
+        self.locks_all: Set[str] = set()         # transitive closure
+
+    @property
+    def qual(self) -> str:
+        return (f'{self.module}::{self.cls}.{self.name}' if self.cls
+                else f'{self.module}::{self.name}')
+
+
+class _ClassInfo:
+    def __init__(self, module: str, node: ast.ClassDef,
+                 sf: Optional[SourceFile] = None):
+        self.module = module
+        self.node = node
+        self.sf = sf
+        self.locks: Dict[str, str] = {}          # attr -> ctor kind
+        # attr -> list of (held frozenset, method name, witness node)
         self.writes: Dict[str, List[Tuple[frozenset, str, ast.AST]]] = {}
 
 
-@register_pass
-class LockOrderPass(AnalysisPass):
-    name = 'lock-order'
-    description = ('lock-acquisition cycles across `with self._lock` '
-                   'sites, re-entry on non-reentrant locks, and fields '
-                   'written both with and without their lock')
+class _Program:
+    """Whole-target-set model: every lock, every function, one graph."""
 
-    def visit_file(self, sf: SourceFile) -> List[Finding]:
-        findings: List[Finding] = []
+    def __init__(self):
+        self.files: List[SourceFile] = []
+        self.classes: Dict[Tuple[str, str], _ClassInfo] = {}
+        self.module_locks: Dict[str, Dict[str, str]] = {}  # mod -> var->kind
+        self.funcs: Dict[Tuple[str, Optional[str], str], _Func] = {}
+        self.by_name: Dict[str, List[_Func]] = {}
+        self.imports: Dict[str, Dict[str, str]] = {}   # mod -> name->mod
+        self.lock_kinds: Dict[str, str] = {}           # node -> ctor kind
+        self.reentries: List[Tuple[str, ast.AST, SourceFile]] = []
+
+    # -- collection ----------------------------------------------------
+    def collect(self, sf: SourceFile):
+        self.files.append(sf)
+        module = os.path.splitext(os.path.basename(sf.rel))[0]
+        mlocks = self.module_locks.setdefault(module, {})
+        imports = self.imports.setdefault(module, {})
+        for stmt in sf.tree.body:
+            if isinstance(stmt, ast.ImportFrom) and stmt.module:
+                src = stmt.module.rsplit('.', 1)[-1]
+                for alias in stmt.names:
+                    imports[alias.asname or alias.name] = src
+            elif isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                seg = _util.last_segment(_util.call_name(stmt.value))
+                if seg in _LOCK_CTORS:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            mlocks[t.id] = seg
+                            self.lock_kinds[f'{module}.{t.id}'] = seg
         for node in ast.walk(sf.tree):
             if isinstance(node, ast.ClassDef):
-                info = self._analyze_class(node)
-                if info.locks:
-                    findings.extend(self._report(sf, info))
-        return findings
+                self._collect_class(sf, module, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and isinstance(getattr(node, 'parent', None),
+                                   ast.Module):
+                self._add_func(sf, module, None, node)
 
-    # -- per-class analysis -------------------------------------------------
-
-    def _analyze_class(self, cls: ast.ClassDef) -> _ClassInfo:
-        info = _ClassInfo(cls)
+    def _collect_class(self, sf: SourceFile, module: str,
+                       cls: ast.ClassDef):
+        info = _ClassInfo(module, cls, sf)
         methods = [n for n in cls.body
                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
         for m in methods:
@@ -92,120 +181,273 @@ class LockOrderPass(AnalysisPass):
                 if seg in _LOCK_CTORS:
                     for a in attrs:
                         info.locks[a] = seg
-        if not info.locks:
-            return info
+                        self.lock_kinds[f'{cls.name}.{a}'] = seg
+        self.classes[(module, cls.name)] = info
         for m in methods:
-            acquired: Set[str] = set()
-            self._walk_method(info, m, m.body, (), acquired,
-                              in_init=(m.name == '__init__'))
-            info.method_locks[m.name] = acquired
-        # one-hop interprocedural: call under lock -> callee's locks
-        for held, callee, witness in info.calls_under_lock:
-            for lk in info.method_locks.get(callee, ()):
-                for h in held:
-                    if h != lk:
-                        info.edges.setdefault((h, lk), witness)
-                    elif info.locks.get(lk) == 'Lock':
-                        info.reentry.append((lk, witness))
-        return info
+            self._add_func(sf, module, cls.name, m, info)
 
-    def _walk_method(self, info: _ClassInfo, method, body,
-                     held: Tuple[str, ...], acquired: Set[str],
-                     in_init: bool):
+    def _add_func(self, sf: SourceFile, module: str, cls: Optional[str],
+                  node, info: Optional[_ClassInfo] = None):
+        f = _Func(module, cls, node.name, sf, node)
+        self.funcs[(module, cls, node.name)] = f
+        self.by_name.setdefault(node.name, []).append(f)
+        self._walk(f, info, node.body, (),
+                   in_init=(cls is not None and node.name == '__init__'))
+
+    def _walk(self, f: _Func, info: Optional[_ClassInfo], body,
+              held: Tuple[str, ...], in_init: bool):
         for node in body:
-            self._walk_stmt(info, method, node, held, acquired, in_init)
+            self._walk_stmt(f, info, node, held, in_init)
 
-    def _walk_stmt(self, info: _ClassInfo, method, node,
-                   held: Tuple[str, ...], acquired: Set[str],
-                   in_init: bool):
+    def _walk_stmt(self, f: _Func, info: Optional[_ClassInfo], node,
+                   held: Tuple[str, ...], in_init: bool):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            # separate execution context: no lock assumed held
-            self._walk_method(info, method, node.body, (), acquired,
-                              in_init)
+            # separate execution context: a closure may run on another
+            # thread; its acquisitions are not the definer's either
+            nested = _Func(f.module, f.cls, node.name, f.sf, node)
+            self._walk(nested, info, node.body, (), in_init)
             return
         if isinstance(node, ast.With):
             new_held = held
             for item in node.items:
-                attr = _self_attr(item.context_expr)
-                if attr in info.locks:
-                    acquired.add(attr)
-                    if attr in new_held and info.locks[attr] == 'Lock':
-                        info.reentry.append((attr, node))
-                    for h in new_held:
-                        if h != attr:
-                            info.edges.setdefault((h, attr), node)
-                    new_held = new_held + (attr,)
-            self._walk_method(info, method, node.body, new_held, acquired,
-                              in_init)
+                lock = self._lock_node(f, info, item.context_expr)
+                if lock is None:
+                    continue
+                f.acquires.add(lock)
+                if lock in new_held \
+                        and self.lock_kinds.get(lock) == 'Lock':
+                    self.reentries.append((lock, node, f.sf))
+                f.acq_under.append((new_held, lock, node))
+                new_held = new_held + (lock,)
+            self._walk(f, info, node.body, new_held, in_init)
             return
-        # record attr writes + calls, then recurse through control flow
-        if not in_init:
+        if info is not None and not in_init:
             for a in _util.assigned_attr_names(node):
                 if a not in info.locks:
                     info.writes.setdefault(a, []).append(
-                        (frozenset(held), method.name, node))
+                        (frozenset(held), f.name, node))
         for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.Call) and held:
-                func = child.func
-                if isinstance(func, ast.Attribute):
-                    callee_self = _self_attr(func)
-                    if callee_self:
-                        info.calls_under_lock.append(
-                            (held, callee_self, child))
-            self._walk_stmt(info, method, child, held, acquired, in_init)
+            if isinstance(child, ast.Call):
+                ref = self._callee_ref(child.func)
+                if ref is not None:
+                    f.calls.append(ref)
+                    if held:
+                        f.calls_under.append((held, ref, child))
+            self._walk_stmt(f, info, child, held, in_init)
 
-    # -- reporting ----------------------------------------------------------
+    def _lock_node(self, f: _Func, info: Optional[_ClassInfo],
+                   expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None:
+            if info is not None and attr in info.locks:
+                return f'{info.node.name}.{attr}'
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks.get(f.module, ()):
+                return f'{f.module}.{expr.id}'
+        return None
 
-    def _report(self, sf: SourceFile, info: _ClassInfo) -> List[Finding]:
+    @staticmethod
+    def _callee_ref(func: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == 'self':
+                return ('self', func.attr)
+            return ('attr', func.attr)
+        if isinstance(func, ast.Name):
+            return ('bare', func.id)
+        return None
+
+    # -- resolution + closure ------------------------------------------
+    def _resolve(self, f: _Func, ref: Tuple[str, str]) -> List[_Func]:
+        kind, name = ref
+        if kind == 'self' and f.cls is not None:
+            g = self.funcs.get((f.module, f.cls, name))
+            return [g] if g is not None else []
+        if kind == 'bare':
+            g = self.funcs.get((f.module, None, name))
+            if g is not None:
+                return [g]
+            src = self.imports.get(f.module, {}).get(name)
+            if src is not None:
+                g = self.funcs.get((src, None, name))
+                return [g] if g is not None else []
+            return []
+        if kind == 'attr':
+            if name in _ATTR_SKIP or name.startswith('__'):
+                return []
+            cands = [g for g in self.by_name.get(name, ())
+                     if g.locks_all]
+            # unique-name match only: ambiguity means no resolution (a
+            # wrong guess here turns into a phantom deadlock report)
+            return cands if len(cands) == 1 else []
+        return []
+
+    def close_over_calls(self):
+        """Fixed point: every function's transitive lock set. Monotone
+        (sets only grow), so it terminates; attr-resolution re-checks
+        uniqueness each round against the current estimate."""
+        for f in self.funcs.values():
+            f.locks_all = set(f.acquires)
+        changed = True
+        while changed:
+            changed = False
+            for f in self.funcs.values():
+                new = set(f.locks_all)
+                for ref in f.calls:
+                    for g in self._resolve(f, ref):
+                        new |= g.locks_all
+                if new != f.locks_all:
+                    f.locks_all = new
+                    changed = True
+
+
+@register_pass
+class LockOrderPass(AnalysisPass):
+    name = 'lock-order'
+    description = ('whole-program lock-acquisition graph (interprocedural'
+                   ' + runtime-observed edges): AB/BA cycles, re-entry on'
+                   ' non-reentrant locks, fields written both with and '
+                   'without their lock')
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        prog = _Program()
+        for sf in files:
+            prog.collect(sf)
+        prog.close_over_calls()
         findings: List[Finding] = []
-        cls = info.node.name
-        for cycle, witness in self._find_cycles(info.edges):
-            pretty = ' -> '.join(cycle + (cycle[0],))
-            findings.append(self.finding(
-                sf, witness,
-                f'lock-order cycle in {cls}: {pretty} — two paths take '
-                f'these locks in opposite orders; pick one global order '
-                f'or collapse to a single lock'))
-        for lk, witness in info.reentry:
-            findings.append(self.finding(
-                sf, witness,
-                f're-entry on non-reentrant {cls}.{lk} '
-                f'(threading.Lock) — self-deadlock; use RLock or '
-                f'restructure'))
-        for attr, writes in sorted(info.writes.items()):
-            locked = {lk for held, _, _ in writes for lk in held}
-            unlocked = [(m, w) for held, m, w in writes if not held]
-            if locked and unlocked:
-                m, w = unlocked[0]
-                findings.append(self.finding(
-                    sf, w,
-                    f'{cls}.{attr} is written under '
-                    f'{sorted(locked)} elsewhere but without a lock in '
-                    f'`{m}` — torn/racy writes; take the lock on every '
-                    f'write path'))
+
+        # direct re-entry witnessed while walking
+        reentries = list(prog.reentries)
+
+        # edges: (a, b) -> (witness sf, witness node, via)
+        edges: Dict[Tuple[str, str], Tuple[Optional[SourceFile],
+                                           Optional[ast.AST], str]] = {}
+        for f in prog.funcs.values():
+            for held, lock, node in f.acq_under:
+                for h in held:
+                    if h != lock:
+                        edges.setdefault((h, lock), (f.sf, node, 'static'))
+            for held, ref, node in f.calls_under:
+                targets = prog._resolve(f, ref)
+                for g in targets:
+                    for lk in g.locks_all:
+                        if lk in held:
+                            # same lock reached under itself: a certain
+                            # self-deadlock only when the call stays on
+                            # this object (self.*); for foreign objects
+                            # it may be a sibling instance's lock
+                            if ref[0] == 'self' \
+                                    and prog.lock_kinds.get(lk) == 'Lock':
+                                reentries.append((lk, node, f.sf))
+                            continue
+                        for h in held:
+                            if h != lk:
+                                edges.setdefault(
+                                    (h, lk), (f.sf, node, 'static'))
+
+        # merge runtime-observed edges (the sanitizer's JSON artifact)
+        runtime_nodes: Set[str] = set()
+        path = runtime_edges_path()
+        if path:
+            from ..runtime.concurrency import load_edges
+            for e in load_edges(path):
+                a, b = str(e['from']), str(e['to'])
+                runtime_nodes.update((a, b))
+                edges.setdefault((a, b), (None, None, 'runtime'))
+
+        for lock, node, sf in reentries:
+            findings.append(Finding(
+                pass_name=self.name, path=sf.rel,
+                line=getattr(node, 'lineno', 0),
+                col=getattr(node, 'col_offset', 0),
+                message=(f're-entry on non-reentrant {lock} '
+                         f'(threading.Lock) — self-deadlock; use RLock '
+                         f'or restructure'),
+                scope=_scope(node)))
+
+        findings.extend(self._cycle_findings(edges, path))
+        findings.extend(self._write_findings(prog))
         return findings
 
-    def _find_cycles(self, edges: Dict[Tuple[str, str], ast.AST]):
+    # -- cycles --------------------------------------------------------
+    def _cycle_findings(self, edges, artifact_path) -> List[Finding]:
         graph: Dict[str, Set[str]] = {}
         for (a, b) in edges:
             graph.setdefault(a, set()).add(b)
-        cycles: List[Tuple[Tuple[str, ...], ast.AST]] = []
+        findings: List[Finding] = []
         seen_canon: Set[Tuple[str, ...]] = set()
+
+        def emit(cycle: Tuple[str, ...]):
+            i = cycle.index(min(cycle))
+            canon = cycle[i:] + cycle[:i]
+            if canon in seen_canon:
+                return
+            seen_canon.add(canon)
+            pairs = list(zip(canon, canon[1:] + (canon[0],)))
+            vias = {edges[p][2] for p in pairs if p in edges}
+            witness = None
+            for p in pairs:
+                w = edges.get(p)
+                if w is not None and w[0] is not None:
+                    witness = w
+                    break
+            pretty = ' -> '.join(canon + (canon[0],))
+            note = (' (includes runtime-observed edges)'
+                    if 'runtime' in vias else '')
+            msg = (f'lock-order cycle: {pretty} — two paths take these '
+                   f'locks in opposite orders; pick one global order or '
+                   f'collapse to a single lock{note}')
+            if witness is not None:
+                sf, node, _ = witness
+                findings.append(Finding(
+                    pass_name=self.name, path=sf.rel,
+                    line=getattr(node, 'lineno', 0),
+                    col=getattr(node, 'col_offset', 0),
+                    message=msg, scope=_scope(node)))
+            else:
+                findings.append(Finding(
+                    pass_name=self.name,
+                    path=str(artifact_path or '<runtime-edges>'),
+                    line=0, col=0, message=msg, scope='<runtime>'))
 
         def dfs(start: str, cur: str, path: Tuple[str, ...]):
             for nxt in sorted(graph.get(cur, ())):
                 if nxt == start:
-                    cyc = path
-                    # canonical rotation so each cycle reports once
-                    i = cyc.index(min(cyc))
-                    canon = cyc[i:] + cyc[:i]
-                    if canon not in seen_canon:
-                        seen_canon.add(canon)
-                        cycles.append(
-                            (canon, edges[(cur, start)]))
+                    emit(path)
                 elif nxt not in path:
                     dfs(start, nxt, path + (nxt,))
 
         for node in sorted(graph):
             dfs(node, node, (node,))
-        return cycles
+        return findings
+
+    # -- per-class write discipline (unchanged semantics) --------------
+    def _write_findings(self, prog: _Program) -> List[Finding]:
+        findings: List[Finding] = []
+        for (module, cls), info in sorted(prog.classes.items()):
+            if not info.locks:
+                continue
+            sf = info.sf
+            if sf is None:
+                continue
+            for attr, writes in sorted(info.writes.items()):
+                locked = {lk for held, _, _ in writes for lk in held}
+                unlocked = [(m, w) for held, m, w in writes if not held]
+                if locked and unlocked:
+                    m, w = unlocked[0]
+                    findings.append(Finding(
+                        pass_name=self.name, path=sf.rel,
+                        line=getattr(w, 'lineno', 0),
+                        col=getattr(w, 'col_offset', 0),
+                        message=(
+                            f'{cls}.{attr} is written under '
+                            f'{sorted(locked)} elsewhere but without a '
+                            f'lock in `{m}` — torn/racy writes; take '
+                            f'the lock on every write path'),
+                        scope=_scope(w)))
+        return findings
+
+
+def _scope(node: ast.AST) -> str:
+    from ..core import enclosing_scope
+    return enclosing_scope(node)

@@ -1,0 +1,77 @@
+"""raw-lock: every lock allocation must go through the sanitized
+wrappers in `paddle_tpu.analysis.runtime.concurrency`.
+
+The runtime concurrency sanitizer only sees locks allocated through its
+`Lock`/`RLock`/`Condition` wrappers — a raw `threading.Lock()` is a
+blind spot in the acquisition graph AND in every `guarded_by` lockset.
+This pass flags raw allocations of the three wrapped primitives:
+
+- `threading.Lock()` / `threading.RLock()` / `threading.Condition()`
+  (any alias the module was imported under), and
+- bare `Lock()` / `RLock()` / `Condition()` when the file does
+  `from threading import ...` them.
+
+`threading.Event` / `Semaphore` / `Barrier` are signaling primitives,
+not mutual exclusion — the sanitizer has nothing to say about them, so
+they stay raw. Deliberate exceptions (the sanitizer's own internals
+wrap raw primitives) carry inline annotations::
+
+    _state_lock = threading.Lock()  # paddle-lint: disable=raw-lock -- <why>
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import AnalysisPass, Finding, SourceFile, register_pass
+from . import _util
+
+_PRIMITIVES = frozenset(('Lock', 'RLock', 'Condition'))
+
+
+@register_pass
+class RawLockPass(AnalysisPass):
+    name = 'raw-lock'
+    description = ('threading.Lock/RLock/Condition allocated raw instead '
+                   'of through the sanitized analysis.runtime.concurrency '
+                   'wrappers (annotated exceptions allowed)')
+
+    def visit_file(self, sf: SourceFile) -> List[Finding]:
+        threading_aliases: Set[str] = set()
+        from_imports = {}   # local name -> real primitive name
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == 'threading':
+                        threading_aliases.add(alias.asname or 'threading')
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == 'threading':
+                    for alias in node.names:
+                        if alias.name in _PRIMITIVES:
+                            from_imports[alias.asname or alias.name] = \
+                                alias.name
+        if not threading_aliases and not from_imports:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _util.call_name(node)
+            if name is None:
+                continue
+            hit = None
+            if '.' in name:
+                root, seg = name.split('.', 1)
+                if root in threading_aliases and seg in _PRIMITIVES:
+                    hit = seg
+            elif name in from_imports:
+                hit = from_imports[name]
+            if hit is not None:
+                findings.append(self.finding(
+                    sf, node,
+                    f'raw threading.{hit}() allocation — the runtime '
+                    f'concurrency sanitizer cannot see this lock; '
+                    f'allocate it via analysis.runtime.concurrency.'
+                    f'{hit}("Class.attr") (or annotate why it must '
+                    f'stay raw)'))
+        return findings

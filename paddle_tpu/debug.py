@@ -170,7 +170,7 @@ def _observability_data(max_rows: int = 10) -> dict:
     spans = reg.get('paddle_span_seconds')
     span_rows = []
     if spans is not None:
-        for key, child in sorted(spans._children.items(),
+        for key, child in sorted(spans.children(),
                                  key=lambda kv: -kv[1].sum)[:max_rows]:
             span_rows.append({
                 'name': key[0], 'calls': child.count,
@@ -314,7 +314,7 @@ def _router_data(reg) -> dict:
     fam = reg.get('paddle_router_breaker_state')
     out_fam = reg.get('paddle_router_outstanding_tokens')
     if fam is not None:
-        for (rid,), child in sorted(fam._children.items()):
+        for (rid,), child in sorted(fam.children()):
             outstanding = 0
             if out_fam is not None:
                 oc = out_fam._children.get((rid,))
@@ -330,7 +330,7 @@ def _router_data(reg) -> dict:
     outcomes: dict = {}
     req_fam = reg.get('paddle_router_requests_total')
     if req_fam is not None:
-        for (tenant, outcome), child in req_fam._children.items():
+        for (tenant, outcome), child in req_fam.children():
             outcomes[outcome] = outcomes.get(outcome, 0) + int(child.value)
     return {
         'replicas': int(reg.value('paddle_router_replicas')),
@@ -557,7 +557,7 @@ def _jit_cache_entries(reg) -> int:
     fam = reg.get('paddle_jit_cache_entries')
     if fam is None:
         return 0
-    return int(sum(c.value for c in fam._children.values()))
+    return int(fam.total())
 
 
 def _labeled_total(reg, name: str) -> float:
@@ -565,7 +565,7 @@ def _labeled_total(reg, name: str) -> float:
     fam = reg.get(name)
     if fam is None:
         return 0.0
-    return sum(c.value for c in fam._children.values())
+    return fam.total()
 
 
 def _hist_avg_ms(reg, name: str) -> float:

@@ -19,6 +19,7 @@ from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..analysis.runtime import concurrency as _concurrency
 from ..tensor import Tensor
 from . import native
 
@@ -529,10 +530,11 @@ class DataLoader:
         cap = self.num_workers * self.prefetch_factor
         n_batches = max(0, len(self.batch_sampler) - self._pending_skip)
         index_it = enumerate(self._index_batches())
-        lock = threading.Lock()
+        lock = _concurrency.Lock('DataLoader.index_lock')
         stop = threading.Event()
         results: dict = {}
-        results_cv = threading.Condition()
+        results_cv = _concurrency.Condition(
+            name='DataLoader.results_cv')
         # bound in-flight batches with a semaphore acquired BEFORE taking
         # an index (never block the insert — blocking the worker that
         # holds the batch the consumer is waiting on would deadlock)

@@ -9,16 +9,17 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from ..analysis.runtime import concurrency as _concurrency
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), 'csrc')
 _BUILD = os.path.join(_CSRC, 'build')
 _LIB_PATH = os.path.join(_BUILD, 'libpaddle_tpu_staging.so')
 
-_lock = threading.Lock()
+_lock = _concurrency.Lock('native._lock')
 _lib = None
 _tried = False
 

@@ -11,11 +11,11 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import List, Optional
 
 import numpy as np
 
+from ..analysis.runtime import concurrency as _concurrency
 from .tokenizer import BPETokenizer, _WORD_END
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -24,7 +24,7 @@ _BUILD = os.path.join(_CSRC, 'build')
 _LIB_PATH = os.path.join(_BUILD, 'libpaddle_tpu_fast_tokenizer.so')
 _SRC = os.path.join(_CSRC, 'fast_tokenizer.cpp')
 
-_lock = threading.Lock()
+_lock = _concurrency.Lock('fast_tokenizer._lock')
 _lib = None
 _tried = False
 

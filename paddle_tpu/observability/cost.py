@@ -31,11 +31,11 @@ like the `paddle_dispatch_*` metrics.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
+from ..analysis.runtime import concurrency as _concurrency
 
 # ---------------------------------------------------------------------------
 # roofline peaks: per-device-kind peak bf16 FLOP/s + HBM bandwidth.
@@ -378,7 +378,7 @@ class ProgramCatalog:
     """Registry of every named compiled program in the process."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _concurrency.RLock('ProgramCatalog._lock')
         self._records: Dict[str, ProgramRecord] = {}
 
     # -- enrollment ---------------------------------------------------------

@@ -21,6 +21,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from . import metrics as _metrics
+from ..analysis.runtime import concurrency as _concurrency
 
 # one process-wide clock origin so event timestamps from every thread /
 # subsystem land on a single comparable timeline
@@ -101,6 +102,10 @@ EVENT_SCHEMA: Dict[str, str] = {
         'dead mid-commit weight publisher detected; marker+tmp swept',
     'rollout_iteration':
         'one serve→score→train→publish→swap turn of the rollout loop',
+    # concurrency sanitizer (analysis/runtime/concurrency.py)
+    'sanitizer_violation': 'runtime concurrency sanitizer report: '
+                           'lock-order cycle, non-reentrant re-entry, '
+                           'or lockset race',
     # goodput-driven autoscaling (serving/autoscaler.py)
     'autoscale_up': 'autoscaler provisioned a replica (warm '
                     'program-store path) and joined it to the fleet',
@@ -123,7 +128,7 @@ class EventLog:
 
     def __init__(self, capacity: int = 8192):
         self._events: collections.deque = collections.deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = _concurrency.Lock('EventLog._lock')
         self._dropped = 0
         self._seq = 0
         self._listeners: List = []

@@ -35,11 +35,11 @@ import collections
 import json
 import os
 import tempfile
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from . import metrics as _metrics
+from ..analysis.runtime import concurrency as _concurrency
 
 # anomaly events that auto-trigger a dump (emitted by resilience/
 # serving/debug — see each site)
@@ -47,6 +47,7 @@ TRIGGER_EVENTS = frozenset((
     'hang_suspected', 'loss_spike', 'bad_step', 'skip_budget_exhausted',
     'serving_request_failed', 'checkpoint_corrupt',
     'router_failover_storm', 'donation_quarantined',
+    'sanitizer_violation',
 ))
 
 
@@ -59,7 +60,19 @@ def _default_dir() -> str:
 
 class FlightRecorder:
     """Bounded ring of recent step/memory samples + anomaly-triggered
-    postmortem dumps. Always on: recording is a deque append per step."""
+    postmortem dumps. Always on: recording is a deque append per step.
+
+    The rings are written by the training/serving thread and read by
+    whatever thread EMITTED the trigger event (a watchdog or scrape
+    thread dumping mid-run) — iterating a deque while another thread
+    appends raises "deque mutated during iteration", which is exactly
+    the postmortem dying mid-incident. Both rings are declared
+    `guarded_by('_lock')` so the concurrency sanitizer enforces the
+    discipline the hard-won fix below established: every access copies
+    or appends under the lock."""
+
+    _steps = _concurrency.guarded_by('_lock', mutable=True)
+    _memory = _concurrency.guarded_by('_lock', mutable=True)
 
     def __init__(self, capacity: int = 512,
                  min_interval_s: float = 60.0,
@@ -67,17 +80,17 @@ class FlightRecorder:
         self.capacity = int(capacity)
         self.min_interval_s = float(min_interval_s)
         self.dump_dir = dump_dir or _default_dir()
+        self._lock = _concurrency.Lock('FlightRecorder._lock')
         self._steps: collections.deque = collections.deque(maxlen=capacity)
         self._memory: collections.deque = collections.deque(
             maxlen=capacity)
-        self._lock = threading.Lock()
         self._last_dump_t: Optional[float] = None
         self._last_counters: Dict[str, float] = {}
         self._dumping = False
         self._n_dumps = 0
         self.dumps: List[str] = []
 
-    # -- recording (hot-ish path: one deque append per train step) ----------
+    # -- recording (hot-ish path: one locked deque append per step) ---------
     def record_step(self, loss=None, tokens_per_sec: Optional[float] = None,
                     step: Optional[int] = None):
         sample = {'t': time.time(), 'step': step}
@@ -85,10 +98,12 @@ class FlightRecorder:
             sample['loss'] = float(loss)
         if tokens_per_sec is not None:
             sample['tokens_per_sec'] = float(tokens_per_sec)
-        self._steps.append(sample)
+        with self._lock:
+            self._steps.append(sample)
 
     def record_memory(self, nbytes: int):
-        self._memory.append({'t': time.time(), 'bytes': int(nbytes)})
+        with self._lock:
+            self._memory.append({'t': time.time(), 'bytes': int(nbytes)})
 
     # -- triggering ---------------------------------------------------------
     def on_event(self, event: Dict[str, Any]):
@@ -126,8 +141,7 @@ class FlightRecorder:
         for name in ('paddle_program_cache_hits_total',
                      'paddle_program_cache_rejects_total'):
             fam = reg.get(name)
-            out[name] = (sum(c.value for c in fam._children.values())
-                         if fam is not None else 0.0)
+            out[name] = fam.total() if fam is not None else 0.0
         return out
 
     def dump(self, dir: Optional[str] = None, reason: str = 'manual',
@@ -152,12 +166,19 @@ class FlightRecorder:
             deltas = {k: v - self._last_counters.get(k, 0.0)
                       for k, v in counters.items()}
             self._last_counters = counters
+            with self._lock:
+                # copy under the lock: the train/serving thread keeps
+                # appending while this (listener) thread dumps — an
+                # unlocked list() dies with "deque mutated during
+                # iteration" exactly when the postmortem matters
+                steps = list(self._steps)
+                memory = list(self._memory)
             with open(os.path.join(path, 'flight.json'), 'w') as f:
                 json.dump({
                     'reason': reason, 'trigger': trigger,
                     'time': time.time(),
-                    'steps': list(self._steps),
-                    'memory': list(self._memory),
+                    'steps': steps,
+                    'memory': memory,
                     'counters': counters,
                     'counters_delta_since_last_dump': deltas,
                 }, f, indent=1, default=str)
@@ -221,8 +242,9 @@ class FlightRecorder:
                 self._dumping = False
 
     def clear(self):
-        self._steps.clear()
-        self._memory.clear()
+        with self._lock:
+            self._steps.clear()
+            self._memory.clear()
 
 
 _recorder = FlightRecorder()

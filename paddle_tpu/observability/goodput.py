@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from . import events as _events
 from . import metrics as _metrics
+from ..analysis.runtime import concurrency as _concurrency
 
 # the exhaustive, non-overlapping taxonomy (order = report order).
 # 'residual' is computed, not accumulated: wall - sum(attributed).
@@ -134,7 +135,7 @@ class GoodputLedger:
         # `is None`, not truthiness: an empty EventLog is falsy
         self._log = _events.get_event_log() if log is None else log
         self._map = dict(span_map or SPAN_CATEGORIES)
-        self._lock = threading.Lock()
+        self._lock = _concurrency.Lock('GoodputLedger._lock')
         self._seconds: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
         self._intervals: Dict[int, List[Tuple[float, float]]] = {}
         # tid -> seconds the most recent step-span attributed (the

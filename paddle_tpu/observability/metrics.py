@@ -27,11 +27,11 @@ from __future__ import annotations
 import bisect
 import collections
 import math
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import flags as _flags
+from ..analysis.runtime import concurrency as _concurrency
 
 _flags.register_flag('FLAGS_observability', True)
 
@@ -205,7 +205,7 @@ class SlidingWindow:
         self.window_s = float(window_s)
         self._clock = clock
         self._obs: collections.deque = collections.deque(maxlen=maxlen)
-        self._lock = threading.Lock()
+        self._lock = _concurrency.Lock('SlidingWindow._lock')
 
     def _prune(self, now: float):
         cutoff = now - self.window_s
@@ -293,6 +293,22 @@ class _Family:
                     self, key)
         return child
 
+    def children(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        """Locked snapshot of (label-values, child) pairs. Readers on
+        scrape/summary/listener threads iterate THIS, never `_children`
+        directly: `labels()` on another thread (a router scaling up
+        mints a new replica's gauge child mid-scrape) grows the dict,
+        and an unlocked iteration dies with "dictionary changed size
+        during iteration"."""
+        with self._registry._lock:
+            return list(self._children.items())
+
+    def total(self) -> float:
+        """Locked sum of every child's value (labeled counter/gauge
+        families; the headline-view aggregation)."""
+        with self._registry._lock:
+            return sum(c.value for c in self._children.values())
+
     def _sole(self):
         if self.labelnames:
             raise ValueError(
@@ -330,7 +346,7 @@ class _Family:
 
 class MetricsRegistry:
     def __init__(self, process_index: Optional[int] = None):
-        self._lock = threading.RLock()
+        self._lock = _concurrency.RLock('MetricsRegistry._lock')
         self._families: Dict[str, _Family] = {}
         self._collectors: List[Callable[['MetricsRegistry'], None]] = []
         self._process_index = process_index

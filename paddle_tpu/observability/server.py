@@ -37,8 +37,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..analysis.runtime import concurrency as _concurrency
+
 # -- liveness state (written by instrumented loops + the watchdog) ----------
-_live_lock = threading.Lock()
+_live_lock = _concurrency.Lock('server._live_lock')
 _progress: Dict[str, float] = {}        # kind -> monotonic ts of last beat
 _hangs: Dict[int, Dict[str, Any]] = {}  # watchdog id -> hang info
 # (scope, state) -> {'count': refs, 'info': context}. Ref-counted, NOT

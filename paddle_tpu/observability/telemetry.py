@@ -133,7 +133,7 @@ def collective_totals(reg: Optional['_metrics.MetricsRegistry'] = None
         fam = reg.get(metric)
         if fam is None:
             continue
-        for key, child in fam._children.items():
+        for key, child in fam.children():
             out[field] += child.value
             row = out['per_op'].setdefault(key, {'calls': 0.0, 'bytes': 0.0})
             row[field] += child.value

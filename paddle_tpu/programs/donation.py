@@ -53,12 +53,12 @@ import json
 import os
 import subprocess
 import sys
-import threading
 import time
 from typing import Any, Dict, Optional
 
 from .. import flags as _flags
 from .. import observability as _obs
+from ..analysis.runtime import concurrency as _concurrency
 
 _flags.register_flag('FLAGS_donation', 'auto')          # auto | on | off
 _flags.register_flag('FLAGS_donation_probe_runs', 8)
@@ -70,7 +70,7 @@ _VERDICT_VERSION = 1
 #: fingerprint-token -> verdict dict; one probe per process per runtime
 #: (test helpers reset this via `clear_cache()`)
 _PROC_VERDICTS: Dict[str, Dict[str, Any]] = {}
-_probe_lock = threading.Lock()
+_probe_lock = _concurrency.Lock('donation._probe_lock')
 
 
 def clear_cache():
@@ -191,7 +191,7 @@ def run_probe(runs: Optional[int] = None,
         proc = subprocess.run([sys.executable, '-c', _PROBE_SRC],
                               capture_output=True, text=True,
                               timeout=timeout, env=env)
-    except subprocess.TimeoutExpired:  # paddle-lint: disable=swallowed-exception -- the timeout IS the classification: a hung probe means a runtime we must not donate on
+    except subprocess.TimeoutExpired:
         verdict.update(verdict='corrupting',
                        reason=f'probe timed out after {timeout}s '
                               f'(single-client device? see the module '
@@ -425,7 +425,7 @@ def outputs_ok(out) -> bool:
             dt = getattr(leaf, 'dtype', None)
             if dt is None or not jnp.issubdtype(dt, jnp.floating):
                 continue
-            if not bool(np.asarray(jnp.isfinite(leaf).all())):  # paddle-lint: disable=host-sync -- the sentinel IS a deliberate bounded d2h: one bool per leaf for the first K donated calls
+            if not bool(np.asarray(jnp.isfinite(leaf).all())):
                 return False
     except Exception:
         # a sentinel that cannot even read the outputs is a trip: the

@@ -66,7 +66,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
@@ -75,6 +74,7 @@ import jax
 
 from .. import flags as _flags
 from .. import observability as _obs
+from ..analysis.runtime import concurrency as _concurrency
 from ..observability import cost as _cost
 from . import donation as _donation
 
@@ -354,7 +354,7 @@ class ProgramStore:
         # `is None`, not truthiness: these framework objects are falsy
         # when empty (the PR 10 EventLog rerouting bug class)
         self.catalog = catalog if catalog is not None else _cost.get_catalog()
-        self._lock = threading.RLock()
+        self._lock = _concurrency.RLock('ProgramStore._lock')
         self._mem: Dict[str, _StoreEntry] = {}
         self._dir = directory
         self._fingerprint = backend_fingerprint()
@@ -1142,7 +1142,7 @@ class StoredJit:
 
 
 _store: Optional[ProgramStore] = None
-_store_lock = threading.Lock()
+_store_lock = _concurrency.Lock('store._store_lock')
 
 
 def get_store() -> ProgramStore:

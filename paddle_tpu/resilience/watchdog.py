@@ -21,6 +21,7 @@ from typing import Callable, Optional, Union
 
 from .. import flags as _flags
 from .. import observability as _obs
+from ..analysis.runtime import concurrency as _concurrency
 
 
 class StepWatchdog:
@@ -44,7 +45,7 @@ class StepWatchdog:
         self.on_hang = on_hang
         self.poll = poll_interval if poll_interval is not None else \
             min(max(self.deadline / 4.0, 0.01), 1.0)
-        self._lock = threading.Lock()
+        self._lock = _concurrency.Lock('StepWatchdog._lock')
         self._armed_at: Optional[float] = None
         self._fired_this_arm = False
         self._stop = threading.Event()

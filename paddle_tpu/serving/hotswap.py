@@ -395,10 +395,10 @@ class WeightPublisher:
         src = self.source
         if callable(src) and not hasattr(src, 'state_dict') \
                 and not hasattr(src, 'capture_host_state'):
-            return _host_tree(src())  # paddle-lint: disable=host-sync -- the publish snapshot IS the d2h: weights must reach the store
+            return _host_tree(src())
         if hasattr(src, 'capture_host_state'):
             return dict(src.capture_host_state()['model'])
-        return _host_tree(src.state_dict())  # paddle-lint: disable=host-sync -- the publish snapshot IS the d2h: weights must reach the store
+        return _host_tree(src.state_dict())
 
     def publish(self, step: Optional[int] = None) -> int:
         """Snapshot + commit now; returns the new weight version."""

@@ -51,6 +51,7 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from .. import observability as _obs
+from ..analysis.runtime import concurrency as _concurrency
 from ..resilience.retry import is_transient
 from .api import (FAILED, FINISHED, PRIORITY_LOW, QUEUED, RequestHandle,
                   SamplingParams)
@@ -359,6 +360,14 @@ class Router:
             clock.
     """
 
+    # the replica map is mutated by scale actions (add_replica /
+    # remove_replica, possibly on an operator/autoscaler thread) and
+    # read per reap round and per stats() call (the /summary scrape
+    # thread) — declared to the concurrency sanitizer so any access
+    # outside `_lock` after the router is shared across threads is a
+    # lockset-race report
+    _by_id = _concurrency.guarded_by('_lock', mutable=True)
+
     def __init__(self, replicas, tenants=None, max_failovers: int = 2,
                  classify: Optional[Callable[[BaseException], bool]] = None,
                  shed_queue_depth: Optional[int] = None,
@@ -373,6 +382,10 @@ class Router:
             self.replicas = list(replicas)
         if not self.replicas:
             raise ValueError('router needs at least one replica')
+        # guards replica-set mutation (add/remove/drain) against the
+        # per-round reap reads and the stats()/scrape readers; RLock so
+        # a locked scale action may refresh gauges (which re-reads)
+        self._lock = _concurrency.RLock('Router._lock')
         self._by_id = {r.id: r for r in self.replicas}
         if isinstance(tenants, TenantRegistry):
             self.tenants = tenants
@@ -741,7 +754,8 @@ class Router:
                     and rh.inner.tokens):
                 rh._t_first = now
                 self._win_ttft.observe(now - rh._t_submit)
-            replica = self._by_id.get(rh.replica_id)
+            with self._lock:
+                replica = self._by_id.get(rh.replica_id)
             if rh._error is not None:
                 self._finalize(rh, 'failed')
             elif rh.inner is not None and rh.inner.status == FINISHED:
@@ -887,12 +901,14 @@ class Router:
         siblings so it resolves the identical ProgramStore keys (the
         warm scale-up path: it loads, not compiles). Returns the new
         Replica, immediately eligible for placement."""
-        rid = self._next_rid
-        self._next_rid += 1
-        r = Replica(rid, engine,
-                    CircuitBreaker(name=str(rid), **(breaker_kwargs or {})))
-        self.replicas.append(r)
-        self._by_id[rid] = r
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            r = Replica(rid, engine,
+                        CircuitBreaker(name=str(rid),
+                                       **(breaker_kwargs or {})))
+            self.replicas.append(r)
+            self._by_id[rid] = r
         if _obs.enabled():
             self._m_replicas.set(len(self.replicas))
             self._refresh_gauges()
@@ -905,17 +921,18 @@ class Router:
         accepted work — removal must never drop a request — and clears
         the replica's scoped `draining` health state so /healthz
         converges once the replica is gone."""
-        r = self._by_id[rid]
-        if r.engine.has_work:
-            raise RuntimeError(
-                f'replica {rid} still holds accepted work '
-                f'(queued={r.engine.scheduler.queue_depth}, '
-                f'in_flight={len(r.engine._slot_req)}); drain it before '
-                f'removing')
-        if len(self.replicas) <= 1:
-            raise RuntimeError('refusing to remove the last replica')
-        del self._by_id[rid]
-        self.replicas.remove(r)
+        with self._lock:
+            r = self._by_id[rid]
+            if r.engine.has_work:
+                raise RuntimeError(
+                    f'replica {rid} still holds accepted work '
+                    f'(queued={r.engine.scheduler.queue_depth}, '
+                    f'in_flight={len(r.engine._slot_req)}); drain it '
+                    f'before removing')
+            if len(self.replicas) <= 1:
+                raise RuntimeError('refusing to remove the last replica')
+            del self._by_id[rid]
+            self.replicas.remove(r)
         _obs.clear_degraded('draining', scope=r.scope, force=True)
         if _obs.enabled():
             self._m_replicas.set(len(self.replicas))
@@ -927,7 +944,8 @@ class Router:
         restart / eviction). Its scoped `draining` state excludes it
         from placement immediately; router steps keep driving its
         accepted requests to completion. Returns the replica."""
-        r = self._by_id[rid]
+        with self._lock:
+            r = self._by_id[rid]
         r.engine.begin_drain()
         return r
 
@@ -948,7 +966,11 @@ class Router:
         """Router-level counters + a per-replica health/load snapshot
         (the chaos tests' 'none dangle' assertions read this)."""
         per_replica = []
-        for r in self.replicas:
+        # snapshot under the fleet lock: stats() runs on scrape threads
+        # while add_replica/remove_replica resize the list
+        with self._lock:
+            replicas = list(self.replicas)
+        for r in replicas:
             per_replica.append({
                 'id': r.id,
                 'breaker': r.breaker.state,

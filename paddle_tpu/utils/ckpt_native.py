@@ -15,10 +15,11 @@ import ctypes
 import json
 import os
 import subprocess
-import threading
 from typing import Dict, List, Tuple
 
 import numpy as np
+
+from ..analysis.runtime import concurrency as _concurrency
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), 'csrc')
@@ -26,7 +27,7 @@ _BUILD = os.path.join(_CSRC, 'build')
 _LIB_PATH = os.path.join(_BUILD, 'libpaddle_tpu_ckpt.so')
 _SRC = os.path.join(_CSRC, 'ckpt_sharder.cpp')
 
-_lock = threading.Lock()
+_lock = _concurrency.Lock('ckpt_native._lock')
 _lib = None
 _tried = False
 

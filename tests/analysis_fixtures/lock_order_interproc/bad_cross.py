@@ -1,0 +1,103 @@
+"""True-positive fixtures for the WHOLE-PROGRAM lock-order pass (parsed
+only): cycles and re-entries the old per-class one-hop analysis could
+not see."""
+import threading
+
+_flush_lock = threading.Lock()
+
+
+# snippet 1: cross-CLASS AB/BA — each class is individually consistent,
+# the cycle only exists on the interprocedural graph
+class Ledger:
+    def __init__(self, journal):
+        self._ledger_lock = threading.Lock()
+        self._journal = journal
+
+    def post(self):
+        with self._ledger_lock:
+            return self._journal.record_entry()
+
+    def audit_one(self):
+        with self._ledger_lock:
+            return 1
+
+
+class Journal:
+    def __init__(self, ledger):
+        self._journal_lock = threading.Lock()
+        self._ledger = ledger
+
+    def record_entry(self):
+        with self._journal_lock:
+            return 1
+
+    def reconcile(self):
+        with self._journal_lock:
+            return self._ledger.audit_one()
+
+
+# snippet 2: TWO-hop transitive cycle — the middle helper takes no lock
+# itself, so one-hop interprocedural analysis sees nothing
+class TwoHop:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def a_then_b(self):
+        with self._alock:
+            return self._middle()
+
+    def _middle(self):
+        return self._deep_b()
+
+    def _deep_b(self):
+        with self._block:
+            return 1
+
+    def b_then_a(self):
+        with self._block:
+            with self._alock:
+                return 2
+
+
+# snippet 3: module-level lock in the cycle — a module function holding
+# the module lock calls into a class that calls back out
+class Spooler:
+    def __init__(self):
+        self._spool_lock = threading.Lock()
+
+    def push_item(self):
+        with self._spool_lock:
+            return 1
+
+    def drain_spool(self):
+        with self._spool_lock:
+            return flush_all()
+
+
+def flush_all():
+    with _flush_lock:
+        return 1
+
+
+def flush_then_push(spooler):
+    with _flush_lock:
+        return spooler.push_item()
+
+
+# snippet 4: transitive re-entry on a non-reentrant Lock through a
+# helper chain (self-deadlock two calls deep)
+class DeepReentry:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            return self._mid()
+
+    def _mid(self):
+        return self._inner_locked()
+
+    def _inner_locked(self):
+        with self._lock:
+            return 1

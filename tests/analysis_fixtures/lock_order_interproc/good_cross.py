@@ -1,0 +1,98 @@
+"""True-negative fixtures for the whole-program lock-order pass:
+cross-class and transitive locking that keeps ONE global order, plus
+the resolution traps that must not produce phantom edges."""
+import threading
+
+_flush_lock = threading.Lock()
+
+
+# snippet 1: cross-class calls in ONE consistent order (ledger before
+# journal on every path) — no cycle
+class Ledger:
+    def __init__(self, journal):
+        self._ledger_lock = threading.Lock()
+        self._journal = journal
+
+    def post(self):
+        with self._ledger_lock:
+            return self._journal.record_entry()
+
+    def settle(self):
+        with self._ledger_lock:
+            return self._journal.record_entry()
+
+
+class Journal:
+    def __init__(self):
+        self._journal_lock = threading.Lock()
+
+    def record_entry(self):
+        with self._journal_lock:
+            return 1
+
+
+# snippet 2: two-hop transitive chain, same order everywhere
+class TwoHopOk:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def a_then_b(self):
+        with self._alock:
+            return self._middle()
+
+    def _middle(self):
+        return self._deep_b()
+
+    def _deep_b(self):
+        with self._block:
+            return 1
+
+    def also_a_then_b(self):
+        with self._alock:
+            with self._block:
+                return 2
+
+
+# snippet 3: builtin container-method names must not alias real
+# methods — `self._events.append(...)` under the lock is a deque, not
+# Buffer.append, so there is no re-entry here
+class Buffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+
+    def append(self, item):
+        with self._lock:
+            self._events.append(item)
+
+
+# snippet 4: transitive re-entry on an RLock is legal by construction
+class ReentrantChain:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            return self._mid()
+
+    def _mid(self):
+        return self._inner_locked()
+
+    def _inner_locked(self):
+        with self._lock:
+            return 1
+
+
+# snippet 5: a closure defined under a held lock runs elsewhere — its
+# acquisitions are not the definer's, so no edge and no re-entry
+class ClosureFactory:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def make_callback(self):
+        with self._lock:
+            def callback():
+                with self._lock:
+                    return 1
+            return callback

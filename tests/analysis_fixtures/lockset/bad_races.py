@@ -1,0 +1,103 @@
+"""True-positive fixtures for the RUNTIME lockset checker: three
+deliberately racy `@guarded_by` access patterns, each driven under an
+injected deterministic schedule (event hand-off, no timing luck).
+
+Unlike the static fixture files, this module is EXECUTED by the fixture
+harness: `run_scenarios()` runs with the sanitizer in report mode and
+must produce >=3 distinct `lockset_race` reports (one per scenario's
+field)."""
+import threading
+
+from paddle_tpu.analysis.runtime import concurrency
+
+
+class RacyCounter:
+    """Scenario 1: the classic unguarded increment — thread B bumps the
+    counter without the lock after thread A shared it properly."""
+
+    count = concurrency.guarded_by('_lock')
+
+    def __init__(self):
+        self._lock = concurrency.Lock('RacyCounter._lock')
+        self.count = 0
+
+
+class RacyFlag:
+    """Scenario 2: locked writer, UNLOCKED reader — a read is enough to
+    empty the lockset once a write was ever involved."""
+
+    flag = concurrency.guarded_by('_lock')
+
+    def __init__(self):
+        self._lock = concurrency.Lock('RacyFlag._lock')
+        self.flag = False
+
+
+class RacyRing:
+    """Scenario 3: a mutable container touched without the lock —
+    `mutable=True` counts container reads as writes."""
+
+    ring = concurrency.guarded_by('_lock', mutable=True)
+
+    def __init__(self):
+        self._lock = concurrency.Lock('RacyRing._lock')
+        self.ring = []
+
+
+def _handoff(first, then):
+    """Deterministic two-thread schedule: `first()` completes on thread
+    A before `then()` starts on thread B."""
+    done = threading.Event()
+
+    def a():
+        first()
+        done.set()
+
+    def b():
+        done.wait()
+        then()
+
+    ta, tb = threading.Thread(target=a), threading.Thread(target=b)
+    ta.start()
+    tb.start()
+    ta.join()
+    tb.join()
+
+
+def run_scenarios() -> int:
+    c = RacyCounter()
+    _handoff(lambda: _locked_inc(c), lambda: _unlocked_inc(c))
+
+    g = RacyFlag()
+    _handoff(lambda: _locked_set(g), lambda: _unlocked_read(g))
+
+    r = RacyRing()
+    _handoff(lambda: _locked_push(r), lambda: _unlocked_push(r))
+    return 3
+
+
+def _locked_inc(c):
+    with c._lock:
+        c.count += 1
+
+
+def _unlocked_inc(c):
+    c.count += 1          # BAD: no lock after the object went shared
+
+
+def _locked_set(g):
+    with g._lock:
+        g.flag = True
+
+
+def _unlocked_read(g):
+    return g.flag         # BAD: unlocked read of a written field
+
+
+def _locked_push(r):
+    with r._lock:
+        r.ring.append(1)
+
+
+def _unlocked_push(r):
+    r.ring.append(2)      # BAD: container mutation without the lock

@@ -1,0 +1,80 @@
+"""True-negative fixtures for the RUNTIME lockset checker: the same
+shapes as bad_races.py with the locking discipline intact, plus the
+two patterns that look racy but are not (init warmup, read-only
+sharing). `run_scenarios()` must produce ZERO lockset reports."""
+import threading
+
+from paddle_tpu.analysis.runtime import concurrency
+
+
+class GuardedCounter:
+    count = concurrency.guarded_by('_lock')
+
+    def __init__(self):
+        self._lock = concurrency.Lock('GuardedCounter._lock')
+        # init warmup: pre-sharing writes without the lock are setup,
+        # not races
+        self.count = 0
+
+
+class GuardedRing:
+    ring = concurrency.guarded_by('_lock', mutable=True)
+
+    def __init__(self):
+        self._lock = concurrency.Lock('GuardedRing._lock')
+        self.ring = []
+
+
+class FrozenConfig:
+    """Written once during init, then only READ from other threads —
+    no write after sharing means no race, lock or not."""
+
+    value = concurrency.guarded_by('_lock')
+
+    def __init__(self):
+        self._lock = concurrency.Lock('FrozenConfig._lock')
+        self.value = 42
+
+
+def _handoff(first, then):
+    done = threading.Event()
+
+    def a():
+        first()
+        done.set()
+
+    def b():
+        done.wait()
+        then()
+
+    ta, tb = threading.Thread(target=a), threading.Thread(target=b)
+    ta.start()
+    tb.start()
+    ta.join()
+    tb.join()
+
+
+def run_scenarios() -> int:
+    c = GuardedCounter()
+    _handoff(lambda: _locked_inc(c), lambda: _locked_inc(c))
+
+    r = GuardedRing()
+    _handoff(lambda: _locked_push(r, 1), lambda: _locked_push(r, 2))
+
+    f = FrozenConfig()
+    _handoff(lambda: _read_only(f), lambda: _read_only(f))
+    return 3
+
+
+def _locked_inc(c):
+    with c._lock:
+        c.count += 1
+
+
+def _locked_push(r, v):
+    with r._lock:
+        r.ring.append(v)
+
+
+def _read_only(f):
+    return f.value

@@ -1,0 +1,26 @@
+"""True-positive fixtures for raw-lock (parsed only): raw threading
+primitives the runtime sanitizer cannot see."""
+import threading
+from threading import Condition
+from threading import Lock as TLock
+
+
+# snippet 1: raw module-level lock
+_cache_lock = threading.Lock()
+
+
+# snippet 2: raw instance RLock
+class Registry:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+
+# snippet 3: raw Condition
+class Queue:
+    def __init__(self):
+        self._cv = Condition()
+
+
+# snippet 4: from-import alias
+def make_worker_lock():
+    return TLock()

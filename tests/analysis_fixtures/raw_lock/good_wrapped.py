@@ -1,0 +1,28 @@
+"""True-negative fixtures for raw-lock: sanitized wrappers, signaling
+primitives, and an annotated exception."""
+import threading
+
+from paddle_tpu.analysis.runtime import concurrency as _concurrency
+
+
+# snippet 1: sanitized module-level lock
+_cache_lock = _concurrency.Lock('good_wrapped._cache_lock')
+
+
+# snippet 2: sanitized instance locks + condition
+class Registry:
+    def __init__(self):
+        self._lock = _concurrency.RLock('Registry._lock')
+        self._cv = _concurrency.Condition(name='Registry._cv')
+
+
+# snippet 3: Event/Semaphore are signaling, not mutual exclusion — raw
+# is fine
+class Worker:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._slots = threading.Semaphore(4)
+
+
+# snippet 4: a justified raw lock carries its annotation
+_boot_lock = threading.Lock()  # paddle-lint: disable=raw-lock -- allocated before the sanitizer package imports

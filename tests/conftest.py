@@ -55,6 +55,38 @@ def _reset_span_state():
 
 
 @pytest.fixture
+def sanitizer_strict():
+    """Run the test under the runtime concurrency sanitizer in STRICT
+    mode (ISSUE 15): any lock-order cycle, non-reentrant re-entry, or
+    guarded-field lockset race raises ConcurrencySanitizerError at the
+    offending acquire/access — and even if a violation is swallowed by
+    a failover/retry path mid-test, the teardown assertion on the
+    violation counter still fails the test. The chaos gauntlets
+    (router failover storm, autoscaler thundering herd, hotswap
+    kill-mid-swap, donation sentinel trips) all opt in."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.analysis import runtime as _rt
+
+    reg = obs.get_registry()
+
+    def _total():
+        fam = reg.get('paddle_sanitizer_violations_total')
+        return fam.total() if fam is not None else 0.0
+
+    before = _total()
+    n_before = len(_rt.violations())
+    _rt.enable('strict')
+    try:
+        yield _rt
+    finally:
+        _rt.disable()
+    new = _rt.violations()[n_before:]
+    assert _total() == before and not new, (
+        'concurrency sanitizer reported violations during the '
+        f'gauntlet: {new}')
+
+
+@pytest.fixture
 def fleet_mesh():
     """Factory for a hybrid fleet mesh over the forced 8-device CPU
     platform: `fleet_mesh(dp=..., mp=..., pp=..., sp=...)` runs

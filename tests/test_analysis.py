@@ -31,7 +31,13 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 FIXTURES = ROOT / 'tests' / 'analysis_fixtures'
 
 ALL_PASSES = ('donation-path', 'falsy-guard', 'host-sync', 'lock-order',
-              'obs-schema', 'swallowed-exception', 'trace-hazard')
+              'obs-schema', 'raw-lock', 'swallowed-exception',
+              'trace-hazard')
+
+#: FIXTURE_SPECS entries whose "pass" is a RUNTIME checker: the fixture
+#: modules are EXECUTED under the report-mode sanitizer instead of
+#: parsed by a static pass
+RUNTIME_FIXTURE_PASSES = {'lockset'}
 
 
 def run_on(path, passes, baseline=None):
@@ -51,7 +57,7 @@ def write_module(tmp_path, text, name='scratch.py'):
 # ---------------------------------------------------------------------------
 
 class TestTreeCleanliness:
-    def test_registry_has_the_seven_passes(self):
+    def test_registry_has_the_eight_passes(self):
         assert set(core.registered_passes()) == set(ALL_PASSES)
 
     def test_full_tree_lints_clean_modulo_baseline(self):
@@ -110,6 +116,10 @@ FIXTURE_SPECS = [
     ('falsy-guard', 'falsy_guard/bad_falsy_or.py',
      'falsy_guard/good_is_none.py'),
     ('lock-order', 'lock_order/bad_locks.py', 'lock_order/good_locks.py'),
+    ('lock-order', 'lock_order_interproc/bad_cross.py',
+     'lock_order_interproc/good_cross.py'),
+    ('raw-lock', 'raw_lock/bad_raw.py', 'raw_lock/good_wrapped.py'),
+    ('lockset', 'lockset/bad_races.py', 'lockset/good_guarded.py'),
     ('swallowed-exception', 'swallowed_exception/bad_swallows.py',
      'swallowed_exception/good_handled.py'),
     ('obs-schema', 'obs_schema/bad_schema.py', 'obs_schema/good_schema.py'),
@@ -118,10 +128,37 @@ FIXTURE_SPECS = [
 ]
 
 
+def run_lockset_fixture(path):
+    """Execute a runtime-lockset fixture module's `run_scenarios()`
+    under the report-mode sanitizer; returns the lockset violations."""
+    import importlib.util
+
+    from paddle_tpu.analysis import runtime as rt
+    spec = importlib.util.spec_from_file_location(
+        f'_lockset_fixture_{path.stem}', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rt.reset()
+    rt.enable('report')
+    try:
+        mod.run_scenarios()
+        return rt.violations('lockset_race')
+    finally:
+        rt.disable()
+        rt.reset()
+
+
 class TestFixtureCorpus:
     @pytest.mark.parametrize('pass_name,bad,_good', FIXTURE_SPECS,
                              ids=[s[0] for s in FIXTURE_SPECS])
     def test_true_positives(self, pass_name, bad, _good):
+        if pass_name in RUNTIME_FIXTURE_PASSES:
+            violations = run_lockset_fixture(FIXTURES / bad)
+            fields = {v['field'] for v in violations}
+            assert len(fields) >= 3, (
+                f'{pass_name} caught only {sorted(fields)} of >=3 '
+                f'seeded races in {bad}')
+            return
         result = run_on(FIXTURES / bad, [pass_name])
         assert len(result.findings) >= 3, (
             f'{pass_name} found only {len(result.findings)} of >=3 '
@@ -132,6 +169,11 @@ class TestFixtureCorpus:
     @pytest.mark.parametrize('pass_name,_bad,good', FIXTURE_SPECS,
                              ids=[s[0] for s in FIXTURE_SPECS])
     def test_true_negatives(self, pass_name, _bad, good):
+        if pass_name in RUNTIME_FIXTURE_PASSES:
+            violations = run_lockset_fixture(FIXTURES / good)
+            assert not violations, (
+                f'{pass_name} false-positives: {violations}')
+            return
         result = run_on(FIXTURES / good, [pass_name])
         msgs = [f.render() for f in result.findings]
         assert not msgs, f'{pass_name} false-positives:\n' + '\n'.join(msgs)
@@ -145,6 +187,19 @@ class TestFixtureCorpus:
         assert 'lock-order cycle' in msgs
         assert 're-entry on non-reentrant' in msgs
         assert '_count' in msgs and 'without a lock' in msgs
+
+    def test_interprocedural_cycles_name_both_classes(self):
+        """The whole-program upgrade: cross-class, two-hop-transitive,
+        and module-lock cycles plus a transitive re-entry — each names
+        the exact lock nodes involved."""
+        result = run_on(FIXTURES / 'lock_order_interproc/bad_cross.py',
+                        ['lock-order'])
+        msgs = ' | '.join(f.message for f in result.findings)
+        assert 'Ledger._ledger_lock' in msgs and \
+            'Journal._journal_lock' in msgs
+        assert 'TwoHop._alock' in msgs and 'TwoHop._block' in msgs
+        assert 'bad_cross._flush_lock' in msgs       # module-level node
+        assert 're-entry on non-reentrant DeepReentry._lock' in msgs
 
 
 # ---------------------------------------------------------------------------
@@ -357,6 +412,95 @@ class TestCliContract:
         assert r.returncode == 0
         for name in ALL_PASSES:
             assert name in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# --stats subcommand: per-pass accounting + stale-suppression audit
+# ---------------------------------------------------------------------------
+
+class TestStatsAndStaleSuppressions:
+    def test_stats_clean_on_the_real_tree(self):
+        """The tree's own contract: every inline suppression still
+        silences a live finding (the inline mirror of the shrink-only
+        baseline rule) and the JSON carries per-pass counts."""
+        r = run_cli('--stats', '--format=json')
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc['clean'] is True
+        assert set(doc['passes']) == set(ALL_PASSES)
+        for row in doc['passes'].values():
+            assert set(row) == {'findings', 'grandfathered', 'suppressed',
+                                'baseline_entries', 'stale_suppressions'}
+        # the tree HAS live suppressions — the audit is not vacuous
+        assert sum(row['suppressed'] for row in doc['passes'].values()) > 0
+
+    def test_stale_suppression_fails_the_run(self, tmp_path):
+        p = write_module(tmp_path, '''
+            X = 1  # paddle-lint: disable=swallowed-exception -- nothing fires here
+        ''')
+        r = run_cli('--stats', '--no-baseline', str(p))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert 'STALE-SUPPRESSION' in r.stdout
+        assert 'swallowed-exception' in r.stdout
+
+    def test_unknown_pass_suppression_fails_the_run(self, tmp_path):
+        p = write_module(tmp_path, '''
+            X = 1  # paddle-lint: disable=swalloed-exceptoin -- typo
+        ''')
+        r = run_cli('--stats', '--no-baseline', str(p))
+        assert r.returncode == 1
+        assert 'unknown pass' in r.stdout
+
+    def test_docstring_examples_are_not_suppressions_nor_stale(
+            self, tmp_path):
+        """A suppression EXAMPLE inside a docstring neither silences
+        findings on its line nor trips the stale audit — comments are
+        found by tokenizing, not line-scanning."""
+        p = write_module(tmp_path, '''
+            """Docs showing the syntax:
+
+                x = y  # paddle-lint: disable=swallowed-exception -- example
+            """
+
+            def a():
+                try:
+                    return 1
+                except Exception:
+                    return 0
+        ''')
+        result = run_on(p, ['swallowed-exception'])
+        assert len(result.findings) == 1          # not suppressed
+        files = [core.SourceFile(p, root=p.parent)]
+        res = core.run_analysis(files=files, passes=['swallowed-exception'])
+        assert core.audit_suppressions(files, res) == []
+
+    def test_live_suppression_is_not_stale(self, tmp_path):
+        p = write_module(tmp_path, '''
+            def a():
+                try:
+                    return 1
+                except Exception:  # paddle-lint: disable=swallowed-exception -- fixture
+                    return 0
+
+            # paddle-lint: disable-file=falsy-guard -- no protected types here
+        ''')
+        files = [core.SourceFile(p, root=tmp_path)]
+        res = core.run_analysis(
+            files=files, passes=['swallowed-exception', 'falsy-guard'])
+        stale = core.audit_suppressions(files, res)
+        # the same-line one is live; the file-level falsy-guard one
+        # suppresses nothing -> stale
+        assert len(stale) == 1
+        assert stale[0]['pass'] == 'falsy-guard'
+        assert stale[0]['kind'] == 'disable-file'
+
+    def test_audit_skips_passes_that_did_not_run(self, tmp_path):
+        p = write_module(tmp_path, '''
+            X = 1  # paddle-lint: disable=trace-hazard -- judged only when the pass runs
+        ''')
+        files = [core.SourceFile(p, root=tmp_path)]
+        res = core.run_analysis(files=files, passes=['swallowed-exception'])
+        assert core.audit_suppressions(files, res) == []
 
 
 # ---------------------------------------------------------------------------

@@ -379,6 +379,14 @@ def _drive_to_min(scaler, router, deadline_s=30.0):
 
 
 class TestIntegration:
+    @pytest.fixture(autouse=True)
+    def _strict_sanitizer(self, sanitizer_strict):
+        """Thundering-herd + kill-mid-scale-up run under the runtime
+        concurrency sanitizer in strict mode (ISSUE 15): scale actions
+        mutate the replica set while signals/stats are read, which is
+        exactly the interleaving the sanitizer watches."""
+        yield
+
     def test_herd_scales_up_drains_back_zero_drops(self, gpt):
         marker = _seq_marker()
         trace = _herd_trace()

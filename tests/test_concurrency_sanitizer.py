@@ -1,0 +1,676 @@
+"""Runtime concurrency sanitizer (ISSUE 15): lockdep-style lock-order
+tracking + Eraser-style lockset race detection on the instrumented
+lock wrappers, wired into events/metrics/flight and the static
+lock-order pass via the runtime-edges artifact.
+
+Four layers:
+
+- seeded-race meta-tests: deliberately racy harnesses (AB/BA pair,
+  non-reentrant re-entry, unguarded counter) must produce their exact
+  violation `kind` DETERMINISTICALLY under injected thread schedules —
+  the detector's own TP proof; the disciplined twins prove TN;
+- the report machinery: `paddle_sanitizer_violations_total{kind}`,
+  `sanitizer_violation` events, flight-recorder trigger membership,
+  per-site dedup, strict-mode raises;
+- the runtime-edges JSON artifact round-trips into the static
+  lock-order pass (a runtime-observed BA edge closes a static AB edge
+  into a reported cycle);
+- regression tests for the two real races this PR fixed (flight
+  recorder dump vs record_step; router stats/scrape vs
+  add_replica/remove_replica), each reproducing the schedule with
+  injected barriers, plus the sanitizer's proof it would catch the
+  unfixed shape.
+"""
+import json
+import pathlib
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.analysis import core, runtime as rt
+from paddle_tpu.analysis.passes import lock_order
+from paddle_tpu.analysis.runtime import concurrency
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def report_mode():
+    """Fresh sanitizer state in report mode; off + clean afterwards."""
+    rt.reset()
+    rt.enable('report')
+    yield rt
+    rt.disable()
+    rt.reset()
+
+
+@pytest.fixture
+def strict_mode():
+    rt.reset()
+    rt.enable('strict')
+    yield rt
+    rt.disable()
+    rt.reset()
+
+
+def _handoff(first, then):
+    """Deterministic two-thread schedule: `first` completes on thread A
+    before `then` starts on thread B; both joined. Errors propagate."""
+    done = threading.Event()
+    errs = []
+
+    def a():
+        try:
+            first()
+        except BaseException as e:   # noqa: BLE001 - test harness
+            errs.append(e)
+        finally:
+            done.set()
+
+    def b():
+        done.wait()
+        try:
+            then()
+        except BaseException as e:
+            errs.append(e)
+
+    ta, tb = threading.Thread(target=a), threading.Thread(target=b)
+    ta.start()
+    tb.start()
+    ta.join()
+    tb.join()
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# wrapper surface: drop-in threading semantics
+# ---------------------------------------------------------------------------
+
+class TestWrapperSurface:
+    def test_lock_acquire_release_locked_and_context(self, report_mode):
+        lk = rt.Lock('T.lock1')
+        assert not lk.locked()
+        assert lk.acquire()
+        assert lk.locked()
+        lk.release()
+        with lk:
+            assert lk.locked()
+            assert lk.held_by_current_thread()
+        assert not lk.locked()
+
+    def test_nonblocking_acquire_failure_does_not_corrupt_held(
+            self, report_mode):
+        lk = rt.Lock('T.lock2')
+        grabbed = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lk:
+                grabbed.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        grabbed.wait(5)
+        assert lk.acquire(blocking=False) is False
+        assert not lk.held_by_current_thread()
+        release.set()
+        t.join()
+
+    def test_rlock_reentry_is_legal(self, report_mode):
+        rl = rt.RLock('T.rlock')
+        with rl:
+            with rl:
+                pass
+        assert not rt.violations()
+
+    def test_condition_wait_notify_across_threads(self, report_mode):
+        cv = rt.Condition(name='T.cv')
+        state = []
+
+        def producer():
+            with cv:
+                state.append(1)
+                cv.notify_all()
+
+        with cv:
+            t = threading.Thread(target=producer)
+            t.start()
+            assert cv.wait_for(lambda: state, timeout=5)
+        t.join()
+        assert state == [1]
+        assert not rt.violations()
+
+    def test_condition_rejects_raw_locks(self, report_mode):
+        with pytest.raises(TypeError):
+            rt.Condition(threading.Lock())
+
+    def test_off_mode_records_nothing(self):
+        rt.reset()
+        rt.disable()
+        a, b = rt.Lock('Off.a'), rt.Lock('Off.b')
+        with a:
+            with b:
+                pass
+        assert rt.observed_edges() == []
+        assert rt.stats()['edges'] == 0
+
+
+# ---------------------------------------------------------------------------
+# seeded-race meta-tests: each kind, deterministically
+# ---------------------------------------------------------------------------
+
+class TestSeededKinds:
+    def test_ab_ba_pair_reports_lock_order_cycle(self, report_mode):
+        """The AB/BA deadlock pair under an injected schedule: thread A
+        takes A->B and finishes; thread B then takes B->A. No actual
+        deadlock ever happens — the ORDER violation is the report,
+        exactly lockdep's power."""
+        for trial in range(3):      # deterministic across repeats
+            rt.reset()
+            la = rt.Lock(f'SeedA{trial}.lock')
+            lb = rt.Lock(f'SeedB{trial}.lock')
+
+            def ab():
+                with la:
+                    with lb:
+                        pass
+
+            def ba():
+                with lb:
+                    with la:
+                        pass
+
+            errs = _handoff(ab, ba)
+            assert not errs
+            vs = rt.violations(rt.KIND_LOCK_ORDER)
+            assert len(vs) == 1, vs
+            assert set(vs[0]['cycle']) == {la.name, lb.name}
+            assert vs[0]['witnesses'], 'cycle report must carry witnesses'
+
+    def test_reentry_raises_in_any_enabled_mode(self, report_mode):
+        """Re-entry on a non-reentrant Lock is a CERTAIN self-deadlock:
+        even report-only mode raises instead of hanging forever."""
+        lk = rt.Lock('SeedReentry.lock')
+        with pytest.raises(rt.ConcurrencySanitizerError) as ei:
+            with lk:
+                with lk:
+                    pass
+        assert ei.value.kind == rt.KIND_REENTRY
+        assert rt.violations(rt.KIND_REENTRY)
+        # the outer hold was released cleanly by the with-statement
+        assert not lk.locked()
+
+    def test_unguarded_increment_reports_lockset_race(self, report_mode):
+        """The classic unguarded counter: thread A increments under the
+        lock (shares the object), thread B increments bare. The empty
+        lockset intersection reports with BOTH access stacks."""
+        class Counter:
+            count = concurrency.guarded_by('_lock')
+
+            def __init__(self):
+                self._lock = rt.Lock('SeedCounter._lock')
+                self.count = 0
+
+        c = Counter()
+
+        def locked_inc():
+            with c._lock:
+                c.count += 1
+
+        def bare_inc():
+            c.count += 1
+
+        errs = _handoff(locked_inc, bare_inc)
+        assert not errs
+        vs = rt.violations(rt.KIND_LOCKSET)
+        assert len(vs) == 1, vs
+        v = vs[0]
+        assert v['field'] == 'Counter.count'
+        assert v['stack'], 'racing access stack missing'
+        assert v['other_access'] and v['other_access']['stack'], \
+            'previous access stack missing'
+        assert c.count == 2     # report-only: execution continued
+
+    def test_strict_mode_raises_on_cycle_and_lockset(self, strict_mode):
+        la, lb = rt.Lock('StrictA.lock'), rt.Lock('StrictB.lock')
+        with la:
+            with lb:
+                pass
+        with pytest.raises(rt.ConcurrencySanitizerError) as ei:
+            with lb:
+                with la:
+                    pass
+        assert ei.value.kind == rt.KIND_LOCK_ORDER
+
+        class Obj:
+            field = concurrency.guarded_by('_lock')
+
+            def __init__(self):
+                self._lock = rt.Lock('StrictObj._lock')
+                self.field = 0
+
+        o = Obj()
+        errs = _handoff(lambda: _locked_write(o), lambda: _bare_write(o))
+        assert len(errs) == 1
+        assert isinstance(errs[0], rt.ConcurrencySanitizerError)
+        assert errs[0].kind == rt.KIND_LOCKSET
+
+    def test_disciplined_twins_stay_silent(self, report_mode):
+        """TN proof: the same shapes with the discipline intact."""
+        la, lb = rt.Lock('CleanA.lock'), rt.Lock('CleanB.lock')
+
+        def ab():
+            with la:
+                with lb:
+                    pass
+
+        errs = _handoff(ab, ab)      # same order on both threads
+        assert not errs
+
+        class Counter:
+            count = concurrency.guarded_by('_lock')
+
+            def __init__(self):
+                self._lock = rt.Lock('CleanCounter._lock')
+                self.count = 0
+
+        c = Counter()
+
+        def locked_inc():
+            with c._lock:
+                c.count += 1
+
+        errs = _handoff(locked_inc, locked_inc)
+        assert not errs
+        # a guarded field means ALWAYS hold the guard — including this
+        # post-join read (Eraser has no happens-before for join())
+        with c._lock:
+            assert c.count == 2
+        assert rt.violations() == []
+
+
+def _locked_write(o):
+    with o._lock:
+        o.field = 1
+
+
+def _bare_write(o):
+    o.field = 2
+
+
+# ---------------------------------------------------------------------------
+# guarded_by mechanics
+# ---------------------------------------------------------------------------
+
+class TestGuardedByMechanics:
+    def test_single_thread_warmup_never_reports(self, report_mode):
+        class Obj:
+            f = concurrency.guarded_by('_lock')
+
+            def __init__(self):
+                self._lock = rt.Lock('WarmObj._lock')
+                self.f = 0      # init write, no lock: warmup
+
+        o = Obj()
+        for _ in range(5):
+            o.f += 1            # still single-threaded: fine
+        assert not rt.violations()
+
+    def test_read_only_sharing_never_reports(self, report_mode):
+        class Obj:
+            f = concurrency.guarded_by('_lock')
+
+            def __init__(self):
+                self._lock = rt.Lock('RoObj._lock')
+                self.f = 42
+
+        o = Obj()
+        errs = _handoff(lambda: o.f, lambda: o.f)
+        assert not errs
+        assert not rt.violations()
+
+    def test_access_before_assignment_raises_attribute_error(
+            self, report_mode):
+        class Obj:
+            f = concurrency.guarded_by('_lock')
+
+        with pytest.raises(AttributeError):
+            Obj().f
+
+    def test_class_access_returns_descriptor(self):
+        class Obj:
+            f = concurrency.guarded_by('_lock')
+
+        assert isinstance(Obj.f, concurrency.guarded_by)
+
+    def test_dedup_one_report_per_field(self, report_mode):
+        class Obj:
+            f = concurrency.guarded_by('_lock')
+
+            def __init__(self):
+                self._lock = rt.Lock('DedupObj._lock')
+                self.f = 0
+
+        o = Obj()
+
+        def bare_many():
+            for _ in range(10):
+                o.f += 1
+
+        errs = _handoff(lambda: _locked_f(o), bare_many)
+        assert not errs
+        assert len(rt.violations(rt.KIND_LOCKSET)) == 1
+
+
+def _locked_f(o):
+    with o._lock:
+        o.f += 1
+
+
+# ---------------------------------------------------------------------------
+# reporting machinery: metrics, events, flight trigger
+# ---------------------------------------------------------------------------
+
+class TestReporting:
+    def test_violation_increments_kind_metric_and_emits_event(
+            self, report_mode):
+        reg = obs.get_registry()
+        log = obs.get_event_log()
+        before = reg.value('paddle_sanitizer_violations_total',
+                           kind=rt.KIND_LOCK_ORDER)
+        n_events = len([e for e in log.events()
+                        if e.get('name') == 'sanitizer_violation'])
+        la, lb = rt.Lock('RepA.lock'), rt.Lock('RepB.lock')
+        with la:
+            with lb:
+                pass
+        with lb:
+            with la:
+                pass
+        after = reg.value('paddle_sanitizer_violations_total',
+                          kind=rt.KIND_LOCK_ORDER)
+        assert after == before + 1
+        events = [e for e in log.events()
+                  if e.get('name') == 'sanitizer_violation']
+        assert len(events) == n_events + 1
+        assert events[-1]['attrs']['kind'] == rt.KIND_LOCK_ORDER
+
+    def test_sanitizer_violation_is_declared_and_a_flight_trigger(self):
+        from paddle_tpu.observability import flight
+        assert 'sanitizer_violation' in obs.EVENT_SCHEMA
+        assert 'sanitizer_violation' in flight.TRIGGER_EVENTS
+
+    def test_stats_shape_and_mode_roundtrip(self, report_mode):
+        s = rt.stats()
+        assert s['mode'] == 'report'
+        assert set(s['violations']) == set(rt.KINDS)
+        rt.enable('strict')
+        assert rt.mode() == 'strict'
+        rt.enable('report')
+
+    def test_sanitized_context_manager_restores_mode(self):
+        rt.disable()
+        with concurrency.sanitized('strict'):
+            assert rt.mode() == 'strict'
+        assert rt.mode() == 'off'
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError):
+            rt.enable('loud')
+
+
+# ---------------------------------------------------------------------------
+# runtime-edges artifact -> static lock-order pass round trip
+# ---------------------------------------------------------------------------
+
+class TestRuntimeEdgesRoundTrip:
+    def test_export_load_round_trip(self, report_mode, tmp_path):
+        la, lb = rt.Lock('RtA.lock'), rt.Lock('RtB.lock')
+        with la:
+            with lb:
+                pass
+        p = tmp_path / 'edges.json'
+        rt.export_edges(str(p))
+        edges = rt.load_edges(str(p))
+        assert {'from': 'RtA.lock', 'to': 'RtB.lock'} == \
+            {k: edges[0][k] for k in ('from', 'to')}
+        assert edges[0]['stack']
+        doc = json.loads(p.read_text())
+        assert doc['version'] == 1
+
+    def test_malformed_artifact_rejected(self, tmp_path):
+        p = tmp_path / 'bad.json'
+        p.write_text('{"edges": "nope"}')
+        with pytest.raises(ValueError):
+            rt.load_edges(str(p))
+        p.write_text('{"edges": [{"from": "a"}]}')
+        with pytest.raises(ValueError):
+            rt.load_edges(str(p))
+
+    def test_runtime_ba_edge_closes_static_ab_into_a_cycle(
+            self, tmp_path):
+        """The acceptance round trip: the static pass alone sees only
+        A->B (clean); merged with a runtime-observed B->A edge whose
+        node names match the static derivation, the cycle reports and
+        names its runtime provenance."""
+        mod = tmp_path / 'scratch_locks.py'
+        mod.write_text(textwrap.dedent('''
+            import threading
+
+            class Scratch:
+                def __init__(self):
+                    self.lock_a = threading.Lock()
+                    self.lock_b = threading.Lock()
+
+                def a_then_b(self):
+                    with self.lock_a:
+                        with self.lock_b:
+                            return 1
+        '''))
+        files = [core.SourceFile(mod, root=tmp_path)]
+        clean = core.run_analysis(files=files, passes=['lock-order'])
+        assert not clean.findings
+
+        artifact = tmp_path / 'edges.json'
+        artifact.write_text(json.dumps({
+            'version': 1,
+            'edges': [{'from': 'Scratch.lock_b', 'to': 'Scratch.lock_a',
+                       'thread': 'MainThread(1)', 'stack': []}]}))
+        lock_order.set_runtime_edges_path(str(artifact))
+        try:
+            merged = core.run_analysis(files=files, passes=['lock-order'])
+        finally:
+            lock_order.set_runtime_edges_path(None)
+        msgs = [f.message for f in merged.findings]
+        assert len(msgs) == 1, msgs
+        assert 'lock-order cycle' in msgs[0]
+        assert 'runtime-observed' in msgs[0]
+        assert 'Scratch.lock_a' in msgs[0] and 'Scratch.lock_b' in msgs[0]
+
+    def test_live_observed_edges_feed_the_static_pass(
+            self, report_mode, tmp_path):
+        """End-to-end: really exercise instrumented runtime locks (the
+        observability layer under a live scrape), export the observed
+        graph, and point the pass at the artifact over the REAL
+        observability package — it must load, merge, and stay clean
+        (runtime-observed edges are consistent with the static
+        graph)."""
+        reg = obs.get_registry()
+        with obs.span('sanitizer.roundtrip.probe'):
+            reg.counter('paddle_steps_total').inc(0)
+        reg.snapshot()                   # collectors under the RLock
+        obs.get_event_log().events()
+        p = tmp_path / 'live_edges.json'
+        rt.export_edges(str(p))
+        lock_order.set_runtime_edges_path(str(p))
+        try:
+            result = core.run_analysis(
+                targets=[str(ROOT / 'paddle_tpu' / 'observability')],
+                passes=['lock-order'])
+        finally:
+            lock_order.set_runtime_edges_path(None)
+        assert not result.findings, [f.render() for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# regression tests: the two real races this PR fixed
+# ---------------------------------------------------------------------------
+
+class TestRaceRegressions:
+    def test_flight_dump_concurrent_with_record_step(
+            self, report_mode, tmp_path):
+        """PR-15 fix: FlightRecorder.dump copied its rings UNLOCKED
+        while the train thread appended — 'deque mutated during
+        iteration' killing the postmortem mid-incident. Barrier-aligned
+        writer+dumper now run clean, and the sanitizer (the rings are
+        `guarded_by('_lock')`) confirms every access held the lock."""
+        from paddle_tpu.observability.flight import FlightRecorder
+        rec = FlightRecorder(capacity=256, dump_dir=str(tmp_path))
+        for i in range(64):
+            rec.record_step(loss=0.1, step=i)    # warm the ring
+        barrier = threading.Barrier(2)
+        stop = threading.Event()
+        errs = []
+
+        def writer():
+            barrier.wait()
+            i = 0
+            while not stop.is_set():
+                try:
+                    rec.record_step(loss=0.5, tokens_per_sec=1.0, step=i)
+                    rec.record_memory(i)
+                except Exception as e:
+                    errs.append(e)
+                    return
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            barrier.wait()
+            for _ in range(2):
+                rec.dump(reason='manual')
+        except Exception as e:
+            errs.append(e)
+        finally:
+            stop.set()
+            t.join()
+        assert not errs
+        assert len(rec.dumps) == 2
+        bad = [v for v in rt.violations(rt.KIND_LOCKSET)
+               if 'FlightRecorder' in v['field']]
+        assert not bad, bad
+
+    def test_sanitizer_catches_the_unfixed_flight_shape(
+            self, report_mode, tmp_path):
+        """The detector's proof for THIS specific race: bypass the lock
+        the way the pre-fix code did (bare ring access from a second
+        thread) and the lockset checker must flag FlightRecorder's
+        guarded ring."""
+        from paddle_tpu.observability.flight import FlightRecorder
+        rec = FlightRecorder(capacity=64, dump_dir=str(tmp_path))
+        rec.record_step(loss=0.1, step=0)
+
+        def locked_write():
+            rec.record_step(loss=0.2, step=1)    # the fixed, locked path
+
+        def bare_read():
+            list(rec._steps)                     # the pre-fix dump shape
+
+        errs = _handoff(locked_write, bare_read)
+        assert not errs
+        bad = [v for v in rt.violations(rt.KIND_LOCKSET)
+               if v['field'] == 'FlightRecorder._steps']
+        assert len(bad) == 1, rt.violations()
+
+    def test_router_stats_concurrent_with_add_remove_replica(
+            self, report_mode):
+        """PR-15 fix: a stats()/scrape reader iterating the replica set
+        while add_replica/remove_replica resize it (the autoscaler
+        path). Barrier-aligned reader+resizer run clean; Router._by_id
+        is `guarded_by('_lock')` so the sanitizer confirms the lock
+        discipline on both sides."""
+        from paddle_tpu import debug
+        from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+        from paddle_tpu.serving import InferenceEngine, ReplicaSet, Router
+
+        paddle.seed(7)
+        gpt = GPTForCausalLM(GPTConfig.tiny()).eval()
+        router = Router(ReplicaSet(gpt, 1, num_slots=2, max_length=64,
+                                   decode_block=2))
+        spares = [InferenceEngine(gpt, num_slots=2, max_length=64,
+                                  decode_block=2) for _ in range(2)]
+        barrier = threading.Barrier(2)
+        stop = threading.Event()
+        errs = []
+
+        def reader():
+            barrier.wait()
+            while not stop.is_set():
+                try:
+                    router.stats()
+                    debug.observability_summary(as_dict=True)
+                except Exception as e:
+                    errs.append(e)
+                    return
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            barrier.wait()
+            for _ in range(8):
+                added = [router.add_replica(e) for e in spares]
+                for r in added:
+                    router.remove_replica(r.id)
+        except Exception as e:
+            errs.append(e)
+        finally:
+            stop.set()
+            t.join()
+        assert not errs, errs
+        assert len(router.replicas) == 1
+        bad = [v for v in rt.violations(rt.KIND_LOCKSET)
+               if 'Router' in v['field']]
+        assert not bad, bad
+
+    def test_sanitizer_catches_the_unfixed_router_shape(
+            self, report_mode):
+        """Bypass Router._lock the way pre-fix readers did: a bare
+        `_by_id` read from a second thread must report."""
+        from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+        from paddle_tpu.serving import ReplicaSet, Router
+
+        paddle.seed(7)
+        gpt = GPTForCausalLM(GPTConfig.tiny()).eval()
+        router = Router(ReplicaSet(gpt, 1, num_slots=2, max_length=64,
+                                   decode_block=2))
+
+        def locked_touch():
+            with router._lock:
+                router._by_id.get(0)
+
+        def bare_touch():
+            dict(router._by_id)                  # the pre-fix shape
+
+        errs = _handoff(locked_touch, bare_touch)
+        assert not errs
+        bad = [v for v in rt.violations(rt.KIND_LOCKSET)
+               if v['field'] == 'Router._by_id']
+        assert len(bad) == 1, rt.violations()
+
+
+# ---------------------------------------------------------------------------
+# bench guard: report-mode overhead on the eager hot path
+# ---------------------------------------------------------------------------
+
+class TestSanitizerOverheadGuard:
+    def test_report_mode_overhead_under_3pct(self):
+        import bench
+        res = bench.sanitizer_overhead_ab(steps=30, trials=3)
+        assert res['mode'] == 'report'
+        assert res['overhead_pct'] < 3.0, res

@@ -220,6 +220,12 @@ class TestPostureResolution:
 # ---------------------------------------------------------------------------
 
 class TestDonatedTrain:
+    @pytest.fixture(autouse=True)
+    def _strict_sanitizer(self, sanitizer_strict):
+        """Donated train paths — incl. the sentinel-trip quarantine —
+        run under the strict concurrency sanitizer (ISSUE 15)."""
+        yield
+
     def test_store_served_donated_losses_bit_exact(self, tmp_path):
         pflags.set_flags({'FLAGS_donation': 'on'})
         store = programs.configure(str(tmp_path / 'don'))
@@ -294,6 +300,13 @@ class TestDonatedTrain:
 # ---------------------------------------------------------------------------
 
 class TestDonatedServing:
+    @pytest.fixture(autouse=True)
+    def _strict_sanitizer(self, sanitizer_strict):
+        """Donated serving — incl. the mid-serving sentinel trip and
+        pool recovery — runs under the strict concurrency sanitizer
+        (ISSUE 15)."""
+        yield
+
     def _run(self, gpt, donate_pool, prompts, max_new=6):
         eng = InferenceEngine(gpt, num_slots=4, max_length=64,
                               donate_pool=donate_pool)

@@ -379,6 +379,13 @@ class TestEngineSwap:
 # ---------------------------------------------------------------------------
 
 class TestRollingSwap:
+    @pytest.fixture(autouse=True)
+    def _strict_sanitizer(self, sanitizer_strict):
+        """Rolling swaps (incl. the kill-mid-swap chaos path in
+        test_router.py) run under the strict concurrency sanitizer
+        (ISSUE 15)."""
+        yield
+
     def test_train_publish_swap_under_traffic_full_contract(
             self, tmp_path, gpt, trained_state):
         """The ISSUE-12 acceptance test. A 2-replica Router serves a

@@ -263,6 +263,14 @@ class TestPlacement:
 # ---------------------------------------------------------------------------
 
 class TestChaosGauntlet:
+    @pytest.fixture(autouse=True)
+    def _strict_sanitizer(self, sanitizer_strict):
+        """Every chaos scenario runs under the runtime concurrency
+        sanitizer in strict mode (ISSUE 15): the gauntlet is exactly
+        where scrape/watchdog/driver interleavings happen, so a
+        lock-order cycle or lockset race here fails the test."""
+        yield
+
     def test_replica_killed_mid_decode_fails_over_bit_identical(self, gpt):
         """The headline guarantee: a replica dies mid-decode (transient
         device loss), its accepted requests fail over and their greedy
