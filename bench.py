@@ -1257,6 +1257,199 @@ def stack_ab(num_requests=12, num_slots=10, max_length=96,
     }
 
 
+# int8 KV quality bound: relative decode-logit RMSE vs the float32 cache,
+# measured by paged_int8_rmse below and documented in the README Paged-KV
+# section. Guarded in tier-1 (tests/test_paged_kv.py) with the same value.
+PAGED_INT8_RMSE_BOUND = 0.05
+
+
+def paged_int8_rmse(prompt_len=56, steps=8, page_size=16, seed=0):
+    """Teacher-forced decode-logit drift for int8 KV: prefill one prompt
+    through the shared `cached_forward` contract, roundtrip the KV slab
+    page-wise through the per-(page, head) absmax int8 path (exactly
+    what the quantized paged pool stores), then decode `steps` tokens
+    against BOTH caches teacher-forced on the float32 greedy trajectory.
+    Reports absolute and relative logit RMSE — the README's documented
+    int8 quality bound (relative RMSE <= PAGED_INT8_RMSE_BOUND) is the
+    number this function measures. The quantized arm re-roundtrips its
+    cache after every step, matching the pool (every settled page lives
+    in int8; nothing stays float between rounds)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit import functional_state
+    from paddle_tpu.nlp.generation import cached_forward
+    from paddle_tpu.quantization import (kv_dequantize_page,
+                                         kv_page_scales, kv_quantize_page)
+
+    model = _serving_model()
+    params, frozen, buffers = functional_state(model)
+    fwd = cached_forward(model, params, frozen, buffers)
+    maxlen = -(-(prompt_len + steps) // page_size) * page_size
+
+    def roundtrip(cache):
+        def rt(leaf):
+            b, length, h, d = leaf.shape
+            pages = leaf.reshape(b * (length // page_size),
+                                 page_size, h, d)
+            scales = kv_page_scales(pages)
+            dq = kv_dequantize_page(
+                kv_quantize_page(pages, scales), scales, leaf.dtype)
+            return dq.reshape(leaf.shape)
+        return jax.tree_util.tree_map(rt, cache)
+
+    rng = np.random.RandomState(seed)
+    ids = jnp.asarray(rng.randint(0, model.config.vocab_size,
+                                  (1, prompt_len)), jnp.int32)
+    cache = model.init_cache(1, maxlen)
+    logits, cache = fwd(ids, cache, jnp.int32(0), jnp.int32(0), None)
+    cache_q = roundtrip(cache)
+    k_pos = jnp.arange(maxlen, dtype=jnp.int32)
+
+    tok = int(np.asarray(logits[0, -1]).argmax())
+    sq_err = sq_ref = 0.0
+    agree = 0
+    for i in range(steps):
+        pos = jnp.full((1,), prompt_len + i, jnp.int32)
+        mask = (k_pos[None, :] <= pos[:, None])[:, None, None, :]
+        tok_dev = jnp.full((1, 1), tok, jnp.int32)
+        la, cache = fwd(tok_dev, cache, pos, pos, mask)
+        lq, cache_q = fwd(tok_dev, cache_q, pos, pos, mask)
+        cache_q = roundtrip(cache_q)
+        la = np.asarray(la[0, -1], np.float64)
+        lq = np.asarray(lq[0, -1], np.float64)
+        sq_err += float(((la - lq) ** 2).sum())
+        sq_ref += float(((la - la.mean()) ** 2).sum())
+        agree += int(la.argmax() == lq.argmax())
+        tok = int(la.argmax())      # teacher-force the float32 path
+    n = steps * la.shape[-1]
+    rmse = (sq_err / n) ** 0.5
+    rel = (sq_err / sq_ref) ** 0.5 if sq_ref else 0.0
+    return {
+        'logit_rmse': round(rmse, 6),
+        'logit_rmse_rel': round(rel, 6),
+        'rmse_bound': PAGED_INT8_RMSE_BOUND,
+        'within_bound': rel <= PAGED_INT8_RMSE_BOUND,
+        'greedy_agree_rate': round(agree / steps, 4),
+        'prompt_len': prompt_len, 'steps': steps,
+    }
+
+
+def paged_ab(num_requests=12, system_len=48, max_length=96,
+             decode_block=8, page_size=16, cap_requests=24, trials=2):
+    """Row-vs-paged KV A/B at EQUAL HBM budget (also imported by the
+    tier-1 paged guard). Both arms get the same number of KV rows:
+    the row arm as 4 monolithic max_length slots, the paged arm as
+    (4 * max_length / page_size) pages shared by 16 seats — the pool
+    byte counts are asserted equal-or-better so the comparison is
+    capacity-per-byte, never extra memory.
+
+    Three sections:
+    - capacity: a burst of short requests is submitted to both arms and
+      stepped once; the row arm seats at most its 4 slots (every seat
+      strands max_length - ~14 rows), the paged arm seats one page per
+      request — the >= 3x concurrent-admission acceptance bar.
+    - throughput/reuse: the shared-system-prompt trace (prefix_trace)
+      with the prefix cache on in both arms. The paged arm retains the
+      system prefix as SHARED pages (COW refcounts) instead of a whole
+      retained slot, so reuse survives at equal HBM. Reports tokens/sec,
+      prefill tokens reused, bit-exact greedy parity vs generate(), and
+      zero recompiles after warmup per arm.
+    - int8: the paged_int8_rmse teacher-forced logit-drift measurement
+      for the quantized-KV mode, with the documented bound.
+    """
+    from paddle_tpu.serving import InferenceEngine, SamplingParams
+
+    model = _serving_model()
+    vocab = model.config.vocab_size
+    kv_pages = (4 * max_length) // page_size
+    row_kw = dict(num_slots=4, max_length=max_length,
+                  decode_block=decode_block)
+    paged_kw = dict(num_slots=16, max_length=max_length,
+                    decode_block=decode_block,
+                    kv_page_size=page_size, kv_pages=kv_pages)
+
+    # --- capacity: short-request burst, peak seats after one step ----
+    # each request spans exactly ONE page (prompt + max_new == page
+    # size) and outlives the first decode block, so seats are read
+    # while everyone is still resident
+    cap_new = decode_block + 4
+    cap_len = max(1, page_size - cap_new)
+    rng = np.random.RandomState(11)
+    cap_prompts = [rng.randint(0, vocab, (cap_len,)).tolist()
+                   for _ in range(cap_requests)]
+
+    def capacity(kw):
+        eng = InferenceEngine(model, **kw)
+        hs = [eng.submit(p, SamplingParams(max_new_tokens=cap_new,
+                                           eos_token_id=-1))
+              for p in cap_prompts]
+        eng.step()
+        seated = eng.pool.used_count
+        eng.run()
+        done = sum(1 for h in hs if h.status == 'FINISHED')
+        return seated, done, eng.pool.pool_bytes
+
+    row_seated, row_done, row_bytes = capacity(row_kw)
+    paged_seated, paged_done, paged_bytes = capacity(paged_kw)
+
+    # --- throughput + prefill reuse on the shared-prefix trace -------
+    trace = prefix_trace(num_requests, system_len=system_len,
+                         vocab=vocab)
+    prompts = [p for p, _ in trace]
+    sparams = [SamplingParams(max_new_tokens=mn, eos_token_id=-1)
+               for _, mn in trace]
+    expected = _ref_outputs(model, trace)
+    tokens = sum(mn for _, mn in trace)
+
+    def run_arm(kw):
+        eng = InferenceEngine(model, prefix_cache=0.25, **kw)
+        # warmup: request 0 alone seeds the cache (inserts happen at
+        # retirement), then a wave that HITS it — compiling the suffix
+        # chunk buckets, the hit path, and the decode step
+        eng.generate_many(prompts[:1], sparams[:1])
+        eng.generate_many(prompts[:4], sparams[:4])
+        warm = dict(eng.stats()['traces'])
+        best = None
+        for _ in range(trials):
+            eng.reset_stats()
+            t0 = time.perf_counter()
+            hs = eng.generate_many(prompts, sparams)
+            dt = time.perf_counter() - t0
+            if best is None or dt < best[0]:
+                best = (dt, hs, dict(eng.stats()))
+        dt, hs, st = best
+        return {
+            'dt': dt, 'parity': [h.tokens for h in hs] == expected,
+            'recompiles': sum(eng.stats()['traces'].values())
+            - sum(warm.values()),
+            'reused': st.get('prefix_cache', {}).get('tokens_reused', 0),
+        }
+
+    row = run_arm(row_kw)
+    paged = run_arm(paged_kw)
+
+    return {
+        'row_pool_bytes': row_bytes,
+        'paged_pool_bytes': paged_bytes,
+        'equal_hbm': paged_bytes <= row_bytes,
+        'concurrent_row': row_seated,
+        'concurrent_paged': paged_seated,
+        'capacity_ratio': round(paged_seated / row_seated, 2)
+        if row_seated else 0.0,
+        'cap_completed': min(row_done, paged_done),
+        'tokens_per_sec_row': round(tokens / row['dt'], 1),
+        'tokens_per_sec_paged': round(tokens / paged['dt'], 1),
+        'prefill_reuse_row': row['reused'],
+        'prefill_reuse_paged': paged['reused'],
+        'parity': row['parity'] and paged['parity'],
+        'recompiles_after_warmup': row['recompiles']
+        + paged['recompiles'],
+        'int8': paged_int8_rmse(page_size=page_size),
+        'num_requests': num_requests, 'cap_requests': cap_requests,
+        'page_size': page_size, 'kv_pages': kv_pages,
+    }
+
+
 def _phase_serving():
     """Serving phase: continuous-batching throughput vs the sequential
     generate() loop, then the latency stack — prefix-cache, chunked-
@@ -1266,7 +1459,7 @@ def _phase_serving():
     out = {}
     for key, fn in (('serving', serving_ab), ('prefix', prefix_ab),
                     ('chunked', chunked_ab), ('spec', spec_ab),
-                    ('stack', stack_ab)):
+                    ('stack', stack_ab), ('paged', paged_ab)):
         try:
             out[key] = fn()
         except Exception as e:
@@ -2397,7 +2590,7 @@ def _cpu_phase_plan():
     BENCH_CPU_PHASES (comma list) restricts the set — the probe-fallback
     regression test runs a single fast phase."""
     plan = [('headline', 1500), ('eager', 600), ('obs', 600),
-            ('resilience', 600), ('serving', 900), ('router', 900),
+            ('resilience', 600), ('serving', 1200), ('router', 900),
             ('coldstart', 900), ('goodput', 600), ('donation', 600),
             ('autoscale', 600)]
     only = os.environ.get('BENCH_CPU_PHASES')

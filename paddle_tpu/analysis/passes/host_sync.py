@@ -52,6 +52,12 @@ HOT_SCOPES = {
     # engine scopes protect
     'paddle_tpu/serving/autoscaler.py': ('Autoscaler.',),
     'paddle_tpu/loadgen/replay.py': ('LoadReplayer.',),
+    # the page manager (ISSUE 16) runs INSIDE the admission/decode loop:
+    # reserve/attach/COW on every seating, note_written every round. Its
+    # bookkeeping is host-side numpy BY DESIGN — any device read that
+    # creeps in (e.g. materializing a page to inspect it) stalls every
+    # decode round, so the whole class is a hot scope
+    'paddle_tpu/serving/kv_pool.py': ('PagedSlotPool.',),
 }
 
 _NP_ROOTS = frozenset(('np', 'numpy', 'onp'))

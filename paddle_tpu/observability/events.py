@@ -83,6 +83,10 @@ EVENT_SCHEMA: Dict[str, str] = {
     'serving_drain_complete': 'graceful drain finished',
     'prefix_hit': 'radix prefix-cache hit on admission',
     'prefix_evict': 'retained prefix slot reclaimed',
+    # paged KV pool (serving/kv_pool.PagedSlotPool)
+    'paged_cow': 'copy-on-write split of a shared KV page at admission',
+    'page_pool_exhausted': 'page reservation failed after reclaiming '
+                           'retention; request requeued',
     'request_shed': 'admission rejected under load shedding',
     'request_promoted': 'starvation promotion across QoS classes',
     'router_failover': 'accepted requests resubmitted to survivors',

@@ -642,3 +642,172 @@ def _sce_bwd(interpret, res, g):
 
 
 softmax_cross_entropy.defvjp(_sce_fwd, _sce_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused paged-attention decode kernel (ISSUE 16; upstream analogue:
+# vLLM's paged_attention_v1 CUDA kernel, SOSP'23). One query token per
+# slot attends over its page-table-scattered KV: the kernel gathers
+# pages, dequantizes int8 KV against per-(page, head) scales, and runs
+# the online-softmax attend in one pass — the KV never materializes
+# contiguously in HBM.
+# ---------------------------------------------------------------------------
+
+def paged_attention_reference(q, k_pages, v_pages, table, lengths, *,
+                              k_scales=None, v_scales=None, sm_scale=None):
+    """Pure-lax paged attention: gather pages → dequant → masked attend.
+
+    The CPU/backward-compat fallback for `paged_attention` (and the
+    parity ground truth for the pallas kernel, which is run against it
+    in interpret mode).
+
+    q           [N, H, D]      one decode query per slot
+    k/v_pages   [num_pages, page_size, HKV, D]  paged KV (float or int8)
+    table       [N, P] int32   per-slot page table (page 0 = null page)
+    lengths     [N] int32      valid KV rows per slot (pos < length)
+    k/v_scales  [num_pages, HKV] f32 int8 dequant scales, or None
+
+    GQA folds query heads as [HKV, G] groups (G = H // HKV), matching
+    `jnp.repeat(k, G, axis=2)` head order everywhere else in the repo.
+    Slots with length == 0 yield a finite but meaningless row (uniform
+    average of their gathered pages) — callers mask inactive slots, per
+    the serving engine's active-mask convention.
+    """
+    n, h, d = q.shape
+    ps, hkv = k_pages.shape[1], k_pages.shape[2]
+    p = table.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    k = k_pages[table].astype(jnp.float32)     # [N, P, ps, HKV, D]
+    v = v_pages[table].astype(jnp.float32)
+    if k_scales is not None:
+        k = k * k_scales[table][:, :, None, :, None]
+    if v_scales is not None:
+        v = v * v_scales[table][:, :, None, :, None]
+    s_len = p * ps
+    k = k.reshape(n, s_len, hkv, d)
+    v = v.reshape(n, s_len, hkv, d)
+    g = h // hkv
+    qf = q.astype(jnp.float32).reshape(n, hkv, g, d) * sm_scale
+    s = jnp.einsum('nkgd,nskd->nkgs', qf, k)   # [N, HKV, G, S]
+    kpos = jnp.arange(s_len, dtype=jnp.int32)
+    live = kpos[None, :] < lengths[:, None]
+    s = jnp.where(live[:, None, None, :], s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum('nkgs,nskd->nkgd', w, v)
+    return o.reshape(n, h, d).astype(q.dtype)
+
+
+def _paged_attn_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                       page_size, n_pages, sm_scale, quant):
+    """Grid (N, HKV, P); pages arrive via scalar-prefetch page-table
+    lookup in the k/v BlockSpec index maps, so each step's DMA lands the
+    right page while the previous one computes."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        o_ref, m_s, l_s, acc_s = rest
+    n = pl.program_id(0)
+    ip = pl.program_id(2)
+
+    @pl.when(ip == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # pages entirely past the slot's length contribute nothing — skip
+    # their FLOPs (their DMA was to the null page already if unreserved);
+    # page 0 always computes so fully-idle slots still finalize finite
+    @pl.when((ip == 0) | (ip * page_size < len_ref[n]))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale     # [G, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)          # [ps, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [G, ps]
+        kpos = ip * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < len_ref[n], s, _NEG_INF)
+        m_prev = m_s[:]                                    # [G, 128]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        pexp = jnp.exp(s - m_new[:, :1])
+        l_s[:] = l_s[:] * alpha + jnp.broadcast_to(
+            jnp.sum(pexp, axis=-1, keepdims=True), l_s.shape)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = m_new
+
+    @pl.when(ip == n_pages - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_s[:] / l_s[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, table, lengths, k_scales,
+                            v_scales, sm_scale, interpret):
+    n, h, d = q.shape
+    ps, hkv = k_pages.shape[1], k_pages.shape[2]
+    p = table.shape[1]
+    g = h // hkv
+    quant = k_scales is not None
+    q4 = q.reshape(n, hkv, g, d)
+    qspec = pl.BlockSpec((1, 1, g, d),
+                         lambda n_, h_, p_, tr, lr: (n_, h_, 0, 0))
+    kspec = pl.BlockSpec((1, ps, 1, d),
+                         lambda n_, h_, p_, tr, lr: (tr[n_, p_], 0, h_, 0))
+    in_specs = [qspec, kspec, kspec]
+    args = (table.astype(jnp.int32), lengths.astype(jnp.int32),
+            q4, k_pages, v_pages)
+    if quant:
+        sspec = pl.BlockSpec((1, 1),
+                             lambda n_, h_, p_, tr, lr: (tr[n_, p_], h_))
+        in_specs += [sspec, sspec]
+        args += (k_scales, v_scales)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n, hkv, p),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda n_, h_, p_, tr, lr: (n_, h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),   # running max
+            pltpu.VMEM((g, 128), jnp.float32),   # running denom
+            pltpu.VMEM((g, d), jnp.float32),     # output accumulator
+        ])
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, page_size=ps, n_pages=p,
+                          sm_scale=sm_scale, quant=quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, hkv, g, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(n, h, d)
+
+
+def paged_attention(q, k_pages, v_pages, table, lengths, *, k_scales=None,
+                    v_scales=None, sm_scale=None, interpret=False):
+    """Fused paged-attention decode step over a page-table KV pool.
+
+    Dispatch: the pallas kernel under `pltpu` on TPU (or anywhere with
+    interpret=True); the pure-lax gather reference on every other
+    backend so CPU tier-1 runs unchanged. Shapes as in
+    `paged_attention_reference`; pass k/v_scales for int8 pages.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret or jax.default_backend() == 'tpu':
+        return _paged_attention_pallas(q, k_pages, v_pages, table, lengths,
+                                       k_scales, v_scales, sm_scale,
+                                       interpret)
+    return paged_attention_reference(q, k_pages, v_pages, table, lengths,
+                                     k_scales=k_scales, v_scales=v_scales,
+                                     sm_scale=sm_scale)

@@ -25,13 +25,46 @@ from ..nn.layer import Layer
 from ..tensor import Tensor, apply_op
 
 from . import observers as observers  # noqa: F401  (paddle.quantization.observers)
-from .observers import (AbsmaxObserver, AVGObserver, BaseObserver,
-                        EMAObserver, HistObserver, KLObserver, MSEObserver)
+from .observers import (AbsmaxChannelObserver, AbsmaxObserver, AVGObserver,
+                        BaseObserver, EMAObserver, HistObserver, KLObserver,
+                        MSEObserver)
 
 __all__ = ['QuantConfig', 'PTQ', 'QAT', 'QuantedLinear',
            'FakeQuantAbsMax', 'quanted_state_bytes', 'observers',
-           'AbsmaxObserver', 'AVGObserver', 'EMAObserver', 'HistObserver',
-           'KLObserver', 'MSEObserver']
+           'AbsmaxObserver', 'AbsmaxChannelObserver', 'AVGObserver',
+           'EMAObserver', 'HistObserver', 'KLObserver', 'MSEObserver',
+           'kv_page_scales', 'kv_quantize_page', 'kv_dequantize_page']
+
+# int8 KV-cache quantization (ISSUE 16): traced per-(page, head) absmax
+# helpers shared by the paged KV pool's scatter/gather, the fused
+# paged-attention kernel's dequant, and — for parity — the host-side
+# AbsmaxChannelObserver (same absmax/127 semantics, observers.py).
+_KV_QMAX = 127.0
+
+
+def kv_page_scales(page, qmax: float = _KV_QMAX):
+    """Per-(page, head) absmax int8 scale for KV page slabs shaped
+    [..., page_size, H, D]: reduce |x| over the rows and head_dim, keep
+    the head axis — one scale per head per page, so a page's scale never
+    couples heads with very different activation ranges. Zero pages get
+    scale 1.0 (quantize to zero, never divide by zero). Traced."""
+    amax = jnp.max(jnp.abs(page.astype(jnp.float32)), axis=(-3, -1))
+    return jnp.where(amax > 0, amax / qmax, 1.0)
+
+
+def kv_quantize_page(page, scales, qmax: float = _KV_QMAX):
+    """Round-clip `page` [..., ps, H, D] to int8 at per-(page, head)
+    `scales` [..., H]. Traced (lives inside scatter_pages)."""
+    q = jnp.round(page.astype(jnp.float32) / scales[..., None, :, None])
+    return jnp.clip(q, -qmax, qmax).astype(jnp.int8)
+
+
+def kv_dequantize_page(q, scales, dtype):
+    """Inverse of kv_quantize_page: int8 pages [..., ps, H, D] back to
+    `dtype` at per-(page, head) scales [..., H]. Traced (lives inside
+    gather_pages and the paged-attention kernels)."""
+    return (q.astype(jnp.float32)
+            * scales[..., None, :, None]).astype(dtype)
 
 _OBSERVERS = {'abs_max': AbsmaxObserver, 'avg': AVGObserver,
               'ema': EMAObserver, 'hist': HistObserver,

@@ -15,8 +15,9 @@ import numpy as np
 from ..nn.layer import Layer
 from ..tensor import Tensor
 
-__all__ = ['BaseObserver', 'AbsmaxObserver', 'AVGObserver',
-           'HistObserver', 'KLObserver', 'MSEObserver', 'EMAObserver']
+__all__ = ['BaseObserver', 'AbsmaxObserver', 'AbsmaxChannelObserver',
+           'AVGObserver', 'HistObserver', 'KLObserver', 'MSEObserver',
+           'EMAObserver']
 
 _QMAX = 127.0
 
@@ -62,6 +63,41 @@ class AbsmaxObserver(BaseObserver):
 
     def _absmax(self):
         return self._max
+
+
+class AbsmaxChannelObserver(BaseObserver):
+    """Per-CHANNEL running absmax (upstream analogue:
+    abs_max_channel_wise weight semantics, applied to activations):
+    tracks max |x| over every axis EXCEPT `channel_axis`, and `scales()`
+    returns an ARRAY of per-channel scales instead of a float.
+
+    This is the observer path behind the paged KV cache's per-(page,
+    head) int8 scales: observing a [page_size, H, D] page slab with
+    channel_axis=1 yields exactly the per-head scales the traced
+    `quantization.kv_page_scales` computes inside scatter_pages — the
+    parity test in the paged-KV suite holds the two to agreement."""
+
+    def __init__(self, quant_bits: int = 8, channel_axis: int = -1):
+        super().__init__(quant_bits)
+        self.channel_axis = int(channel_axis)
+        self._max = None
+
+    def _observe(self, a):
+        ax = self.channel_axis % a.ndim
+        reduce_axes = tuple(i for i in range(a.ndim) if i != ax)
+        m = np.max(np.abs(a), axis=reduce_axes)
+        self._max = m if self._max is None else np.maximum(self._max, m)
+
+    def _absmax(self):
+        return self._max
+
+    def scales(self) -> np.ndarray:
+        if not self._seen:
+            raise RuntimeError(
+                f'{type(self).__name__} has seen no calibration data')
+        amax = np.asarray(self._absmax(), np.float32)
+        return np.where(amax > 0, amax / self.qmax,
+                        1.0).astype(np.float32)
 
 
 class AVGObserver(BaseObserver):

@@ -34,6 +34,18 @@ verified in one target forward, exactly greedy for any draft):
                           prefix_cache=0.25, prefill_chunk_tokens=32,
                           draft_model=draft)
 
+Paged KV (ISSUE 16): `kv_page_size=` switches the pool to the
+page-table layout (`kv_pool.PagedSlotPool`) — fixed-size pages with
+per-slot page tables, reservation-based admission, copy-on-write
+page sharing through the prefix cache (`PagedPrefixCache`), optional
+`kv_quant='int8'` with per-(page, head) scales, and `kv_pages=` to
+oversubscribe HBM so short requests admit at page (not slot-row)
+granularity. Greedy outputs stay bit-identical to the row pool:
+
+    eng = InferenceEngine(model, num_slots=32, max_length=256,
+                          kv_page_size=16, kv_pages=257,
+                          prefix_cache=0.25)
+
 Fleet layer (`router.py` + `tenancy.py`): a `Router` over a
 `ReplicaSet` of N engines adds health-checked least-loaded placement,
 mid-flight failover with per-replica circuit breakers, and per-tenant
@@ -101,8 +113,9 @@ from .engine import InferenceEngine, sample_rows
 from .hotswap import (CanaryGate, ReplicaUpdater, SwapFailed,
                       WeightLoadError, WeightPublisher, WeightStore,
                       finite_weights_gate)
-from .kv_pool import SlotPool, default_buckets
-from .prefix_cache import RadixPrefixCache
+from .kv_pool import (PageHold, PagePoolExhausted, PagedSlotPool,
+                      PromptTooLongError, SlotPool, default_buckets)
+from .prefix_cache import PagedPrefixCache, RadixPrefixCache
 from .router import (CircuitBreaker, Replica, ReplicaFailure, ReplicaSet,
                      Router, RouterHandle)
 from .scheduler import FCFSScheduler
@@ -115,6 +128,8 @@ __all__ = [
     'PRIORITY_HIGH', 'PRIORITY_NORMAL', 'PRIORITY_LOW', 'PRIORITY_NAMES',
     'RequestHandle', 'SamplingParams', 'InferenceEngine', 'sample_rows',
     'SlotPool', 'default_buckets', 'FCFSScheduler', 'RadixPrefixCache',
+    'PagedSlotPool', 'PagedPrefixCache', 'PageHold',
+    'PagePoolExhausted', 'PromptTooLongError',
     'CircuitBreaker', 'Replica', 'ReplicaFailure', 'ReplicaSet',
     'Router', 'RouterHandle',
     'AdmissionRejected', 'Tenant', 'TenantRegistry', 'TokenBucket',

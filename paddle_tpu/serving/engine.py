@@ -62,8 +62,10 @@ from ..nlp.generation import _NEG_INF, cached_forward
 from ..resilience import RetryPolicy, call_with_retry
 from ..tensor import Tensor
 from .api import GREEDY, RUNNING, RequestHandle, SamplingParams
-from .kv_pool import SlotPool, split_rows, stack_rows
-from .prefix_cache import RadixPrefixCache
+from .kv_pool import (PagePoolExhausted, PagedSlotPool, SlotPool,
+                      gather_pages, scatter_pages, split_rows,
+                      stack_rows)
+from .prefix_cache import PagedPrefixCache, RadixPrefixCache
 from .scheduler import FCFSScheduler
 
 # occupancy is a ratio; the latency-shaped default buckets are wrong here
@@ -163,6 +165,22 @@ class InferenceEngine:
             requires a donation-gauntlet-safe verdict and runs
             sentinel-guarded). Default True; the bench donation phase
             A/Bs False against it.
+        kv_page_size: setting this (or kv_pages/kv_quant) switches the
+            KV cache to the PAGED layout (kv_pool.PagedSlotPool):
+            fixed-size pages + a per-slot page table, reservation-based
+            admission (page exhaustion requeues instead of failing),
+            prefix retention by PAGE (copy-on-write shared), and the
+            paged decode/prefill/spec programs that gather/scatter
+            through the table. max_length must be a multiple.
+        kv_pages: total pages in the paged pool (page 0 is the null
+            page). Default num_slots * pages_per_slot + 1 — set LOWER
+            to oversubscribe HBM: short requests then reserve only the
+            pages they can touch, admitting more concurrent requests
+            than row slots would at the same byte budget.
+        kv_quant: 'int8' stores paged KV as int8 with per-(page, head)
+            absmax scales (half/quarter the bytes of bf16/f32 KV);
+            gather dequantizes, scatter requantizes touched pages. The
+            bench `paged_ab` phase measures the logit-RMSE cost.
 
     Not thread-safe: one engine is one event loop; drive it with
     `step()`, `run()`, `stream()`, or `generate_many()`.
@@ -179,7 +197,10 @@ class InferenceEngine:
                  prefill_chunk_tokens: Optional[int] = None,
                  draft_model=None, num_draft_tokens: int = 4,
                  weight_version: int = 0,
-                 donate_pool: Optional[bool] = None):
+                 donate_pool: Optional[bool] = None,
+                 kv_page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None,
+                 kv_quant: Optional[str] = None):
         cfg = getattr(model, 'config', None)
         max_pos = getattr(cfg, 'max_position_embeddings', None)
         if max_pos is not None and max_length > max_pos:
@@ -199,7 +220,16 @@ class InferenceEngine:
             getattr(cfg, 'eos_token_id', -1) if eos_token_id is None
             else eos_token_id)
         self.decode_block = int(decode_block)
-        self.pool = SlotPool(model, num_slots, max_length, dtype, buckets)
+        self._paged = (kv_page_size is not None or kv_pages is not None
+                       or kv_quant is not None)
+        if self._paged:
+            self.pool = PagedSlotPool(
+                model, num_slots, max_length, dtype, buckets,
+                page_size=int(kv_page_size) if kv_page_size else 16,
+                num_pages=kv_pages, quant=kv_quant)
+        else:
+            self.pool = SlotPool(model, num_slots, max_length, dtype,
+                                 buckets)
         self.scheduler = FCFSScheduler(max_prefill_tokens,
                                        max_wait_s=max_wait_s)
         if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
@@ -208,19 +238,29 @@ class InferenceEngine:
                                      if prefill_chunk_tokens else None)
         self.pool.prefill_chunk_tokens = self.prefill_chunk_tokens
         if isinstance(prefix_cache, RadixPrefixCache):
+            if self._paged != isinstance(prefix_cache, PagedPrefixCache):
+                raise ValueError(
+                    'prefix cache layout does not match the pool: a '
+                    'paged engine needs a PagedPrefixCache (and a row '
+                    'engine a RadixPrefixCache)')
             self.prefix_cache: Optional[RadixPrefixCache] = prefix_cache
         elif prefix_cache:
             fraction = (0.5 if prefix_cache is True
                         else float(prefix_cache))
-            self.prefix_cache = RadixPrefixCache(self.pool, fraction)
+            cache_cls = (PagedPrefixCache if self._paged
+                         else RadixPrefixCache)
+            self.prefix_cache = cache_cls(self.pool, fraction)
         else:
             self.prefix_cache = None
-        if self.prefix_cache is not None \
-                and self.prefix_cache.budget_slots < 1:
-            raise ValueError(
-                'prefix cache budget rounds to zero slots; raise the '
-                'fraction or the slot count (retention must leave at '
-                'least one slot for decode)')
+        if self.prefix_cache is not None:
+            budget = (self.prefix_cache.budget_pages if self._paged
+                      else self.prefix_cache.budget_slots)
+            if budget < 1:
+                raise ValueError(
+                    'prefix cache budget rounds to zero '
+                    + ('pages' if self._paged else 'slots')
+                    + '; raise the fraction or the pool size (retention '
+                    'must leave capacity for decode)')
         if self.prefix_cache is not None:
             self.prefix_cache.set_version(self.weight_version)
         self.draft_model = draft_model
@@ -303,19 +343,51 @@ class InferenceEngine:
             'decode_block': self.decode_block,
             'donate_pool': self._donate_pool,
         }
-        self._decode_jit = store.wrap_jit(
-            self._decode_block_fn, name='serving.decode_block',
-            kind='serving', statics=engine_statics,
-            donate_argnums=(3,) if self._donate_pool else ())
-        self._prefill_jit = store.wrap_jit(   # 1 trace per bucket
-            self._prefill_fn,
-            name_fn=lambda args: f'serving.prefill_{args[3].shape[1]}',
-            kind='serving', statics=engine_statics)
-        self._chunk_prefill_jit = store.wrap_jit(  # 1 per chunk bucket
-            self._chunk_prefill_fn,
-            name_fn=lambda args: f'serving.chunk_prefill_'
-                                 f'{args[4].shape[1]}',
-            kind='serving', statics=engine_statics)
+        if self._paged:
+            # page geometry is invisible in the contiguous avals the
+            # decode scan sees (the table aval only fixes num_slots x
+            # pages_per_slot), so it MUST ride the statics — and paged
+            # vs row programs must never share a store key
+            engine_statics.update(
+                kv_layout='paged',
+                kv_page_size=self.pool.page_size,
+                kv_pages=self.pool.num_pages,
+                kv_quant=self.pool.quant or 'none')
+        if self._paged:
+            # page buffers (and scales) donate through the PR-13
+            # gauntlet exactly like the row pool did: decode/spec alias
+            # the pool in place; prefill/chunk stay UNDONATED so a
+            # prefill failure remains request-level (a donated prefill
+            # dying would invalidate the whole pool)
+            self._decode_jit = store.wrap_jit(
+                self._paged_decode_fn, name='serving.paged_decode_block',
+                kind='serving', statics=engine_statics,
+                donate_argnums=(3, 4) if self._donate_pool else ())
+            self._prefill_jit = store.wrap_jit(   # 1 trace per bucket
+                self._paged_prefill_fn,
+                name_fn=lambda args: f'serving.paged_prefill_'
+                                     f'{args[6].shape[1]}',
+                kind='serving', statics=engine_statics)
+            self._chunk_prefill_jit = store.wrap_jit(
+                self._paged_chunk_prefill_fn,
+                name_fn=lambda args: f'serving.paged_chunk_prefill_'
+                                     f'{args[6].shape[1]}',
+                kind='serving', statics=engine_statics)
+        else:
+            self._decode_jit = store.wrap_jit(
+                self._decode_block_fn, name='serving.decode_block',
+                kind='serving', statics=engine_statics,
+                donate_argnums=(3,) if self._donate_pool else ())
+            self._prefill_jit = store.wrap_jit(   # 1 trace per bucket
+                self._prefill_fn,
+                name_fn=lambda args: f'serving.prefill_'
+                                     f'{args[3].shape[1]}',
+                kind='serving', statics=engine_statics)
+            self._chunk_prefill_jit = store.wrap_jit(  # 1 / chunk bucket
+                self._chunk_prefill_fn,
+                name_fn=lambda args: f'serving.chunk_prefill_'
+                                     f'{args[4].shape[1]}',
+                kind='serving', statics=engine_statics)
         if draft_model is not None:
             spec_statics = dict(
                 engine_statics,
@@ -327,11 +399,19 @@ class InferenceEngine:
             # one compiled speculation round per k: the drafts/verify
             # shapes are internal, invisible in any input aval, so k
             # MUST ride the statics
-            self._spec_jit = store.wrap_jit(
-                self._spec_decode_fn,
-                name=f'serving.spec_decode_k{self.spec_k}',
-                kind='serving', statics=spec_statics,
-                donate_argnums=(3, 7) if self._donate_pool else ())
+            if self._paged:
+                self._spec_jit = store.wrap_jit(
+                    self._paged_spec_fn,
+                    name=f'serving.paged_spec_decode_k{self.spec_k}',
+                    kind='serving', statics=spec_statics,
+                    donate_argnums=(3, 4, 9) if self._donate_pool
+                    else ())
+            else:
+                self._spec_jit = store.wrap_jit(
+                    self._spec_decode_fn,
+                    name=f'serving.spec_decode_k{self.spec_k}',
+                    kind='serving', statics=spec_statics,
+                    donate_argnums=(3, 7) if self._donate_pool else ())
             self._draft_prefill_jit = store.wrap_jit(
                 self._draft_prefill_fn,
                 name_fn=lambda args: f'serving.draft_prefill_'
@@ -564,6 +644,162 @@ class InferenceEngine:
         toks = jnp.where(active[:, None], toks, 0).astype(jnp.int32)
         counts = jnp.where(active, a + 1, 0).astype(jnp.int32)
         return (toks, counts, split_rows(pool, n),
+                split_rows(d_pool, self.draft_pool.num_slots))
+
+    # ------------------------------------------------------------------
+    # compiled programs: PAGED layout
+    # ------------------------------------------------------------------
+    def _paged_decode_fn(self, params, frozen, buffers, pages, scales,
+                         table, tok, pos, steps, active, temp, topk,
+                         topp, greedy, keys):
+        """The decode block over the PAGE-TABLE pool: gather every
+        slot's pages into the contiguous [N, max_length, H, D] view the
+        row-pool scan already consumes (dequantizing int8 pages in the
+        same expression), run the IDENTICAL per-token scan, then scatter
+        only the pages overlapping [pos, pos+block) back — untouched
+        pages are never rewritten, which makes the unquantized path a
+        bit-exact writeback and keeps settled int8 pages from
+        requantization drift. Inactive slots (parked mid-prefill, free)
+        have their table row redirected to the null page so their junk
+        token-0 writes can land nowhere real. `pages`/`scales` are
+        donated (argnums 3, 4) so the pool aliases in place."""
+        self._trace_counts['paged_decode_step'] += 1
+        fwd = cached_forward(self.model, params, frozen, buffers)
+        max_len = self.pool.max_length
+        k_slot = jnp.arange(max_len, dtype=jnp.int32)
+        sc = scales if self.pool.quant else None
+        table = jnp.where(active[:, None], table, 0)
+        contig = gather_pages(pages, table, sc,
+                              out_dtype=self.pool.compute_dtype)
+        pos0 = pos
+
+        def sub(carry, _):
+            tok, pos, steps, pool = carry
+            mask = (k_slot[None, :] <= pos[:, None])[:, None, None, :]
+            logits, pool = fwd(tok[:, None], pool, pos, pos, mask)
+            nxt = sample_rows(logits[:, -1], temp, topk, topp, greedy,
+                              keys, steps)
+            nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+            pos = jnp.minimum(pos + 1, jnp.int32(max_len - 1))
+            return (nxt, pos, steps + 1, pool), nxt
+
+        (tok, pos, steps, contig), toks = jax.lax.scan(
+            sub, (tok, pos, steps, contig), None,
+            length=self.decode_block)
+        pages, sc = scatter_pages(pages, table, contig, pos0,
+                                  self.decode_block,
+                                  self.pool.page_size, sc)
+        return (jnp.transpose(toks), pages,
+                sc if sc is not None else ())
+
+    def _paged_prefill_fn(self, params, frozen, buffers, pages, scales,
+                          table, ids):
+        """Whole-prompt prefill into the PAGE pool: same batch-1 forward
+        over a zero slab as `_prefill_fn`, then one scatter of
+        [0, bucket) through the slot's table row ([1, P]). Pad rows past
+        the reservation fall on null-table entries and vanish. UNDONATED
+        on purpose: a prefill failure must stay request-level."""
+        b = ids.shape[1]
+        self._trace_counts[f'paged_prefill_{b}'] += 1
+        fwd = cached_forward(self.model, params, frozen, buffers)
+        slab = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self.pool.row_spec)
+        _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
+        sc = scales if self.pool.quant else None
+        pages, sc = scatter_pages(pages, table, slab,
+                                  jnp.zeros(1, jnp.int32), b,
+                                  self.pool.page_size, sc)
+        return pages, sc if sc is not None else ()
+
+    def _paged_chunk_prefill_fn(self, params, frozen, buffers, pages,
+                                scales, table, ids, start, floor):
+        """One chunk of one prompt through the PAGE table: gather the
+        slot's contiguous view (attached prefix pages included — the
+        chunk attends the shared prefix through its own table, no src
+        row needed), forward [start, start+chunk) with the slot-causal
+        mask, scatter back. `floor` is the prefix-attach boundary
+        (page-aligned): a tail-shifted window re-forwards rows below the
+        cursor with bit-identical values, and the floor redirect makes
+        sure those duplicate writes can never touch a SHARED page (int8
+        requantization there would drift siblings)."""
+        b = ids.shape[1]
+        self._trace_counts[f'paged_chunk_prefill_{b}'] += 1
+        fwd = cached_forward(self.model, params, frozen, buffers)
+        sc = scales if self.pool.quant else None
+        row = gather_pages(pages, table, sc,
+                           out_dtype=self.pool.compute_dtype)
+        k_slot = jnp.arange(self.pool.max_length, dtype=jnp.int32)
+        q_pos = start + jnp.arange(b, dtype=jnp.int32)
+        mask = (k_slot[None, :] <= q_pos[:, None])[None, None]
+        _, row = fwd(ids, row, start, start, mask)
+        pages, sc = scatter_pages(pages, table, row,
+                                  jnp.reshape(start, (1,)), b,
+                                  self.pool.page_size, sc,
+                                  floor=jnp.reshape(floor, (1,)))
+        return pages, sc if sc is not None else ()
+
+    def _paged_spec_fn(self, params, frozen, buffers, pages, scales,
+                       table, d_params, d_frozen, d_buffers, d_pool,
+                       tok, pos, steps, active, temp, topk, topp,
+                       greedy, keys, eos):
+        """The speculation round over the PAGED target pool: identical
+        draft-propose / k+1-verify / longest-prefix-accept math as
+        `_spec_decode_fn`, with the target KV gathered through the page
+        table and the verify's k+1-row span scattered back (reservation
+        headroom guarantees the span never clamps past max_length). The
+        DRAFT pool stays a row SlotPool — it is small, never shared,
+        and keeping it row-shaped bounds this PR's blast radius.
+        Donates pages, scales, and the draft rows (argnums 3, 4, 9)."""
+        k = self.spec_k
+        self._trace_counts[f'paged_spec_decode_k{k}'] += 1
+        fwd_t = cached_forward(self.model, params, frozen, buffers)
+        fwd_d = cached_forward(self.draft_model, d_params, d_frozen,
+                               d_buffers)
+        sc = scales if self.pool.quant else None
+        table = jnp.where(active[:, None], table, 0)
+        pool = gather_pages(pages, table, sc,
+                            out_dtype=self.pool.compute_dtype)
+        d_pool = stack_rows(d_pool)
+        max_len = self.pool.max_length
+        k_slot = jnp.arange(max_len, dtype=jnp.int32)
+        n = tok.shape[0]
+
+        def draft_body(j, carry):
+            cur, d_pool, drafts = carry
+            p = pos + j
+            mask = (k_slot[None, :] <= p[:, None])[:, None, None, :]
+            lg, d_pool = fwd_d(cur[:, None], d_pool, p, p, mask)
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return nxt, d_pool, drafts.at[:, j].set(nxt)
+
+        _, d_pool, drafts = jax.lax.fori_loop(
+            0, k, draft_body,
+            (tok, d_pool, jnp.zeros((n, k), jnp.int32)))
+
+        block = jnp.concatenate([tok[:, None], drafts], axis=1)
+        q_pos = pos[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
+        mask = (k_slot[None, None, :] <= q_pos[:, :, None])[:, None]
+        logits, pool = fwd_t(block, pool, pos, pos, mask)
+
+        choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        match = ((drafts == choice[:, :k])
+                 & (drafts != eos[:, None]) & greedy[:, None])
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        sampled = sample_rows(logits[:, 0], temp, topk, topp, greedy,
+                              keys, steps)
+        v_new = jnp.where(
+            greedy,
+            jnp.take_along_axis(choice, a[:, None], axis=1)[:, 0],
+            sampled)
+        j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        draft_ext = jnp.concatenate([drafts, drafts[:, -1:]], axis=1)
+        toks = jnp.where(j < a[:, None], draft_ext,
+                         jnp.where(j == a[:, None], v_new[:, None], 0))
+        toks = jnp.where(active[:, None], toks, 0).astype(jnp.int32)
+        counts = jnp.where(active, a + 1, 0).astype(jnp.int32)
+        pages, sc = scatter_pages(pages, table, pool, pos, k + 1,
+                                  self.pool.page_size, sc)
+        return (toks, counts, pages, sc if sc is not None else (),
                 split_rows(d_pool, self.draft_pool.num_slots))
 
     # ------------------------------------------------------------------
@@ -921,6 +1157,8 @@ class InferenceEngine:
                 self._tok[slot] = toks[slot, c - 1]
                 self._pos[slot] += c
                 self._steps[slot] += (1 if counts is not None else c)
+                # stranded-capacity accounting: rows actually written
+                self.pool.note_written(slot, self._pos[slot] + 1)
         return n
 
     def _recover_pool(self):
@@ -930,7 +1168,10 @@ class InferenceEngine:
         floors are gone) BEFORE re-raising — the error still classifies
         and fails over normally, but the engine itself stays
         serviceable for the next admission."""
-        self.pool.reset_rows()
+        if self._paged:
+            self.pool.reset_pages()
+        else:
+            self.pool.reset_rows()
         if self.draft_pool is not None:
             self.draft_pool.reset_rows()
         if self.prefix_cache is not None:
@@ -946,16 +1187,30 @@ class InferenceEngine:
                        requests=[h.request_id
                                  for h in self._slot_req.values()]):
             try:
-                toks_dev, new_pool = self._decode_jit(
-                    self._params, self._frozen, self._buffers,
-                    self.pool.cache, self._tok, self._pos, self._steps,
-                    self._active, self._temp, self._topk, self._topp,
-                    self._greedy, self._keys)
+                if self._paged:
+                    pages, scales = self.pool.device_state()
+                    table = call_with_retry(
+                        _to_device, self.pool.page_table,
+                        policy=self._retry, site='serving.h2d')
+                    toks_dev, new_pages, new_scales = self._decode_jit(
+                        self._params, self._frozen, self._buffers,
+                        pages, scales, table, self._tok, self._pos,
+                        self._steps, self._active, self._temp,
+                        self._topk, self._topp, self._greedy,
+                        self._keys)
+                    self.pool.set_device_state(new_pages, new_scales)
+                else:
+                    toks_dev, new_pool = self._decode_jit(
+                        self._params, self._frozen, self._buffers,
+                        self.pool.cache, self._tok, self._pos,
+                        self._steps, self._active, self._temp,
+                        self._topk, self._topp, self._greedy,
+                        self._keys)
+                    self.pool.cache = new_pool
             except Exception:
                 if self._donate_pool:
                     self._recover_pool()
                 raise
-            self.pool.cache = new_pool
             toks = call_with_retry(_from_device, toks_dev,
                                    policy=self._retry, site='serving.d2h')
         _obs.note_progress('decode')   # /healthz decode liveness beat
@@ -974,19 +1229,35 @@ class InferenceEngine:
                        requests=[h.request_id
                                  for h in self._slot_req.values()]):
             try:
-                toks_dev, counts_dev, new_pool, new_d_pool = \
-                    self._spec_jit(
+                if self._paged:
+                    pages, scales = self.pool.device_state()
+                    table = call_with_retry(
+                        _to_device, self.pool.page_table,
+                        policy=self._retry, site='serving.h2d')
+                    (toks_dev, counts_dev, new_pages, new_scales,
+                     new_d_pool) = self._spec_jit(
                         self._params, self._frozen, self._buffers,
-                        self.pool.cache, d_params, d_frozen, d_buffers,
-                        self.draft_pool.cache, self._tok, self._pos,
-                        self._steps, self._active, self._temp,
-                        self._topk, self._topp, self._greedy,
-                        self._keys, self._eos_arr)
+                        pages, scales, table, d_params, d_frozen,
+                        d_buffers, self.draft_pool.cache, self._tok,
+                        self._pos, self._steps, self._active,
+                        self._temp, self._topk, self._topp,
+                        self._greedy, self._keys, self._eos_arr)
+                    self.pool.set_device_state(new_pages, new_scales)
+                else:
+                    toks_dev, counts_dev, new_pool, new_d_pool = \
+                        self._spec_jit(
+                            self._params, self._frozen, self._buffers,
+                            self.pool.cache, d_params, d_frozen,
+                            d_buffers, self.draft_pool.cache,
+                            self._tok, self._pos, self._steps,
+                            self._active, self._temp, self._topk,
+                            self._topp, self._greedy, self._keys,
+                            self._eos_arr)
+                    self.pool.cache = new_pool
             except Exception:
                 if self._donate_pool:
                     self._recover_pool()
                 raise
-            self.pool.cache = new_pool
             self.draft_pool.cache = new_d_pool
             toks = call_with_retry(_from_device, toks_dev,
                                    policy=self._retry, site='serving.d2h')
@@ -1040,15 +1311,18 @@ class InferenceEngine:
         return self.pool.bucket_for(prompt_len)
 
     def _effective_free(self) -> int:
-        """Slots admissible right now: free-list + zero-ref cached
-        prefixes the pool can reclaim on demand."""
+        """Slots admissible right now: free-list + (row mode) zero-ref
+        cached prefixes the pool can reclaim on demand. Paged retention
+        pins PAGES, not slots, so there the free list is the truth —
+        page pressure surfaces at reservation and requeues."""
         free = self.pool.free_count
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not self._paged:
             free += self.prefix_cache.reclaimable_count
         return free
 
     def _alloc_slot(self) -> int:
-        if self.pool.free_count == 0 and self.prefix_cache is not None:
+        if (not self._paged and self.pool.free_count == 0
+                and self.prefix_cache is not None):
             # pool pressure: retained prefixes yield to live requests
             self.prefix_cache.evict_lru()
         return self.pool.alloc()
@@ -1070,6 +1344,20 @@ class InferenceEngine:
                 break
             try:
                 self._begin_request(slot, h)
+            except PagePoolExhausted as exc:
+                # paged admission could not reserve its pages even after
+                # reclaiming retention: NOT a failure — free the slot
+                # (returning whatever was attached) and send this handle
+                # and everything behind it back to the queue front; the
+                # pages free up as in-flight requests retire
+                self.pool.free(slot)
+                _obs.emit('page_pool_exhausted',
+                          request_id=h.request_id,
+                          queued=self.scheduler.queue_depth,
+                          detail=str(exc))
+                for back in reversed(admitted[idx:]):
+                    self.scheduler.requeue(back)
+                break
             except Exception as exc:
                 # REQUEST-level failure: free the slot, fail the handle,
                 # keep the engine serving everyone else
@@ -1086,12 +1374,93 @@ class InferenceEngine:
         if _obs.enabled():
             self._m_active.set(len(self._slot_req))
 
+    def _seat_paged(self, slot: int, h: RequestHandle, s: int):
+        """Page-table admission, BEFORE any handle/engine bookkeeping:
+        attach the longest PAGE-ALIGNED cached prefix read-only, then
+        reserve every page the request can touch (prompt + token budget
+        + speculation headroom) all-or-nothing, reclaiming zero-ref
+        retained holds under pressure. Raises PagePoolExhausted with the
+        handle untouched — still QUEUED — so `_admit` can requeue it.
+        Returns (node, cursor): cursor is the page-aligned prefix rows
+        already seated (suffix prefill starts there, in fresh pages)."""
+        ps = self.pool.page_size
+        node, cursor = None, 0
+        if self.prefix_cache is not None:
+            node, matched = self.prefix_cache.lookup(h.prompt_tokens)
+            if node is not None:
+                # whole pages only: the suffix [cursor, s) prefills
+                # into FRESH exclusive pages, so a shared page is never
+                # in any suffix/decode scatter window
+                cursor = (min(matched, node.slot.kv_len) // ps) * ps
+                if cursor < 1:
+                    node = None
+                else:
+                    self.prefix_cache.acquire(node)
+        try:
+            if node is not None:
+                self.pool.attach_prefix(slot, node.slot, cursor // ps)
+            headroom = (self.spec_k if self.draft_model is not None
+                        else 0)
+            self._reserve_pages(
+                slot, min(s + h.params.max_new_tokens + headroom,
+                          self.pool.max_length))
+            if cursor >= s:
+                # full-page hit: the pending-token re-forward at s-1
+                # writes INTO the last shared page — COW-split it first
+                while True:
+                    try:
+                        if self.pool.ensure_exclusive(slot, s - 1):
+                            _obs.emit('paged_cow', slot=slot,
+                                      request_id=h.request_id, pos=s - 1)
+                        break
+                    except PagePoolExhausted:
+                        if self.prefix_cache is None or \
+                                not self.prefix_cache.evict_lru():
+                            raise
+        except PagePoolExhausted:
+            if node is not None:
+                self.prefix_cache.release(node)
+            raise
+        return node, cursor
+
+    def _reserve_pages(self, slot: int, total: int):
+        """`PagedSlotPool.reserve` with pressure relief: zero-ref
+        retained prefix holds yield their pages to live admissions,
+        LRU-first, until the reservation fits or nothing is left."""
+        while True:
+            try:
+                self.pool.reserve(slot, total)
+                return
+            except PagePoolExhausted:
+                if self.prefix_cache is None or \
+                        not self.prefix_cache.evict_lru():
+                    raise
+
     def _begin_request(self, slot: int, h: RequestHandle):
-        """Admission: claim the longest cached prefix (jitted row copy,
-        suffix-only prefill), then either whole-prompt prefill (short
-        cold prompts — the PR-4 path, one compile per bucket) or enter
-        the chunked-prefill state machine."""
+        """Admission: claim the longest cached prefix (row mode: jitted
+        row copy + suffix-only prefill; paged mode: read-only page
+        attach + page reservation), then either whole-prompt prefill
+        (short cold prompts — the PR-4 path, one compile per bucket) or
+        enter the chunked-prefill state machine."""
         s = len(h.prompt_tokens)
+        cursor = 0
+        src = slot
+        node = None
+        if self._paged:
+            # seating raises PagePoolExhausted BEFORE any bookkeeping:
+            # the handle stays queueable for the requeue path
+            node, cursor = self._seat_paged(slot, h, s)
+            if node is not None:
+                h._prefix_node = node
+                h._prefix_len = cursor
+        elif self.prefix_cache is not None:
+            node, matched = self.prefix_cache.lookup(h.prompt_tokens)
+            if node is not None:
+                self.prefix_cache.acquire(node)
+                h._prefix_node = node
+                h._prefix_len = matched
+                cursor = matched
+                src = node.slot
         if h._queue_span is not None:
             h._queue_span.end()   # admission closes the queue span
             h._queue_span = None
@@ -1101,22 +1470,16 @@ class InferenceEngine:
         # swap requires a drained engine, so every token this request
         # emits decodes under this version
         h.weight_version = self.weight_version
-        cursor = 0
-        src = slot
-        if self.prefix_cache is not None:
-            node, matched = self.prefix_cache.lookup(h.prompt_tokens)
-            if node is not None:
-                self.prefix_cache.acquire(node)
-                h._prefix_node = node
-                h._prefix_len = matched
-                cursor = matched
-                src = node.slot
-                _obs.emit('prefix_hit', request_id=h.request_id,
-                          matched=matched, prompt_len=s, slot=slot)
+        if node is not None:
+            _obs.emit('prefix_hit', request_id=h.request_id,
+                      matched=h._prefix_len, prompt_len=s, slot=slot)
         if cursor >= s:
-            # full-prompt hit: ZERO prefill — copy the retained row and
-            # let the pending token re-forward the last prompt position
-            self.pool.copy_slot(src, slot)
+            # full-prompt hit: ZERO prefill — row mode copies the
+            # retained row; paged mode already shares the pages — then
+            # the pending token re-forwards the last prompt position
+            if not self._paged:
+                self.pool.copy_slot(src, slot)
+            self.pool.note_written(slot, s)
             self._activate(slot, h)
             return
         chunk = self.prefill_chunk_tokens
@@ -1145,9 +1508,20 @@ class InferenceEngine:
             ids[0, :s] = h.prompt_tokens
             ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
                                       site='serving.h2d')
-            # row in, row out: the undonated copy surface is pool/N
-            self.pool.set_row(slot, self._prefill_jit(
-                self._params, self._frozen, self._buffers, ids_dev))
+            if self._paged:
+                pages, scales = self.pool.device_state()
+                table = call_with_retry(
+                    _to_device, self.pool.page_table[slot:slot + 1],
+                    policy=self._retry, site='serving.h2d')
+                new_pages, new_scales = self._prefill_jit(
+                    self._params, self._frozen, self._buffers,
+                    pages, scales, table, ids_dev)
+                self.pool.set_device_state(new_pages, new_scales)
+            else:
+                # row in, row out: the undonated copy surface is pool/N
+                self.pool.set_row(slot, self._prefill_jit(
+                    self._params, self._frozen, self._buffers, ids_dev))
+        self.pool.note_written(slot, s)
         self._counts['prefills'] += 1
         self._counts['prefill_tokens'] += s
         if _obs.enabled():
@@ -1191,13 +1565,30 @@ class InferenceEngine:
             ids[0, :len(window)] = window
             ids_dev = call_with_retry(_to_device, ids, policy=self._retry,
                                       site='serving.h2d')
-            # forwards against the src ROW (the retained row on a
-            # prefix hit's first chunk, the slot's own row after);
-            # returns the slot's new row — one-row surface either way
-            self.pool.set_row(slot, self._chunk_prefill_jit(
-                self._params, self._frozen, self._buffers,
-                self.pool.row(src), ids_dev, jnp.int32(start)))
+            if self._paged:
+                # the slot's own table carries any attached prefix
+                # pages, so there is no src row: the chunk gathers,
+                # attends, and scatters through the table. The floor
+                # (the page-aligned attach boundary) keeps tail-shifted
+                # duplicate writes out of the shared pages.
+                pages, scales = self.pool.device_state()
+                table = call_with_retry(
+                    _to_device, self.pool.page_table[slot:slot + 1],
+                    policy=self._retry, site='serving.h2d')
+                new_pages, new_scales = self._chunk_prefill_jit(
+                    self._params, self._frozen, self._buffers,
+                    pages, scales, table, ids_dev, jnp.int32(start),
+                    jnp.int32(h._prefix_len))
+                self.pool.set_device_state(new_pages, new_scales)
+            else:
+                # forwards against the src ROW (the retained row on a
+                # prefix hit's first chunk, the slot's own row after);
+                # returns the slot's new row — one-row surface either way
+                self.pool.set_row(slot, self._chunk_prefill_jit(
+                    self._params, self._frozen, self._buffers,
+                    self.pool.row(src), ids_dev, jnp.int32(start)))
         new_cursor = min(start + bucket, s)
+        self.pool.note_written(slot, new_cursor)
         self._prefilling[slot][1] = new_cursor
         self._prefilling[slot][2] = slot   # later chunks extend own row
         self._counts['chunk_rounds'] += 1
@@ -1293,6 +1684,7 @@ class InferenceEngine:
             'active_slots': len(self._slot_req),
             'weight_version': self.weight_version,
             'donate_pool': self._donate_pool,
+            'kv_layout': 'paged' if self._paged else 'row',
             'traces': dict(self._trace_counts),
             'pool': self.pool.stats(),
         }

@@ -36,6 +36,26 @@ position, where the slot-causal decode mask hides it until the slot's
 own decode overwrites it — the same stale-slot argument as speculative
 decoding). Buckets bound the number of prefill compilations to
 O(len(buckets)), not O(distinct prompt lengths).
+
+Paged mode (ISSUE 16): `PagedSlotPool` is the finer-grained variant —
+the true PagedAttention layout under the same static-shape discipline.
+KV storage is ONE [num_pages, page_size, H_kv, D] buffer per layer
+leaf; a slot owns a page LIST (a row of the [num_slots,
+pages_per_slot] page table, host-side), pages come from a free list,
+and page id 0 is a reserved NULL page: unreserved table entries point
+at it, so out-of-range program writes land in junk that no mask ever
+attends. Sharing is per-PAGE with refcounts: the prefix cache pins a
+prefix's pages once (`hold_pages`) and every live request that hits it
+attaches the same page ids read-only (`attach_prefix`); the only
+write-into-shared-page case (a full-prompt hit re-forwarding its last
+token) is copy-on-write split via `ensure_exclusive`. The compiled
+programs see (pages, scales, table) and translate addresses with
+`gather_pages` / `scatter_pages` — gather reconstructs the SAME
+[N, max_length, H, D] contiguous view the row pool stacks, so the
+decode math (and greedy output) is bit-identical; scatter writes back
+only the pages overlapping the written span, so settled int8 pages are
+never requantized. Optional int8 storage keeps per-(page, head) absmax
+scales (quantization.kv_page_scales semantics) alongside the pages.
 """
 from __future__ import annotations
 
@@ -44,8 +64,23 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _tree = jax.tree_util
+
+
+class PromptTooLongError(ValueError):
+    """A prompt is longer than the largest prefill bucket (and therefore
+    than max_length). Subclasses ValueError so pre-ISSUE-16 callers that
+    caught ValueError keep working; typed so admission layers can
+    distinguish 'request can never fit' from other validation errors."""
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free KV pages for a reservation. Subclasses RuntimeError so it
+    rides the engine's existing requeue-on-exhaustion path: the request
+    is NOT failed — it goes back to the queue front and admission waits
+    for retirements (or prefix-cache evictions) to return pages."""
 
 
 def default_buckets(max_length: int, smallest: int = 8) -> Tuple[int, ...]:
@@ -84,6 +119,29 @@ def _leaf_bytes(tree) -> int:
                for leaf in _tree.tree_leaves(tree))
 
 
+def _normalize_buckets(buckets, max_length: int) -> Tuple[int, ...]:
+    out = tuple(sorted(set(
+        int(b) for b in (buckets or default_buckets(max_length))
+        if int(b) <= max_length)))
+    if not out:
+        raise ValueError('no prefill bucket <= max_length')
+    return out
+
+
+def _bucket_for(buckets: Tuple[int, ...], length: int,
+                max_length: int) -> int:
+    """Smallest bucket >= length; PromptTooLongError past the largest.
+    `bisect` over the sorted bucket tuple — this runs once per submit
+    AND once per scheduler admission pass, so it must not be a linear
+    scan of a long custom bucket list."""
+    i = bisect.bisect_left(buckets, length)
+    if i == len(buckets):
+        raise PromptTooLongError(
+            f'prompt length {length} exceeds the largest prefill '
+            f'bucket {buckets[-1]} (max_length {max_length})')
+    return buckets[i]
+
+
 class SlotPool:
     """Owns the per-slot KV rows + the slot free list.
 
@@ -111,12 +169,12 @@ class SlotPool:
                                            c.dtype), base)
         self.row_bytes = _leaf_bytes(self.rows[0])
         self.pool_bytes = self.row_bytes * self.num_slots
-        self.buckets = tuple(sorted(set(
-            int(b) for b in (buckets or default_buckets(self.max_length))
-            if int(b) <= self.max_length)))
-        if not self.buckets:
-            raise ValueError('no prefill bucket <= max_length')
+        self.buckets = _normalize_buckets(buckets, self.max_length)
         self._free = sorted(range(self.num_slots), reverse=True)
+        # per-slot high-water mark of WRITTEN rows (vs the max_length
+        # rows a slot always allocates) — the stranded-capacity figure
+        # the paged A/B reports utilization against
+        self._written = [0] * self.num_slots
         # chunked-prefill config rides the pool so stats()/debuggers see
         # the full prefill geometry in one place (the engine sets it)
         self.prefill_chunk_tokens: Optional[int] = None
@@ -153,20 +211,21 @@ class SlotPool:
             raise ValueError(f'slot {slot} is already free')
         self._free.append(slot)
         self._free.sort(reverse=True)
+        self._written[slot] = 0
+
+    def note_written(self, slot: int, rows) -> None:
+        """Record that `slot` holds live KV through row `rows` (the
+        engine calls this at prefill and after each decode round); the
+        high-water mark feeds the stranded-capacity stats."""
+        r = min(int(rows), self.max_length)
+        if r > self._written[slot]:
+            self._written[slot] = r
 
     # -- prefill bucketing -------------------------------------------------
     def bucket_for(self, length: int) -> int:
-        """Smallest bucket >= length; ValueError past the largest.
-        `bisect` over the sorted bucket tuple — this runs once per
-        submit AND once per scheduler admission pass, so it must not be
-        a linear scan of a long custom bucket list."""
-        i = bisect.bisect_left(self.buckets, length)
-        if i == len(self.buckets):
-            raise ValueError(
-                f'prompt length {length} exceeds the largest prefill '
-                f'bucket {self.buckets[-1]} (max_length '
-                f'{self.max_length})')
-        return self.buckets[i]
+        """Smallest bucket >= length; `PromptTooLongError` (a ValueError)
+        past the largest bucket."""
+        return _bucket_for(self.buckets, length, self.max_length)
 
     # -- the cache pytree (decode-facing view) -----------------------------
     @property
@@ -228,6 +287,27 @@ class SlotPool:
                            self.row_spec)
             for _ in range(self.num_slots)]
 
+    def _capacity_stats(self) -> dict:
+        """Allocated vs written rows over USED slots: the row pool
+        allocates max_length rows per seated request no matter how few
+        it writes, and `stranded_rows` is exactly that waste (the paged
+        A/B's honesty metric; ~0 for the paged pool by construction)."""
+        used = [s for s in range(self.num_slots) if s not in self._free]
+        allocated = sum(self.allocated_rows(s) for s in used)
+        written = sum(self._written[s] for s in used)
+        return {
+            'allocated_rows': allocated,
+            'written_rows': written,
+            'stranded_rows': allocated - written,
+            'row_utilization': written / allocated if allocated else 1.0,
+            'slot_written_rows': {s: self._written[s] for s in used},
+        }
+
+    def allocated_rows(self, slot: int) -> int:
+        """KV rows reserved for `slot` while seated (a whole row here;
+        the paged pool overrides with its page-granular figure)."""
+        return self.max_length
+
     def stats(self) -> dict:
         return {'num_slots': self.num_slots, 'max_length': self.max_length,
                 'used': self.used_count, 'free': self.free_count,
@@ -237,4 +317,430 @@ class SlotPool:
                 'pool_bytes': self.pool_bytes,
                 'row_writes': self._row_writes,
                 'row_copies': self._row_copies,
-                'copied_bytes': self._copied_bytes}
+                'copied_bytes': self._copied_bytes,
+                **self._capacity_stats()}
+
+
+# ---------------------------------------------------------------------------
+# paged pool (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def gather_pages(pages, table, scales=None, out_dtype=None):
+    """Address-translate the page pool into the decode-facing contiguous
+    view: leaves [num_pages, ps, H, D] indexed by `table` [N, P] become
+    [N, P*ps, H, D] = [N, max_length, H, D] — the SAME shape (and, for
+    the unquantized path, the same bits) the row pool's `stack_rows`
+    feeds the decode scan, so the attention math downstream is
+    bit-identical. With `scales` (per-(page, head) int8 scales, leaves
+    [num_pages, H]) the gather dequantizes in the same expression.
+    Traced inside every paged program."""
+    from ..quantization import kv_dequantize_page
+    n, p = table.shape
+
+    def g(leaf, s=None):
+        out = leaf[table]                       # [N, P, ps, H, D]
+        if s is not None:
+            out = kv_dequantize_page(out, s[table],
+                                     out_dtype or jnp.float32)
+        out = out.reshape(n, p * leaf.shape[1], *leaf.shape[2:])
+        return out if out_dtype is None else out.astype(out_dtype)
+
+    if scales is None:
+        return _tree.tree_map(g, pages)
+    return _tree.tree_map(g, pages, scales)
+
+
+def scatter_pages(pages, table, contig, start, length: int,
+                  page_size: int, scales=None, floor=None):
+    """Write the span [start, start+length) of the contiguous view back
+    into the page pool — ONLY the pages overlapping the span. `start` is
+    per-slot traced [N]; `length` is static, so the window count is
+    static: a length-L span can straddle at most (L+ps-2)//ps + 1 pages
+    at any alignment. Windows outside a slot's actual span are redirected
+    to the NULL page (id 0) so untouched pages are never rewritten —
+    which is what keeps settled int8 pages from requantization drift,
+    and makes the unquantized path an exact-value (bit-identical)
+    writeback. With `scales`, each touched page is (re)quantized at its
+    fresh per-(page, head) absmax scale. `floor` (traced [N], rows)
+    additionally redirects pages that end at or below it — the chunk
+    programs pass the prefix-attach boundary so a tail-shifted window
+    that re-forwards already-settled rows can never rewrite a SHARED
+    page. Returns (pages, scales)."""
+    from ..quantization import kv_page_scales, kv_quantize_page
+    n, p = table.shape
+    first = start // page_size                  # [N]
+    nwin = (length + page_size - 2) // page_size + 1
+
+    def upd(leaf, s_leaf, cont):
+        for w in range(nwin):
+            idx = jnp.clip(first + w, 0, p - 1)             # [N]
+            pid = jnp.take_along_axis(table, idx[:, None], 1)[:, 0]
+            touched = ((idx * page_size < start + length)
+                       & ((idx + 1) * page_size > start))
+            if floor is not None:
+                touched &= (idx + 1) * page_size > floor
+            pid = jnp.where(touched, pid, 0)    # junk -> null page
+            sl = jax.vmap(
+                lambda c, i: jax.lax.dynamic_slice_in_dim(
+                    c, i * page_size, page_size, axis=0))(
+                        cont, idx)              # [N, ps, H, D]
+            if s_leaf is not None:
+                sc = kv_page_scales(sl)
+                leaf = leaf.at[pid].set(kv_quantize_page(sl, sc))
+                s_leaf = s_leaf.at[pid].set(sc)
+            else:
+                leaf = leaf.at[pid].set(sl.astype(leaf.dtype))
+        return leaf, s_leaf
+
+    if scales is None:
+        out = _tree.tree_map(lambda lf, ct: upd(lf, None, ct)[0],
+                             pages, contig)
+        return out, None
+    flat_p, treedef = _tree.tree_flatten(pages)
+    flat_s = treedef.flatten_up_to(scales)
+    flat_c = treedef.flatten_up_to(contig)
+    new_p, new_s = [], []
+    for lf, s, ct in zip(flat_p, flat_s, flat_c):
+        a, b = upd(lf, s, ct)
+        new_p.append(a)
+        new_s.append(b)
+    return (_tree.tree_unflatten(treedef, new_p),
+            _tree.tree_unflatten(treedef, new_s))
+
+
+class PageHold:
+    """A reference-counted pin on a set of pages (the prefix cache's
+    retained resource in paged mode): the first `kv_len` rows across
+    `pages` are a prompt prefix's prefill KV. Created by
+    `PagedSlotPool.hold_pages`, released by `release_hold` — the pages
+    survive the originating slot's free for as long as the hold lives."""
+
+    __slots__ = ('pages', 'kv_len', 'released')
+
+    def __init__(self, pages: Tuple[int, ...], kv_len: int):
+        self.pages = tuple(int(p) for p in pages)
+        self.kv_len = int(kv_len)
+        self.released = False
+
+    def __len__(self):
+        return len(self.pages)
+
+    def __repr__(self):
+        return (f'PageHold(pages={len(self.pages)}, kv_len={self.kv_len}'
+                f'{", released" if self.released else ""})')
+
+
+class PagedSlotPool:
+    """Page-table KV pool: fixed-size pages, per-slot page lists,
+    free-list allocation, copy-on-write refcounts (vLLM's PagedAttention
+    memory manager under TPU static shapes).
+
+    Storage is `model.init_cache(num_pages, page_size)` — per-layer
+    (K, V) leaves [num_pages, page_size, H_kv, D] — so any model
+    honoring the init_cache contract pools unchanged. Page id 0 is the
+    reserved NULL page (junk sink for out-of-span program writes; never
+    allocated, never attended unmasked). With `quant='int8'` the pages
+    are int8 with per-(page, head) float32 absmax scales; gather
+    dequantizes, scatter requantizes touched pages only.
+
+    Admission is reservation-based: `reserve(slot, total_len)` claims
+    every page the request can touch (prompt + new tokens + speculation
+    headroom) up front, so a seated request can never die of page
+    exhaustion mid-decode — exhaustion surfaces at admission as
+    `PagePoolExhausted` and the engine requeues.
+    """
+
+    def __init__(self, model, num_slots: int, max_length: int,
+                 dtype=None, buckets: Optional[Sequence[int]] = None,
+                 *, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 quant: Optional[str] = None):
+        if num_slots < 1:
+            raise ValueError('num_slots must be >= 1')
+        if max_length < 2:
+            raise ValueError('max_length must be >= 2')
+        if page_size < 1:
+            raise ValueError('page_size must be >= 1')
+        if max_length % page_size != 0:
+            raise ValueError(
+                f'max_length {max_length} must be a multiple of '
+                f'page_size {page_size} (the page table is dense)')
+        if quant not in (None, 'int8'):
+            raise ValueError(f"kv quant mode {quant!r} not supported "
+                             f"(None or 'int8')")
+        self.num_slots = int(num_slots)
+        self.max_length = int(max_length)
+        self.page_size = int(page_size)
+        self.pages_per_slot = self.max_length // self.page_size
+        # +1: page 0 is the null page — a full-capacity default budget
+        # still seats num_slots max-length requests
+        self.num_pages = int(num_pages) if num_pages is not None else \
+            self.num_slots * self.pages_per_slot + 1
+        if self.num_pages < self.pages_per_slot + 1:
+            raise ValueError(
+                f'num_pages {self.num_pages} cannot seat even one '
+                f'max-length request ({self.pages_per_slot} pages + '
+                f'the null page)')
+        self.quant = quant
+        base = model.init_cache(self.num_pages, self.page_size, dtype)
+        for leaf in _tree.tree_leaves(base):
+            if leaf.ndim != 4:
+                raise ValueError(
+                    'PagedSlotPool requires [B, L, H, D] KV leaves, got '
+                    f'shape {tuple(leaf.shape)}')
+        self.compute_dtype = _tree.tree_leaves(base)[0].dtype
+        if quant == 'int8':
+            self.pages = _tree.tree_map(
+                lambda c: jnp.zeros(c.shape, jnp.int8), base)
+            self.scales = _tree.tree_map(
+                lambda c: jnp.ones((c.shape[0], c.shape[2]),
+                                   jnp.float32), base)
+        else:
+            self.pages = base
+            self.scales = None
+        # the row-shaped spec the (reused) whole-prefill program fills
+        self.row_spec = _tree.tree_map(
+            lambda c: jax.ShapeDtypeStruct(
+                (1, self.max_length) + tuple(c.shape[2:]),
+                self.compute_dtype), base)
+        self.page_bytes = _leaf_bytes(
+            _tree.tree_map(lambda c: c[:1], self.pages))
+        self.row_bytes = self.page_bytes * self.pages_per_slot
+        self.pool_bytes = _leaf_bytes(self.pages) + \
+            (_leaf_bytes(self.scales) if self.scales is not None else 0)
+        self.buckets = _normalize_buckets(buckets, self.max_length)
+        self.prefill_chunk_tokens: Optional[int] = None
+        # host-side address map + refcounts: entry 0 = unreserved/null
+        self.page_table = np.zeros(
+            (self.num_slots, self.pages_per_slot), np.int32)
+        self._page_refs = np.zeros(self.num_pages, np.int64)
+        self._page_refs[0] = 1                  # null page: never freed
+        self._free_pages: List[int] = list(
+            range(self.num_pages - 1, 0, -1))
+        self._free = sorted(range(self.num_slots), reverse=True)
+        self._written = [0] * self.num_slots
+        self._cow_splits = 0
+        self._holds_live = 0
+
+    # -- slot lifecycle ----------------------------------------------------
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.used_count / self.num_slots
+
+    @property
+    def free_page_count(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def used_page_count(self) -> int:
+        return self.num_pages - 1 - len(self._free_pages)
+
+    def pages_for(self, length: int) -> int:
+        """Pages covering `length` KV rows (ceil division)."""
+        return -(-int(length) // self.page_size)
+
+    def alloc(self) -> int:
+        """Claim the lowest free slot index; raises when full. Pages are
+        reserved SEPARATELY (`reserve`) — a slot is just the decode-row
+        index, which is host bookkeeping, not HBM."""
+        if not self._free:
+            raise RuntimeError('slot pool exhausted')
+        return self._free.pop()
+
+    def free(self, slot: int):
+        """Release the slot AND its page references: exclusive pages
+        return to the free list immediately; shared pages (a live
+        PageHold or a sibling request's attach) survive at refs >= 1."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f'slot {slot} out of range')
+        if slot in self._free:
+            raise ValueError(f'slot {slot} is already free')
+        for pid in self.page_table[slot]:
+            self._decref(int(pid))
+        self.page_table[slot] = 0
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+        self._written[slot] = 0
+
+    def _decref(self, pid: int):
+        if pid == 0:
+            return
+        self._page_refs[pid] -= 1
+        if self._page_refs[pid] < 0:
+            raise RuntimeError(f'page {pid} freed more than referenced')
+        if self._page_refs[pid] == 0:
+            self._free_pages.append(pid)
+
+    def _incref(self, pid: int):
+        if pid != 0:
+            self._page_refs[pid] += 1
+
+    # -- page lifecycle ----------------------------------------------------
+    def reserve(self, slot: int, total_len: int):
+        """Ensure `slot`'s table covers [0, total_len): allocate a fresh
+        exclusive page for every still-null entry in range. All-or-
+        nothing: raises PagePoolExhausted (allocating nothing) when the
+        free list cannot cover the need, so admission can requeue
+        without partial-state cleanup."""
+        if total_len > self.max_length:
+            raise ValueError(
+                f'reservation {total_len} exceeds max_length '
+                f'{self.max_length}')
+        npages = self.pages_for(total_len)
+        missing = [i for i in range(npages)
+                   if self.page_table[slot, i] == 0]
+        if len(missing) > len(self._free_pages):
+            raise PagePoolExhausted(
+                f'need {len(missing)} KV pages, {len(self._free_pages)} '
+                f'free (of {self.num_pages - 1})')
+        for i in missing:
+            pid = self._free_pages.pop()
+            self._page_refs[pid] = 1
+            self.page_table[slot, i] = pid
+
+    def attach_prefix(self, slot: int, hold: PageHold, npages: int):
+        """Map the first `npages` of a retained prefix hold into
+        `slot`'s table READ-ONLY (refcount shared). The engine only
+        attaches whole pages and prefills/decodes strictly above them —
+        except the full-hit pending re-forward, which must
+        `ensure_exclusive` first."""
+        if hold.released:
+            raise RuntimeError('attach_prefix on a released PageHold')
+        if npages > len(hold.pages):
+            raise ValueError(
+                f'attach of {npages} pages exceeds the hold '
+                f'({len(hold.pages)})')
+        for i in range(npages):
+            if self.page_table[slot, i] != 0:
+                raise RuntimeError(
+                    f'slot {slot} table entry {i} already mapped')
+            pid = hold.pages[i]
+            self._incref(pid)
+            self.page_table[slot, i] = pid
+
+    def ensure_exclusive(self, slot: int, pos: int) -> bool:
+        """Copy-on-write split: if the page holding row `pos` of `slot`
+        is shared (refs > 1), copy it to a fresh page and repoint the
+        table — writes at `pos` then never touch the shared original.
+        Returns True when a split happened. Raises PagePoolExhausted
+        when no page is free for the copy."""
+        i = int(pos) // self.page_size
+        pid = int(self.page_table[slot, i])  # paddle-lint: disable=host-sync -- page_table is host numpy (the address map never leaves the host)
+        if pid == 0:
+            raise RuntimeError(
+                f'ensure_exclusive on unreserved page {i} of slot {slot}')
+        if self._page_refs[pid] <= 1:
+            return False
+        if not self._free_pages:
+            raise PagePoolExhausted(
+                'no free page for a copy-on-write split')
+        npid = self._free_pages.pop()
+        # one-page device copy (the entire COW surface)
+        self.pages = _tree.tree_map(
+            lambda c: c.at[npid].set(c[pid]), self.pages)
+        if self.scales is not None:
+            self.scales = _tree.tree_map(
+                lambda s: s.at[npid].set(s[pid]), self.scales)
+        self._page_refs[npid] = 1
+        self.page_table[slot, i] = npid
+        self._decref(pid)
+        self._cow_splits += 1
+        return True
+
+    def hold_pages(self, slot: int, kv_len: int) -> Optional[PageHold]:
+        """Pin the FULL pages covering `slot`'s first `kv_len` rows as a
+        PageHold (the prefix cache's retention primitive). Only whole
+        pages are held — a trailing partial page is left to the slot
+        (its rows above the last full page re-prefill on a hit, which
+        is what keeps suffix writes out of shared pages). None when no
+        full page is covered."""
+        npages = int(kv_len) // self.page_size
+        if npages < 1:
+            return None
+        pids = [int(p) for p in self.page_table[slot, :npages]]
+        if any(p == 0 for p in pids):
+            raise RuntimeError(
+                f'hold_pages: slot {slot} has unreserved pages below '
+                f'kv_len {kv_len}')
+        for pid in pids:
+            self._incref(pid)
+        self._holds_live += 1
+        return PageHold(tuple(pids), npages * self.page_size)
+
+    def release_hold(self, hold: PageHold):
+        if hold.released:
+            raise RuntimeError('PageHold released twice')
+        hold.released = True
+        for pid in hold.pages:
+            self._decref(pid)
+        self._holds_live -= 1
+
+    def note_written(self, slot: int, rows) -> None:
+        r = min(int(rows), self.max_length)
+        if r > self._written[slot]:
+            self._written[slot] = r
+
+    def allocated_rows(self, slot: int) -> int:
+        """Rows actually reserved for `slot` = mapped pages * page_size
+        (the page-granular figure the row pool cannot offer)."""
+        # paddle-lint: disable-next=host-sync -- page_table is host numpy, no device read
+        return int(np.count_nonzero(self.page_table[slot])) \
+            * self.page_size
+
+    def bucket_for(self, length: int) -> int:
+        """Smallest bucket >= length; `PromptTooLongError` (a ValueError)
+        past the largest bucket."""
+        return _bucket_for(self.buckets, length, self.max_length)
+
+    # -- device state ------------------------------------------------------
+    def device_state(self) -> Tuple[Any, Any]:
+        """(pages, scales) as the compiled programs take them — scales
+        is an EMPTY pytree when unquantized so every program signature
+        is mode-stable."""
+        return self.pages, (self.scales if self.scales is not None
+                            else ())
+
+    def set_device_state(self, pages, scales):
+        self.pages = pages
+        if self.scales is not None:
+            self.scales = scales
+
+    def reset_pages(self):
+        """Re-zero the page storage (fresh buffers) WITHOUT touching the
+        table/refcount bookkeeping: the donation-failure recovery path —
+        a donated paged program dying mid-call may have invalidated the
+        page buffers, and the in-flight requests are about to fail
+        through the normal error path, which frees their mappings."""
+        self.pages = _tree.tree_map(
+            lambda c: jnp.zeros(c.shape, c.dtype), self.pages)
+        if self.scales is not None:
+            self.scales = _tree.tree_map(
+                lambda s: jnp.ones(s.shape, s.dtype), self.scales)
+
+    def stats(self) -> dict:
+        return {'num_slots': self.num_slots,
+                'max_length': self.max_length,
+                'used': self.used_count, 'free': self.free_count,
+                'page_size': self.page_size,
+                'num_pages': self.num_pages,
+                'pages_per_slot': self.pages_per_slot,
+                'free_pages': len(self._free_pages),
+                'used_pages': self.used_page_count,
+                'shared_pages': int(np.sum(self._page_refs[1:] > 1)),  # paddle-lint: disable=host-sync -- _page_refs is host numpy bookkeeping
+                'holds_live': self._holds_live,
+                'cow_splits': self._cow_splits,
+                'kv_quant': self.quant,
+                'buckets': list(self.buckets),
+                'prefill_chunk_tokens': self.prefill_chunk_tokens,
+                'page_bytes': self.page_bytes,
+                'row_bytes': self.row_bytes,
+                'pool_bytes': self.pool_bytes,
+                **SlotPool._capacity_stats(self)}

@@ -259,6 +259,28 @@ class RadixPrefixCache:
             raise RuntimeError('prefix node released more than acquired')
         node.refs -= 1
 
+    # -- resource hooks (row-slot vs paged-hold retention) ------------------
+    def _release_entry(self, resource) -> None:
+        """Return a retained resource to the pool. Base: the resource IS
+        a slot index. PagedPrefixCache overrides with release_hold."""
+        self.pool.free(resource)
+
+    def _entry_repr(self, resource) -> int:
+        """JSON-safe scalar for events/snapshots (`slot` fields). Base:
+        the slot index itself; paged entries report -1 (they retain
+        pages, not a slot)."""
+        return int(resource)
+
+    def _entry_pages(self, resource) -> int:
+        """Pages pinned by a retained resource (0 in row mode — budget
+        accounting there is per-slot)."""
+        return 0
+
+    def _needs_eviction(self, incoming) -> bool:
+        """True while adopting `incoming` would leave retention over
+        budget. Base budget: retained SLOTS."""
+        return len(self._owners) >= self.budget_slots
+
     # -- insertion ----------------------------------------------------------
     def insert(self, tokens, slot: int) -> bool:
         """Retain `slot` (whose rows [0, len(tokens)) hold the prefill KV
@@ -266,8 +288,16 @@ class RadixPrefixCache:
         ADOPTED the slot — the caller must NOT free it — and False when
         the caller keeps it (already covered / under min_tokens / budget
         exhausted by pinned entries)."""
+        if self.budget_slots < 1:
+            return False
+        return self._insert_resource(tokens, int(slot))
+
+    def _insert_resource(self, tokens, resource) -> bool:
+        """The trie half of insert: walk/split to the prompt's node and
+        adopt `resource` as its retained entry. Shared by row mode
+        (resource = slot index) and paged mode (resource = PageHold)."""
         tokens = list(tokens)
-        if len(tokens) < self.min_tokens or self.budget_slots < 1:
+        if len(tokens) < self.min_tokens:
             return False
         node, depth = self._root, 0
         while depth < len(tokens):
@@ -303,10 +333,10 @@ class RadixPrefixCache:
             # prefix: refresh it rather than spending a second slot
             self._touch(covering)
             return False
-        while len(self._owners) >= self.budget_slots:
+        while self._needs_eviction(resource):
             if not self.evict_lru():
                 return False        # everything is pinned
-        node.slot = int(slot)
+        node.slot = resource
         node.kv_len = len(tokens)
         node.version = self.version
         self._owners.add(node)
@@ -323,8 +353,8 @@ class RadixPrefixCache:
         """Free `victim`'s retained slot back into the pool and drop it
         from the owner set. `prune=False` keeps the (now structural)
         node in the trie — the insert path re-owns it in place."""
-        slot, kv_len = victim.slot, victim.kv_len
-        self.pool.free(victim.slot)
+        slot, kv_len = self._entry_repr(victim.slot), victim.kv_len
+        self._release_entry(victim.slot)
         victim.slot = None
         victim.kv_len = 0
         self._owners.discard(victim)
@@ -401,8 +431,80 @@ class RadixPrefixCache:
         return {
             **self.stats(),
             'entries': sorted(
-                ({'kv_len': n.kv_len, 'slot': n.slot, 'refs': n.refs,
+                ({'kv_len': n.kv_len, 'slot': self._entry_repr(n.slot),
+                  'refs': n.refs, 'pages': self._entry_pages(n.slot),
                   'last_use': n.last_use, 'version': n.version}
                  for n in self._owners),
                 key=lambda e: -e['last_use']),
         }
+
+
+class PagedPrefixCache(RadixPrefixCache):
+    """Radix prefix cache over a `PagedSlotPool`: retention pins PAGES,
+    not slots — the tentpole difference. At retirement the cache takes a
+    `PageHold` over the prompt's full pages and the SLOT always goes
+    back to the pool (insert never adopts it); on a hit the engine
+    attaches the held page ids into the new request's page table
+    read-only, so a shared system prompt costs its pages ONCE across
+    every live request plus the cache — vs once per retained slot in row
+    mode. Budget is counted in PAGES (`fraction * num_pages`); eviction
+    stays LRU-over-zero-ref with stale-version preference, and releasing
+    a hold returns its pages straight to the pool free list."""
+
+    def __init__(self, pool, fraction: float = 0.5, min_tokens: int = 1):
+        super().__init__(pool, fraction, min_tokens)
+        # pages, not slots: leave at least one slot's worth for decode
+        self.budget_pages = min(
+            int(fraction * (pool.num_pages - 1)),
+            pool.num_pages - 1 - pool.pages_per_slot)
+        self._held_pages = 0
+
+    # -- resource hooks ----------------------------------------------------
+    def _release_entry(self, resource) -> None:
+        self._held_pages -= len(resource.pages)
+        self.pool.release_hold(resource)
+
+    def _entry_repr(self, resource) -> int:
+        return -1                      # pages retained, no slot
+
+    def _entry_pages(self, resource) -> int:
+        return len(resource.pages)
+
+    def _needs_eviction(self, incoming) -> bool:
+        return (self._held_pages + len(incoming.pages)
+                > self.budget_pages)
+
+    @property
+    def held_pages(self) -> int:
+        return self._held_pages
+
+    @property
+    def reclaimable_pages(self) -> int:
+        """Pages in zero-ref holds (releasable on pool pressure)."""
+        return sum(len(n.slot.pages) for n in self._owners
+                   if n.refs == 0)
+
+    def insert(self, tokens, slot: int) -> bool:
+        """Pin the prompt's full pages as a PageHold and retain that.
+        ALWAYS returns False: the slot itself is never adopted — the
+        engine frees it, and the held pages survive the free at
+        refs >= 1."""
+        tokens = list(tokens)
+        if len(tokens) < self.min_tokens or self.budget_pages < 1:
+            return False
+        hold = self.pool.hold_pages(slot, len(tokens))
+        if hold is None:               # no full page covered
+            return False
+        adopted = self._insert_resource(tokens, hold)
+        if adopted:
+            self._held_pages += len(hold.pages)
+        else:
+            self.pool.release_hold(hold)
+        return False
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(budget_pages=self.budget_pages,
+                   held_pages=self._held_pages,
+                   reclaimable_pages=self.reclaimable_pages)
+        return out

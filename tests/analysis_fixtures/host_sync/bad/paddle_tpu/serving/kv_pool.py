@@ -1,0 +1,31 @@
+"""True-positive fixtures for host-sync over the page-manager scope
+(parsed only, never imported). The file path mirrors the real
+hot-scope config (`paddle_tpu/serving/kv_pool.py` + the
+`PagedSlotPool.` prefix): reserve/attach/COW run on every admission
+and note_written on every decode round, so an unannotated device read
+here stalls every round."""
+import numpy as np
+import jax
+
+
+class PagedSlotPool:
+    def reserve(self, slot, total_len):
+        # snippet 1: materializing a device page to "check" it is a
+        # full d2h copy per admission
+        page = np.asarray(self.pages[0][0][self.page_table[slot, 0]])
+        return page.sum()
+
+    def ensure_exclusive(self, slot, pos):
+        # snippet 2: per-element device read on the COW decision path
+        ref = int(self.refs_device[pos])
+        # snippet 3: blocking on the copy defeats async dispatch
+        self.pages[0][0].block_until_ready()
+        return ref > 1
+
+    def note_written(self, slot, rows):
+        # snippet 4: .item() per decode round
+        self._written[slot] = rows.item()
+
+    def device_state(self):
+        # snippet 5: device_get is a sync however it is spelled
+        return jax.device_get(self.pages)

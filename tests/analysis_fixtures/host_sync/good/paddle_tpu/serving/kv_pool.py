@@ -1,0 +1,33 @@
+"""True-negative fixtures for host-sync over the page-manager scope:
+host-numpy bookkeeping, annotated syncs, and syncs outside the
+configured scope prefix."""
+import numpy as np
+
+
+class PagedSlotPool:
+    def reserve(self, slot, total_len):
+        # snippet 1: the page table is HOST numpy — indexing it never
+        # touches the device
+        missing = [i for i in range(4) if self.page_table[slot, i] == 0]
+        return len(missing)
+
+    def free(self, slot):
+        # snippet 2: plain python free-list bookkeeping is not a sync
+        self._free.append(int(slot))
+        self._free.sort(reverse=True)
+
+    def stats(self):
+        # snippet 3: the SAME host-numpy element read, annotated
+        shared = int(np.sum(self._page_refs[1:] > 1))  # paddle-lint: disable=host-sync -- _page_refs is host numpy bookkeeping
+        return {'shared_pages': shared}
+
+
+class SlotPool:
+    def copy_slot(self, src, dst):
+        # snippet 4: NOT in the PagedSlotPool. scope prefix
+        return np.asarray(self.rows[src])
+
+
+def _leaf_bytes(tree):
+    # snippet 5: module-level helper, outside every scope prefix
+    return sum(np.asarray(leaf).nbytes for leaf in tree)

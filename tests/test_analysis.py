@@ -113,6 +113,8 @@ FIXTURE_SPECS = [
      'host_sync/good/paddle_tpu/serving/hotswap.py'),
     ('host-sync', 'host_sync/bad/paddle_tpu/serving/autoscaler.py',
      'host_sync/good/paddle_tpu/serving/autoscaler.py'),
+    ('host-sync', 'host_sync/bad/paddle_tpu/serving/kv_pool.py',
+     'host_sync/good/paddle_tpu/serving/kv_pool.py'),
     ('falsy-guard', 'falsy_guard/bad_falsy_or.py',
      'falsy_guard/good_is_none.py'),
     ('lock-order', 'lock_order/bad_locks.py', 'lock_order/good_locks.py'),
