@@ -663,6 +663,90 @@ def _phase_obs():
     return out
 
 
+def fleet_obs_overhead_ab(steps=30, trials=3, interval_s=0.1):
+    """Fleet-shipper on/off A/B (also imported by the tier-1 <3%
+    overhead guard): the instrumented eager MLP loop with a background
+    Shipper spooling registry deltas + event segments at `interval_s`
+    vs the same loop unshipped. The shipper never touches the hot path
+    — it snapshots on its own daemon thread — so the cost is registry
+    lock contention during snapshots, which this pins under 3%.
+    Min-of-adjacent-pair ratios, same estimator as the scrape guard."""
+    import tempfile
+
+    from paddle_tpu import observability as obs
+
+    ratios = []
+    best_on = best_off = 0.0
+    with tempfile.TemporaryDirectory() as spool:
+        for _ in range(trials):
+            off = eager_mlp_loop(steps=steps, instrument=True)
+            sh = obs.Shipper(spool, interval_s=interval_s).start()
+            try:
+                on = eager_mlp_loop(steps=steps, instrument=True)
+            finally:
+                sh.stop(flush=True)
+            best_off = max(best_off, off['steps_per_sec'])
+            best_on = max(best_on, on['steps_per_sec'])
+            if on['steps_per_sec']:
+                ratios.append(off['steps_per_sec'] / on['steps_per_sec'])
+    overhead = min(ratios) - 1 if ratios else float('inf')
+    return {
+        'shipped_steps_per_sec': best_on,
+        'plain_steps_per_sec': best_off,
+        'overhead_pct': round(overhead * 100, 2),
+        'ship_interval_s': interval_s,
+    }
+
+
+def fleet_roundtrip_smoke():
+    """Spool roundtrip smoke: ship the live registry once, aggregate,
+    and check the merged `paddle_steps_total` matches the local truth —
+    the single-process degenerate case of the fleet merge invariant
+    (the multi-process version lives in tests/test_fleet_obs.py)."""
+    import tempfile
+
+    from paddle_tpu import observability as obs
+
+    with tempfile.TemporaryDirectory() as spool:
+        sh = obs.Shipper(spool)
+        sh.ship_now()
+        agg = obs.Aggregator(spool)
+        counts = agg.poll()
+        merged = agg.merged()
+        local = obs.get_registry().value('paddle_steps_total')
+        fleet = 0.0
+        for m in merged.get('metrics', []):
+            if m['name'] == 'paddle_steps_total':
+                fleet = sum(s['value'] for s in m['samples'])
+        return {
+            'segments_applied': counts['applied'],
+            'local_steps_total': local,
+            'fleet_steps_total': fleet,
+            'merged_matches_local': fleet == local,
+            'processes': agg.process_uids(),
+        }
+
+
+def _phase_fleet_obs():
+    """Fleet observability plane phase: shipper on/off overhead A/B on
+    the eager hot path (tier-1 pins it <3%) plus a single-process spool
+    roundtrip smoke (ship -> aggregate -> merged equals local)."""
+    out = {}
+    try:
+        out['fleet_obs_overhead'] = fleet_obs_overhead_ab()
+    except Exception as e:
+        print(f'# fleet_obs bench failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        out['fleet_obs_overhead'] = {'error': type(e).__name__}
+    try:
+        out['fleet_roundtrip'] = fleet_roundtrip_smoke()
+    except Exception as e:
+        print(f'# fleet roundtrip smoke failed: {type(e).__name__}: {e}',
+              file=sys.stderr)
+        out['fleet_roundtrip'] = {'error': type(e).__name__}
+    return out
+
+
 def resilience_overhead_ab(steps=30, trials=3):
     """A/B the eager MLP loop through a FaultTolerantStep wrapper vs
     plain (also imported by the tier-1 overhead guard). Same best-of-N
@@ -2553,6 +2637,7 @@ PHASES = {
     'goodput': _phase_goodput,
     'donation': _phase_donation,
     'autoscale': _phase_autoscale,
+    'fleet_obs': _phase_fleet_obs,
 }
 
 
@@ -2592,7 +2677,7 @@ def _cpu_phase_plan():
     plan = [('headline', 1500), ('eager', 600), ('obs', 600),
             ('resilience', 600), ('serving', 1200), ('router', 900),
             ('coldstart', 900), ('goodput', 600), ('donation', 600),
-            ('autoscale', 600)]
+            ('autoscale', 600), ('fleet_obs', 600)]
     only = os.environ.get('BENCH_CPU_PHASES')
     if only:
         wanted = {p.strip() for p in only.split(',') if p.strip()}
@@ -2682,6 +2767,7 @@ def main():
     out.update(_run_phase_subprocess('coldstart', 900))
     out.update(_run_phase_subprocess('donation', 600))
     out.update(_run_phase_subprocess('autoscale', 600))
+    out.update(_run_phase_subprocess('fleet_obs', 600))
     print(json.dumps(out))
     return 0
 

@@ -93,11 +93,18 @@ def gather_registry(group=None, registry=None):
     analogue: fleet workers pushing per-rank metrics to the PS/ETCD
     master).
 
-    Each snapshot is tagged with its host's process_index;
+    Each snapshot is tagged with its host's process identity
+    (`process_uid` when present, else process_index);
     `observability.merge_snapshots` dedupes by that tag (a
     single-controller all_gather_object returns world-size copies of
     the one local snapshot), sums counters/histograms across distinct
     hosts, and takes the max of gauges (fleet-wide watermarks).
+
+    The cross-PROCESS fleet plane (`observability.wire` / `Shipper` /
+    `Aggregator`) applies these SAME rules to spool-shipped metric
+    deltas — `wire.merge_states` delegates to the same
+    `merge_snapshots`, so a collective gather and a spool aggregation
+    of the same processes agree on every merged value.
     """
     from .. import observability as obs
     from . import collective

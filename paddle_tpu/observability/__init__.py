@@ -16,6 +16,14 @@ pod scale.
 Multi-host: every exported sample is tagged with the host's
 process_index; `distributed.fleet_utils.gather_registry()` merges
 per-host snapshots over the existing collectives.
+
+Cross-PROCESS (the fleet plane): `wire` is the versioned JSONL segment
+format, `Shipper` spools a process's metric deltas / events / spans to
+a shared directory, `Aggregator` tails spools into one merged view and
+stitches skew-corrected cross-process traces, and `SLOEngine` judges
+declarative objectives over the fleet view with multi-window burn-rate
+alerting (breaches trigger flight-recorder bundles). The server gains
+`/fleet/metrics`, `/fleet/trace`, and `/slo`.
 """
 from __future__ import annotations
 
@@ -25,8 +33,18 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry, merge_snapshots)
 from .events import (EVENT_SCHEMA, EventLog, Span, declare_event, emit,
                      get_event_log, span)
-from .exporters import (read_jsonl, to_chrome_trace, to_jsonl,
+from .exporters import (chrome_track_metadata, fleet_to_prometheus_text,
+                        read_jsonl, to_chrome_trace, to_jsonl,
                         to_prometheus_text)
+from .wire import (WIRE_VERSION, WireError, decode_segment,
+                   encode_segment, make_segment, metrics_delta,
+                   process_uid, read_segment, write_segment)
+from .shipper import Shipper
+from .aggregator import (Aggregator, FleetSignalSource, get_aggregator,
+                         set_aggregator)
+from .slo import (Objective, SLOEngine, default_objectives,
+                  get_engine as get_slo_engine,
+                  set_engine as set_slo_engine)
 from .telemetry import (StepTelemetry, collective_totals,
                         device_memory_bytes, install,
                         note_jit_cache_entry)
@@ -50,6 +68,14 @@ __all__ = [
     'EVENT_SCHEMA', 'EventLog', 'Span', 'declare_event', 'emit',
     'get_event_log', 'span',
     'read_jsonl', 'to_chrome_trace', 'to_jsonl', 'to_prometheus_text',
+    'chrome_track_metadata', 'fleet_to_prometheus_text',
+    'WIRE_VERSION', 'WireError', 'decode_segment', 'encode_segment',
+    'make_segment', 'metrics_delta', 'process_uid', 'read_segment',
+    'write_segment',
+    'Shipper', 'Aggregator', 'FleetSignalSource', 'get_aggregator',
+    'set_aggregator',
+    'Objective', 'SLOEngine', 'default_objectives', 'get_slo_engine',
+    'set_slo_engine',
     'StepTelemetry', 'collective_totals', 'device_memory_bytes',
     'install', 'note_jit_cache_entry',
     'CatalogedJit', 'MfuWindow', 'ProgramCatalog', 'ProgramRecord',

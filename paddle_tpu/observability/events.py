@@ -117,6 +117,15 @@ EVENT_SCHEMA: Dict[str, str] = {
                             'drain toward removal started',
     'autoscale_down_complete': 'drained replica removed from the '
                                'fleet; no request dropped',
+    # fleet observability plane (observability/{wire,shipper,aggregator,slo})
+    'segment_shipped': 'fleet-plane telemetry segments committed to '
+                       'the spool',
+    'segment_quarantined': 'spool segment failed decode/sha256 '
+                           'verification; renamed aside, not applied',
+    'slo_breach': 'multi-window burn-rate alert fired for an SLO '
+                  'objective',
+    'slo_recovered': 'burn-rate alert cleared; short window cooled',
+    'slo_capture': 'bounded jax.profiler capture started on breach',
 }
 
 

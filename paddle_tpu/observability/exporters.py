@@ -129,19 +129,45 @@ def read_jsonl(text_or_path: str):
     return [json.loads(line) for line in text.splitlines() if line.strip()]
 
 
+def chrome_track_metadata(pid: int, process_name: str,
+                          tids: Optional[Dict[int, str]] = None,
+                          sort_index: Optional[int] = None):
+    """Chrome-trace 'M' (metadata) events naming one process track and
+    its threads — without these, Perfetto/chrome://tracing renders bare
+    pid/tid integers, which is useless the moment a stitched fleet trace
+    has one track per process."""
+    events = [{'name': 'process_name', 'ph': 'M', 'pid': pid, 'tid': 0,
+               'args': {'name': process_name}}]
+    if sort_index is not None:
+        events.append({'name': 'process_sort_index', 'ph': 'M', 'pid': pid,
+                       'tid': 0, 'args': {'sort_index': int(sort_index)}})
+    for tid, tname in sorted((tids or {}).items()):
+        events.append({'name': 'thread_name', 'ph': 'M', 'pid': pid,
+                       'tid': tid, 'args': {'name': tname}})
+    return events
+
+
 def to_chrome_trace(event_log=None, path: Optional[str] = None
                     ) -> Dict[str, Any]:
     """chrome://tracing JSON built from the EventLog's REAL timestamps:
     each span becomes a complete ('X') event at its actual begin time
     with its actual duration; instant events ('i') keep their timestamp.
-    Timestamps are microseconds on the process-wide span clock."""
+    Timestamps are microseconds on the process-wide span clock. Track
+    metadata ('M') events label the process track and every live thread
+    by its Python thread name."""
+    import threading
     from .events import get_event_log
+    from .metrics import get_registry
     # `is None`, not truthiness: an empty EventLog is falsy (__len__)
     event_log = get_event_log() if event_log is None else event_log
+    thread_names = {t.ident: t.name for t in threading.enumerate()
+                    if t.ident is not None}
     trace_events = []
+    seen_tids = set()
     for e in event_log.events():
         out = {'name': e['name'], 'ph': e.get('ph', 'X'), 'pid': 0,
                'tid': e.get('tid', 0), 'ts': int(e['ts'] * 1e6)}
+        seen_tids.add(out['tid'])
         if out['ph'] == 'X':
             out['dur'] = int(e.get('dur', 0.0) * 1e6)
         if out['ph'] == 'i':
@@ -152,8 +178,52 @@ def to_chrome_trace(event_log=None, path: Optional[str] = None
         if args:
             out['args'] = args
         trace_events.append(out)
-    doc = {'traceEvents': trace_events, 'displayTimeUnit': 'ms'}
+    proc_name = f'paddle_tpu process {get_registry().process_index()}'
+    meta = chrome_track_metadata(
+        0, proc_name,
+        {tid: thread_names.get(tid, f'thread-{tid}')
+         for tid in sorted(seen_tids)})
+    doc = {'traceEvents': meta + trace_events, 'displayTimeUnit': 'ms'}
     if path is not None:
         with open(path, 'w') as f:
             json.dump(doc, f)
     return doc
+
+
+def fleet_to_prometheus_text(aggregator) -> str:
+    """Prometheus exposition of an Aggregator's fleet view: the merged
+    samples labeled `process="fleet"`, then each per-process state's
+    samples labeled with its process_uid — the `/fleet/metrics` body.
+    This is where `paddle_events_dropped_total{process=...}` becomes a
+    per-process labeled series (locally it is unlabeled — existing
+    single-process scrapes depend on that)."""
+    sections = [('fleet', aggregator.merged())]
+    sections.extend(sorted(aggregator.per_process_snapshots().items()))
+    # group by family across sections: the exposition format wants all
+    # of a metric's lines contiguous under one HELP/TYPE
+    families: Dict[str, Dict[str, Any]] = {}
+    for proc_label, snap in sections:
+        for m in snap.get('metrics', []):
+            fam = families.setdefault(m['name'], {
+                'type': m['type'], 'help': m['help'], 'rows': []})
+            fam['rows'].extend((proc_label, s) for s in m['samples'])
+    lines = []
+    for name, fam in families.items():
+        lines.append(f'# HELP {name} {_escape_help(fam["help"])}')
+        lines.append(f'# TYPE {name} {fam["type"]}')
+        for proc_label, s in fam['rows']:
+            proc = {'process': str(proc_label)}
+            if fam['type'] == 'histogram':
+                for bound, count in s['buckets'].items():
+                    lines.append(
+                        f'{name}_bucket'
+                        f'{_fmt_labels(s["labels"], {**proc, "le": bound})}'
+                        f' {count}')
+                lines.append(f'{name}_sum{_fmt_labels(s["labels"], proc)}'
+                             f' {_num(s["sum"])}')
+                lines.append(f'{name}_count{_fmt_labels(s["labels"], proc)}'
+                             f' {s["count"]}')
+            else:
+                lines.append(f'{name}{_fmt_labels(s["labels"], proc)}'
+                             f' {_num(s["value"])}')
+    return '\n'.join(lines) + '\n'

@@ -23,6 +23,7 @@ runtime already emits (`hang_suspected`, `loss_spike`, `bad_step`,
   programs.json  ProgramCatalog snapshot (per-program cost attribution)
   goodput.json   goodput-ledger books + roofline/MFU attribution
   prefix_cache.json  serving radix-prefix-cache state (when serving)
+  slo.json       SLO burn-rate state + per-process event-drop counts
   summary.txt    debug.observability_summary()
 
 Auto-dumps are debounced (`min_interval_s`) so an anomaly storm
@@ -47,7 +48,7 @@ TRIGGER_EVENTS = frozenset((
     'hang_suspected', 'loss_spike', 'bad_step', 'skip_budget_exhausted',
     'serving_request_failed', 'checkpoint_corrupt',
     'router_failover_storm', 'donation_quarantined',
-    'sanitizer_violation',
+    'sanitizer_violation', 'slo_breach', 'segment_quarantined',
 ))
 
 
@@ -223,6 +224,27 @@ class FlightRecorder:
                 with open(os.path.join(path, 'prefix_cache.json'),
                           'w') as f:
                     json.dump(caches, f, indent=1, default=str)
+            try:
+                # fleet/SLO posture: burn-rate state at the moment of
+                # the incident plus per-process event-ring drop counts
+                # (whose telemetry is truncated) — the breach bundle's
+                # own evidence section
+                from .aggregator import get_aggregator
+                from .slo import get_engine
+                slo_doc: Dict[str, Any] = {
+                    'local_events_dropped': log.dropped}
+                engine = get_engine()
+                if engine is not None:
+                    slo_doc['slo'] = engine.report()
+                agg = get_aggregator()
+                if agg is not None:
+                    slo_doc['fleet_events_dropped'] = agg.events_dropped()
+                    slo_doc['fleet_processes'] = agg.process_uids()
+                    slo_doc['clock_offsets'] = agg.clock_offsets()
+                with open(os.path.join(path, 'slo.json'), 'w') as f:
+                    json.dump(slo_doc, f, indent=1, default=str)
+            except Exception:
+                _metrics.count_suppressed('flight.bundle_section')
             try:
                 from .. import debug
                 summary = debug.observability_summary() + '\n'

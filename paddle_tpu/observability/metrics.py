@@ -452,7 +452,9 @@ class MetricsRegistry:
                 if fam.type == 'histogram':
                     entry['bucket_bounds'] = list(fam.buckets)
                 metrics.append(entry)
+            from . import wire as _wire
             return {'process_index': self.process_index(),
+                    'process_uid': _wire.process_uid(),
                     'metrics': metrics}
 
     def reset(self):
@@ -517,15 +519,24 @@ def count_suppressed(site: str, registry: Optional[MetricsRegistry] = None):
 def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     """Merge per-host registry snapshots into one fleet view.
 
-    Snapshots are deduped by process_index first (all_gather_object on a
+    Snapshots are deduped by process identity first — the
+    `(process_uid, process_index)` pair. all_gather_object on a
     single-controller mesh returns world-size copies of the one local
-    snapshot — merging those must not multiply counters). Counters and
-    histogram sums/counts add across hosts; gauges take the max (the
-    fleet-wide watermark reading).
+    snapshot (same uid AND index — merging those must not multiply
+    counters), while the fleet wire plane ships snapshots from distinct
+    processes that may share a process_index but never a uid. Counters
+    and histogram sums/counts add across hosts; gauges take the max
+    (the fleet-wide watermark reading). The merged view lists the
+    surviving `processes` (indexes) and `process_uids`.
     """
-    by_proc: Dict[int, Dict[str, Any]] = {}
+    by_proc: Dict[Any, Dict[str, Any]] = {}
     for s in snapshots:
-        by_proc.setdefault(int(s.get('process_index', 0)), s)
+        # (uid, index) pair: gathered copies of one snapshot share both
+        # and collapse; distinct processes differ in uid even when their
+        # process_index collides; snapshots taken from several
+        # registries inside one process differ in index.
+        key = (s.get('process_uid'), int(s.get('process_index', 0)))
+        by_proc.setdefault(key, s)
     merged: Dict[str, Dict[str, Any]] = {}
     for snap in by_proc.values():
         for m in snap.get('metrics', []):
@@ -554,7 +565,9 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                         qd = cur.setdefault('quantiles', {})
                         qd[q] = max(qd.get(q, v), v)
     _recompute_goodput_fractions(merged)
-    return {'processes': sorted(by_proc),
+    return {'processes': sorted({idx for _, idx in by_proc}),
+            'process_uids': sorted({uid for uid, _ in by_proc
+                                    if uid is not None}),
             'metrics': [{**m, 'samples': list(m['samples'].values())}
                         for m in merged.values()]}
 

@@ -16,6 +16,13 @@ fleet scraper expects:
   /trace     chrome://tracing JSON of the event log
   /programs  ProgramCatalog report (?format=json for top_programs())
   /goodput   goodput-ledger report (?format=json for the dict)
+  /fleet/metrics  Prometheus text of the FLEET view (merged + one
+             labeled section per process) — requires a registered
+             Aggregator (`aggregator.set_aggregator`), 503 otherwise
+  /fleet/trace    skew-corrected cross-process chrome trace
+             (?trace_id=N stitches one request's waterfall)
+  /slo       SLO engine report: burn rates, budget remaining, breaches
+             (requires `slo.set_engine`, 503 otherwise)
 
 `start_server(port)` is wired into examples/train_gpt.py and
 examples/serve_gpt.py via `--metrics-port`; port 0 binds an ephemeral
@@ -215,6 +222,8 @@ class _Handler(BaseHTTPRequestHandler):
                 '/healthz': self._healthz, '/summary': self._summary,
                 '/events': self._events, '/trace': self._trace,
                 '/programs': self._programs, '/goodput': self._goodput,
+                '/fleet/metrics': self._fleet_metrics,
+                '/fleet/trace': self._fleet_trace, '/slo': self._slo,
             }.get(route)
             if handler is None:
                 self._send(f'unknown route {route}\n', status=404)
@@ -227,7 +236,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _index(self):
         self._send('paddle_tpu observability: /metrics /healthz /summary '
-                   '/events /trace /programs /goodput\n')
+                   '/events /trace /programs /goodput /fleet/metrics '
+                   '/fleet/trace /slo\n')
 
     def _metrics(self):
         from .exporters import to_prometheus_text
@@ -295,6 +305,49 @@ class _Handler(BaseHTTPRequestHandler):
                        + '\n', content_type='application/json')
         else:
             self._send(cat.report() + '\n')
+
+    def _fleet_metrics(self):
+        from .aggregator import get_aggregator
+        from .exporters import fleet_to_prometheus_text
+        agg = get_aggregator()
+        if agg is None:
+            self._send('no fleet aggregator registered (see '
+                       'observability.aggregator.set_aggregator)\n',
+                       status=503)
+            return
+        agg.poll()
+        self._send(fleet_to_prometheus_text(agg),
+                   content_type='text/plain; version=0.0.4')
+
+    def _fleet_trace(self):
+        from .aggregator import get_aggregator
+        agg = get_aggregator()
+        if agg is None:
+            self._send('no fleet aggregator registered (see '
+                       'observability.aggregator.set_aggregator)\n',
+                       status=503)
+            return
+        agg.poll()
+        trace_id = self._query().get('trace_id')
+        if trace_id is not None:
+            try:
+                trace_id = int(trace_id)
+            except ValueError:
+                pass   # string trace ids pass through as-is
+        self._send(json.dumps(agg.stitch_trace(trace_id=trace_id)),
+                   content_type='application/json')
+
+    def _slo(self):
+        from .slo import get_engine
+        engine = get_engine()
+        if engine is None:
+            self._send('no SLO engine registered (see '
+                       'observability.slo.set_engine)\n', status=503)
+            return
+        if self._query().get('poll') == '1':
+            engine.poll()
+        self._send(json.dumps(engine.report(), indent=1, default=str)
+                   + '\n', content_type='application/json')
 
     def _goodput(self):
         from .cost import roofline_summary

@@ -146,18 +146,25 @@ class Autoscaler:
         clock: injectable monotonic clock (tests).
         force: run even while `FLAGS_autoscale` is off (benches that
             A/B the loop explicitly).
+        signal_source: optional zero-arg callable returning a
+            `window_signals()`-shaped dict — plug in an
+            `observability.FleetSignalSource` so decisions read the
+            FLEET view (routers in other processes) instead of the
+            local router's registry. None keeps the local read.
     """
 
     def __init__(self, router: Router,
                  replica_factory: Callable[[], InferenceEngine],
                  config: Optional[AutoscalerConfig] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 force: bool = False):
+                 force: bool = False,
+                 signal_source: Optional[Callable[[], dict]] = None):
         self.router = router
         self.replica_factory = replica_factory
         self.config = config or AutoscalerConfig.from_flags()
         self._clock = clock
         self._force = bool(force)
+        self.signal_source = signal_source
         self._cooldown_until: Optional[float] = None
         self._quiet_since: Optional[float] = None
         self._draining: Dict[int, float] = {}    # rid -> drain start
@@ -225,7 +232,8 @@ class Autoscaler:
         self._integrate(now)
         self._advance_drains(now)
         cfg = self.config
-        sig = self.router.window_signals()
+        sig = (self.signal_source() if self.signal_source is not None
+               else self.router.window_signals())
         want_up, up_why = self._wants_scale_up(sig)
         if self._cooldown_until is not None and now < self._cooldown_until:
             # observe-only window; still note a blocked scale-up WISH so
@@ -391,5 +399,7 @@ class Autoscaler:
             'decisions': dict(self._decisions),
             'provision_ema_s': self._provision_ema_s,
             'cooldown_until': self._cooldown_until,
+            'signal_source': ('local' if self.signal_source is None
+                              else type(self.signal_source).__name__),
             'config': dataclasses.asdict(self.config),
         }

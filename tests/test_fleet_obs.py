@@ -1,0 +1,674 @@
+"""Fleet observability plane (ISSUE 17): the versioned wire format,
+shipper spooling, aggregator idempotence/ordering/quarantine, clock-skew
+corrected cross-process trace stitching, the SLO engine's multi-window
+burn-rate alerting with breach-triggered flight bundles, the fleet
+signal source feeding the autoscaler, the `/fleet/*` + `/slo`
+endpoints, and the shipper-overhead tier-1 guard.
+
+The multi-process harness is the acceptance spine: real spawned
+interpreters (each with its own registry, event log, and an INJECTED
+clock skew) ship into one spool; the parent's aggregator must recover
+merged counters equal to the sum of per-process truths and stitch one
+skew-corrected waterfall keyed by the shared trace_id.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import aggregator as agg_mod
+from paddle_tpu.observability import events as events_mod
+from paddle_tpu.observability import slo as slo_mod
+from paddle_tpu.observability import wire
+from paddle_tpu.observability.events import EventLog
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+
+def _private_source(n=5, trace_id=77):
+    """A private registry + event log pre-loaded with known truth, so
+    shipper tests never ride the process-global telemetry (whose
+    background churn would make deltas nondeterministic)."""
+    reg = MetricsRegistry(process_index=0)
+    reg.counter('paddle_fleet_test_total', 'fleet-plane test counter').inc(n)
+    reg.gauge('paddle_fleet_test_gauge', 'fleet-plane test gauge').set(2.5)
+    log = EventLog(capacity=256)
+    log.append({'name': 'unit.work', 'ph': 'X', 'ts': 1.0, 'dur': 0.25,
+                'tid': 3, 'attrs': {'request_id': trace_id}})
+    return reg, log
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+class TestWire:
+    def test_segment_roundtrip(self):
+        seg = wire.make_segment(
+            wire.KIND_EVENTS, [{'name': 'a', 'ts': 1.5}], seq=3,
+            uid='p1', wall_ts=100.0, mono_ts=10.0)
+        dec = wire.decode_segment(wire.encode_segment(seg))
+        assert dec['records'] == seg['records']
+        assert (dec['process_uid'], dec['seq']) == ('p1', 3)
+        assert (dec['wall_ts'], dec['mono_ts']) == (100.0, 10.0)
+
+    def test_sha_mismatch_raises_wire_error(self):
+        seg = wire.make_segment(wire.KIND_EVENTS, [{'name': 'a'}], 1)
+        enc = wire.encode_segment(seg)
+        head, _, payload = enc.partition('\n')
+        torn = head + '\n' + payload.replace('"a"', '"b"')
+        with pytest.raises(wire.WireError, match='sha256'):
+            wire.decode_segment(torn)
+
+    def test_version_and_kind_rejected(self):
+        seg = wire.make_segment(wire.KIND_METRICS, [], 1)
+        bad = dict(seg, v=99)
+        with pytest.raises(wire.WireError, match='version'):
+            wire.decode_segment(wire.encode_segment(bad))
+        with pytest.raises(ValueError, match='kind'):
+            wire.make_segment('bogus', [], 1)
+
+    def test_counter_delta_and_fold(self):
+        reg, _ = _private_source(n=5)
+        snap1 = reg.snapshot()
+        reg.get('paddle_fleet_test_total').labels().inc(7)
+        snap2 = reg.snapshot()
+        d1 = wire.metrics_delta(None, snap1)
+        d2 = wire.metrics_delta(snap1, snap2)
+        state = wire.new_state('p1')
+        wire.fold_metrics_delta(state, d1, seq=1)
+        wire.fold_metrics_delta(state, d2, seq=2)
+        merged = wire.merge_states([state])
+        by_name = {m['name']: m for m in merged['metrics']}
+        total = by_name['paddle_fleet_test_total']['samples'][0]['value']
+        assert total == 12.0
+
+    def test_gauge_last_write_ordered_by_seq(self):
+        recs = lambda v: [{'name': 'g', 'type': 'gauge', 'help': 'h',
+                           'samples': [{'labels': {}, 'value': v}]}]
+        forward, backward = wire.new_state('p'), wire.new_state('p')
+        wire.fold_metrics_delta(forward, recs(1.0), seq=1)
+        wire.fold_metrics_delta(forward, recs(9.0), seq=2)
+        wire.fold_metrics_delta(backward, recs(9.0), seq=2)
+        wire.fold_metrics_delta(backward, recs(1.0), seq=1)
+        for state in (forward, backward):
+            snap = wire.state_to_snapshot(state)
+            assert snap['metrics'][0]['samples'][0]['value'] == 9.0
+
+    def test_steady_state_ships_nothing(self):
+        reg, _ = _private_source()
+        snap = reg.snapshot()
+        assert wire.metrics_delta(snap, reg.snapshot()) == []
+
+
+# ---------------------------------------------------------------------------
+# shipper
+# ---------------------------------------------------------------------------
+
+class TestShipper:
+    def test_ship_commits_segments_atomically(self, tmp_path):
+        reg, log = _private_source()
+        sh = obs.Shipper(str(tmp_path), registry=reg, event_log=log,
+                         uid='proc-a')
+        paths = sh.ship_now()
+        assert len(paths) == 2   # metrics + spans
+        for p in paths:
+            assert p.endswith(wire.SEGMENT_SUFFIX)
+            assert os.path.dirname(p).endswith('proc-a')
+        assert not [f for f in os.listdir(tmp_path / 'proc-a')
+                    if f.endswith('.tmp')]
+
+    def test_second_ship_is_incremental(self, tmp_path):
+        reg, log = _private_source()
+        sh = obs.Shipper(str(tmp_path), registry=reg, event_log=log,
+                         uid='proc-a')
+        sh.ship_now()
+        assert sh.ship_now() == []   # nothing changed: nothing shipped
+        reg.get('paddle_fleet_test_total').labels().inc(1)
+        paths = sh.ship_now()
+        assert len(paths) == 1   # only the metrics delta
+        seg = wire.read_segment(paths[0])
+        assert seg['kind'] == wire.KIND_METRICS
+        names = [r['name'] for r in seg['records']]
+        assert names == ['paddle_fleet_test_total']
+
+    def test_background_thread_ships_and_stops(self, tmp_path):
+        reg, log = _private_source()
+        sh = obs.Shipper(str(tmp_path), registry=reg, event_log=log,
+                         interval_s=0.05, uid='proc-a').start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not os.path.isdir(tmp_path / 'proc-a') \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            sh.stop(flush=True)
+        assert os.listdir(tmp_path / 'proc-a')
+        assert sh.stats()['running'] is False
+
+
+# ---------------------------------------------------------------------------
+# aggregator: idempotence, ordering, quarantine
+# ---------------------------------------------------------------------------
+
+def _merged_value(agg, name):
+    for m in agg.merged()['metrics']:
+        if m['name'] == name:
+            return sum(s['value'] for s in m['samples'])
+    return 0.0
+
+
+class TestAggregator:
+    def test_duplicate_reship_changes_no_counter(self, tmp_path):
+        reg, log = _private_source(n=5)
+        sh = obs.Shipper(str(tmp_path), registry=reg, event_log=log,
+                         uid='proc-a')
+        paths = sh.ship_now()
+        agg = obs.Aggregator(str(tmp_path))
+        agg.poll()
+        before = _merged_value(agg, 'paddle_fleet_test_total')
+        # re-ship: same (uid, seq) content under a fresh filename, the
+        # crash-between-write-and-bookkeeping scenario
+        for p in paths:
+            shutil.copy(p, p.replace('seg_', 'reship_seg_'))
+        counts = agg.poll()
+        assert counts['duplicates'] == len(paths)
+        assert counts['applied'] == 0
+        assert _merged_value(agg, 'paddle_fleet_test_total') == before == 5.0
+
+    def test_out_of_order_application_converges(self, tmp_path):
+        reg, log = _private_source(n=5)
+        sh = obs.Shipper(str(tmp_path / 'fwd'), registry=reg,
+                         event_log=log, uid='proc-a')
+        sh.ship_now()
+        reg.get('paddle_fleet_test_total').labels().inc(3)
+        reg.get('paddle_fleet_test_gauge').labels().set(9.0)
+        sh.ship_now()
+        reg.get('paddle_fleet_test_gauge').labels().set(4.0)
+        sh.ship_now()
+        # same segments, applied in REVERSE order by a second aggregator
+        src = tmp_path / 'fwd' / 'proc-a'
+        rev_dir = tmp_path / 'rev' / 'proc-a'
+        os.makedirs(rev_dir)
+        agg_fwd = obs.Aggregator(str(tmp_path / 'fwd'))
+        agg_fwd.poll()
+        agg_rev = obs.Aggregator(str(tmp_path / 'rev'))
+        for name in sorted(os.listdir(src), reverse=True):
+            shutil.copy(src / name, rev_dir / name)
+            agg_rev.poll()
+        for name in ('paddle_fleet_test_total', 'paddle_fleet_test_gauge'):
+            assert _merged_value(agg_fwd, name) \
+                == _merged_value(agg_rev, name)
+        assert _merged_value(agg_rev, 'paddle_fleet_test_total') == 8.0
+        assert _merged_value(agg_rev, 'paddle_fleet_test_gauge') == 4.0
+
+    def test_torn_file_quarantined_not_crashed(self, tmp_path):
+        reg, log = _private_source(n=5)
+        sh = obs.Shipper(str(tmp_path), registry=reg, event_log=log,
+                         uid='proc-a')
+        paths = sh.ship_now()
+        # tear the metrics segment: keep the header, truncate payload
+        torn = next(p for p in paths if 'metrics' in p)
+        with open(torn) as f:
+            text = f.read()
+        with open(torn, 'w') as f:
+            f.write(text[:len(text) - 20])
+        agg = obs.Aggregator(str(tmp_path))
+        counts = agg.poll()
+        assert counts['quarantined'] == 1
+        assert not os.path.exists(torn)
+        assert os.path.exists(torn + wire.QUARANTINE_SUFFIX)
+        # the torn metrics never applied; the intact spans segment did
+        assert _merged_value(agg, 'paddle_fleet_test_total') == 0.0
+        assert agg.stats()['quarantined']
+        # and the next poll does not re-trip on it
+        assert agg.poll() == {'applied': 0, 'duplicates': 0,
+                              'quarantined': 0}
+
+    def test_restarted_aggregator_rebuilds_identical_view(self, tmp_path):
+        reg, log = _private_source(n=5)
+        sh = obs.Shipper(str(tmp_path), registry=reg, event_log=log,
+                         uid='proc-a')
+        sh.ship_now()
+        reg.get('paddle_fleet_test_total').labels().inc(2)
+        sh.ship_now()
+        a1 = obs.Aggregator(str(tmp_path))
+        a1.poll()
+        a2 = obs.Aggregator(str(tmp_path))   # restart: replay the spool
+        a2.poll()
+        assert _merged_value(a1, 'paddle_fleet_test_total') \
+            == _merged_value(a2, 'paddle_fleet_test_total') == 7.0
+
+    def test_events_dropped_surfaced_per_process(self, tmp_path):
+        reg, log = _private_source()
+        small = EventLog(capacity=4)
+        for i in range(11):
+            small.append({'name': 'spam', 'ph': 'i', 'ts': float(i),
+                          'tid': 0})
+        # mirror the ring's drop count the way the default registry's
+        # collector does for the process log
+        reg.counter('paddle_events_dropped_total',
+                    'events dropped by the bounded EventLog')._sole() \
+            .value = float(small.dropped)
+        sh = obs.Shipper(str(tmp_path), registry=reg, event_log=small,
+                         uid='proc-a')
+        sh.ship_now()
+        agg = obs.Aggregator(str(tmp_path))
+        agg.poll()
+        assert agg.events_dropped() == {'proc-a': 7.0}
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace track metadata (satellite a)
+# ---------------------------------------------------------------------------
+
+class TestChromeMetadata:
+    def test_local_trace_names_process_and_threads(self):
+        log = EventLog(capacity=16)
+        import threading
+        tid = threading.get_ident()
+        log.append({'name': 'work', 'ph': 'X', 'ts': 0.1, 'dur': 0.2,
+                    'tid': tid})
+        doc = obs.to_chrome_trace(log)
+        meta = [e for e in doc['traceEvents'] if e['ph'] == 'M']
+        names = {e['name'] for e in meta}
+        assert 'process_name' in names and 'thread_name' in names
+        tnames = [e['args']['name'] for e in meta
+                  if e['name'] == 'thread_name' and e['tid'] == tid]
+        assert tnames == [threading.current_thread().name]
+
+    def test_chrome_track_metadata_shape(self):
+        evs = obs.chrome_track_metadata(3, 'router', {7: 'decode-loop'},
+                                        sort_index=1)
+        assert all(e['ph'] == 'M' for e in evs)
+        assert evs[0] == {'name': 'process_name', 'ph': 'M', 'pid': 3,
+                          'tid': 0, 'args': {'name': 'router'}}
+        assert {'name': 'thread_name', 'ph': 'M', 'pid': 3, 'tid': 7,
+                'args': {'name': 'decode-loop'}} in evs
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+def _gauge_view(name, value):
+    return {'metrics': [{'name': name, 'type': 'gauge', 'help': 'h',
+                         'samples': [{'labels': {}, 'value': value}]}]}
+
+
+class TestSLOEngine:
+    def _engine(self, view, clock, **kw):
+        kw.setdefault('flight', False)
+        return slo_mod.SLOEngine(
+            objectives=[slo_mod.Objective.latency_p99(
+                'ttft_p99', 'paddle_ttft_p99_window', 1.0, budget=0.05)],
+            view_fn=lambda: view[0], clock=clock,
+            short_window_s=10.0, long_window_s=100.0, burn_alert=10.0,
+            **kw)
+
+    def test_breach_flips_alert_and_zeroes_budget(self):
+        t = [0.0]
+        view = [_gauge_view('paddle_ttft_p99_window', 5.0)]
+        eng = self._engine(view, lambda: t[0])
+        for _ in range(12):
+            t[0] += 1.0
+            rep = eng.poll()
+        o = rep['objectives'][0]
+        assert o['alerting'] is True
+        assert o['budget_remaining'] == 0.0
+        assert o['burn_short'] == pytest.approx(20.0)
+        assert rep['breaches'] and rep['breaches'][0]['slo'] == 'ttft_p99'
+        reg = obs.get_registry()
+        assert reg.value('paddle_slo_error_budget_remaining',
+                         slo='ttft_p99') == 0.0
+        assert reg.value('paddle_slo_alerting', slo='ttft_p99') == 1.0
+        assert reg.value('paddle_slo_breaches_total', slo='ttft_p99') >= 1.0
+
+    def test_short_blip_does_not_page(self):
+        # multi-window: one bad tick inside an otherwise-healthy long
+        # history must NOT fire (the long window stays under the burn)
+        t = [0.0]
+        view = [_gauge_view('paddle_ttft_p99_window', 0.1)]
+        eng = self._engine(view, lambda: t[0])
+        for _ in range(90):
+            t[0] += 1.0
+            eng.poll()
+        view[0] = _gauge_view('paddle_ttft_p99_window', 5.0)
+        t[0] += 1.0
+        rep = eng.poll()
+        o = rep['objectives'][0]
+        assert o['alerting'] is False
+        assert o['burn_short'] > 0.0
+
+    def test_recovery_clears_alert(self):
+        t = [0.0]
+        view = [_gauge_view('paddle_ttft_p99_window', 5.0)]
+        eng = self._engine(view, lambda: t[0])
+        for _ in range(12):
+            t[0] += 1.0
+            eng.poll()
+        assert eng.alerting('ttft_p99')
+        view[0] = _gauge_view('paddle_ttft_p99_window', 0.1)
+        for _ in range(15):
+            t[0] += 1.0
+            eng.poll()
+        assert not eng.alerting('ttft_p99')
+
+    def test_ratio_objective_judges_counter_deltas(self):
+        t = [0.0]
+        bad, total = [0.0], [0.0]
+
+        def view():
+            return {'metrics': [
+                {'name': 'req_total', 'type': 'counter', 'help': 'h',
+                 'samples': [
+                     {'labels': {'outcome': 'failed'}, 'value': bad[0]},
+                     {'labels': {'outcome': 'ok'},
+                      'value': total[0] - bad[0]}]}]}
+
+        eng = slo_mod.SLOEngine(
+            objectives=[slo_mod.Objective.ratio(
+                'availability',
+                bad=('req_total', {'outcome': 'failed'}),
+                total=[('req_total', None)], budget=0.01)],
+            view_fn=view, clock=lambda: t[0], short_window_s=10.0,
+            long_window_s=100.0, burn_alert=10.0, flight=False)
+        for _ in range(12):
+            t[0] += 1.0
+            total[0] += 100.0
+            bad[0] += 50.0   # 50% failures vs a 1% budget: burn 50x
+            rep = eng.poll()
+        assert rep['objectives'][0]['alerting'] is True
+        assert rep['objectives'][0]['burn_short'] == pytest.approx(50.0)
+
+    def test_breach_emits_event_and_flight_bundle(self, tmp_path):
+        from paddle_tpu.observability.flight import FlightRecorder
+        t = [0.0]
+        view = [_gauge_view('paddle_ttft_p99_window', 5.0)]
+        eng = self._engine(view, lambda: t[0], flight=True)
+        slo_mod.set_engine(eng)
+        rec = FlightRecorder(min_interval_s=0.0, dump_dir=str(tmp_path))
+        log = obs.get_event_log()
+        log.add_listener(rec.on_event)
+        try:
+            for _ in range(12):
+                t[0] += 1.0
+                eng.poll()
+        finally:
+            log.remove_listener(rec.on_event)
+            slo_mod.set_engine(None)
+        assert any(e['name'] == 'slo_breach' for e in log.events())
+        assert rec.dumps, 'slo_breach must trigger a flight bundle'
+        with open(os.path.join(rec.dumps[-1], 'slo.json')) as f:
+            doc = json.load(f)
+        assert doc['slo']['objectives'][0]['name'] == 'ttft_p99'
+        assert doc['slo']['objectives'][0]['alerting'] is True
+        assert 'local_events_dropped' in doc
+
+    def test_default_objectives_shape(self):
+        objs = slo_mod.default_objectives(slo_ttft_s=2.0)
+        assert [o.name for o in objs] \
+            == ['ttft_p99', 'availability', 'shed_rate']
+        eng = slo_mod.SLOEngine(objectives=objs, flight=False)
+        rep = eng.poll()   # empty registry: no data, no alerts, no crash
+        assert all(o['alerting'] is False for o in rep['objectives'])
+
+
+# ---------------------------------------------------------------------------
+# fleet signal source → autoscaler
+# ---------------------------------------------------------------------------
+
+def _ship_router_signals(spool, uid, ttft, queue, shed, serving):
+    reg = MetricsRegistry(process_index=0)
+    reg.gauge('paddle_ttft_p99_window', 'h').set(ttft)
+    reg.gauge('paddle_queue_depth_p99_window', 'h').set(queue)
+    reg.gauge('paddle_shed_rate_window', 'h').set(shed)
+    reg.gauge('paddle_router_available_replicas', 'h').set(serving)
+    obs.Shipper(spool, registry=reg, event_log=EventLog(capacity=4),
+                uid=uid).ship_now()
+
+
+class TestFleetSignalSource:
+    def test_fleet_fold_rules(self, tmp_path):
+        _ship_router_signals(str(tmp_path), 'router-a',
+                             ttft=0.9, queue=3.0, shed=0.5, serving=2)
+        _ship_router_signals(str(tmp_path), 'router-b',
+                             ttft=0.2, queue=1.0, shed=0.0, serving=1)
+        src = obs.FleetSignalSource(obs.Aggregator(str(tmp_path)),
+                                    fresh_s=3600.0)
+        sig = src()
+        assert sig['source'] == 'fleet'
+        assert sig['ttft_p99'] == pytest.approx(0.9)    # worst process
+        assert sig['queue_p99'] == pytest.approx(4.0)   # demand sums
+        assert sig['shed_rate'] == pytest.approx(0.5)
+        assert sig['serving_replicas'] == 3              # capacity sums
+        assert sig['processes'] == ['router-a', 'router-b']
+
+    def test_stale_processes_ignored(self, tmp_path):
+        _ship_router_signals(str(tmp_path), 'router-a',
+                             ttft=9.9, queue=50.0, shed=5.0, serving=2)
+        agg = obs.Aggregator(str(tmp_path))
+        agg.poll()
+        clock = [time.time() + 3600.0]   # an hour later: shipper died
+        src = obs.FleetSignalSource(agg, fresh_s=30.0, poll=False,
+                                    clock=lambda: clock[0])
+        sig = src()
+        assert sig['source'] == 'fleet_empty'
+        assert sig['serving_replicas'] == 0
+
+    def test_autoscaler_reads_fleet_signals(self, tmp_path):
+        # the fleet view reports an SLO breach worthy of scale-up while
+        # the LOCAL router is idle — with signal_source wired, poll()
+        # must want up (capped at max: HOLD_AT_MAX proves the wish came
+        # from the fleet read, without paying a provision)
+        from paddle_tpu.serving.autoscaler import (Autoscaler,
+                                                   AutoscalerConfig,
+                                                   HOLD_AT_MAX)
+        _ship_router_signals(str(tmp_path), 'router-a',
+                             ttft=5.0, queue=0.0, shed=0.0, serving=1)
+
+        class _IdleRouter:
+            replicas = [object()]
+
+            def window_signals(self):
+                return {'window_s': 1.0, 'ttft_p50': None,
+                        'ttft_p99': None, 'queue_p50': 0.0,
+                        'queue_p99': 0.0, 'shed_rate': 0.0,
+                        'accept_rate': 0.0, 'serving_replicas': 1}
+
+        router = _IdleRouter()
+        cfg = AutoscalerConfig(min_replicas=1, max_replicas=1,
+                               slo_ttft_s=1.0, cooldown_s=0.0)
+        src = obs.FleetSignalSource(obs.Aggregator(str(tmp_path)),
+                                    router=router, fresh_s=3600.0)
+        t = [100.0]
+        fleet_as = Autoscaler(router, lambda: None, config=cfg,
+                              clock=lambda: t[0], force=True,
+                              signal_source=src)
+        local_as = Autoscaler(router, lambda: None, config=cfg,
+                              clock=lambda: t[0], force=True)
+        assert fleet_as.poll() == HOLD_AT_MAX     # fleet sees the breach
+        assert local_as.poll() != HOLD_AT_MAX     # local view is idle
+        assert fleet_as.stats()['signal_source'] == 'FleetSignalSource'
+        assert local_as.stats()['signal_source'] == 'local'
+
+
+# ---------------------------------------------------------------------------
+# the multi-process acceptance harness
+# ---------------------------------------------------------------------------
+
+_CHILD = r'''
+import sys, time
+spool, idx, skew, trace_id, base_wall = (
+    sys.argv[1], int(sys.argv[2]), float(sys.argv[3]), int(sys.argv[4]),
+    float(sys.argv[5]))
+from paddle_tpu.observability import events, metrics, shipper
+# INJECT clock skew: shift this process's span-clock epoch so its mono
+# timestamps are offset by `skew` seconds from the truth — the
+# aggregator's (wall_ts, mono_ts) estimate must correct it out
+events._EPOCH -= skew
+reg = metrics.get_registry()
+reg.counter('paddle_fleet_test_total',
+            'fleet-plane test counter').inc((idx + 1) * 10)
+log = events.get_event_log()
+# place this process's span at a DETERMINISTIC true wall time
+# (base_wall + idx seconds) by expressing it on the local skewed span
+# clock: corrected stitching must recover the idx ordering exactly
+local_offset = time.time() - events._now()
+role = ['router', 'prefill', 'decode'][idx % 3]
+log.append({'name': role + '.work', 'ph': 'X',
+            'ts': base_wall + idx * 1.0 - local_offset, 'dur': 0.5,
+            'tid': 1, 'attrs': {'request_id': trace_id,
+                                'role': role, 'child': idx}})
+sh = shipper.Shipper(spool, uid='child-%d' % idx)
+sh.ship_now()
+reg.get('paddle_fleet_test_total').labels().inc(idx + 1)
+sh.ship_now()
+print('child %d ok' % idx)
+'''
+
+TRACE_ID = 424242
+
+
+@pytest.fixture(scope='module')
+def fleet_spool(tmp_path_factory):
+    """Spawn 3 real processes — each with its own interpreter, registry,
+    and an injected span-clock skew (0 s, +500 s, −300 s) — shipping
+    into one spool. Module-scoped: the interpreter spawns are the
+    expensive part, every assertion below reads the same spool."""
+    spool = str(tmp_path_factory.mktemp('fleet_spool'))
+    skews = [0.0, 500.0, -300.0]
+    base_wall = time.time()
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    procs = [
+        subprocess.Popen(
+            [sys.executable, '-c', _CHILD, spool, str(i), str(skews[i]),
+             str(TRACE_ID), str(base_wall)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        for i in range(3)]
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, (
+            f'child {i} failed:\n{err.decode()[-2000:]}')
+    return spool
+
+
+class TestMultiProcessHarness:
+    def test_merged_counters_equal_sum_of_truths(self, fleet_spool):
+        agg = obs.Aggregator(fleet_spool)
+        counts = agg.poll()
+        assert counts['quarantined'] == 0
+        assert sorted(agg.process_uids()) \
+            == ['child-0', 'child-1', 'child-2']
+        # per-process truth: (i+1)*10 + (i+1) -> 11 + 22 + 33
+        assert _merged_value(agg, 'paddle_fleet_test_total') == 66.0
+        per_proc = agg.per_process_value('paddle_fleet_test_total')
+        assert per_proc == {'child-0': 11.0, 'child-1': 22.0,
+                            'child-2': 33.0}
+
+    def test_clock_skew_estimated_per_process(self, fleet_spool):
+        agg = obs.Aggregator(fleet_spool)
+        agg.poll()
+        offs = agg.clock_offsets()
+        # child-1's span clock runs +500 s hot, so its wall-mono offset
+        # sits ~500 s BELOW child-0's; child-2 the mirror image
+        assert offs['child-0'] - offs['child-1'] \
+            == pytest.approx(500.0, abs=5.0)
+        assert offs['child-0'] - offs['child-2'] \
+            == pytest.approx(-300.0, abs=5.0)
+
+    def test_trace_stitches_one_skew_corrected_waterfall(self, fleet_spool):
+        agg = obs.Aggregator(fleet_spool)
+        agg.poll()
+        assert TRACE_ID in agg.trace_ids()
+        doc = agg.stitch_trace(trace_id=TRACE_ID)
+        spans = [e for e in doc['traceEvents'] if e['ph'] == 'X']
+        meta = [e for e in doc['traceEvents'] if e['ph'] == 'M']
+        # one span per process, on three distinct labeled tracks
+        assert len(spans) == 3
+        assert len({e['pid'] for e in spans}) == 3
+        pnames = {e['args']['name'] for e in meta
+                  if e['name'] == 'process_name'}
+        assert pnames == {'process child-0', 'process child-1',
+                          'process child-2'}
+        # skew-corrected ordering: router -> prefill -> decode at 1 s
+        # spacing, despite ±hundreds of seconds of injected skew
+        spans.sort(key=lambda e: e['ts'])
+        assert [e['name'] for e in spans] \
+            == ['router.work', 'prefill.work', 'decode.work']
+        gap01 = spans[1]['ts'] - spans[0]['ts']
+        gap12 = spans[2]['ts'] - spans[1]['ts']
+        assert gap01 == pytest.approx(1e6, abs=0.1e6)
+        assert gap12 == pytest.approx(1e6, abs=0.1e6)
+        assert doc['metadata']['trace_id'] == TRACE_ID
+
+    def test_fleet_endpoints_serve_the_plane(self, fleet_spool):
+        agg = obs.Aggregator(fleet_spool)
+        engine = slo_mod.SLOEngine(view_fn=agg.merged, flight=False)
+        agg_mod.set_aggregator(agg)
+        slo_mod.set_engine(engine)
+        srv = obs.start_server(0)
+        try:
+            body = urllib.request.urlopen(
+                f'{srv.url}/fleet/metrics', timeout=10).read().decode()
+            assert 'paddle_fleet_test_total{process="fleet"} 66' in body
+            assert 'process="child-1"' in body
+            trace = json.loads(urllib.request.urlopen(
+                f'{srv.url}/fleet/trace?trace_id={TRACE_ID}',
+                timeout=10).read())
+            assert len([e for e in trace['traceEvents']
+                        if e['ph'] == 'X']) == 3
+            rep = json.loads(urllib.request.urlopen(
+                f'{srv.url}/slo?poll=1', timeout=10).read())
+            assert [o['name'] for o in rep['objectives']] \
+                == ['ttft_p99', 'availability', 'shed_rate']
+        finally:
+            srv.stop()
+            agg_mod.set_aggregator(None)
+            slo_mod.set_engine(None)
+
+    def test_endpoints_503_without_registration(self):
+        srv = obs.start_server(0)
+        try:
+            for route in ('/fleet/metrics', '/fleet/trace', '/slo'):
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(f'{srv.url}{route}', timeout=10)
+                assert exc.value.code == 503
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 overhead guard (satellite e)
+# ---------------------------------------------------------------------------
+
+def test_fleet_shipper_overhead_under_3pct():
+    """Tier-1 guard: a live background Shipper costs the eager MLP hot
+    path <3%. Same retry protocol as the obs/scrape guards — the true
+    overhead is ~0 (the shipper reads on its own thread), so a genuine
+    hot-path regression fails every attempt. Ship cadence here is the
+    Shipper's 1 Hz DEFAULT with a loop long enough to span several
+    ships: shipping cost is a duty cycle (one snapshot+delta per
+    interval), and inside a full pytest run the global registry has
+    absorbed every prior suite's families — the bench's 10 Hz probe
+    cadence over that bloat measures suite pollution, not what a
+    deployed shipper costs."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'bench', os.path.join(os.path.dirname(__file__), '..', 'bench.py'))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    res = None
+    for _ in range(3):
+        res = bench.fleet_obs_overhead_ab(steps=300, trials=3,
+                                          interval_s=1.0)
+        if res['overhead_pct'] < 3.0:
+            break
+    assert res['overhead_pct'] < 3.0, res

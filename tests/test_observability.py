@@ -227,9 +227,14 @@ class TestExporters:
             time.sleep(0.001)
         path = str(tmp_path / 'trace.json')
         doc = obs.to_chrome_trace(log, path)
-        a, b = doc['traceEvents']
+        a, b = [e for e in doc['traceEvents'] if e['ph'] == 'X']
         assert (a['name'], b['name']) == ('a', 'b')
-        assert a['ph'] == b['ph'] == 'X'
+        # track labels: perfetto names the process/thread rows from 'M'
+        # metadata, not from pids — the export must emit them
+        meta = [e for e in doc['traceEvents'] if e['ph'] == 'M']
+        assert any(e['name'] == 'process_name' for e in meta)
+        assert any(e['name'] == 'thread_name' and e['pid'] == a['pid']
+                   and e['tid'] == a['tid'] for e in meta)
         # true timestamps: b begins AFTER a's end plus the sleep gap,
         # not back-to-back at a fabricated running sum
         assert b['ts'] >= a['ts'] + a['dur'] + 1500
