@@ -727,6 +727,196 @@ def fleet_roundtrip_smoke():
         }
 
 
+_FLEET_PROMPTS = [[5, 6, 7], [11, 12], [3, 1, 4, 1, 5],
+                  [23, 29, 31, 37], [2, 4], [9, 8, 7, 6, 5, 4]]
+_FLEET_ENGINE_KW = dict(num_slots=2, max_length=64, decode_block=2)
+
+
+def _fleet_proc_factory_spec():
+    """Model factory for replica children, addressed by file path so
+    the child interpreter needs no installed test package."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'tests', '_fleet_factory.py') + ':tiny_gpt'
+
+
+def _fleet_proc_supervisor(run_dir, program_store_dir):
+    from paddle_tpu.serving import ReplicaSpec, Supervisor
+    spec = ReplicaSpec(_fleet_proc_factory_spec(),
+                       engine_kwargs=dict(_FLEET_ENGINE_KW),
+                       program_store_dir=program_store_dir,
+                       drain_deadline_s=20.0)
+    return Supervisor(run_dir, spec, spawn_timeout_s=180.0,
+                      backoff_base_s=0.05, backoff_cap_s=0.5,
+                      max_restarts=5)
+
+
+def _fleet_proc_local_engine():
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import InferenceEngine
+    paddle.seed(7)   # same weights as tests/_fleet_factory.py:tiny_gpt
+    model = GPTForCausalLM(GPTConfig.tiny()).eval()
+    return InferenceEngine(model, **_FLEET_ENGINE_KW)
+
+
+def fleet_rpc_overhead_ab(trials=3, max_new_tokens=16):
+    """In-process engine vs ONE supervised replica process, same seeded
+    tiny-GPT greedy workload (also imported by the tier-1 guard). The
+    ratio isolates what the process boundary costs a serving batch:
+    framed-RPC round trips per step + JSON mirror updates vs direct
+    method calls. Both arms warm first (spawn already blocks on child
+    readiness), so compiles never land in a measured window.
+    Min-of-adjacent-pair ratios, same estimator as the scrape guard."""
+    import tempfile
+    import time as _t
+
+    from paddle_tpu.serving import SamplingParams
+
+    sp = SamplingParams(max_new_tokens=max_new_tokens, eos_token_id=-1)
+    local = _fleet_proc_local_engine()
+
+    def run_local():
+        t0 = _t.perf_counter()
+        hs = local.generate_many(_FLEET_PROMPTS, sp)
+        dt = _t.perf_counter() - t0
+        return dt, [h.tokens for h in hs]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        sup = _fleet_proc_supervisor(
+            os.path.join(tmp, 'run'), os.path.join(tmp, 'programs'))
+        try:
+            rr = sup.spawn('bench0')
+
+            def run_remote():
+                t0 = _t.perf_counter()
+                hs = rr.generate_many(_FLEET_PROMPTS, sp)
+                dt = _t.perf_counter() - t0
+                return dt, [h.tokens for h in hs]
+
+            _, ref = run_local()       # warm both arms off the clock
+            _, remote_toks = run_remote()
+            parity = remote_toks == ref
+            ratios, best_local, best_remote = [], float('inf'), \
+                float('inf')
+            for _ in range(trials):
+                t_local, _ = run_local()
+                t_remote, _ = run_remote()
+                best_local = min(best_local, t_local)
+                best_remote = min(best_remote, t_remote)
+                if t_local > 0:
+                    ratios.append(t_remote / t_local)
+            overhead = min(ratios) - 1 if ratios else float('inf')
+            return {
+                'local_s': round(best_local, 4),
+                'remote_s': round(best_remote, 4),
+                'overhead_pct': round(overhead * 100, 2),
+                'tokens_per_arm': len(_FLEET_PROMPTS) * max_new_tokens,
+                'parity': parity,
+            }
+        finally:
+            sup.stop_all(deadline_s=10.0)
+
+
+def fleet_proc_scaling(max_new_tokens=16, repeats=4):
+    """The 2-process scaling row: the SAME workload through a Router
+    over one replica process vs two. Before this PR a second 'replica'
+    shared the parent's Python process (GIL + one runtime): added
+    replicas moved latency, never throughput. Two OS processes are the
+    first configuration where the scaling ratio can genuinely
+    exceed 1."""
+    import tempfile
+    import time as _t
+
+    from paddle_tpu.serving import Replica, Router, SamplingParams
+
+    sp = SamplingParams(max_new_tokens=max_new_tokens, eos_token_id=-1)
+    prompts = _FLEET_PROMPTS * repeats
+
+    def run(router):
+        t0 = _t.perf_counter()
+        handles = [router.submit(p, sp) for p in prompts]
+        while any(not h.done for h in handles):
+            router.step()
+        dt = _t.perf_counter() - t0
+        done = sum(1 for h in handles if h.status == 'FINISHED')
+        return dt, done
+
+    with tempfile.TemporaryDirectory() as tmp:
+        sup = _fleet_proc_supervisor(
+            os.path.join(tmp, 'run'), os.path.join(tmp, 'programs'))
+        try:
+            ra, rb = sup.spawn('s0'), sup.spawn('s1')
+            ra.generate_many(_FLEET_PROMPTS, sp)   # warm off the clock
+            rb.generate_many(_FLEET_PROMPTS, sp)
+            t1, done1 = run(Router([Replica(0, ra)]))
+            t2, done2 = run(Router([Replica(0, ra), Replica(1, rb)]))
+            return {
+                'offered': len(prompts),
+                'one_proc_s': round(t1, 4), 'one_proc_completed': done1,
+                'two_proc_s': round(t2, 4), 'two_proc_completed': done2,
+                'speedup': round(t1 / t2, 3) if t2 > 0 else 0.0,
+            }
+        finally:
+            sup.stop_all(deadline_s=10.0)
+
+
+def fleet_proc_kill_smoke(max_new_tokens=8):
+    """Kill-mid-trace smoke (also imported by the tier-1 guard):
+    SIGKILL one of two replica processes mid-decode under live traffic
+    and count what the fleet lost. The contract is ZERO: every accepted
+    request fails over to the survivor and finishes bit-exact."""
+    import tempfile
+
+    from paddle_tpu.serving import Replica, Router, SamplingParams
+
+    sp = SamplingParams(max_new_tokens=max_new_tokens, eos_token_id=-1)
+    with tempfile.TemporaryDirectory() as tmp:
+        sup = _fleet_proc_supervisor(
+            os.path.join(tmp, 'run'), os.path.join(tmp, 'programs'))
+        try:
+            ra, rb = sup.spawn('k0'), sup.spawn('k1')
+            ref = [h.tokens
+                   for h in ra.generate_many(_FLEET_PROMPTS, sp)]
+            router = Router([Replica(0, ra), Replica(1, rb)])
+            handles = [router.submit(p, sp) for p in _FLEET_PROMPTS]
+            for _ in range(200):
+                router.step()
+                if ra._slot_req and rb._slot_req \
+                        and any(not h.done and h.tokens for h in handles):
+                    break
+            sup.kill('k0')
+            rounds = 0
+            while any(not h.done for h in handles) and rounds < 3000:
+                router.step()
+                rounds += 1
+            finished = sum(1 for h in handles if h.status == 'FINISHED')
+            return {
+                'offered': len(handles),
+                'finished': finished,
+                'lost_requests': len(handles) - finished,
+                'bit_exact': [h.tokens for h in handles] == ref,
+            }
+        finally:
+            sup.stop_all(deadline_s=10.0)
+
+
+def _phase_fleet_proc():
+    """Process fleet runtime phase (ISSUE 18): in-proc vs cross-process
+    RPC overhead A/B, the 2-process scaling row, and the kill-mid-trace
+    zero-loss smoke."""
+    out = {}
+    for key, fn in (('fleet_rpc_overhead', fleet_rpc_overhead_ab),
+                    ('fleet_scaling', fleet_proc_scaling),
+                    ('fleet_kill', fleet_proc_kill_smoke)):
+        try:
+            out[key] = fn()
+        except Exception as e:
+            print(f'# {key} bench failed: {type(e).__name__}: {e}',
+                  file=sys.stderr)
+            out[key] = {'error': type(e).__name__}
+    return out
+
+
 def _phase_fleet_obs():
     """Fleet observability plane phase: shipper on/off overhead A/B on
     the eager hot path (tier-1 pins it <3%) plus a single-process spool
@@ -2638,6 +2828,7 @@ PHASES = {
     'donation': _phase_donation,
     'autoscale': _phase_autoscale,
     'fleet_obs': _phase_fleet_obs,
+    'fleet_proc': _phase_fleet_proc,
 }
 
 

@@ -58,6 +58,21 @@ HOT_SCOPES = {
     # creeps in (e.g. materializing a page to inspect it) stalls every
     # decode round, so the whole class is a hot scope
     'paddle_tpu/serving/kv_pool.py': ('PagedSlotPool.',),
+    # the cross-process RPC client (ISSUE 18) runs INSIDE the router
+    # step loop: every placement reads the mirror scheduler and every
+    # step applies mirror updates. The mirrors are plain-python BY
+    # DESIGN (tokens are ints off the wire) — a device read creeping in
+    # here (e.g. materializing arrays while building a frame) stalls
+    # the routing of every replica, remote or not
+    'paddle_tpu/serving/remote.py': ('RemoteReplica.', '_MirrorScheduler.',
+                                     'RpcClient.'),
+    # the supervisor's monitoring pass interleaves with router steps in
+    # the serving loop; its state machine is pidfiles + clocks only —
+    # any device sync in poll/heartbeat stalls serving fleet-wide
+    'paddle_tpu/serving/supervisor.py': ('Supervisor.poll',
+                                         'Supervisor._poll',
+                                         'Supervisor._on_death',
+                                         'Supervisor._backoff_s'),
 }
 
 _NP_ROOTS = frozenset(('np', 'numpy', 'onp'))

@@ -418,7 +418,24 @@ class FleetSignalSource:
         serving = {u: v for u, v in agg.per_process_value(
             'paddle_router_available_replicas').items() if u in fresh}
         if not serving and not ttft and not queue:
-            # fleet plane dark: the local router is the honest view
+            # fleet plane dark. Two very different darknesses: a spool
+            # that has never shipped (warm-up / single-process — quiet
+            # fallback) vs a spool with data that has ALL gone stale (a
+            # dead shipper fleet-wide) — the latter is an incident, so
+            # it counts and emits instead of degrading silently.
+            ages = self.aggregator.segment_ages(self._clock())
+            if ages and not fresh:
+                from .events import emit
+                if _metrics.enabled():
+                    _metrics.get_registry().counter(
+                        'paddle_fleet_signals_stale_total',
+                        'FleetSignalSource reads that fell back to the '
+                        'local router because every per-process signal '
+                        'was stale').inc()
+                emit('fleet_signals_stale',
+                     processes=len(ages),
+                     oldest_age_s=round(max(ages.values()), 3),
+                     fresh_s=self.fresh_s)
             if self.router is not None:
                 sig = dict(self.router.window_signals())
                 sig['source'] = 'local'

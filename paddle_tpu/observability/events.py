@@ -126,6 +126,21 @@ EVENT_SCHEMA: Dict[str, str] = {
                   'objective',
     'slo_recovered': 'burn-rate alert cleared; short window cooled',
     'slo_capture': 'bounded jax.profiler capture started on breach',
+    'fleet_signals_stale': 'FleetSignalSource fell back to the local '
+                           'router: every per-process signal was stale',
+    # process fleet runtime (serving/{supervisor,remote,replica_main})
+    'replica_spawn': 'supervisor launched a replica process',
+    'replica_ready': 'replica process warm-started and answering RPC',
+    'replica_exit': 'replica process exited (rc + classification)',
+    'replica_crash': 'replica process died uncleanly (crash or hang)',
+    'replica_hang': 'heartbeat deadline exceeded on a live pid; '
+                    'escalated to SIGKILL',
+    'replica_restart': 'respawn scheduled with exponential backoff',
+    'replica_quarantined': 'crash-looping replica circuit-broken out '
+                           'of the respawn loop',
+    'replica_retired': 'replica process retired through graceful drain',
+    'replica_orphan_reaped': 'stale replica process from a previous '
+                             'supervisor incarnation SIGKILLed',
 }
 
 

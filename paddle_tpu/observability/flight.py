@@ -49,6 +49,7 @@ TRIGGER_EVENTS = frozenset((
     'serving_request_failed', 'checkpoint_corrupt',
     'router_failover_storm', 'donation_quarantined',
     'sanitizer_violation', 'slo_breach', 'segment_quarantined',
+    'replica_crash', 'replica_quarantined',
 ))
 
 

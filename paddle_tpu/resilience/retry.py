@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import random
+import socket
 import time
 from typing import Any, Callable, Optional, Tuple, Type
 
@@ -64,10 +65,26 @@ _TRANSIENT_MARKERS: Tuple[str, ...] = (
     'compile timeout',
     'compilation timed out',
     'preempted',
+    # replica RPC vocabulary (serving/remote.py): a peer dying
+    # mid-frame or a corrupted stream reads exactly like a device-side
+    # UNAVAILABLE — evict, resubmit to survivors, maybe retry
+    'incomplete frame',
+    'frame sha256 mismatch',
+    'connection aborted',
+    'timed out',
 )
 
+# ConnectionResetError / BrokenPipeError / ConnectionRefusedError /
+# ConnectionAbortedError are ConnectionError subclasses and
+# socket.timeout aliases TimeoutError since 3.10, but the fleet-runtime
+# failover contract depends on every one of them classifying transient,
+# so they are listed EXPLICITLY — subclass-lattice drift in a future
+# stdlib must not silently change failover behavior (chain-walk tests
+# pin each name).
 _transient_types: Tuple[Type[BaseException], ...] = (
     TransientError, ConnectionError, TimeoutError, InterruptedError,
+    ConnectionResetError, BrokenPipeError, ConnectionRefusedError,
+    ConnectionAbortedError, socket.timeout,
 )
 
 

@@ -96,6 +96,30 @@ loop in ten lines:
     print(loadgen.LoadReplayer(router, trace, autoscaler=scaler)
           .run().report(slo_ttft_s=0.5))
 
+Process fleet runtime (`remote.py` / `replica_main.py` /
+`supervisor.py`, ISSUE 18): replicas become supervised OS processes.
+A `Supervisor` spawns `python -m paddle_tpu.serving.replica_main`
+children that warm-start from the shared ProgramStore (load, never
+compile) and pull weights from the `WeightStore`; the parent talks to
+each over a checksummed framed RPC socket through a `RemoteReplica` —
+the same duck-type surface as an in-process engine, so Router
+placement, QoS, breakers, failover, hot-swap rollouts, and the
+Autoscaler work unchanged across the process boundary. SIGKILL a
+replica mid-decode and the router fails its accepted requests over to
+survivors bit-exactly while the supervisor respawns the victim
+(backoff + jitter, crash-loop quarantine, hang detection, orphan
+reaping):
+
+    from paddle_tpu.serving import (ReplicaSpec, Router, Replica,
+                                    Supervisor)
+    spec = ReplicaSpec('my_models:tiny_gpt',
+                       engine_kwargs=dict(num_slots=8, max_length=256),
+                       program_store_dir='/store/programs',
+                       weight_store_dir='/store/weights')
+    sup = Supervisor('/run/fleet', spec)
+    router = Router([Replica(i, sup.spawn()) for i in range(2)])
+    scaler = Autoscaler(router, sup.replica_factory(), config)
+
 Flags: `FLAGS_autoscale` (gate the poll loop),
 `FLAGS_autoscale_min_replicas` / `FLAGS_autoscale_max_replicas`
 (fleet bounds), `FLAGS_autoscale_cooldown_s` (decision spacing); all
@@ -116,9 +140,13 @@ from .hotswap import (CanaryGate, ReplicaUpdater, SwapFailed,
 from .kv_pool import (PageHold, PagePoolExhausted, PagedSlotPool,
                       PromptTooLongError, SlotPool, default_buckets)
 from .prefix_cache import PagedPrefixCache, RadixPrefixCache
+from .remote import (FrameChecksumError, IncompleteFrameError,
+                     RemoteFatalError, RemoteReplica, RemoteTransientError,
+                     RpcClient)
 from .router import (CircuitBreaker, Replica, ReplicaFailure, ReplicaSet,
                      Router, RouterHandle)
 from .scheduler import FCFSScheduler
+from .supervisor import ReplicaSpec, Supervisor
 from .tenancy import (AdmissionRejected, Tenant, TenantRegistry,
                       TokenBucket, estimate_queue_rounds,
                       parse_tenant_spec, prefill_rounds)
@@ -137,4 +165,7 @@ __all__ = [
     'CanaryGate', 'ReplicaUpdater', 'SwapFailed', 'WeightLoadError',
     'WeightPublisher', 'WeightStore', 'finite_weights_gate',
     'Autoscaler', 'AutoscalerConfig',
+    'RemoteReplica', 'RpcClient', 'IncompleteFrameError',
+    'FrameChecksumError', 'RemoteTransientError', 'RemoteFatalError',
+    'ReplicaSpec', 'Supervisor',
 ]

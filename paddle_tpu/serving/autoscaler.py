@@ -371,6 +371,13 @@ class Autoscaler:
                 continue
             with _obs.span('autoscale.retire'):
                 self.router.remove_replica(rid)
+                # process-backed replicas (RemoteReplica) tear their OS
+                # process down through the supervisor here — SIGTERM →
+                # graceful drain → reap; in-process engines have no
+                # retire() and just get garbage-collected
+                retire = getattr(r.engine, 'retire', None)
+                if retire is not None:
+                    retire()
             self._draining.pop(rid)
             _obs.emit('autoscale_down_complete', replica=rid,
                       drain_s=round(now - t_begin, 4),

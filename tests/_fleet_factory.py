@@ -1,0 +1,15 @@
+"""Model factory for replica_main child processes in the fleet tests.
+
+Addressed by file path (`tests/_fleet_factory.py:tiny_gpt`) so child
+interpreters load it without the tests being an installed package. The
+seed makes every process build the SAME weights — the chaos gauntlet's
+bit-exact failover claim needs parent and children to agree even when
+no WeightStore is wired in.
+"""
+
+
+def tiny_gpt(seed: int = 7):
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    return GPTForCausalLM(GPTConfig.tiny()).eval()

@@ -1,0 +1,38 @@
+"""True-positive fixtures for host-sync over the cross-process RPC
+client scopes (parsed only, never imported). The file path mirrors the
+real hot-scope config (`paddle_tpu/serving/remote.py` + the
+`RemoteReplica.`/`_MirrorScheduler.`/`RpcClient.` prefixes): the
+mirror bookkeeping runs inside every router step and placement, so an
+unannotated device sync here stalls routing for the whole fleet."""
+import numpy as np
+import jax
+
+
+class RemoteReplica:
+    def step(self):
+        # snippet 1: unannotated d2h while applying mirror updates
+        for h in self._handles.values():
+            h.tokens = np.asarray(h._device_toks).tolist()
+        return len(self._handles)
+
+    def submit(self, prompt, params):
+        # snippet 2: blocking sync while framing the request
+        prompt.block_until_ready()
+        return self._rpc.call('submit', prompt_tokens=list(prompt))
+
+    def _apply_updates(self, res):
+        # snippet 3: per-token device read on the step hot path
+        return int(self._engine_tok[0])
+
+
+class _MirrorScheduler:
+    @property
+    def queue_depth(self):
+        # snippet 4: materializing a device array per placement read
+        return jax.device_get(self._owner._depth_vec).sum()
+
+
+class RpcClient:
+    def call(self, method, **args):
+        # snippet 5: .item() inside the per-call serialization
+        return {'t': self._t0.item(), 'method': method}

@@ -1,0 +1,30 @@
+"""True-positive fixtures for host-sync over the supervisor scopes
+(parsed only, never imported). The file path mirrors the real
+hot-scope config (`paddle_tpu/serving/supervisor.py` + the
+`Supervisor.poll`/`Supervisor._poll*` prefixes): the monitoring pass
+interleaves with router steps, so a device sync per heartbeat stalls
+serving fleet-wide."""
+import numpy as np
+import jax
+
+
+class Supervisor:
+    def poll(self, now=None):
+        # snippet 1: unannotated d2h inside the monitoring pass
+        usage = np.asarray(self._mem_watermark)
+        return usage.nbytes
+
+    def _poll_ready(self, child, now):
+        # snippet 2: blocking sync while heartbeating a child
+        self._probe_buf.block_until_ready()
+        return child.replica.healthz()
+
+    def _poll_backoff(self, child, now):
+        # snippet 3: per-poll device read deciding a respawn
+        if float(self._load_vec[0]) < 0.5:
+            return self._start(child)
+
+    def _on_death(self, child, now):
+        # snippet 4: .tolist() materialization in the crash handler,
+        # which runs inline in the serving loop's poll
+        return jax.device_get(self._crash_vec).tolist()

@@ -1,0 +1,37 @@
+"""True-negative fixtures for host-sync over the supervisor scopes:
+clock/pidfile bookkeeping, annotated syncs, and syncs outside the
+configured scope prefixes."""
+import json
+import numpy as np
+
+
+class Supervisor:
+    def poll(self, now=None):
+        # snippet 1: the state machine is clocks + process polls only
+        now = self.clock() if now is None else now
+        for child in list(self._children.values()):
+            if child.state == 'ready':
+                self._poll_ready(child, now)
+        return self.stats()
+
+    def _poll_ready(self, child, now):
+        # snippet 2: heartbeat bookkeeping is float comparisons
+        if now >= child.hb_due:
+            child.hb_due = now + self.heartbeat_interval_s
+            child.replica.healthz(deadline_s=self.heartbeat_timeout_s)
+
+    def _poll_backoff(self, child, now):
+        # snippet 3: the SAME d2h, annotated with a justification
+        probe = np.asarray(self._warm_probe)  # paddle-lint: disable=host-sync -- one-element readiness probe, once per respawn, off the decode path
+        if now >= child.not_before and probe.size:
+            return self._start(child)
+
+    def spawn(self, name):
+        # snippet 4: NOT a hot scope — spawn is a provisioning path
+        return float(np.asarray(self._spawn_budget))
+
+
+def _pidfile_digest(path):
+    # snippet 5: not in any configured scope prefix (module helper)
+    with open(path) as f:
+        return np.asarray(json.load(f)['pid']).tobytes()

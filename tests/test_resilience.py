@@ -170,6 +170,78 @@ class TestClassifierChainWalk:
         assert calls[0] == 3
 
 
+class TestRpcErrorVocabulary:
+    """ISSUE-18 satellite: socket/RPC failures from the cross-process
+    replica plane classify transient — a dead replica process must ride
+    the same failover path as a lost PjRt device."""
+
+    _wrap = staticmethod(TestClassifierChainWalk._wrap)
+
+    def test_socket_errors_transient_by_type(self):
+        import socket
+        for exc in (ConnectionResetError('peer reset'),
+                    BrokenPipeError('pipe'),
+                    ConnectionRefusedError('refused'),
+                    ConnectionAbortedError('aborted'),
+                    socket.timeout('timed out'),
+                    TimeoutError('rpc deadline')):
+            assert res.is_transient(exc), exc
+
+    def test_frame_errors_transient_and_registered(self):
+        from paddle_tpu.serving.remote import (FrameChecksumError,
+                                               IncompleteFrameError)
+        assert res.is_transient(
+            IncompleteFrameError('incomplete frame: peer closed after '
+                                 '3/7 bytes of payload'))
+        assert res.is_transient(
+            FrameChecksumError('frame sha256 mismatch over 42 bytes'))
+
+    def test_rpc_markers_on_generic_exceptions(self):
+        # the marker vocabulary catches third-party wrappers that lose
+        # the exception type but keep the message
+        for msg in ('incomplete frame: short read',
+                    'frame sha256 mismatch',
+                    'connection aborted by peer',
+                    'recv timed out'):
+            assert res.is_transient(Exception(msg)), msg
+
+    def test_wrapped_socket_error_walks_the_chain(self):
+        # RemoteReplica.step failures surface wrapped in router/
+        # framework layers; the chain walk must still see the socket
+        got = self._wrap(RuntimeError('replica step failed'),
+                         ConnectionResetError('peer reset'))
+        assert res.is_transient(got)
+        from paddle_tpu.serving.remote import IncompleteFrameError
+        got = self._wrap(RuntimeError('rpc layer'),
+                         IncompleteFrameError('incomplete frame'))
+        assert res.is_transient(got)
+
+    def test_programming_error_never_matches_rpc_markers(self):
+        # a ValueError that happens to SAY "timed out" is still a bug,
+        # not a retryable blip
+        assert not res.is_transient(ValueError('parse timed out field'))
+        assert not res.is_transient(TypeError('connection aborted arg'))
+
+    def test_remote_classification_round_trip(self):
+        from paddle_tpu.serving.remote import (RemoteFatalError,
+                                               RemoteTransientError,
+                                               _rehydrate_error)
+        assert res.is_transient(_rehydrate_error(
+            {'type': 'SomeChildError', 'message': 'x', 'transient': True}))
+        assert isinstance(_rehydrate_error(
+            {'type': 'SomeChildError', 'message': 'x', 'transient': True}),
+            RemoteTransientError)
+        assert not res.is_transient(_rehydrate_error(
+            {'type': 'SomeChildError', 'message': 'x',
+             'transient': False}))
+        assert isinstance(_rehydrate_error(
+            {'type': 'SomeChildError', 'message': 'x',
+             'transient': False}), RemoteFatalError)
+        # known builtins come back as THEMSELVES (submit validation)
+        assert isinstance(_rehydrate_error(
+            {'type': 'ValueError', 'message': 'bad prompt'}), ValueError)
+
+
 class TestRetry:
     def _policy(self, **kw):
         kw.setdefault('base_delay', 0.0)

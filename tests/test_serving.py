@@ -657,7 +657,11 @@ class TestSpeculativeEngine:
 # tier-1 bench guard: bit-identical outputs + zero recompiles + speedup
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_bench_serving_guard():
+    # Full-gate tier: parity + zero-recompile are asserted fast-tier by
+    # test_engine_mixed_length_greedy_matches_generate and the
+    # zero-recompile wave tests; this re-proves them through bench.py.
     import bench
     res = bench.serving_ab(num_requests=8, num_slots=4, trials=1)
     assert res['parity'], 'engine greedy outputs diverged from generate()'
@@ -669,7 +673,11 @@ def test_bench_serving_guard():
     assert res['sequential_tokens_per_sec'] > 0
 
 
+@pytest.mark.slow
 def test_bench_prefix_guard():
+    # Full-gate tier: prefix parity/hit behavior is asserted fast-tier
+    # by test_prefix_cache.py TestEngineIntegration; the bench A/B adds
+    # the prefill-reduction headline at ~24 s.
     import bench
     res = bench.prefix_ab(num_requests=8, num_slots=10, trials=1)
     assert res['parity'], 'prefix-cache outputs diverged from generate()'
@@ -681,7 +689,11 @@ def test_bench_prefix_guard():
     assert res['prefill_token_reduction'] >= 0.3
 
 
+@pytest.mark.slow
 def test_bench_chunked_guard():
+    # Full-gate tier: chunked parity/rounds/TTFT streaming are asserted
+    # fast-tier by TestChunkedPrefill; this re-proves them through the
+    # bench A/B arms.
     import bench
     res = bench.chunked_ab(num_short=4, long_len=48, max_length=64,
                            num_slots=6, chunk=16, trials=1)

@@ -1,0 +1,382 @@
+"""Supervisor state machine under injected faults — zero real spawns.
+
+The process-level chaos lives in test_fleet_proc.py; here the
+Supervisor's POLICY is pinned down on synthetic children (fake
+popen/connect/clock), where every transition is deterministic:
+
+- exit-code classification: clean exit vs crash vs hang
+- exponential backoff + jitter spacing, asserted from the
+  `replica_restart` events' own backoff_s attrs AND from when the
+  respawn actually fires against the injected clock
+- crash-loop quarantine: more than max_restarts crashes inside the
+  window circuit-breaks the replica out of the respawn loop
+- attempts reset after sustained health (backoff exponent forgiveness)
+- orphan reaping: a stale pidfile pointing at a live replica_main gets
+  SIGKILLed; one pointing at an innocent (recycled) pid does not
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.serving.supervisor import (BACKOFF, QUARANTINED, READY,
+                                           STOPPED, ReplicaSpec, Supervisor)
+
+
+def _events_since(seq, name=None):
+    evs = [e for e in obs.get_event_log().events()
+           if e.get('seq', 0) > seq and e.get('ph') == 'i']
+    if name is not None:
+        evs = [e for e in evs if e['name'] == name]
+    return evs
+
+
+def _last_seq():
+    evs = obs.get_event_log().events()
+    return evs[-1]['seq'] if evs else 0
+
+
+class FakeProc:
+    _next_pid = [900000]   # far above any real pid on this box
+
+    def __init__(self):
+        FakeProc._next_pid[0] += 1
+        self.pid = FakeProc._next_pid[0]
+        self.rc = None
+        self.signals = []
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        if sig in (signal.SIGKILL, signal.SIGTERM):
+            self.rc = -int(sig)
+
+    def kill(self):
+        self.send_signal(signal.SIGKILL)
+
+
+class FakeReplica:
+    def __init__(self):
+        self.hang = False
+        self.healthz_calls = 0
+        self.closed = False
+
+    def healthz(self, deadline_s=5.0):
+        self.healthz_calls += 1
+        if self.hang:
+            raise socket.timeout('timed out')
+        return {'ok': True}
+
+    def close(self):
+        self.closed = True
+
+
+class Harness:
+    """One supervisor over fake children and a hand-cranked clock."""
+
+    def __init__(self, tmp_path, **sup_kw):
+        self.now = [0.0]
+        self.procs = []
+        self.replicas = []
+        self.restarted = []
+        spec = ReplicaSpec('fake:factory')
+        kw = dict(heartbeat_interval_s=1.0, heartbeat_timeout_s=3.0,
+                  backoff_base_s=1.0, backoff_mult=2.0,
+                  backoff_cap_s=30.0, backoff_jitter=0.25,
+                  max_restarts=3, restart_window_s=60.0)
+        kw.update(sup_kw)
+        self.sup = Supervisor(
+            str(tmp_path), spec,
+            clock=lambda: self.now[0],
+            sleep=lambda s: None,
+            popen_fn=self._popen, connect_fn=self._connect,
+            on_restart=lambda name, r: self.restarted.append((name, r)),
+            **kw)
+
+    def _popen(self, argv, env, log_path):
+        proc = FakeProc()
+        self.procs.append(proc)
+        return proc
+
+    def _connect(self, child):
+        r = FakeReplica()
+        self.replicas.append(r)
+        return r
+
+    def tick(self, dt=1.0):
+        self.now[0] += dt
+        return self.sup.poll()
+
+
+class TestExitClassification:
+    def test_clean_exit_is_not_a_crash(self, tmp_path):
+        h = Harness(tmp_path)
+        seq0 = _last_seq()
+        h.sup.spawn('a')
+        h.procs[-1].rc = 0
+        h.tick()
+        exits = _events_since(seq0, 'replica_exit')
+        assert exits and exits[-1]['attrs']['reason'] == 'clean_exit'
+        assert not _events_since(seq0, 'replica_crash')
+        # clean or not, an unsupervised death schedules a respawn
+        assert h.sup.stats()['a']['state'] == BACKOFF
+
+    def test_nonzero_rc_is_a_crash(self, tmp_path):
+        h = Harness(tmp_path)
+        seq0 = _last_seq()
+        h.sup.spawn('a')
+        h.procs[-1].rc = 1
+        h.tick()
+        crashes = _events_since(seq0, 'replica_crash')
+        assert crashes and crashes[-1]['attrs']['reason'] == 'crash'
+        assert h.sup.stats()['a']['state'] == BACKOFF
+
+    def test_signal_death_is_a_crash(self, tmp_path):
+        h = Harness(tmp_path)
+        seq0 = _last_seq()
+        h.sup.spawn('a')
+        h.procs[-1].rc = -int(signal.SIGKILL)
+        h.tick()
+        crashes = _events_since(seq0, 'replica_crash')
+        assert crashes[-1]['attrs']['rc'] == -9
+
+    def test_hang_escalates_to_sigkill(self, tmp_path):
+        h = Harness(tmp_path, heartbeat_interval_s=1.0,
+                    heartbeat_timeout_s=3.0)
+        seq0 = _last_seq()
+        h.sup.spawn('a')
+        h.replicas[-1].hang = True
+        proc = h.procs[-1]
+        # heartbeats fail but the deadline has not passed: still READY
+        h.tick(1.5)
+        assert h.sup.stats()['a']['state'] == READY
+        # past the deadline: hang declared, SIGKILL, respawn scheduled
+        h.tick(3.0)
+        hangs = _events_since(seq0, 'replica_hang')
+        assert hangs and hangs[-1]['attrs']['silent_s'] >= 3.0
+        assert signal.SIGKILL in proc.signals
+        assert h.sup.stats()['a']['state'] == BACKOFF
+        crashes = _events_since(seq0, 'replica_crash')
+        assert crashes[-1]['attrs']['reason'] == 'hang'
+
+    def test_healthy_child_is_left_alone(self, tmp_path):
+        h = Harness(tmp_path)
+        h.sup.spawn('a')
+        for _ in range(10):
+            h.tick()
+        assert h.sup.stats()['a']['state'] == READY
+        assert h.replicas[-1].healthz_calls >= 9
+        assert h.procs[-1].signals == []
+
+
+class TestBackoffSpacing:
+    def test_exponential_backoff_with_bounded_jitter(self, tmp_path):
+        h = Harness(tmp_path, backoff_base_s=1.0, backoff_mult=2.0,
+                    backoff_cap_s=30.0, backoff_jitter=0.25,
+                    max_restarts=10, restart_window_s=10_000.0)
+        seq0 = _last_seq()
+        h.sup.spawn('a')
+        for _ in range(6):
+            h.procs[-1].rc = 1          # crash the live child
+            h.tick(0.001)               # classify; schedules backoff
+            # a poll BEFORE the backoff gate must not respawn
+            spawned = len(h.procs)
+            h.tick(0.001)
+            assert len(h.procs) == spawned
+            while h.sup.stats()['a']['state'] == BACKOFF:
+                h.tick(0.5)
+        backoffs = [e['attrs']['backoff_s']
+                    for e in _events_since(seq0, 'replica_restart')]
+        assert len(backoffs) == 6
+        for i, b in enumerate(backoffs):
+            ideal = min(1.0 * 2.0 ** i, 30.0)
+            assert ideal * 0.75 <= b <= ideal * 1.25, (i, b)
+        # monotone envelope: attempt 5's floor is above attempt 1's cap
+        assert backoffs[4] > backoffs[0]
+
+    def test_attempts_reset_after_sustained_health(self, tmp_path):
+        h = Harness(tmp_path, backoff_base_s=1.0, restart_window_s=20.0)
+        h.sup.spawn('a')
+        h.procs[-1].rc = 1
+        h.tick(0.001)
+        while h.sup.stats()['a']['state'] == BACKOFF:
+            h.tick(0.5)
+        assert h.sup.stats()['a']['attempts'] == 1
+        # a long healthy stretch forgives: the exponent goes back to 0
+        h.tick(25.0)
+        assert h.sup.stats()['a']['state'] == READY
+        assert h.sup.stats()['a']['attempts'] == 0
+
+
+class TestCrashLoopQuarantine:
+    def test_crash_loop_breaks_the_respawn_circuit(self, tmp_path):
+        h = Harness(tmp_path, max_restarts=3, restart_window_s=60.0,
+                    backoff_base_s=0.1, backoff_cap_s=0.2)
+        seq0 = _last_seq()
+        h.sup.spawn('a')
+        for _ in range(10):             # would be 10 crashes unbounded
+            if h.sup.stats()['a']['state'] == QUARANTINED:
+                break
+            if h.sup.stats()['a']['state'] == READY:
+                h.procs[-1].rc = 1
+            h.tick(0.3)
+        assert h.sup.stats()['a']['state'] == QUARANTINED
+        q = _events_since(seq0, 'replica_quarantined')
+        assert q and q[-1]['attrs']['crashes_in_window'] == 4  # > max 3
+        # the circuit stays broken: no further spawns ever
+        spawned = len(h.procs)
+        for _ in range(5):
+            h.tick(10.0)
+        assert len(h.procs) == spawned
+        # 1 initial spawn + 3 respawns, then the breaker
+        assert spawned == 4
+        # stale state swept: no pidfile/socket left for the quarantined
+        assert not os.path.exists(os.path.join(str(tmp_path), 'a.json'))
+
+    def test_slow_crashes_outside_window_never_quarantine(self, tmp_path):
+        h = Harness(tmp_path, max_restarts=2, restart_window_s=5.0,
+                    backoff_base_s=0.1, backoff_cap_s=0.2)
+        h.sup.spawn('a')
+        for _ in range(6):              # 6 crashes, spread far apart
+            h.procs[-1].rc = 1
+            h.tick(0.001)
+            while h.sup.stats()['a']['state'] == BACKOFF:
+                h.tick(0.2)
+            h.tick(20.0)                # window empties between crashes
+        assert h.sup.stats()['a']['state'] == READY
+
+
+class TestRetire:
+    def test_retire_is_not_a_crash_and_stays_down(self, tmp_path):
+        h = Harness(tmp_path)
+        seq0 = _last_seq()
+        h.sup.spawn('a')
+        h.sup.retire('a', deadline_s=1.0)
+        assert h.sup.stats()['a']['state'] == STOPPED
+        assert signal.SIGTERM in h.procs[-1].signals
+        assert _events_since(seq0, 'replica_retired')
+        assert not _events_since(seq0, 'replica_crash')
+        spawned = len(h.procs)
+        for _ in range(3):
+            h.tick(10.0)                # no respawn of the retired
+        assert len(h.procs) == spawned
+
+
+class TestOrphanReaping:
+    def _write_pidfile(self, run_dir, name, pid, uid='stale-uid'):
+        with open(os.path.join(run_dir, f'{name}.json'), 'w') as f:
+            json.dump({'pid': pid, 'name': name,
+                       'socket': os.path.join(run_dir, f'{name}.sock'),
+                       'uid': uid}, f)
+
+    def test_live_replica_orphan_is_killed_and_swept(self, tmp_path):
+        run_dir = str(tmp_path / 'run')
+        spool = tmp_path / 'spool'
+        os.makedirs(run_dir)
+        # a real process whose /proc cmdline carries the replica_main
+        # marker (sys.argv lands in cmdline), parked in sleep
+        orphan = subprocess.Popen(
+            [sys.executable, '-c', 'import time; time.sleep(120)',
+             'replica_main-marker'])
+        try:
+            # wait out the fork->exec window: until exec lands, the
+            # child's /proc cmdline doesn't carry the marker yet and a
+            # reaping supervisor would (correctly) spare it as pid reuse
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    with open(f'/proc/{orphan.pid}/cmdline', 'rb') as f:
+                        if b'replica_main' in f.read():
+                            break
+                except OSError:
+                    pass
+                time.sleep(0.01)
+            self._write_pidfile(run_dir, 'old', orphan.pid, uid='dead-1')
+            open(os.path.join(run_dir, 'old.sock'), 'w').close()
+            os.makedirs(spool / 'dead-1')
+            (spool / 'dead-1' / 'seg.bin').write_bytes(b'x')
+            seq0 = _last_seq()
+            spec = ReplicaSpec('fake:factory', spool_dir=str(spool))
+            Supervisor(run_dir, spec,
+                       popen_fn=lambda *a: FakeProc(),
+                       connect_fn=lambda c: FakeReplica())
+            assert orphan.wait(timeout=10) == -int(signal.SIGKILL)
+            reaped = _events_since(seq0, 'replica_orphan_reaped')
+            assert reaped and reaped[-1]['attrs']['pid'] == orphan.pid
+            assert os.listdir(run_dir) == []      # pidfile+socket swept
+            assert not (spool / 'dead-1').exists()  # stale spool gone
+        finally:
+            if orphan.poll() is None:
+                orphan.kill()
+
+    def test_recycled_pid_is_not_killed(self, tmp_path):
+        run_dir = str(tmp_path / 'run')
+        os.makedirs(run_dir)
+        # an innocent process with NO replica_main in its cmdline: the
+        # pidfile's pid was recycled and must not catch a stray SIGKILL
+        innocent = subprocess.Popen(
+            [sys.executable, '-c', 'import time; time.sleep(120)'])
+        try:
+            self._write_pidfile(run_dir, 'old', innocent.pid)
+            seq0 = _last_seq()
+            Supervisor(run_dir, ReplicaSpec('fake:factory'),
+                       popen_fn=lambda *a: FakeProc(),
+                       connect_fn=lambda c: FakeReplica())
+            time.sleep(0.1)
+            assert innocent.poll() is None        # still alive
+            assert not _events_since(seq0, 'replica_orphan_reaped')
+            # the stale pidfile itself is still swept
+            assert os.listdir(run_dir) == []
+        finally:
+            innocent.kill()
+
+    def test_garbage_pidfile_is_swept_quietly(self, tmp_path):
+        run_dir = str(tmp_path / 'run')
+        os.makedirs(run_dir)
+        with open(os.path.join(run_dir, 'junk.json'), 'w') as f:
+            f.write('not json{{{')
+        Supervisor(run_dir, ReplicaSpec('fake:factory'),
+                   popen_fn=lambda *a: FakeProc(),
+                   connect_fn=lambda c: FakeReplica())
+        assert os.listdir(run_dir) == []
+
+
+class TestSpawnFailure:
+    def test_connect_failure_kills_half_started_child(self, tmp_path):
+        h = Harness(tmp_path)
+
+        def bad_connect(child):
+            raise TimeoutError('never became ready')
+
+        h.sup.connect_fn = bad_connect
+        with pytest.raises(TimeoutError):
+            h.sup.spawn('a')
+        assert signal.SIGKILL in h.procs[-1].signals
+        assert h.sup.stats()['a']['state'] == STOPPED
+        assert not os.path.exists(os.path.join(str(tmp_path), 'a.json'))
+
+    def test_failed_respawn_counts_against_the_window(self, tmp_path):
+        h = Harness(tmp_path, max_restarts=2, restart_window_s=60.0,
+                    backoff_base_s=0.1, backoff_cap_s=0.2)
+        h.sup.spawn('a')
+        h.sup.connect_fn = lambda c: (_ for _ in ()).throw(
+            TimeoutError('spawn wedged'))
+        h.procs[-1].rc = 1
+        for _ in range(12):
+            if h.sup.stats()['a']['state'] == QUARANTINED:
+                break
+            h.tick(0.3)
+        # every respawn fails -> each failure is one more crash -> the
+        # loop breaks at the quarantine line instead of spinning forever
+        assert h.sup.stats()['a']['state'] == QUARANTINED
