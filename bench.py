@@ -1724,6 +1724,215 @@ def paged_ab(num_requests=12, system_len=48, max_length=96,
     }
 
 
+def adapter_ab(num_adapters=3, requests_per_group=3, num_slots=4,
+               max_length=96, decode_block=8, max_new=12, trials=2):
+    """Heterogeneous-adapter batched-decode A/B (also imported by the
+    tier-1 adapter guard). One base GPT + `num_adapters` LoRA adapters
+    in a packed `AdapterBank`, over a deterministic mixed trace that
+    round-robins base + every adapter. Three guard fields:
+
+    - parity: every request's greedy output in the MIXED batch is
+      bit-identical to running its adapter alone on a fresh
+      single-adapter engine (base requests check against generate()).
+    - zero recompiles after warmup — by python trace counters AND
+      `paddle_jit_compiles_total` — across arbitrary adapter mixes
+      AND a store-backed hot-swap (publish v2 of one adapter mid-run:
+      new pins pick it up, outputs under it change, nothing retraces).
+    - throughput: the mixed batch beats sequential per-adapter group
+      serving on tokens/sec (homogeneous groups under-fill the slots;
+      the packed bank lets one decode wave serve any mix).
+    """
+    import shutil
+    import tempfile
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import (AdapterBank, InferenceEngine,
+                                    SamplingParams, make_adapter_factors)
+
+    store_dir = tempfile.mkdtemp(prefix='adapter_bench_')
+    try:
+        model = _serving_model()
+        vocab = model.config.vocab_size
+        ids = [None] + [f'ad{i}' for i in range(num_adapters)]
+        bank = AdapterBank(model, capacity=num_adapters + 1, rank=8,
+                           store_dir=store_dir)
+        for i, aid in enumerate(ids[1:]):
+            bank.load(aid, make_adapter_factors(bank, seed=i + 1))
+
+        # deterministic mixed trace: round-robin base + every adapter
+        rng = np.random.RandomState(23)
+        plens = [5, 11, 8, 14]
+        trace = []
+        for i in range(len(ids) * requests_per_group):
+            prompt = rng.randint(1, vocab, (plens[i % 4],)).tolist()
+            trace.append((prompt, ids[i % len(ids)]))
+        sp = SamplingParams(max_new_tokens=max_new, eos_token_id=-1)
+
+        # alone references: each adapter on a FRESH single-adapter
+        # engine (identical weights — _serving_model reseeds), base
+        # against per-request generate()
+        expected = {}
+        for gi, aid in enumerate(ids):
+            group = [(j, p) for j, (p, a) in enumerate(trace) if a == aid]
+            if aid is None:
+                refs = _ref_outputs(model, [(p, max_new) for _, p in group])
+                for (j, _), ref in zip(group, refs):
+                    expected[j] = ref
+                continue
+            m = _serving_model()
+            b = AdapterBank(m, capacity=2, rank=8)
+            b.load(aid, make_adapter_factors(b, seed=gi))
+            e = InferenceEngine(m, num_slots=num_slots,
+                                max_length=max_length,
+                                decode_block=decode_block, adapter_bank=b)
+            for j, p in group:
+                h = e.submit(p, sp, adapter_id=aid)
+                e.run()
+                expected[j] = h.tokens
+
+        eng = InferenceEngine(model, num_slots=num_slots,
+                              max_length=max_length,
+                              decode_block=decode_block, adapter_bank=bank)
+
+        def run_mixed(order=None):
+            picks = order if order is not None else range(len(trace))
+            t0 = time.perf_counter()
+            hs = {j: eng.submit(trace[j][0], sp, adapter_id=trace[j][1])
+                  for j in picks}
+            eng.run()
+            return time.perf_counter() - t0, hs
+
+        # warmup covers every prompt bucket under every adapter, then
+        # both compile counters must stay FLAT to the end
+        run_mixed()
+        warm = dict(eng.stats()['traces'])
+        reg = obs.get_registry()
+        compiles0 = reg.value('paddle_jit_compiles_total')
+
+        best_mixed, hs = min((run_mixed() for _ in range(trials)),
+                             key=lambda t: t[0])
+        parity = all(hs[j].tokens == expected[j] for j in hs)
+
+        # a PERMUTED mix, still zero recompiles
+        perm = list(reversed(range(len(trace))))
+        _, hs_perm = run_mixed(perm)
+        parity = parity and all(hs_perm[j].tokens == expected[j]
+                                for j in hs_perm)
+
+        # sequential per-adapter-group serving: same engine, same
+        # requests, but homogeneous waves (what an engine without
+        # heterogeneous batching is forced into)
+        def run_sequential():
+            t0 = time.perf_counter()
+            for aid in ids:
+                for j, (p, a) in enumerate(trace):
+                    if a == aid:
+                        eng.submit(p, sp, adapter_id=aid)
+                eng.run()
+            return time.perf_counter() - t0
+
+        best_seq = min(run_sequential() for _ in range(trials))
+
+        # store-backed hot-swap: publish ad0 v2; the next pins load it
+        # into a fresh slot — outputs under ad0 change, every other
+        # request stays bit-exact, and NOTHING retraces
+        bank.publish('ad0', make_adapter_factors(bank, seed=101))
+        _, hs_swap = run_mixed()
+        swap_changed = any(hs_swap[j].tokens != expected[j]
+                           for j in hs_swap if trace[j][1] == 'ad0')
+        swap_others_exact = all(hs_swap[j].tokens == expected[j]
+                                for j in hs_swap if trace[j][1] != 'ad0')
+
+        tokens = len(trace) * max_new
+        return {
+            'parity': parity,
+            'recompiles_after_warmup': sum(eng.stats()['traces'].values())
+            - sum(warm.values()),
+            'jit_compiles_delta': reg.value('paddle_jit_compiles_total')
+            - compiles0,
+            'tokens_per_sec_mixed': round(tokens / best_mixed, 1),
+            'tokens_per_sec_sequential': round(tokens / best_seq, 1),
+            'mixed_speedup': round(best_seq / best_mixed, 2),
+            'hot_swap_outputs_changed': swap_changed,
+            'hot_swap_others_bit_exact': swap_others_exact,
+            'num_adapters': num_adapters,
+            'num_requests': len(trace),
+            'bank': bank.stats(),
+        }
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def adapters_smoke(duration_s=4.0, rate=8.0, seed=77, time_scale=0.2):
+    """Tier-1 smoke (`bench.py adapters --smoke`): a deterministic
+    mixed-adapter loadgen trace — two tenants, one with a per-tenant
+    adapter mix, one pure base — replayed through a Router onto a
+    bank-backed engine. The guard asserts the trace is bit-identical
+    across two builds from the same seed (adapter draws included),
+    zero requests dropped, and at least two different adapters
+    actually served."""
+    from paddle_tpu.loadgen import (FixedLength, LoadReplayer,
+                                    PoissonSchedule, TenantClass,
+                                    make_trace, trace_stats)
+    from paddle_tpu.serving import (AdapterBank, InferenceEngine,
+                                    PRIORITY_HIGH, PRIORITY_LOW,
+                                    Replica, Router,
+                                    make_adapter_factors)
+    from paddle_tpu.serving.tenancy import TenantRegistry
+
+    model = _serving_model()
+    bank = AdapterBank(model, capacity=4, rank=8)
+    bank.load('ad0', make_adapter_factors(bank, seed=1))
+    bank.load('ad1', make_adapter_factors(bank, seed=2))
+    eng = InferenceEngine(model, num_slots=4, max_length=96,
+                          decode_block=8, adapter_bank=bank)
+
+    tenants = [
+        TenantClass(name='paid', weight=2.0, priority=PRIORITY_HIGH,
+                    adapters=(('ad0', 2.0), ('ad1', 1.0), (None, 1.0))),
+        TenantClass(name='free', weight=1.0, priority=PRIORITY_LOW),
+    ]
+    kw = dict(schedule=PoissonSchedule(rate), duration_s=duration_s,
+              seed=seed, prompt_lengths=FixedLength(8),
+              output_lengths=FixedLength(6), tenants=tenants,
+              vocab_size=model.config.vocab_size)
+    trace = make_trace(**kw)
+    deterministic = make_trace(**kw) == trace
+
+    reg = TenantRegistry()
+    reg.add('paid', priority=PRIORITY_HIGH)
+    reg.add('free', priority=PRIORITY_LOW)
+    router = Router([Replica(0, eng)], tenants=reg)
+    report = LoadReplayer(router, trace, time_scale=time_scale,
+                          max_wall_s=60.0).run().report(slo_ttft_s=2.0)
+    stats = trace_stats(trace)
+    return {
+        'trace_deterministic': deterministic,
+        'offered': report['offered'],
+        'completed': report['completed'],
+        'dropped': report['dropped'],
+        'by_adapter': stats.get('by_adapter', {}),
+        'adapters_served': len(stats.get('by_adapter', {})),
+        'bank': bank.stats(),
+    }
+
+
+def _phase_adapters():
+    """Multi-tenant adapter phase: the heterogeneous-adapter batched
+    decode A/B (parity / zero-recompile / mixed-vs-sequential — the
+    ISSUE 19 acceptance fields) plus the loadgen mixed-adapter smoke."""
+    out = {}
+    for key, fn in (('adapter_ab', adapter_ab),
+                    ('adapters_smoke', adapters_smoke)):
+        try:
+            out[key] = fn()
+        except Exception as e:
+            print(f'# {key} bench failed: {type(e).__name__}: {e}',
+                  file=sys.stderr)
+            out[key] = {'error': type(e).__name__}
+    return out
+
+
 def _phase_serving():
     """Serving phase: continuous-batching throughput vs the sequential
     generate() loop, then the latency stack — prefix-cache, chunked-
@@ -2822,6 +3031,7 @@ PHASES = {
     'obs': _phase_obs,
     'resilience': _phase_resilience,
     'serving': _phase_serving,
+    'adapters': _phase_adapters,
     'router': _phase_router,
     'coldstart': _phase_coldstart,
     'goodput': _phase_goodput,
@@ -2866,9 +3076,9 @@ def _cpu_phase_plan():
     BENCH_CPU_PHASES (comma list) restricts the set — the probe-fallback
     regression test runs a single fast phase."""
     plan = [('headline', 1500), ('eager', 600), ('obs', 600),
-            ('resilience', 600), ('serving', 1200), ('router', 900),
-            ('coldstart', 900), ('goodput', 600), ('donation', 600),
-            ('autoscale', 600), ('fleet_obs', 600)]
+            ('resilience', 600), ('serving', 1200), ('adapters', 900),
+            ('router', 900), ('coldstart', 900), ('goodput', 600),
+            ('donation', 600), ('autoscale', 600), ('fleet_obs', 600)]
     only = os.environ.get('BENCH_CPU_PHASES')
     if only:
         wanted = {p.strip() for p in only.split(',') if p.strip()}
@@ -2890,6 +3100,14 @@ def main():
             print(json.dumps({'autoscale_smoke': autoscale_smoke()}))
         else:
             print(json.dumps(_phase_autoscale()))
+        return 0
+    if len(sys.argv) >= 2 and sys.argv[1] == 'adapters':
+        # `bench.py adapters [--smoke]`: --smoke is the deterministic
+        # mixed-adapter loadgen trace the tier-1 guard asserts on
+        if '--smoke' in sys.argv[2:]:
+            print(json.dumps({'adapters_smoke': adapters_smoke()}))
+        else:
+            print(json.dumps(_phase_adapters()))
         return 0
     if len(sys.argv) >= 3 and sys.argv[1] == '--coldstart-child':
         if os.environ.get('BENCH_FORCE_CPU'):

@@ -27,6 +27,17 @@ speculative decoding (greedy outputs stay bit-identical):
     python examples/serve_gpt.py --prefix-cache 0.5 --prefill-chunk 16 \\
         --draft-model tiny
 
+Multi-tenant adapter serving: `--adapters N` packs N LoRA adapters
+into a device-resident `AdapterBank` and round-robins requests across
+base + every adapter — one compiled decode block serves the whole
+heterogeneous mix (zero recompiles across any adapter assignment).
+Combine with `--tenants` using the `adapter=` spec key to pin a
+tenant's default adapter:
+
+    python examples/serve_gpt.py --adapters 3
+    python examples/serve_gpt.py --replicas 2 --adapters 2 \\
+        --tenants 'paid:priority=high,adapter=ad0;free:priority=low'
+
 Live introspection: `--metrics-port 8000` serves the HTTP observability
 endpoint while the engine decodes — /metrics (Prometheus, incl. the
 paddle_serving_* and paddle_router_* families), /healthz (decode-round
@@ -62,12 +73,18 @@ def _make_requests(model, num_requests):
     return out
 
 
-def _serve_single(model, requests, engine_kwargs=None):
+def _adapter_for(i, adapter_ids):
+    return adapter_ids[i % len(adapter_ids)] if adapter_ids else None
+
+
+def _serve_single(model, requests, engine_kwargs=None, adapter_ids=None):
     # one engine = one slot pool + scheduler; 4 slots serve the whole
     # burst by admitting queued requests as running ones retire
     engine = InferenceEngine(model, num_slots=4, max_length=64,
                              decode_block=4, **(engine_kwargs or {}))
-    handles = [engine.submit(p, sp) for p, sp in requests]
+    handles = [engine.submit(p, sp,
+                             adapter_id=_adapter_for(i, adapter_ids))
+               for i, (p, sp) in enumerate(requests)]
 
     # stream the FIRST request token-by-token; the engine advances every
     # running request under the hood on each step
@@ -78,8 +95,9 @@ def _serve_single(model, requests, engine_kwargs=None):
 
     engine.run()   # drain the rest
     for h in handles:
+        ad = f' adapter={h.adapter_id}' if h.adapter_id else ''
         print(f'req {h.request_id}: {h.status.lower():8s} '
-              f'prompt={len(h.prompt_tokens):2d} tokens={h.tokens}')
+              f'prompt={len(h.prompt_tokens):2d} tokens={h.tokens}{ad}')
 
     stats = engine.stats()
     print(f"\n{stats['completed']}/{stats['submitted']} served, "
@@ -98,11 +116,18 @@ def _serve_single(model, requests, engine_kwargs=None):
         sp = stats['spec']
         print(f"speculation (k={sp['k']}): {sp['rounds']} rounds, "
               f"acceptance {sp['acceptance_rate']:.1%}")
+    if 'adapters' in stats:
+        ad = stats['adapters']
+        resident = ', '.join(f"{k}(v{v['version']})"
+                             for k, v in ad['resident'].items())
+        print(f"adapter bank: {len(ad['resident'])}/{ad['capacity']} "
+              f"slots resident [{resident}], rank {ad['rank']}, "
+              f"{ad['pinned']} pinned")
     return handles
 
 
 def _serve_routed(model, requests, replicas, tenants, shed_queue_depth,
-                  engine_kwargs=None):
+                  engine_kwargs=None, adapter_ids=None):
     router = Router(
         ReplicaSet(model, replicas, num_slots=4, max_length=64,
                    decode_block=4, **(engine_kwargs or {})),
@@ -112,16 +137,21 @@ def _serve_routed(model, requests, replicas, tenants, shed_queue_depth,
     for i, (p, sp) in enumerate(requests):
         tenant = tenant_names[i % len(tenant_names)]
         try:
-            handles.append((tenant, router.submit(p, sp, tenant=tenant)))
+            # explicit per-request adapter; unset, the tenant's
+            # `adapter=` spec default applies inside the router
+            handles.append((tenant, router.submit(
+                p, sp, tenant=tenant,
+                adapter_id=_adapter_for(i, adapter_ids))))
         except AdmissionRejected as exc:
             rejected += 1
             print(f'req {i}: REJECTED for {exc.tenant!r} '
                   f'({exc.reason}, retry after {exc.retry_after_s})')
     router.run()
     for tenant, h in handles:
+        ad = f' adapter={h.adapter_id}' if h.adapter_id else ''
         print(f'req {h.router_id}: {h.status.lower():8s} '
               f'tenant={tenant:8s} replica={h.replica_id} '
-              f'failovers={h.failovers} tokens={h.tokens}')
+              f'failovers={h.failovers} tokens={h.tokens}{ad}')
     st = router.stats()
     print(f"\nrouter: {st['completed']}/{st['accepted']} completed, "
           f"{st['failed']} failed, {rejected} rejected at admission")
@@ -134,7 +164,7 @@ def _serve_routed(model, requests, replicas, tenants, shed_queue_depth,
 
 def main(num_requests=10, metrics_port=None, replicas=1, tenants=None,
          shed_queue_depth=None, program_store=None, prefix_cache=None,
-         prefill_chunk=None, draft_model=None):
+         prefill_chunk=None, draft_model=None, adapters=None):
     paddle.seed(0)
     if program_store:
         # persistent program store: a cold replica loads its decode/
@@ -164,14 +194,29 @@ def main(num_requests=10, metrics_port=None, replicas=1, tenants=None,
                 GPTConfig.tiny(num_hidden_layers=1)).eval()
         engine_kwargs['draft_model'] = draft
         engine_kwargs['num_draft_tokens'] = 3
+    adapter_ids = None
+    if adapters:
+        from paddle_tpu.serving import AdapterBank, make_adapter_factors
+        # one packed bank serves every replica in-process: requests
+        # round-robin base (None) + ad0..adN-1 through ONE compiled
+        # decode block — the heterogeneous-mix demo
+        bank = AdapterBank(model, capacity=adapters + 1, rank=4)
+        for i in range(adapters):
+            bank.load(f'ad{i}', make_adapter_factors(bank, seed=i + 1))
+        engine_kwargs['adapter_bank'] = bank
+        adapter_ids = [None] + [f'ad{i}' for i in range(adapters)]
+        print(f'adapter bank: {adapters} LoRA adapters resident '
+              f'(rank {bank.rank}, targets {len(bank.sites)} sites)')
 
     if replicas > 1 or tenants or shed_queue_depth is not None:
         handles = _serve_routed(model, requests, max(replicas, 1),
                                 tenants, shed_queue_depth,
-                                engine_kwargs=engine_kwargs)
+                                engine_kwargs=engine_kwargs,
+                                adapter_ids=adapter_ids)
     else:
         handles = _serve_single(model, requests,
-                                engine_kwargs=engine_kwargs)
+                                engine_kwargs=engine_kwargs,
+                                adapter_ids=adapter_ids)
     print(debug.observability_summary())
     # the exit ledger: where every wall-clock second of this run went
     print(observability.get_ledger().report_text())
@@ -205,6 +250,11 @@ if __name__ == '__main__':
                    help='per-slot speculative decoding: "tiny" builds a '
                         '1-layer draft, "self" uses the target as an '
                         'oracle draft (high acceptance demo)')
+    p.add_argument('--adapters', type=int, default=None, metavar='N',
+                   help='pack N LoRA adapters into a device-resident '
+                        'bank and round-robin requests across base + '
+                        'every adapter (one decode program, any mix); '
+                        'tenant specs may pin defaults via adapter=adK')
     p.add_argument('--metrics-port', type=int, default=None,
                    help='serve the HTTP observability endpoint on this '
                         'port while decoding')
@@ -219,4 +269,4 @@ if __name__ == '__main__':
          program_store=args.program_store,
          prefix_cache=args.prefix_cache,
          prefill_chunk=args.prefill_chunk,
-         draft_model=args.draft_model)
+         draft_model=args.draft_model, adapters=args.adapters)

@@ -73,6 +73,19 @@ HOT_SCOPES = {
                                          'Supervisor._poll',
                                          'Supervisor._on_death',
                                          'Supervisor._backoff_s'),
+    # the adapter bank (ISSUE 19) is consulted INSIDE the admission/
+    # decode loop: pin/unpin on every request boundary, device_arrays()
+    # per jit call. Its slot table is host-side python BY DESIGN — the
+    # one sanctioned device op is the `.at[slot].set` hot-load in
+    # _write_slot (a device-side scatter, not a sync); anything reading
+    # factors back (np.asarray on a bank, .item on a scale) stalls
+    # every decode round, so the whole class is a hot scope. The
+    # trace-time apply hook runs inside the COMPILED program where a
+    # sync is a tracer error, but np.asarray there would silently
+    # constant-fold a weight into the executable — equally banned
+    'paddle_tpu/serving/adapters/bank.py': ('AdapterBank.',),
+    'paddle_tpu/serving/adapters/apply.py': ('linear_hook',
+                                             'adapter_scope.'),
 }
 
 _NP_ROOTS = frozenset(('np', 'numpy', 'onp'))

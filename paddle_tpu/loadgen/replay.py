@@ -165,7 +165,8 @@ class LoadReplayer:
                         list(req.prompt_tokens),
                         SamplingParams(max_new_tokens=req.max_new_tokens,
                                        eos_token_id=NO_EOS),
-                        tenant=req.tenant, priority=req.priority)
+                        tenant=req.tenant, priority=req.priority,
+                        adapter_id=getattr(req, 'adapter', None))
                     live.append((req, h))
                 except AdmissionRejected as exc:
                     outcomes.append(ReplayOutcome(

@@ -29,14 +29,26 @@ from .lengths import FixedLength, LengthDistribution
 @dataclasses.dataclass(frozen=True)
 class TenantClass:
     """One traffic class: selection weight + the priority its requests
-    carry (serving.api.PRIORITY_HIGH/NORMAL/LOW)."""
+    carry (serving.api.PRIORITY_HIGH/NORMAL/LOW).
+
+    `adapters` is the tenant's LoRA adapter mix as
+    ((adapter_id | None, weight), ...): each request drawn for this
+    tenant picks one entry by weight (None = the base model). Empty
+    means pure base traffic AND consumes no RNG draw, so traces built
+    before adapter mixes existed stay bit-identical."""
     name: str = 'default'
     weight: float = 1.0
     priority: int = PRIORITY_NORMAL
+    adapters: Tuple[Tuple[Optional[str], float], ...] = ()
 
     def __post_init__(self):
         if self.weight <= 0:
             raise ValueError('tenant weight must be positive')
+        for entry in self.adapters:
+            if len(entry) != 2 or float(entry[1]) <= 0:
+                raise ValueError(
+                    f'adapter mix entries must be (adapter_id, '
+                    f'positive weight); got {entry!r}')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +61,7 @@ class TraceRequest:
     priority: int
     prompt_tokens: Tuple[int, ...]
     max_new_tokens: int
+    adapter: Optional[str] = None
 
 
 def make_trace(schedule: ArrivalSchedule, duration_s: float, seed: int,
@@ -85,11 +98,24 @@ def make_trace(schedule: ArrivalSchedule, duration_s: float, seed: int,
         plen = prompt_lengths.sample(rng)
         olen = output_lengths.sample(rng)
         toks = tuple(int(v) for v in rng.randint(1, vocab_size, size=plen))
+        # adapter draw comes LAST and only for tenants that declare a
+        # mix: pre-adapter traces (and base-only tenants) consume the
+        # exact same RNG stream as before, so they stay bit-identical
+        adapter = None
+        if tenant.adapters:
+            aw = np.array([float(w) for _, w in tenant.adapters],
+                          dtype=np.float64)
+            acdf = np.cumsum(aw / aw.sum())
+            au = float(rng.random_sample())
+            ai = int(np.searchsorted(acdf, au, side='right')) \
+                if au < acdf[-1] else len(tenant.adapters) - 1
+            adapter = tenant.adapters[ai][0]
         out.append(TraceRequest(index=i, arrival_s=float(at),
                                 tenant=tenant.name,
                                 priority=int(tenant.priority),
                                 prompt_tokens=toks,
-                                max_new_tokens=int(olen)))
+                                max_new_tokens=int(olen),
+                                adapter=adapter))
     return out
 
 
@@ -116,9 +142,15 @@ def trace_stats(trace: Sequence[TraceRequest]) -> dict:
     plens = [len(r.prompt_tokens) for r in trace]
     olens = [r.max_new_tokens for r in trace]
     by_tenant: dict = {}
+    by_adapter: dict = {}
     for r in trace:
         by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + 1
+        ad = getattr(r, 'adapter', None)
+        if ad is not None:
+            by_adapter[ad] = by_adapter.get(ad, 0) + 1
+    extra = {'by_adapter': by_adapter} if by_adapter else {}
     return {
+        **extra,
         'requests': len(trace),
         'span_s': round(trace[-1].arrival_s - trace[0].arrival_s, 3),
         'prompt_tokens': int(sum(plens)),

@@ -31,7 +31,14 @@ class Linear(Layer):
             if bias_attr is not False else None
 
     def forward(self, x):
-        return F.linear(x, self.weight, self.bias)
+        y = F.linear(x, self.weight, self.bias)
+        # serving.adapters tags target projections with a per-instance
+        # hook (inert unless an adapter scope is active at trace time);
+        # untagged Linears pay one dict lookup per TRACE, nothing at run
+        hook = self.__dict__.get('_adapter_hook')
+        if hook is not None:
+            y = hook(self, x, y)
+        return y
 
     def extra_repr(self):
         return f'in={self.in_features}, out={self.out_features}'

@@ -141,6 +141,13 @@ EVENT_SCHEMA: Dict[str, str] = {
     'replica_retired': 'replica process retired through graceful drain',
     'replica_orphan_reaped': 'stale replica process from a previous '
                              'supervisor incarnation SIGKILLed',
+    # multi-tenant adapter serving (serving/adapters/bank.py)
+    'adapter_load': 'LoRA adapter factors written into a bank slot',
+    'adapter_publish': 'adapter version committed to its weight store',
+    'adapter_evict': 'zero-ref adapter slot reclaimed (LRU) for a '
+                     'newcomer',
+    'adapter_load_reject': 'adapter manifest failed verification; '
+                           'version quarantined, bank keeps serving',
 }
 
 

@@ -811,3 +811,90 @@ def paged_attention(q, k_pages, v_pages, table, lengths, *, k_scales=None,
     return paged_attention_reference(q, k_pages, v_pages, table, lengths,
                                      k_scales=k_scales, v_scales=v_scales,
                                      sm_scale=sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# fused segmented adapter matmul (ISSUE 19; upstream analogues: Punica's
+# SGMV / S-LoRA's unified multi-adapter batched kernels). Each batch row
+# carries its own LoRA adapter slot in a packed bank; the kernel gathers
+# that row's [H, R] / [R, O] factors straight out of the bank via
+# scalar-prefetched indices and computes x @ A @ B * scale without ever
+# materializing per-request adapter copies — so ONE compiled decode
+# program serves any heterogeneous adapter mix.
+# ---------------------------------------------------------------------------
+
+def adapter_matmul_reference(x, a_bank, b_bank, rows, scale):
+    """Pure-lax segmented LoRA delta: gather-over-the-bank + einsum.
+
+    The CPU fallback for `adapter_matmul` (and the parity ground truth
+    for the pallas kernel, run against it in interpret mode).
+
+    x       [B, T, H]    per-row activations (decode: B=num_slots, T=1)
+    a_bank  [C, H, R]    packed down-projection factors, C bank slots
+    b_bank  [C, R, O]    packed up-projection factors
+    rows    [B] int32    per-row bank slot (slot 0 = zero base adapter)
+    scale   [C] f32      per-slot alpha/rank scaling (scale[0] == 0)
+
+    Returns the [B, T, O] delta in x.dtype. Rows pointing at slot 0 get
+    an exactly-zero delta (0-factors x 0-scale), so adapter-less rows
+    decode bit-identically to a bank-less engine.
+    """
+    xf = x.astype(jnp.float32)
+    a = a_bank[rows].astype(jnp.float32)        # [B, H, R]
+    b = b_bank[rows].astype(jnp.float32)        # [B, R, O]
+    s = scale[rows].astype(jnp.float32)         # [B]
+    h1 = jnp.einsum('bth,bhr->btr', xf, a)
+    out = jnp.einsum('btr,bro->bto', h1, b)
+    return (out * s[:, None, None]).astype(x.dtype)
+
+
+def _adapter_matmul_kernel(rows_ref, x_ref, a_ref, b_ref, s_ref, o_ref):
+    """Grid (B,); the row's bank slot arrives via scalar-prefetch in the
+    a/b/s BlockSpec index maps, so each step's DMA lands that row's
+    factors while the previous row computes."""
+    x = x_ref[0].astype(jnp.float32)                       # [T, H]
+    a = a_ref[0].astype(jnp.float32)                       # [H, R]
+    b = b_ref[0].astype(jnp.float32)                       # [R, O]
+    h1 = jnp.dot(x, a, preferred_element_type=jnp.float32)
+    out = jnp.dot(h1, b, preferred_element_type=jnp.float32)
+    o_ref[0] = (out * s_ref[0, 0]).astype(o_ref.dtype)
+
+
+def _adapter_matmul_pallas(x, a_bank, b_bank, rows, scale, interpret):
+    bsz, t, h = x.shape
+    c, _, r = a_bank.shape
+    o = b_bank.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bsz,),
+        in_specs=[
+            pl.BlockSpec((1, t, h), lambda i, rr: (i, 0, 0)),
+            pl.BlockSpec((1, h, r), lambda i, rr: (rr[i], 0, 0)),
+            pl.BlockSpec((1, r, o), lambda i, rr: (rr[i], 0, 0)),
+            pl.BlockSpec((1, 1), lambda i, rr: (rr[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, t, o), lambda i, rr: (i, 0, 0)),
+    )
+    return pl.pallas_call(
+        _adapter_matmul_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bsz, t, o), x.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=('arbitrary',)),
+        interpret=interpret,
+    )(rows.astype(jnp.int32), x, a_bank, b_bank,
+      scale.astype(jnp.float32).reshape(c, 1))
+
+
+def adapter_matmul(x, a_bank, b_bank, rows, scale, *, interpret=False):
+    """Fused gather+matmul LoRA delta over a packed adapter bank.
+
+    Dispatch: the pallas kernel under `pltpu` on TPU (or anywhere with
+    interpret=True); the pure-lax gather reference on every other
+    backend so CPU tier-1 runs unchanged. Shapes as in
+    `adapter_matmul_reference`.
+    """
+    if interpret or jax.default_backend() == 'tpu':
+        return _adapter_matmul_pallas(x, a_bank, b_bank, rows, scale,
+                                      interpret)
+    return adapter_matmul_reference(x, a_bank, b_bank, rows, scale)

@@ -120,6 +120,26 @@ reaping):
     router = Router([Replica(i, sup.spawn()) for i in range(2)])
     scaler = Autoscaler(router, sup.replica_factory(), config)
 
+Multi-tenant adapter serving (`adapters/`, ISSUE 19): an
+`AdapterBank` packs up to `capacity` LoRA adapters as device-resident
+`[capacity+1, ...]` A/B factor banks per target projection (slot 0 =
+the base model's zero delta). Per-slot adapter indices flow through
+decode/prefill/spec programs as ARRAY inputs — one compiled decode
+block serves any adapter mix, with zero recompiles across mixes and
+hot-swaps. Requests pin their adapter version at admission (publish
+never disturbs a pinned slot; LRU eviction only claims zero-ref
+slots), the radix prefix cache namespaces on (adapter_id, version),
+and tenants may carry a default `adapter=` in their spec; a missing
+adapter fast-fails typed as
+`AdmissionRejected(reason='adapter_unavailable')`:
+
+    from paddle_tpu.serving import AdapterBank, InferenceEngine
+    bank = AdapterBank(model, capacity=8, rank=8)
+    eng = InferenceEngine(model, num_slots=8, max_length=256,
+                          adapter_bank=bank)
+    bank.load('tenant-a', factors_a)       # or publish()/store-backed
+    h = eng.submit(prompt_ids, adapter_id='tenant-a')
+
 Flags: `FLAGS_autoscale` (gate the poll loop),
 `FLAGS_autoscale_min_replicas` / `FLAGS_autoscale_max_replicas`
 (fleet bounds), `FLAGS_autoscale_cooldown_s` (decision spacing); all
@@ -129,6 +149,8 @@ goodput ledger books provisioning/retirement under the `scale_up` /
 """
 from __future__ import annotations
 
+from .adapters import (AdapterBank, AdapterUnavailable,
+                       make_adapter_factors)
 from .api import (FAILED, FINISHED, GREEDY, PRIORITY_HIGH, PRIORITY_LOW,
                   PRIORITY_NAMES, PRIORITY_NORMAL, QUEUED, RUNNING,
                   SAMPLING, RequestHandle, SamplingParams)
@@ -168,4 +190,5 @@ __all__ = [
     'RemoteReplica', 'RpcClient', 'IncompleteFrameError',
     'FrameChecksumError', 'RemoteTransientError', 'RemoteFatalError',
     'ReplicaSpec', 'Supervisor',
+    'AdapterBank', 'AdapterUnavailable', 'make_adapter_factors',
 ]

@@ -1,0 +1,31 @@
+"""paddle_tpu.serving.adapters — multi-tenant LoRA adapter serving.
+
+One base model, thousands of per-tenant fine-tuned variants, ONE
+compiled decode program (S-LoRA / Punica). The pieces:
+
+- `bank.AdapterBank` — fixed-capacity device-resident packed A/B
+  factor banks per target projection, host-side slot table with
+  ref-count pinning + LRU eviction, and hot-load/publish through
+  versioned sha256-manifested `WeightStore` manifests.
+- `apply.adapter_scope` / `apply.linear_hook` — trace-time segmented
+  adapter application: per-row bank slots flow as array inputs into
+  the engine's decode/prefill/speculative programs and gather their
+  factors via `ops.pallas_kernels.adapter_matmul` (fused pallas kernel
+  on TPU, pure-lax reference elsewhere).
+
+    from paddle_tpu.serving import AdapterBank, InferenceEngine
+    bank = AdapterBank(model, capacity=8, rank=8, store_dir='/adapters')
+    bank.publish('tenant-a', factors_a)
+    eng = InferenceEngine(model, num_slots=8, adapter_bank=bank)
+    h = eng.submit(prompt, params, adapter_id='tenant-a')
+"""
+from __future__ import annotations
+
+from .apply import adapter_scope, linear_hook
+from .bank import (AdapterBank, AdapterUnavailable, DEFAULT_TARGETS,
+                   make_adapter_factors)
+
+__all__ = [
+    'AdapterBank', 'AdapterUnavailable', 'DEFAULT_TARGETS',
+    'adapter_scope', 'linear_hook', 'make_adapter_factors',
+]

@@ -1,0 +1,102 @@
+"""Trace-time segmented adapter application (ISSUE 19).
+
+The engine's decode/prefill/speculative programs are traced ONCE and
+replayed for every request mix, so per-row LoRA deltas cannot live in
+python control flow — they must be part of the traced graph, driven
+entirely by array inputs (the packed bank factors and a per-row slot
+index vector). This module is the trace-time glue:
+
+- `adapter_scope(arrays, rows)` — a context manager the engine wraps
+  around each program body's forward calls. It publishes the bank's
+  device arrays + the per-row adapter slots to a thread-local, visible
+  to every `Linear` the trace touches. Outside the scope (training,
+  `generate()`, draft models) the hook is inert, so attaching a bank
+  never perturbs any other path.
+- `linear_hook(linear, x, y)` — installed on target `Linear` instances
+  by `AdapterBank.attach`; adds the segmented LoRA delta
+  `adapter_matmul(x, A, B, rows, scale)` to the base projection output
+  when a scope is active. Rows pointing at bank slot 0 (the reserved
+  all-zero base adapter) receive an exactly-zero delta, so adapter-less
+  requests stay bit-identical to a bank-less engine.
+
+Everything row-level is an array input — never a static — so one
+compiled program serves any heterogeneous adapter mix with zero
+recompiles after warmup (the Punica/S-LoRA property).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ...ops.pallas_kernels import adapter_matmul
+from ...tensor import Tensor
+
+
+class _ScopeState(threading.local):
+    def __init__(self):
+        self.scope: Optional['_Scope'] = None
+
+
+_state = _ScopeState()
+
+
+class _Scope:
+    """One active adapter application context: the bank's device arrays
+    (`factors[site] = {'a': [C,H,R], 'b': [C,R,O]}` + `scale [C]`) and
+    the per-row bank slots `rows [B]` for the current program."""
+
+    __slots__ = ('factors', 'scale', 'rows')
+
+    def __init__(self, factors: Dict[str, Dict[str, Any]], scale, rows):
+        self.factors = factors
+        self.scale = scale
+        self.rows = rows
+
+
+class adapter_scope:
+    """`with adapter_scope(arrays, rows): fwd(...)` — arrays is the
+    pytree from `AdapterBank.device_arrays()` (or None for an inert
+    scope, so call sites need no branching)."""
+
+    __slots__ = ('_arrays', '_rows', '_prev')
+
+    def __init__(self, arrays: Optional[Dict[str, Any]], rows):
+        self._arrays = arrays
+        self._rows = rows
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _state.scope
+        if self._arrays is not None:
+            _state.scope = _Scope(self._arrays['factors'],
+                                  self._arrays['scale'], self._rows)
+        return self
+
+    def __exit__(self, *exc):
+        _state.scope = self._prev
+        return False
+
+
+def active_scope() -> Optional[_Scope]:
+    return _state.scope
+
+
+def linear_hook(linear, x, y):
+    """Adds the per-row LoRA delta to a tagged Linear's output while an
+    adapter scope is active; a no-op otherwise. Installed per-instance
+    by `AdapterBank.attach` (the Layer stays ignorant of serving)."""
+    sc = _state.scope
+    if sc is None:
+        return y
+    fac = sc.factors.get(linear._adapter_site)
+    if fac is None:
+        return y
+    xv = x.value if isinstance(x, Tensor) else x
+    squeeze = False
+    if xv.ndim == 2:                       # [B, H] -> [B, 1, H]
+        xv = xv[:, None, :]
+        squeeze = True
+    delta = adapter_matmul(xv, fac['a'], fac['b'], sc.rows, sc.scale)
+    if squeeze:
+        delta = delta[:, 0, :]
+    return y + Tensor(delta)

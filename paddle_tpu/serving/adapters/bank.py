@@ -1,0 +1,404 @@
+"""Packed device-resident LoRA adapter bank (ISSUE 19).
+
+Serving millions of users is one base model plus thousands of
+per-tenant LoRA variants (S-LoRA, Punica). The `AdapterBank` keeps a
+fixed number of adapters resident on device as PACKED factors — one
+`[capacity+1, in, rank]` A-bank and one `[capacity+1, rank, out]`
+B-bank per target projection, plus a `[capacity+1]` scale vector —
+so the decode program gathers each row's factors by index and the
+program's avals never change:
+
+- statics carry ONLY (capacity, rank, target-set): compiles stay
+  bounded no matter how many adapters cycle through the bank;
+- bank slot 0 is the reserved all-zero base adapter (scale 0), so
+  adapter-less rows get an exactly-zero delta;
+- a host-side slot table maps adapter_id -> (slot, version) with
+  ref-count pinning while any request decodes under an adapter and
+  LRU eviction of zero-ref slots;
+- hot-load/publish rides the versioned sha256-manifested
+  `WeightStore` (one per adapter id, under `store_dir/<adapter_id>/`):
+  publishing v2 while v1 requests decode never touches v1's slot —
+  v1 finishes bit-exact, new pins load v2 into a fresh slot; a
+  corrupt/truncated manifest is quarantined with an
+  `adapter_load_reject` event and the bank keeps serving the version
+  it has.
+
+Slot writes are functional `.at[slot].set` updates on the packed
+arrays — same shapes, same avals, zero recompiles across any sequence
+of loads, evictions, and hot-swaps.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ... import observability as _obs
+from ..hotswap import WeightLoadError, WeightStore
+from . import apply as _apply
+
+#: attribute-name suffixes of the projections that receive adapters by
+#: default: attention qkv/out — the classic LoRA target set
+DEFAULT_TARGETS = ('qkv_proj', 'out_proj')
+
+_ADAPTER_ID_RE = re.compile(r'^[A-Za-z0-9._\-]+$')
+
+
+class AdapterUnavailable(KeyError):
+    """Typed miss: the bank cannot pin the named adapter (never loaded,
+    store empty/corrupt, or bank full of pinned slots). The router maps
+    this onto `AdmissionRejected(reason='adapter_unavailable')`."""
+
+    def __init__(self, adapter_id: str, detail: str = ''):
+        super().__init__(adapter_id)
+        self.adapter_id = adapter_id
+        self.detail = detail
+
+    def __str__(self):
+        base = f'adapter {self.adapter_id!r} unavailable'
+        return f'{base}: {self.detail}' if self.detail else base
+
+
+class AdapterBank:
+    """Fixed-capacity packed LoRA bank over a model's target Linears.
+
+    `capacity` counts loadable adapter slots (the packed arrays carry
+    one extra row: the reserved zero base adapter at slot 0). `rank`
+    is the shared LoRA rank — factors of any other rank are rejected
+    at load (rank is a static; mixing ranks would mean re-tracing).
+    """
+
+    def __init__(self, model, capacity: int = 8, rank: int = 8, *,
+                 targets: Sequence[str] = DEFAULT_TARGETS,
+                 dtype=jnp.float32, store_dir: Optional[str] = None,
+                 keep_versions: int = 4):
+        if capacity < 1:
+            raise ValueError(f'capacity must be >= 1, got {capacity}')
+        if rank < 1:
+            raise ValueError(f'rank must be >= 1, got {rank}')
+        self.capacity = int(capacity)
+        self.rank = int(rank)
+        self.dtype = dtype
+        self.targets = tuple(targets)
+        self.store_dir = store_dir
+        self.keep_versions = int(keep_versions)
+        # site name -> (in_features, out_features), insertion-ordered
+        self.sites: Dict[str, Tuple[int, int]] = {}
+        self._tagged: List[Any] = []
+        self._attach(model)
+        if not self.sites:
+            raise ValueError(
+                f'no target projections matching {self.targets} found '
+                f'on {type(model).__name__} — nothing to adapt')
+        rows = self.capacity + 1
+        self._a = {s: jnp.zeros((rows, i, self.rank), dtype)
+                   for s, (i, o) in self.sites.items()}
+        self._b = {s: jnp.zeros((rows, self.rank, o), dtype)
+                   for s, (i, o) in self.sites.items()}
+        self._scale = jnp.zeros((rows,), jnp.float32)
+        # host-side slot table (plain python BY DESIGN: consulted on
+        # every admission — see the host-sync hot scope)
+        self._keys: List[Optional[str]] = [None] * rows   # slot -> id
+        self._versions: List[int] = [0] * rows            # slot -> ver
+        self._refs: List[int] = [0] * rows
+        self._lru: List[int] = [0] * rows
+        self._refs[0] = 1          # slot 0 is never evictable
+        self._by_key: Dict[str, int] = {}                 # id -> slot
+        self._stores: Dict[str, WeightStore] = {}
+        self._tick = 0
+        reg = _obs.get_registry()
+        self._m_loads = reg.counter(
+            'paddle_adapter_loads_total',
+            'adapters loaded into a bank slot (fresh or hot-swap)')
+        self._m_evict = reg.counter(
+            'paddle_adapter_evictions_total',
+            'zero-ref adapter slots reclaimed by LRU eviction')
+        self._m_pinned = reg.gauge(
+            'paddle_adapter_pinned',
+            'bank slots currently pinned by in-flight requests')
+        self._m_requests = reg.counter(
+            'paddle_adapter_requests_total',
+            'requests admitted per adapter', ('adapter',))
+
+    # -- model tagging ------------------------------------------------------
+
+    def _attach(self, model):
+        suffixes = set(self.targets)
+        for name, layer in model.named_sublayers():
+            attr = name.rsplit('.', 1)[-1]
+            if attr not in suffixes:
+                continue
+            if not hasattr(layer, 'in_features'):
+                continue
+            self.sites[name] = (int(layer.in_features),
+                                int(layer.out_features))
+            layer._adapter_site = name
+            layer._adapter_hook = _apply.linear_hook
+            self._tagged.append(layer)
+
+    def detach(self):
+        """Remove the hooks (tests / model reuse); the bank is dead
+        after this."""
+        for layer in self._tagged:
+            layer.__dict__.pop('_adapter_hook', None)
+            layer.__dict__.pop('_adapter_site', None)
+        self._tagged = []
+
+    # -- statics / traced inputs --------------------------------------------
+
+    def describe_statics(self) -> Dict[str, Any]:
+        """The ONLY bank facts that ride program-store keys: packed
+        geometry and the target-site set. Slot contents never appear —
+        loading/evicting/hot-swapping adapters can't cause a retrace."""
+        return {'capacity': self.capacity, 'rank': self.rank,
+                'targets': tuple(sorted(self.sites))}
+
+    def device_arrays(self) -> Dict[str, Any]:
+        """The traced-input pytree the engine passes into every
+        program call: `{'factors': {site: {'a', 'b'}}, 'scale'}`."""
+        return {'factors': {s: {'a': self._a[s], 'b': self._b[s]}
+                            for s in self.sites},
+                'scale': self._scale}
+
+    # -- slot table ----------------------------------------------------------
+
+    def lookup(self, adapter_id: str) -> Optional[Tuple[int, int]]:
+        """(slot, version) if the adapter is resident, else None."""
+        slot = self._by_key.get(adapter_id)
+        if slot is None:
+            return None
+        return slot, self._versions[slot]
+
+    def available(self, adapter_id: str) -> bool:
+        """True if a pin() could succeed right now: resident, or the
+        store holds a committed, non-quarantined version."""
+        if adapter_id in self._by_key:
+            return True
+        store = self._store(adapter_id, create=False)
+        if store is None:
+            return False
+        return any(not store.is_quarantined(v) for v in store.versions())
+
+    def pin(self, adapter_id: str) -> Tuple[int, int]:
+        """Pin `adapter_id` for one request; returns (slot, version).
+        Loads from the store on a miss, and hot-swaps to the store's
+        latest version when it is newer than the resident one (the old
+        slot keeps serving its pinned requests bit-exact). Raises
+        `AdapterUnavailable` when nothing servable exists."""
+        slot = self._by_key.get(adapter_id)
+        store = self._store(adapter_id, create=False)
+        if store is not None:
+            latest = self._latest_good(store)
+            if latest is not None and (
+                    slot is None or latest > self._versions[slot]):
+                loaded = self._load_version(adapter_id, store, latest)
+                if loaded is not None:
+                    slot = loaded
+        if slot is None:
+            raise AdapterUnavailable(
+                adapter_id, 'not loaded and no servable store version')
+        self._refs[slot] += 1
+        self._tick += 1
+        self._lru[slot] = self._tick
+        if _obs.enabled():
+            self._m_requests.labels(adapter=adapter_id).inc()
+            self._m_pinned.set(self._pinned_count())
+        return slot, self._versions[slot]
+
+    def unpin(self, slot: int):
+        if slot <= 0:
+            return
+        if self._refs[slot] <= 0:
+            raise RuntimeError(f'unpin of unpinned bank slot {slot}')
+        self._refs[slot] -= 1
+        if _obs.enabled():
+            self._m_pinned.set(self._pinned_count())
+
+    def _pinned_count(self) -> int:
+        return sum(1 for s in range(1, self.capacity + 1)
+                   if self._refs[s] > 0)
+
+    def _alloc_slot(self, adapter_id: str) -> int:
+        free = [s for s in range(1, self.capacity + 1)
+                if self._keys[s] is None]
+        if free:
+            return free[0]
+        victims = [s for s in range(1, self.capacity + 1)
+                   if self._refs[s] == 0]
+        if not victims:
+            raise AdapterUnavailable(
+                adapter_id, f'bank full: all {self.capacity} slots '
+                            f'pinned by in-flight requests')
+        victim = min(victims, key=lambda s: self._lru[s])
+        old = self._keys[victim]
+        _obs.emit('adapter_evict', adapter=old, slot=victim,
+                  version=self._versions[victim])
+        if _obs.enabled():
+            self._m_evict.inc()
+        if old is not None and self._by_key.get(old) == victim:
+            del self._by_key[old]
+        self._keys[victim] = None
+        self._versions[victim] = 0
+        return victim
+
+    # -- loading -------------------------------------------------------------
+
+    def load(self, adapter_id: str, factors: Dict[str, Tuple[Any, Any]],
+             *, alpha: Optional[float] = None, version: int = 0
+             ) -> Tuple[int, int]:
+        """Directly install host factors (`{site: (A [in,rank],
+        B [rank,out])}`) into a bank slot, bypassing the store (tests,
+        in-process trainers). Returns (slot, version)."""
+        self._check_factors(adapter_id, factors)
+        slot = self._by_key.get(adapter_id)
+        if slot is None:
+            slot = self._alloc_slot(adapter_id)
+        self._write_slot(slot, adapter_id, factors, alpha, int(version))
+        return slot, int(version)
+
+    def publish(self, adapter_id: str, factors: Dict[str, Tuple[Any, Any]],
+                *, alpha: Optional[float] = None,
+                meta: Optional[Dict[str, Any]] = None) -> int:
+        """Commit a new adapter version through the WeightStore plane
+        (sha256 manifests, monotone versions, writer markers). The bank
+        does NOT swap eagerly — the next `pin()` picks the version up,
+        so live requests are never touched."""
+        self._check_factors(adapter_id, factors)
+        store = self._store(adapter_id, create=True)
+        flat = {}
+        for site, (a, b) in factors.items():
+            # the publish snapshot is the one sanctioned bulk d2h on
+            # this plane (same doctrine as WeightStore.publish)
+            flat[f'{site}::a'] = np.asarray(a)  # paddle-lint: disable=host-sync -- publish snapshot: factors must land on the host to be sha256-manifested
+            flat[f'{site}::b'] = np.asarray(b)  # paddle-lint: disable=host-sync -- publish snapshot: factors must land on the host to be sha256-manifested
+        m = dict(meta or {})
+        m['adapter'] = adapter_id
+        m['alpha'] = float(self.rank if alpha is None else alpha)
+        version = store.publish(flat, meta=m)
+        _obs.emit('adapter_publish', adapter=adapter_id, version=version)
+        return version
+
+    def _store(self, adapter_id: str,
+               create: bool = False) -> Optional[WeightStore]:
+        if self.store_dir is None:
+            return None
+        st = self._stores.get(adapter_id)
+        if st is not None:
+            return st
+        if not _ADAPTER_ID_RE.match(adapter_id):
+            raise ValueError(f'bad adapter id {adapter_id!r} (want '
+                             f'[A-Za-z0-9._-]+; it names a directory)')
+        d = os.path.join(self.store_dir, adapter_id)
+        if not create and not os.path.isdir(d):
+            return None
+        st = WeightStore(d, keep_versions=self.keep_versions)
+        self._stores[adapter_id] = st
+        return st
+
+    def _latest_good(self, store: WeightStore) -> Optional[int]:
+        vs = [v for v in store.versions() if not store.is_quarantined(v)]
+        return vs[-1] if vs else None
+
+    def _load_version(self, adapter_id: str, store: WeightStore,
+                      version: int) -> Optional[int]:
+        """Try to load one store version into a slot. On a corrupt or
+        shape-mismatched manifest: quarantine + `adapter_load_reject`
+        event, return None — the bank keeps serving whatever it has."""
+        try:
+            flat = store.load(version)
+            meta = store.meta(version)
+            factors = self._unflatten(adapter_id, flat)
+        except (WeightLoadError, ValueError, KeyError) as e:
+            store.quarantine(version, f'adapter load failed: {e}')
+            _obs.emit('adapter_load_reject', adapter=adapter_id,
+                      version=version, reason=str(e)[:200])
+            return None
+        slot = self._alloc_slot(adapter_id)
+        alpha = meta.get('alpha')
+        self._write_slot(slot, adapter_id, factors,
+                         None if alpha is None else float(alpha), version)
+        return slot
+
+    def _unflatten(self, adapter_id: str, flat: Dict[str, Any]
+                   ) -> Dict[str, Tuple[Any, Any]]:
+        factors = {}
+        for site in self.sites:
+            a, b = flat.get(f'{site}::a'), flat.get(f'{site}::b')
+            if a is None or b is None:
+                raise ValueError(f'manifest missing factors for target '
+                                 f'site {site!r}')
+            factors[site] = (a, b)
+        self._check_factors(adapter_id, factors)
+        return factors
+
+    def _check_factors(self, adapter_id: str,
+                       factors: Dict[str, Tuple[Any, Any]]):
+        for site, (a, b) in factors.items():
+            dims = self.sites.get(site)
+            if dims is None:
+                raise ValueError(f'{adapter_id}: unknown target site '
+                                 f'{site!r} (bank targets '
+                                 f'{tuple(self.sites)})')
+            i, o = dims
+            a, b = np.asarray(a), np.asarray(b)  # paddle-lint: disable=host-sync -- load/publish-time shape validation, not a decode-round path
+            if a.shape != (i, self.rank) or b.shape != (self.rank, o):
+                raise ValueError(
+                    f'{adapter_id}: factor shapes for {site!r} are '
+                    f'{a.shape}/{b.shape}, bank wants '
+                    f'{(i, self.rank)}/{(self.rank, o)} (rank is a '
+                    f'static — all adapters share rank={self.rank})')
+        missing = set(self.sites) - set(factors)
+        if missing:
+            raise ValueError(f'{adapter_id}: factors missing for target '
+                             f'sites {sorted(missing)}')
+
+    def _write_slot(self, slot: int, adapter_id: str,
+                    factors: Dict[str, Tuple[Any, Any]],
+                    alpha: Optional[float], version: int):
+        # functional .at[slot].set keeps shapes/dtypes — identical
+        # avals, so resident programs replay without a retrace
+        for site, (a, b) in factors.items():
+            self._a[site] = self._a[site].at[slot].set(
+                jnp.asarray(a, self.dtype))
+            self._b[site] = self._b[site].at[slot].set(
+                jnp.asarray(b, self.dtype))
+        scaling = float(self.rank if alpha is None else alpha) / self.rank
+        self._scale = self._scale.at[slot].set(scaling)
+        self._keys[slot] = adapter_id
+        self._versions[slot] = int(version)
+        self._by_key[adapter_id] = slot
+        self._tick += 1
+        self._lru[slot] = self._tick
+        if _obs.enabled():
+            self._m_loads.inc()
+        _obs.emit('adapter_load', adapter=adapter_id, slot=slot,
+                  version=int(version))
+
+    # -- introspection ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        resident = {self._keys[s]: {'slot': s,
+                                    'version': self._versions[s],
+                                    'refs': self._refs[s]}
+                    for s in range(1, self.capacity + 1)
+                    if self._keys[s] is not None}
+        return {'capacity': self.capacity, 'rank': self.rank,
+                'sites': len(self.sites), 'resident': resident,
+                'pinned': self._pinned_count()}
+
+
+def make_adapter_factors(bank: AdapterBank, seed: int, scale: float = 0.02
+                         ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic random LoRA factors matching `bank`'s sites/rank —
+    the test/bench/demo helper. Both factors are non-zero (real LoRA
+    inits zero B; here the point is outputs that DIFFER per adapter)."""
+    rng = np.random.RandomState(seed)
+    out = {}
+    for site, (i, o) in bank.sites.items():
+        a = rng.standard_normal((i, bank.rank)).astype(np.float32) * scale
+        b = rng.standard_normal((bank.rank, o)).astype(np.float32) * scale
+        out[site] = (a, b)
+    return out

@@ -119,6 +119,13 @@ class RequestHandle:
         # the weight version this request decodes under (stamped at
         # admission; None while still queued)
         self.weight_version: Optional[int] = None
+        # multi-tenant adapter serving: the LoRA adapter this request
+        # decodes under (None = base model), the adapter VERSION pinned
+        # at admission (the whole response decodes under it — publish
+        # never touches a pinned slot), and the engine-side bank pin
+        self.adapter_id: Optional[str] = None
+        self.adapter_version: Optional[int] = None
+        self._adapter_pin: Optional[int] = None
 
     @property
     def trace_id(self) -> int:

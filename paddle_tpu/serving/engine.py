@@ -61,6 +61,7 @@ from ..jit import functional_state
 from ..nlp.generation import _NEG_INF, cached_forward
 from ..resilience import RetryPolicy, call_with_retry
 from ..tensor import Tensor
+from .adapters.apply import adapter_scope as _adapter_scope
 from .api import GREEDY, RUNNING, RequestHandle, SamplingParams
 from .kv_pool import (PagePoolExhausted, PagedSlotPool, SlotPool,
                       gather_pages, scatter_pages, split_rows,
@@ -181,6 +182,16 @@ class InferenceEngine:
             absmax scales (half/quarter the bytes of bf16/f32 KV);
             gather dequantizes, scatter requantizes touched pages. The
             bench `paged_ab` phase measures the logit-RMSE cost.
+        adapter_bank: a `serving.adapters.AdapterBank` attached to this
+            model — enables `submit(..., adapter_id=)` multi-tenant
+            LoRA serving: the packed bank arrays and a per-slot adapter
+            row vector ride every decode/prefill/spec program as TRACED
+            inputs, so one compiled program serves any heterogeneous
+            adapter mix (loads/evictions/hot-swaps never recompile).
+            Requests pin their bank slot at admission and release it at
+            retirement; the prefix cache keys adapter requests under
+            `(adapter_id, adapter_version)` namespaces so tenants never
+            share prefix KV across adapters.
 
     Not thread-safe: one engine is one event loop; drive it with
     `step()`, `run()`, `stream()`, or `generate_many()`.
@@ -200,7 +211,8 @@ class InferenceEngine:
                  donate_pool: Optional[bool] = None,
                  kv_page_size: Optional[int] = None,
                  kv_pages: Optional[int] = None,
-                 kv_quant: Optional[str] = None):
+                 kv_quant: Optional[str] = None,
+                 adapter_bank=None):
         cfg = getattr(model, 'config', None)
         max_pos = getattr(cfg, 'max_position_embeddings', None)
         if max_pos is not None and max_length > max_pos:
@@ -284,6 +296,13 @@ class InferenceEngine:
         else:
             self._draft_state = None
             self.draft_pool = None
+        # multi-tenant LoRA serving (ISSUE 19): the bank's packed
+        # factor arrays + a per-slot adapter row vector are TRACED
+        # inputs to every program below — adapter loads, evictions and
+        # hot-swaps move array contents, never avals, so the compiled
+        # set is exactly the bank-less engine's (one decode block, one
+        # prefill per bucket, ...), just with wider signatures
+        self.adapter_bank = adapter_bank
         # slot -> [handle, prefill cursor]: slots mid-chunked-prefill
         # (inactive for decode until the cursor reaches the prompt end)
         self._prefilling: dict = {}
@@ -310,6 +329,7 @@ class InferenceEngine:
         self._greedy = np.ones(n, bool)
         self._keys = np.zeros((n, 2), np.uint32)
         self._eos_arr = np.full(n, -1, np.int32)   # spec accept stop
+        self._adapter_rows = np.zeros(n, np.int32)  # 0 = base adapter
         self._slot_req: dict = {}               # slot -> RequestHandle
 
         self._trace_counts = collections.Counter()
@@ -343,6 +363,13 @@ class InferenceEngine:
             'decode_block': self.decode_block,
             'donate_pool': self._donate_pool,
         }
+        if self.adapter_bank is not None:
+            # ONLY the packed geometry + target-site set ride the key:
+            # which adapters are resident is array CONTENT, invisible
+            # to the program — but an adapter engine must never share
+            # a store key with a base engine (different signatures)
+            engine_statics['adapters'] = \
+                self.adapter_bank.describe_statics()
         if self._paged:
             # page geometry is invisible in the contiguous avals the
             # decode scan sees (the table aval only fixes num_slots x
@@ -502,12 +529,16 @@ class InferenceEngine:
     # compiled programs
     # ------------------------------------------------------------------
     def _decode_block_fn(self, params, frozen, buffers, pool, tok, pos,
-                         steps, active, temp, topk, topp, greedy, keys):
+                         steps, active, temp, topk, topp, greedy, keys,
+                         adapters=None, adapter_rows=None):
         """One compiled program: `decode_block` single-token steps over
         ALL slots (lax.scan), per-slot positions/masks/sampling. `pool`
         arrives as the tuple of per-slot rows and is stacked/split
         inside the program (bit-identical math); with `donate_pool` the
-        row inputs are donated so the round trip aliases in place."""
+        row inputs are donated so the round trip aliases in place.
+        `adapters`/`adapter_rows` (bank-attached engines only) are the
+        packed LoRA banks + per-slot bank rows — traced inputs, so any
+        adapter mix replays this same program."""
         self._trace_counts['decode_step'] += 1   # python-level trace count
         fwd = cached_forward(self.model, params, frozen, buffers)
         max_len = self.pool.max_length
@@ -526,12 +557,17 @@ class InferenceEngine:
             pos = jnp.minimum(pos + 1, jnp.int32(max_len - 1))
             return (nxt, pos, steps + 1, pool), nxt
 
-        (tok, pos, steps, pool), toks = jax.lax.scan(
-            sub, (tok, pos, steps, pool), None, length=self.decode_block)
+        # the scope is trace-time thread-local state: every tagged
+        # Linear the scan body traces adds its gathered per-row delta
+        with _adapter_scope(adapters, adapter_rows):
+            (tok, pos, steps, pool), toks = jax.lax.scan(
+                sub, (tok, pos, steps, pool), None,
+                length=self.decode_block)
         # [num_slots, block] tokens + the pool back as per-slot rows
         return jnp.transpose(toks), split_rows(pool, self.pool.num_slots)
 
-    def _prefill_fn(self, params, frozen, buffers, ids):
+    def _prefill_fn(self, params, frozen, buffers, ids,
+                    adapters=None, adapter_rows=None):
         """Prefill ONE request (batch-1, right-padded to its bucket) and
         return the resulting KV ROW — the host stores it as the slot's
         row, so the undonated copy surface is one row, never the pool.
@@ -545,10 +581,12 @@ class InferenceEngine:
         fwd = cached_forward(self.model, params, frozen, buffers)
         slab = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), self.pool.row_spec)
-        _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
+        with _adapter_scope(adapters, adapter_rows):
+            _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
         return slab
 
-    def _chunk_prefill_fn(self, params, frozen, buffers, row, ids, start):
+    def _chunk_prefill_fn(self, params, frozen, buffers, row, ids, start,
+                          adapters=None, adapter_rows=None):
         """Prefill ONE chunk of ONE request's prompt at positions
         [start, start+chunk): the shared program behind both chunked
         prefill and prefix-cache suffix prefill. Forwards against an
@@ -564,7 +602,8 @@ class InferenceEngine:
         k_slot = jnp.arange(self.pool.max_length, dtype=jnp.int32)
         q_pos = start + jnp.arange(b, dtype=jnp.int32)
         mask = (k_slot[None, :] <= q_pos[:, None])[None, None]
-        _, row = fwd(ids, row, start, start, mask)
+        with _adapter_scope(adapters, adapter_rows):
+            _, row = fwd(ids, row, start, start, mask)
         return row
 
     def _draft_prefill_fn(self, params, frozen, buffers, ids):
@@ -581,7 +620,8 @@ class InferenceEngine:
     def _spec_decode_fn(self, params, frozen, buffers, pool,
                         d_params, d_frozen, d_buffers, d_pool,
                         tok, pos, steps, active, temp, topk, topp,
-                        greedy, keys, eos):
+                        greedy, keys, eos,
+                        adapters=None, adapter_rows=None):
         """One compiled SPECULATION round over all slots (replaces the
         plain decode block when a draft model is configured): the draft
         proposes k tokens autoregressively for every slot, the target
@@ -618,11 +658,16 @@ class InferenceEngine:
             0, k, draft_body,
             (tok, d_pool, jnp.zeros((n, k), jnp.int32)))
 
-        # target scores [pending, d_1..d_k] at positions pos..pos+k
+        # target scores [pending, d_1..d_k] at positions pos..pos+k —
+        # the adapter scope covers ONLY the target verify: the draft
+        # model is untagged (drafts stay base-model proposals; a miss
+        # costs acceptance rate, never correctness — the verify's
+        # adapter logits decide what is emitted)
         block = jnp.concatenate([tok[:, None], drafts], axis=1)
         q_pos = pos[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
         mask = (k_slot[None, None, :] <= q_pos[:, :, None])[:, None]
-        logits, pool = fwd_t(block, pool, pos, pos, mask)
+        with _adapter_scope(adapters, adapter_rows):
+            logits, pool = fwd_t(block, pool, pos, pos, mask)
 
         choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [N,k+1]
         # longest accepted draft prefix; acceptance stops at EOS
@@ -651,7 +696,8 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def _paged_decode_fn(self, params, frozen, buffers, pages, scales,
                          table, tok, pos, steps, active, temp, topk,
-                         topp, greedy, keys):
+                         topp, greedy, keys,
+                         adapters=None, adapter_rows=None):
         """The decode block over the PAGE-TABLE pool: gather every
         slot's pages into the contiguous [N, max_length, H, D] view the
         row-pool scan already consumes (dequantizing int8 pages in the
@@ -683,9 +729,10 @@ class InferenceEngine:
             pos = jnp.minimum(pos + 1, jnp.int32(max_len - 1))
             return (nxt, pos, steps + 1, pool), nxt
 
-        (tok, pos, steps, contig), toks = jax.lax.scan(
-            sub, (tok, pos, steps, contig), None,
-            length=self.decode_block)
+        with _adapter_scope(adapters, adapter_rows):
+            (tok, pos, steps, contig), toks = jax.lax.scan(
+                sub, (tok, pos, steps, contig), None,
+                length=self.decode_block)
         pages, sc = scatter_pages(pages, table, contig, pos0,
                                   self.decode_block,
                                   self.pool.page_size, sc)
@@ -693,7 +740,7 @@ class InferenceEngine:
                 sc if sc is not None else ())
 
     def _paged_prefill_fn(self, params, frozen, buffers, pages, scales,
-                          table, ids):
+                          table, ids, adapters=None, adapter_rows=None):
         """Whole-prompt prefill into the PAGE pool: same batch-1 forward
         over a zero slab as `_prefill_fn`, then one scatter of
         [0, bucket) through the slot's table row ([1, P]). Pad rows past
@@ -704,7 +751,8 @@ class InferenceEngine:
         fwd = cached_forward(self.model, params, frozen, buffers)
         slab = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), self.pool.row_spec)
-        _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
+        with _adapter_scope(adapters, adapter_rows):
+            _, slab = fwd(ids, slab, jnp.int32(0), jnp.int32(0), None)
         sc = scales if self.pool.quant else None
         pages, sc = scatter_pages(pages, table, slab,
                                   jnp.zeros(1, jnp.int32), b,
@@ -712,7 +760,8 @@ class InferenceEngine:
         return pages, sc if sc is not None else ()
 
     def _paged_chunk_prefill_fn(self, params, frozen, buffers, pages,
-                                scales, table, ids, start, floor):
+                                scales, table, ids, start, floor,
+                                adapters=None, adapter_rows=None):
         """One chunk of one prompt through the PAGE table: gather the
         slot's contiguous view (attached prefix pages included — the
         chunk attends the shared prefix through its own table, no src
@@ -731,7 +780,8 @@ class InferenceEngine:
         k_slot = jnp.arange(self.pool.max_length, dtype=jnp.int32)
         q_pos = start + jnp.arange(b, dtype=jnp.int32)
         mask = (k_slot[None, :] <= q_pos[:, None])[None, None]
-        _, row = fwd(ids, row, start, start, mask)
+        with _adapter_scope(adapters, adapter_rows):
+            _, row = fwd(ids, row, start, start, mask)
         pages, sc = scatter_pages(pages, table, row,
                                   jnp.reshape(start, (1,)), b,
                                   self.pool.page_size, sc,
@@ -741,7 +791,8 @@ class InferenceEngine:
     def _paged_spec_fn(self, params, frozen, buffers, pages, scales,
                        table, d_params, d_frozen, d_buffers, d_pool,
                        tok, pos, steps, active, temp, topk, topp,
-                       greedy, keys, eos):
+                       greedy, keys, eos,
+                       adapters=None, adapter_rows=None):
         """The speculation round over the PAGED target pool: identical
         draft-propose / k+1-verify / longest-prefix-accept math as
         `_spec_decode_fn`, with the target KV gathered through the page
@@ -779,7 +830,8 @@ class InferenceEngine:
         block = jnp.concatenate([tok[:, None], drafts], axis=1)
         q_pos = pos[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
         mask = (k_slot[None, None, :] <= q_pos[:, :, None])[:, None]
-        logits, pool = fwd_t(block, pool, pos, pos, mask)
+        with _adapter_scope(adapters, adapter_rows):
+            logits, pool = fwd_t(block, pool, pos, pos, mask)
 
         choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         match = ((drafts == choice[:, :k])
@@ -819,16 +871,33 @@ class InferenceEngine:
         return [int(t) for t in arr]
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
-               priority: Optional[int] = None, **kwargs) -> RequestHandle:
+               priority: Optional[int] = None,
+               adapter_id: Optional[str] = None, **kwargs
+               ) -> RequestHandle:
         """Queue one request; returns its live handle. Validation errors
         raise HERE (caller bug); runtime failures mark the handle
         FAILED instead. `priority` sets the scheduler admission class
-        (PRIORITY_HIGH/NORMAL/LOW; default NORMAL)."""
+        (PRIORITY_HIGH/NORMAL/LOW; default NORMAL). `adapter_id` decodes
+        the request under that LoRA adapter from the engine's bank
+        (None = base model); an unknown/unservable adapter fast-fails
+        HERE with `adapters.AdapterUnavailable` — the typed miss the
+        router maps onto `AdmissionRejected(reason=
+        'adapter_unavailable')`."""
         if params is None:
             params = SamplingParams(**kwargs)
         elif kwargs:
             raise TypeError('pass params= or keyword sampling args, '
                             'not both')
+        if adapter_id is not None:
+            from .adapters.bank import AdapterUnavailable
+            if self.adapter_bank is None:
+                raise ValueError(
+                    f'adapter_id={adapter_id!r} needs an engine built '
+                    f'with adapter_bank=')
+            if not self.adapter_bank.available(adapter_id):
+                raise AdapterUnavailable(
+                    adapter_id, 'not resident and no servable store '
+                                'version')
         self._check_drain()
         if self._draining:
             self._counts['rejected'] += 1
@@ -853,6 +922,7 @@ class InferenceEngine:
                    else '')
                 + f' exceeds the slot length ({self.pool.max_length})')
         h = RequestHandle(toks, params, engine=self)
+        h.adapter_id = adapter_id
         if priority is not None:
             h.priority = int(priority)
         h._eos = int(self.eos_token_id if params.eos_token_id is None
@@ -919,14 +989,36 @@ class InferenceEngine:
 
     def _detach_slot(self, slot: int, h: RequestHandle):
         """Common slot teardown for fail/evict/retire: drop the engine's
-        references and release the request's prefix pin. Does NOT free
-        the pool slot — retirement may hand it to the prefix cache."""
+        references, release the request's prefix pin, and unpin its
+        adapter bank slot. Does NOT free the pool slot — retirement may
+        hand it to the prefix cache."""
         del self._slot_req[slot]
         self._active[slot] = False
         self._prefilling.pop(slot, None)
         if h._prefix_node is not None:
             self.prefix_cache.release(h._prefix_node)
             h._prefix_node = None
+        self._unpin_adapter(slot, h)
+
+    def _unpin_adapter(self, slot: int, h: RequestHandle):
+        """Release the request's adapter bank pin (idempotent) and point
+        the pool slot's adapter row back at the zero base adapter. The
+        handle keeps `adapter_id`/`adapter_version` — failover resubmits
+        it elsewhere, and the version stamp is a per-response fact."""
+        if h._adapter_pin is not None:
+            self.adapter_bank.unpin(h._adapter_pin)
+            h._adapter_pin = None
+        self._adapter_rows[slot] = 0
+
+    def _prefix_ns(self, h: RequestHandle):
+        """The prefix-cache namespace this request's KV belongs to:
+        adapter requests key under (adapter_id, adapter_version) — an
+        adapter's prefill KV contains its LoRA deltas, so tenants with
+        different adapters (or versions of one) must NEVER share a
+        cached prefix; base requests share the default namespace."""
+        if h.adapter_id is None:
+            return None
+        return (h.adapter_id, h.adapter_version)
 
     def _fail_remaining(self, exc: BaseException):
         for h in self.scheduler.drain():
@@ -1179,6 +1271,18 @@ class InferenceEngine:
         _obs.emit('serving_pool_recovered',
                   slots=self.pool.num_slots)
 
+    def _adapter_args(self, slot: Optional[int] = None) -> tuple:
+        """Trailing (bank arrays, per-row bank slots) appended to a
+        program call — () on a bank-less engine, whose signatures and
+        program-store keys stay exactly the pre-adapter ones. `slot`
+        narrows the row vector to one slot's view for the batch-1
+        prefill/chunk programs."""
+        if self.adapter_bank is None:
+            return ()
+        rows = (self._adapter_rows if slot is None
+                else self._adapter_rows[slot:slot + 1])
+        return (self.adapter_bank.device_arrays(), rows)
+
     def _decode_round(self):
         """The plain compiled decode block (no draft model): every
         active slot advances `decode_block` tokens."""
@@ -1197,7 +1301,7 @@ class InferenceEngine:
                         pages, scales, table, self._tok, self._pos,
                         self._steps, self._active, self._temp,
                         self._topk, self._topp, self._greedy,
-                        self._keys)
+                        self._keys, *self._adapter_args())
                     self.pool.set_device_state(new_pages, new_scales)
                 else:
                     toks_dev, new_pool = self._decode_jit(
@@ -1205,7 +1309,7 @@ class InferenceEngine:
                         self.pool.cache, self._tok, self._pos,
                         self._steps, self._active, self._temp,
                         self._topk, self._topp, self._greedy,
-                        self._keys)
+                        self._keys, *self._adapter_args())
                     self.pool.cache = new_pool
             except Exception:
                 if self._donate_pool:
@@ -1241,7 +1345,8 @@ class InferenceEngine:
                         d_buffers, self.draft_pool.cache, self._tok,
                         self._pos, self._steps, self._active,
                         self._temp, self._topk, self._topp,
-                        self._greedy, self._keys, self._eos_arr)
+                        self._greedy, self._keys, self._eos_arr,
+                        *self._adapter_args())
                     self.pool.set_device_state(new_pages, new_scales)
                 else:
                     toks_dev, counts_dev, new_pool, new_d_pool = \
@@ -1252,7 +1357,7 @@ class InferenceEngine:
                             self._tok, self._pos, self._steps,
                             self._active, self._temp, self._topk,
                             self._topp, self._greedy, self._keys,
-                            self._eos_arr)
+                            self._eos_arr, *self._adapter_args())
                     self.pool.cache = new_pool
             except Exception:
                 if self._donate_pool:
@@ -1285,16 +1390,24 @@ class InferenceEngine:
         """Per-token iterator for one request (see RequestHandle.stream)."""
         return handle.stream()
 
-    def generate_many(self, prompts, params=None) -> List[RequestHandle]:
+    def generate_many(self, prompts, params=None,
+                      adapter_ids=None) -> List[RequestHandle]:
         """Submit a batch of prompts and drain the engine — the
         continuous-batching replacement for a sequential `generate()`
         loop on mixed-length workloads. `params` is one SamplingParams
-        for all, or a per-prompt sequence."""
+        for all, or a per-prompt sequence; `adapter_ids` is one adapter
+        id (or None) for all, or a per-prompt sequence — a mixed batch
+        decodes every adapter in the same compiled step."""
         if params is None or isinstance(params, SamplingParams):
             params = [params or SamplingParams()] * len(prompts)
         if len(params) != len(prompts):
             raise ValueError('one SamplingParams per prompt')
-        handles = [self.submit(p, sp) for p, sp in zip(prompts, params)]
+        if adapter_ids is None or isinstance(adapter_ids, str):
+            adapter_ids = [adapter_ids] * len(prompts)
+        if len(adapter_ids) != len(prompts):
+            raise ValueError('one adapter id (or None) per prompt')
+        handles = [self.submit(p, sp, adapter_id=aid)
+                   for p, sp, aid in zip(prompts, params, adapter_ids)]
         self.run()
         return handles
 
@@ -1386,7 +1499,8 @@ class InferenceEngine:
         ps = self.pool.page_size
         node, cursor = None, 0
         if self.prefix_cache is not None:
-            node, matched = self.prefix_cache.lookup(h.prompt_tokens)
+            node, matched = self.prefix_cache.lookup(
+                h.prompt_tokens, namespace=self._prefix_ns(h))
             if node is not None:
                 # whole pages only: the suffix [cursor, s) prefills
                 # into FRESH exclusive pages, so a shared page is never
@@ -1446,15 +1560,33 @@ class InferenceEngine:
         cursor = 0
         src = slot
         node = None
+        if h.adapter_id is not None:
+            # pin BEFORE the prefix lookup: the namespace key needs the
+            # version this request will actually decode under (pin()
+            # hot-swaps to the store's latest good version, so this is
+            # also where a published v2 takes effect for new requests).
+            # AdapterUnavailable propagates as a request-level failure.
+            pin, version = self.adapter_bank.pin(h.adapter_id)
+            h._adapter_pin = pin
+            h.adapter_version = version
+            self._adapter_rows[slot] = pin
+        else:
+            self._adapter_rows[slot] = 0
         if self._paged:
             # seating raises PagePoolExhausted BEFORE any bookkeeping:
-            # the handle stays queueable for the requeue path
-            node, cursor = self._seat_paged(slot, h, s)
+            # the handle stays queueable for the requeue path (the
+            # adapter pin must roll back with it)
+            try:
+                node, cursor = self._seat_paged(slot, h, s)
+            except PagePoolExhausted:
+                self._unpin_adapter(slot, h)
+                raise
             if node is not None:
                 h._prefix_node = node
                 h._prefix_len = cursor
         elif self.prefix_cache is not None:
-            node, matched = self.prefix_cache.lookup(h.prompt_tokens)
+            node, matched = self.prefix_cache.lookup(
+                h.prompt_tokens, namespace=self._prefix_ns(h))
             if node is not None:
                 self.prefix_cache.acquire(node)
                 h._prefix_node = node
@@ -1515,12 +1647,14 @@ class InferenceEngine:
                     policy=self._retry, site='serving.h2d')
                 new_pages, new_scales = self._prefill_jit(
                     self._params, self._frozen, self._buffers,
-                    pages, scales, table, ids_dev)
+                    pages, scales, table, ids_dev,
+                    *self._adapter_args(slot))
                 self.pool.set_device_state(new_pages, new_scales)
             else:
                 # row in, row out: the undonated copy surface is pool/N
                 self.pool.set_row(slot, self._prefill_jit(
-                    self._params, self._frozen, self._buffers, ids_dev))
+                    self._params, self._frozen, self._buffers, ids_dev,
+                    *self._adapter_args(slot)))
         self.pool.note_written(slot, s)
         self._counts['prefills'] += 1
         self._counts['prefill_tokens'] += s
@@ -1578,7 +1712,7 @@ class InferenceEngine:
                 new_pages, new_scales = self._chunk_prefill_jit(
                     self._params, self._frozen, self._buffers,
                     pages, scales, table, ids_dev, jnp.int32(start),
-                    jnp.int32(h._prefix_len))
+                    jnp.int32(h._prefix_len), *self._adapter_args(slot))
                 self.pool.set_device_state(new_pages, new_scales)
             else:
                 # forwards against the src ROW (the retained row on a
@@ -1586,7 +1720,8 @@ class InferenceEngine:
                 # returns the slot's new row — one-row surface either way
                 self.pool.set_row(slot, self._chunk_prefill_jit(
                     self._params, self._frozen, self._buffers,
-                    self.pool.row(src), ids_dev, jnp.int32(start)))
+                    self.pool.row(src), ids_dev, jnp.int32(start),
+                    *self._adapter_args(slot)))
         new_cursor = min(start + bucket, s)
         self.pool.note_written(slot, new_cursor)
         self._prefilling[slot][1] = new_cursor
@@ -1650,8 +1785,11 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             # retention costs nothing: the slot's rows [0, prompt_len)
             # ARE the prompt's prefill KV (generated-token KV above is
-            # stale-by-construction for the next user)
-            retained = self.prefix_cache.insert(h.prompt_tokens, slot)
+            # stale-by-construction for the next user). Adapter prefill
+            # KV carries the adapter's deltas — it retains under the
+            # (adapter_id, version) namespace, never the base trie.
+            retained = self.prefix_cache.insert(
+                h.prompt_tokens, slot, namespace=self._prefix_ns(h))
         if not retained:
             self.pool.free(slot)
         self._counts['completed'] += 1
@@ -1690,6 +1828,8 @@ class InferenceEngine:
         }
         if self.prefix_cache is not None:
             out['prefix_cache'] = self.prefix_cache.stats()
+        if self.adapter_bank is not None:
+            out['adapters'] = self.adapter_bank.stats()
         if self.draft_model is not None:
             proposed = self._counts['spec_proposed']
             out['spec'] = {

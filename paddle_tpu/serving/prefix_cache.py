@@ -41,6 +41,16 @@ matching lookups and are evicted lazily (on the lookup path that walks
 past them, and preferentially under eviction pressure), never
 wholesale mid-traffic. A rollback to the previous version re-validates
 its surviving entries for free.
+
+Namespaces (ISSUE 19, multi-tenant adapters): prefill KV is ALSO a
+function of the LoRA adapter it was computed under, so `lookup` and
+`insert` take a hashable `namespace` key — the engine passes
+`(adapter_id, adapter_version)` for adapter requests, None for base
+requests. Each namespace is its own radix trie root: two tenants with
+identical prompts but different adapters can never share a cached
+prefix, while base requests keep deduping against each other. Budget,
+LRU eviction, and pinning stay GLOBAL across namespaces (retention is
+a pool-capacity question, not a per-tenant one).
 """
 from __future__ import annotations
 
@@ -109,6 +119,9 @@ class RadixPrefixCache:
                                 pool.num_slots - 1)
         self.min_tokens = max(int(min_tokens), 1)
         self._root = _Node((), None)
+        # namespace key -> that namespace's own trie root (the default
+        # None namespace is self._root); owners/budget/LRU stay global
+        self._ns_roots: Dict = {}
         self._owners: set = set()
         self._tick = 0
         # the weight version CURRENT entries belong to; owners tagged
@@ -160,6 +173,18 @@ class RadixPrefixCache:
         self._tick += 1
         node.last_use = self._tick
 
+    def _ns_root(self, namespace) -> _Node:
+        """The trie root serving `namespace` (created on first use; an
+        empty namespace root is a few-hundred-byte dict entry, so stale
+        (adapter, version) namespaces cost nothing once their owners
+        are evicted)."""
+        if namespace is None:
+            return self._root
+        root = self._ns_roots.get(namespace)
+        if root is None:
+            root = self._ns_roots[namespace] = _Node((), None)
+        return root
+
     # -- weight versioning --------------------------------------------------
     def set_version(self, version: int):
         """Move the cache to a new weight version (the engine calls this
@@ -203,17 +228,19 @@ class RadixPrefixCache:
             self._evict_node(n, stale=True)
         return best
 
-    def lookup(self, tokens) -> Tuple[Optional[_Node], int]:
-        """Longest common prefix between `tokens` and ANY cached entry:
-        (node, matched_len), or (None, 0). The matched length is the
-        common-prefix length — it may be shorter than the owning node's
-        own kv_len (a cached "system prompt + suffix A" serves a
-        "system prompt + suffix B" request for the shared prefix; the
-        stale A-rows above are overwritten/masked). A hit refreshes the
-        node's LRU position."""
+    def lookup(self, tokens,
+               namespace=None) -> Tuple[Optional[_Node], int]:
+        """Longest common prefix between `tokens` and ANY cached entry
+        IN `namespace`: (node, matched_len), or (None, 0). The matched
+        length is the common-prefix length — it may be shorter than the
+        owning node's own kv_len (a cached "system prompt + suffix A"
+        serves a "system prompt + suffix B" request for the shared
+        prefix; the stale A-rows above are overwritten/masked). A hit
+        refreshes the node's LRU position."""
         tokens = list(tokens)
-        node, depth = self._root, 0
-        deepest, deepest_len = self._root, 0   # divergence point
+        root = self._ns_root(namespace)
+        node, depth = root, 0
+        deepest, deepest_len = root, 0   # divergence point
         best_exact: Tuple[Optional[_Node], int] = (None, 0)
         while depth < len(tokens):
             child = node.children.get(tokens[depth])
@@ -282,24 +309,24 @@ class RadixPrefixCache:
         return len(self._owners) >= self.budget_slots
 
     # -- insertion ----------------------------------------------------------
-    def insert(self, tokens, slot: int) -> bool:
+    def insert(self, tokens, slot: int, namespace=None) -> bool:
         """Retain `slot` (whose rows [0, len(tokens)) hold the prefill KV
-        of `tokens`) as a cached prefix. Returns True when the cache
-        ADOPTED the slot — the caller must NOT free it — and False when
-        the caller keeps it (already covered / under min_tokens / budget
-        exhausted by pinned entries)."""
+        of `tokens`) as a cached prefix under `namespace`. Returns True
+        when the cache ADOPTED the slot — the caller must NOT free it —
+        and False when the caller keeps it (already covered / under
+        min_tokens / budget exhausted by pinned entries)."""
         if self.budget_slots < 1:
             return False
-        return self._insert_resource(tokens, int(slot))
+        return self._insert_resource(tokens, int(slot), namespace)
 
-    def _insert_resource(self, tokens, resource) -> bool:
+    def _insert_resource(self, tokens, resource, namespace=None) -> bool:
         """The trie half of insert: walk/split to the prompt's node and
         adopt `resource` as its retained entry. Shared by row mode
         (resource = slot index) and paged mode (resource = PageHold)."""
         tokens = list(tokens)
         if len(tokens) < self.min_tokens:
             return False
-        node, depth = self._root, 0
+        node, depth = self._ns_root(namespace), 0
         while depth < len(tokens):
             child = node.children.get(tokens[depth])
             if child is None:
@@ -406,12 +433,13 @@ class RadixPrefixCache:
 
     # -- introspection ------------------------------------------------------
     def _node_count(self) -> int:
-        n, stack = 0, [self._root]
+        roots = [self._root, *self._ns_roots.values()]
+        n, stack = 0, list(roots)
         while stack:
             node = stack.pop()
             n += 1
             stack.extend(node.children.values())
-        return n - 1                # root is structural
+        return n - len(roots)       # roots are structural
 
     def stats(self) -> dict:
         return {
@@ -419,6 +447,7 @@ class RadixPrefixCache:
             'retained_slots': len(self._owners),
             'pinned': sum(1 for n in self._owners if n.refs > 0),
             'nodes': self._node_count(),
+            'namespaces': len(self._ns_roots),
             'weight_version': self.version,
             'stale_slots': self.stale_count,
             **self._counts,
@@ -484,7 +513,7 @@ class PagedPrefixCache(RadixPrefixCache):
         return sum(len(n.slot.pages) for n in self._owners
                    if n.refs == 0)
 
-    def insert(self, tokens, slot: int) -> bool:
+    def insert(self, tokens, slot: int, namespace=None) -> bool:
         """Pin the prompt's full pages as a PageHold and retain that.
         ALWAYS returns False: the slot itself is never adopted — the
         engine frees it, and the held pages survive the free at
@@ -495,7 +524,7 @@ class PagedPrefixCache(RadixPrefixCache):
         hold = self.pool.hold_pages(slot, len(tokens))
         if hold is None:               # no full page covered
             return False
-        adopted = self._insert_resource(tokens, hold)
+        adopted = self._insert_resource(tokens, hold, namespace)
         if adopted:
             self._held_pages += len(hold.pages)
         else:

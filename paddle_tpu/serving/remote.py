@@ -344,10 +344,15 @@ class RemoteReplica:
             return any(not h.done for h in self._handles.values())
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
-               priority: Optional[int] = None, **kwargs) -> RequestHandle:
+               priority: Optional[int] = None,
+               adapter_id: Optional[str] = None, **kwargs) -> RequestHandle:
         """Mirror of `InferenceEngine.submit`: validation errors raise
         here (rehydrated by type from the child), accepted requests get
-        a LOCAL handle whose stream()/result() drive `self.step()`."""
+        a LOCAL handle whose stream()/result() drive `self.step()`.
+        `adapter_id` ships over the wire — a bank-less child rejects it
+        with the same typed ValueError an in-process engine raises (and
+        the Router's step-0 availability check keeps adapter traffic
+        off process replicas entirely until child banks are wired)."""
         if params is None:
             params = SamplingParams(**kwargs)
         elif kwargs:
@@ -356,10 +361,11 @@ class RemoteReplica:
         toks = InferenceEngine._normalize_prompt(prompt)
         res = self._rpc.call('submit', prompt_tokens=toks,
                              params=params_to_wire(params),
-                             priority=priority)
+                             priority=priority, adapter_id=adapter_id)
         h = RequestHandle(toks, params, engine=self)
         if priority is not None:
             h.priority = int(priority)
+        h.adapter_id = adapter_id
         rid = res.get('rid')
         with self._lock:
             self._handles[int(rid)] = h
@@ -385,6 +391,8 @@ class RemoteReplica:
                     h._emit(tok, now)
                 if upd.get('weight_version') is not None:
                     h.weight_version = upd['weight_version']
+                if upd.get('adapter_version') is not None:
+                    h.adapter_version = upd['adapter_version']
                 status = upd.get('status')
                 if status == RUNNING and h.status == QUEUED:
                     h.status = RUNNING

@@ -116,6 +116,8 @@ class _ReplicaServer:
                                    'tokens': list(h.tokens)}
             if h.weight_version is not None:
                 upd['weight_version'] = h.weight_version
+            if getattr(h, 'adapter_version', None) is not None:
+                upd['adapter_version'] = h.adapter_version
             if h.error is not None:
                 from ..resilience.retry import is_transient
                 upd['error'] = {
@@ -146,12 +148,13 @@ class _ReplicaServer:
         }
 
     def rpc_submit(self, prompt_tokens=None, params=None, priority=None,
-                   **_):
+                   adapter_id=None, **_):
         from .remote import params_from_wire
         with self._elock:
             h = self.engine.submit(prompt_tokens,
                                    params=params_from_wire(params or {}),
-                                   priority=priority)
+                                   priority=priority,
+                                   adapter_id=adapter_id)
             self._requests[h.request_id] = h
             return {'rid': h.request_id, 'status': h.status}
 

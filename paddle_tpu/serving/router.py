@@ -240,12 +240,17 @@ class RouterHandle:
     requests never dangle."""
 
     def __init__(self, router: 'Router', prompt_tokens: List[int],
-                 params: SamplingParams, tenant: str, priority: int):
+                 params: SamplingParams, tenant: str, priority: int,
+                 adapter_id: Optional[str] = None):
         self.router_id = next(_router_ids)
         self.prompt_tokens = list(prompt_tokens)
         self.params = params
         self.tenant = tenant
         self.priority = int(priority)
+        # the LoRA adapter this request decodes under (None = base);
+        # failover resubmits carry it, so the re-decoded response runs
+        # under the same adapter id on the target replica
+        self.adapter_id = adapter_id
         self.failovers = 0
         self.inner: Optional[RequestHandle] = None
         self.replica_id: Optional[int] = None
@@ -267,6 +272,15 @@ class RouterHandle:
         so the tag — like the tokens — is always the live attempt's:
         never mixed within one response."""
         return (self.inner.weight_version if self.inner is not None
+                else None)
+
+    @property
+    def adapter_version(self) -> Optional[int]:
+        """The adapter version the live attempt decodes under (pinned
+        at engine admission; None for base requests or while queued).
+        Like `weight_version`, failover re-decodes on the target
+        replica, so the tag is always the live attempt's."""
+        return (self.inner.adapter_version if self.inner is not None
                 else None)
 
     @property
@@ -575,13 +589,17 @@ class Router:
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
                tenant: Optional[str] = None,
-               priority: Optional[int] = None, **kwargs) -> RouterHandle:
+               priority: Optional[int] = None,
+               adapter_id: Optional[str] = None, **kwargs) -> RouterHandle:
         """Admit one request for `tenant` (QoS checks first — a
         rejection is synchronous, typed, and consumed NO model work),
         then place it on the least-loaded healthy replica. Returns the
         live RouterHandle; raises `AdmissionRejected` (with
         `retry_after_s`) on rate limit / concurrency cap / load shed /
-        no healthy replica, or ValueError on malformed requests."""
+        adapter unavailable / no healthy replica, or ValueError on
+        malformed requests. `adapter_id` names the LoRA adapter the
+        request decodes under; unset, the tenant's default `adapter`
+        (if any) applies."""
         if params is None:
             params = SamplingParams(**kwargs)
         elif kwargs:
@@ -589,9 +607,29 @@ class Router:
                             'not both')
         t = self.tenants.get(tenant)
         prio = int(priority) if priority is not None else t.priority
+        if adapter_id is None:
+            adapter_id = t.adapter
         # snapshot for the shed-accounting invariant: any rejection
         # below must leave the fleet queue depth exactly here
         depth0 = self.queue_depth
+
+        # 0. adapter availability: fail FAST and typed before any QoS
+        # token is spent — a request for a missing adapter can never
+        # succeed, so it must not consume a rate-bucket token either
+        if adapter_id is not None:
+            for r in self.replicas:
+                bank = getattr(r.engine, 'adapter_bank', None)
+                if bank is None:
+                    self._reject(t.name, 'adapter_unavailable', None,
+                                 f'adapter {adapter_id!r} requested but '
+                                 f'replica {r.id} serves no adapter bank',
+                                 depth_guard=depth0)
+                if not bank.available(adapter_id):
+                    self._reject(
+                        t.name, 'adapter_unavailable', None,
+                        f'adapter {adapter_id!r} is not resident on '
+                        f'replica {r.id} and has no servable store '
+                        f'version', depth_guard=depth0)
 
         # 1. per-tenant token-bucket rate
         if t.bucket is not None and not t.bucket.try_acquire():
@@ -641,7 +679,7 @@ class Router:
                          depth_guard=depth0)
 
         rh = RouterHandle(self, InferenceEngine._normalize_prompt(prompt),
-                          params, t.name, prio)
+                          params, t.name, prio, adapter_id=adapter_id)
         try:
             self._place(rh, replica)
         except RuntimeError:
@@ -682,7 +720,8 @@ class Router:
         if replica.breaker.state == BREAKER_HALF_OPEN:
             replica.breaker.begin_probe()   # this request IS the probe
         rh.inner = replica.engine.submit(rh.prompt_tokens, rh.params,
-                                         priority=rh.priority)
+                                         priority=rh.priority,
+                                         adapter_id=rh.adapter_id)
         rh.replica_id = replica.id
 
     # ------------------------------------------------------------------
@@ -950,14 +989,17 @@ class Router:
         return r
 
     def generate_many(self, prompts, params=None, tenant=None,
-                      priority=None) -> List[RouterHandle]:
+                      priority=None,
+                      adapter_id: Optional[str] = None
+                      ) -> List[RouterHandle]:
         """Submit a batch and drive the fleet dry (the router analogue
         of `InferenceEngine.generate_many`)."""
         if params is None or isinstance(params, SamplingParams):
             params = [params or SamplingParams()] * len(prompts)
         if len(params) != len(prompts):
             raise ValueError('one SamplingParams per prompt')
-        handles = [self.submit(p, sp, tenant=tenant, priority=priority)
+        handles = [self.submit(p, sp, tenant=tenant, priority=priority,
+                               adapter_id=adapter_id)
                    for p, sp in zip(prompts, params)]
         self.run()
         return handles

@@ -10,21 +10,24 @@ the data model the router enforces:
 
 - `Tenant` — a name plus its QoS envelope: priority class
   (api.PRIORITY_HIGH/NORMAL/LOW → the scheduler's admission key),
-  a request-rate `TokenBucket` (rate/burst; None = unlimited), and a
-  `max_concurrency` cap on in-flight requests (None = unlimited).
-  Concurrency caps double as capacity reservations: capping best-effort
-  tenants below the slot count keeps slots free for latency-sensitive
-  ones, which is what makes "high-priority TTFT unaffected by overload"
-  a structural guarantee rather than a hope.
+  a request-rate `TokenBucket` (rate/burst; None = unlimited), a
+  `max_concurrency` cap on in-flight requests (None = unlimited), and
+  an optional default `adapter` — the LoRA adapter id the tenant's
+  requests decode under (serving.adapters.AdapterBank; per-request
+  adapter_id overrides it). Concurrency caps double as capacity
+  reservations: capping best-effort tenants below the slot count keeps
+  slots free for latency-sensitive ones, which is what makes
+  "high-priority TTFT unaffected by overload" a structural guarantee
+  rather than a hope.
 - `TenantRegistry` — name -> Tenant with a default template for unknown
   tenants (each still gets its OWN bucket/accounting).
 - `AdmissionRejected` — the typed fast-fail: tenant, reason
-  ('rate_limited' | 'concurrency' | 'shed' | 'no_healthy_replica') and
-  a `retry_after_s` hint, raised by the router BEFORE any prefill work
-  happens.
+  ('rate_limited' | 'concurrency' | 'shed' | 'no_healthy_replica' |
+  'adapter_unavailable') and a `retry_after_s` hint, raised by the
+  router BEFORE any prefill work happens.
 - `parse_tenant_spec` — the CLI/env format used by
   `examples/serve_gpt.py --tenants`:
-      "paid:priority=high,rate=50,burst=100;free:priority=low,rate=2,concurrency=2"
+      "paid:priority=high,rate=50,burst=100,adapter=paid-v2;free:priority=low,rate=2,concurrency=2"
 """
 from __future__ import annotations
 
@@ -104,6 +107,7 @@ class Tenant:
                  rate: Optional[float] = None,
                  burst: Optional[float] = None,
                  max_concurrency: Optional[int] = None,
+                 adapter: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.name = name
         if isinstance(priority, str):
@@ -118,13 +122,17 @@ class Tenant:
                        if rate is not None else None)
         self.max_concurrency = (int(max_concurrency)
                                 if max_concurrency is not None else None)
+        # the tenant's default LoRA adapter (None = base model); the
+        # router stamps it onto submissions that don't name their own
+        self.adapter = adapter
         self.in_flight = 0
 
     def spec(self) -> dict:
         return {'priority': self.priority,
                 'rate': self.bucket.rate if self.bucket else None,
                 'burst': self.bucket.capacity if self.bucket else None,
-                'max_concurrency': self.max_concurrency}
+                'max_concurrency': self.max_concurrency,
+                'adapter': self.adapter}
 
     def __repr__(self):
         return f'Tenant({self.name!r}, {self.spec()})'
@@ -192,7 +200,8 @@ def estimate_queue_rounds(queued_prompt_lens,
 
 
 _SPEC_KEYS = {'priority': str, 'rate': float, 'burst': float,
-              'concurrency': int, 'max_concurrency': int}
+              'concurrency': int, 'max_concurrency': int,
+              'adapter': str}
 
 
 def parse_tenant_spec(spec: str,
@@ -202,9 +211,10 @@ def parse_tenant_spec(spec: str,
 
     Format: `name:key=value,key=value;name2:...`, keys from
     priority (high|normal|low or int) / rate (req/s) / burst /
-    concurrency. A bare `name` (no colon) gets all defaults.
+    concurrency / adapter (default LoRA adapter id). A bare `name`
+    (no colon) gets all defaults.
 
-        parse_tenant_spec('paid:priority=high,rate=50;'
+        parse_tenant_spec('paid:priority=high,rate=50,adapter=paid-ft;'
                           'free:priority=low,rate=2,concurrency=2')
     """
     reg = TenantRegistry(clock=clock)
@@ -234,6 +244,8 @@ def parse_tenant_spec(spec: str,
             elif key == 'priority':
                 v = value.strip()
                 kw['priority'] = int(v) if v.lstrip('-').isdigit() else v
+            elif key == 'adapter':
+                kw['adapter'] = value.strip()
             else:
                 kw[key] = cast(value)
         reg.add(name, **kw)

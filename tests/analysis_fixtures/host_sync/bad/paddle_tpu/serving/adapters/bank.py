@@ -1,0 +1,34 @@
+"""True-positive fixtures for host-sync over the adapter-bank scope
+(parsed only, never imported). The file path mirrors the real
+hot-scope config (`paddle_tpu/serving/adapters/bank.py` + the
+`AdapterBank.` prefix): pin/unpin run on every request boundary and
+device_arrays() feeds every jit call, so an unannotated device read
+here stalls every decode round."""
+import numpy as np
+import jax
+
+
+class AdapterBank:
+    def pin(self, adapter_id):
+        # snippet 1: materializing a factor bank to "inspect" a slot is
+        # a full d2h copy per admission
+        a = np.asarray(self._a_banks['qkv_proj'][self._by_key[adapter_id]])
+        return a.sum()
+
+    def device_arrays(self):
+        # snippet 2: blocking on the banks defeats async dispatch —
+        # this runs before EVERY decode/prefill jit call
+        self._scale.block_until_ready()
+        return {'factors': self._factors, 'scale': self._scale}
+
+    def stats(self):
+        # snippet 3: per-element device read on the scrape path
+        return {'scale0': float(self._scale[0])}
+
+    def _write_slot(self, slot, factors):
+        # snippet 4: .item() while hot-loading
+        self._alpha[slot] = factors['alpha'].item()
+
+    def snapshot(self):
+        # snippet 5: device_get is a sync however it is spelled
+        return jax.device_get(self._a_banks)

@@ -1,0 +1,39 @@
+"""True-negative fixtures for host-sync over the adapter-bank scope:
+host-python slot-table bookkeeping, device-side hot-load scatters,
+annotated publish snapshots, and syncs outside the scope prefix."""
+import numpy as np
+import jax.numpy as jnp
+
+
+class AdapterBank:
+    def pin(self, adapter_id):
+        # snippet 1: the slot table is HOST python — dict lookups and
+        # ref-count bumps never touch the device
+        slot = self._by_key[adapter_id]
+        self._refs[slot] += 1
+        return slot, self._versions[slot]
+
+    def _write_slot(self, slot, a, b):
+        # snippet 2: the hot-load is a device-side scatter (functional
+        # update), not a host read — avals unchanged, no sync
+        self._a_banks['qkv_proj'] = \
+            self._a_banks['qkv_proj'].at[slot].set(jnp.asarray(a))
+        self._b_banks['qkv_proj'] = \
+            self._b_banks['qkv_proj'].at[slot].set(jnp.asarray(b))
+
+    def publish(self, adapter_id, factors):
+        # snippet 3: the SAME d2h copy, annotated — the publish
+        # snapshot must land on the host to be sha256-manifested
+        flat = {k: np.asarray(v) for k, v in factors.items()}  # paddle-lint: disable=host-sync -- publish snapshot: factors must land on the host to be manifested
+        return self._store(adapter_id).publish(flat)
+
+    def stats(self):
+        # snippet 4: plain python counters are not a sync
+        return {'resident': len(self._by_key),
+                'pinned': sum(1 for r in self._refs if r > 0)}
+
+
+def make_adapter_factors(bank, seed):
+    # snippet 5: module-level helper, outside the AdapterBank. prefix
+    rng = np.random.RandomState(seed)
+    return {s: np.asarray(rng.randn(4, 2)) for s in bank.sites}

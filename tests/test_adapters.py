@@ -1,0 +1,719 @@
+"""paddle_tpu.serving.adapters — multi-tenant LoRA adapter serving
+(ISSUE 19).
+
+The acceptance surface: a fixed-capacity packed `AdapterBank` (slot
+table, ref-count pinning, LRU eviction, WeightStore hot-load/publish
+with corrupt-manifest quarantine), heterogeneous-adapter batched
+decode that is bit-identical to serving each adapter alone with ZERO
+recompiles across mixes AND a mid-run publish, prefix-cache
+namespacing on (adapter_id, version) so tenants never share prefix KV
+across adapters, tenancy `adapter=` defaults with the typed
+`adapter_unavailable` fast-fail, and loadgen per-tenant adapter mixes
+that keep traces bit-identical from one seed.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import loadgen, observability as obs
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import (FINISHED, AdapterBank, AdapterUnavailable,
+                                AdmissionRejected, InferenceEngine,
+                                ReplicaSet, Router, SamplingParams,
+                                TenantRegistry, make_adapter_factors,
+                                parse_tenant_spec)
+from paddle_tpu.serving.hotswap import WeightStore
+
+NO_EOS = -1
+
+
+@pytest.fixture(scope='module')
+def gpt():
+    paddle.seed(7)
+    return GPTForCausalLM(GPTConfig.tiny()).eval()
+
+
+def _sp(n):
+    return SamplingParams(max_new_tokens=n, eos_token_id=NO_EOS)
+
+
+def _prompts(lens, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, (s,)).tolist() for s in lens]
+
+
+def _ref_generate(model, prompt, max_new):
+    out, _ = model.generate(
+        paddle.to_tensor(np.array([prompt])), max_new_tokens=max_new,
+        decode_strategy='greedy_search', eos_token_id=NO_EOS)
+    return out.numpy()[0].tolist()
+
+
+def _events_since(log, n0, name):
+    return [e for e in log.events()[n0:] if e['name'] == name]
+
+
+def _bank(gpt, n_adapters=2, capacity=None, rank=4, seed0=1, **kw):
+    """A bank holding `ad0..ad{n-1}` with deterministic factors —
+    `make_adapter_factors(bank, seed)` depends only on sites/rank, so
+    two banks built the same way hold bit-identical adapters."""
+    bank = AdapterBank(gpt, capacity=capacity or n_adapters + 1,
+                       rank=rank, **kw)
+    for i in range(n_adapters):
+        bank.load(f'ad{i}', _factors(bank, seed0 + i), version=1)
+    return bank
+
+
+def _factors(bank, seed):
+    """Factors strong enough to actually flip greedy argmax on the
+    tiny test model (the default 0.02 scale is tuned for bench-sized
+    decode lengths)."""
+    return make_adapter_factors(bank, seed=seed, scale=0.2)
+
+
+# ---------------------------------------------------------------------------
+# the bank: slot table, pinning, eviction, validation
+# ---------------------------------------------------------------------------
+
+class TestAdapterBank:
+    def test_ctor_validation(self, gpt):
+        with pytest.raises(ValueError):
+            AdapterBank(gpt, capacity=0)
+        with pytest.raises(ValueError):
+            AdapterBank(gpt, rank=0)
+        with pytest.raises(ValueError):
+            AdapterBank(gpt, targets=('no_such_proj',))
+
+    def test_statics_carry_only_geometry(self, gpt):
+        """The zero-recompile contract: program-store keys see capacity,
+        rank, and the target-site set — NEVER slot contents."""
+        bank = AdapterBank(gpt, capacity=4, rank=4)
+        st0 = bank.describe_statics()
+        assert st0 == {'capacity': 4, 'rank': 4,
+                       'targets': tuple(sorted(bank.sites))}
+        bank.load('a', make_adapter_factors(bank, 1))
+        assert bank.describe_statics() == st0
+
+    def test_device_arrays_shapes_and_zero_base_row(self, gpt):
+        bank = AdapterBank(gpt, capacity=3, rank=4)
+        arrs = bank.device_arrays()
+        assert set(arrs) == {'factors', 'scale'}
+        assert arrs['scale'].shape == (4,)
+        for site, (i, o) in bank.sites.items():
+            a, b = arrs['factors'][site]['a'], arrs['factors'][site]['b']
+            assert a.shape == (4, i, 4) and b.shape == (4, 4, o)
+            assert not np.asarray(a[0]).any()
+            assert not np.asarray(b[0]).any()
+        assert float(arrs['scale'][0]) == 0.0
+
+    def test_load_lookup_stats(self, gpt):
+        bank = _bank(gpt, 2)
+        assert bank.lookup('ad0') == (1, 1)
+        assert bank.lookup('ad1') == (2, 1)
+        assert bank.lookup('ghost') is None
+        assert bank.available('ad0') and not bank.available('ghost')
+        st = bank.stats()
+        assert st['pinned'] == 0
+        assert set(st['resident']) == {'ad0', 'ad1'}
+        assert st['resident']['ad0'] == {'slot': 1, 'version': 1,
+                                         'refs': 0}
+
+    def test_pin_unpin_refcounts(self, gpt):
+        bank = _bank(gpt, 1)
+        slot, ver = bank.pin('ad0')
+        assert (slot, ver) == (1, 1)
+        bank.pin('ad0')
+        assert bank.stats()['resident']['ad0']['refs'] == 2
+        bank.unpin(slot)
+        bank.unpin(slot)
+        assert bank.stats()['pinned'] == 0
+        with pytest.raises(RuntimeError):
+            bank.unpin(slot)
+        bank.unpin(0)          # the base slot is never refcounted
+
+    def test_pin_unknown_raises_typed(self, gpt):
+        bank = _bank(gpt, 1)
+        with pytest.raises(AdapterUnavailable) as ei:
+            bank.pin('ghost')
+        assert ei.value.adapter_id == 'ghost'
+
+    def test_lru_evicts_oldest_zero_ref_slot(self, gpt):
+        """Bank full of zero-ref adapters: the least-recently-pinned
+        one is evicted for the newcomer, with an adapter_evict event."""
+        bank = _bank(gpt, 2, capacity=2)
+        # ad0 older than ad1 by load order; touching ad0 makes ad1 LRU
+        s0, _ = bank.pin('ad0')
+        bank.unpin(s0)
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        slot, _ = bank.load('ad2', make_adapter_factors(bank, 9))
+        assert slot == 2                       # ad1's old slot
+        assert bank.lookup('ad1') is None
+        assert bank.lookup('ad0') == (1, 1)    # survivor untouched
+        evs = _events_since(log, ev0, 'adapter_evict')
+        assert len(evs) == 1 and evs[0]['attrs']['adapter'] == 'ad1'
+
+    def test_bank_full_of_pins_is_typed_unavailable(self, gpt):
+        bank = _bank(gpt, 2, capacity=2)
+        bank.pin('ad0')
+        bank.pin('ad1')
+        with pytest.raises(AdapterUnavailable) as ei:
+            bank.load('ad2', make_adapter_factors(bank, 9))
+        assert 'bank full' in ei.value.detail
+
+    def test_factor_validation(self, gpt):
+        bank = AdapterBank(gpt, capacity=2, rank=4)
+        good = make_adapter_factors(bank, 1)
+        site = next(iter(bank.sites))
+        # wrong rank (rank is a static — all adapters share it)
+        bad = dict(good)
+        a, b = good[site]
+        bad[site] = (a[:, :2], b[:2, :])
+        with pytest.raises(ValueError, match='rank'):
+            bank.load('x', bad)
+        # unknown target site
+        with pytest.raises(ValueError, match='unknown target site'):
+            bank.load('x', {**good, 'nowhere.qkv_proj': good[site]})
+        # missing site
+        missing = dict(good)
+        del missing[site]
+        with pytest.raises(ValueError, match='missing'):
+            bank.load('x', missing)
+
+    def test_make_adapter_factors_deterministic(self, gpt):
+        bank = AdapterBank(gpt, capacity=2, rank=4)
+        f1 = make_adapter_factors(bank, seed=5)
+        f2 = make_adapter_factors(bank, seed=5)
+        f3 = make_adapter_factors(bank, seed=6)
+        assert set(f1) == set(bank.sites)
+        for site in f1:
+            assert np.array_equal(f1[site][0], f2[site][0])
+            assert np.array_equal(f1[site][1], f2[site][1])
+            assert not np.array_equal(f1[site][0], f3[site][0])
+
+    def test_hot_reload_same_slot_new_version(self, gpt):
+        """Reloading a resident adapter writes its EXISTING slot (a
+        functional .at[slot].set — same avals) and bumps the version."""
+        bank = _bank(gpt, 1)
+        arrs0 = bank.device_arrays()
+        slot, ver = bank.load('ad0', make_adapter_factors(bank, 50),
+                              version=2)
+        assert (slot, ver) == (1, 2)
+        arrs1 = bank.device_arrays()
+        site = next(iter(bank.sites))
+        a0, a1 = arrs0['factors'][site]['a'], arrs1['factors'][site]['a']
+        assert a0.shape == a1.shape and a0.dtype == a1.dtype
+        assert not np.array_equal(np.asarray(a0[1]), np.asarray(a1[1]))
+
+
+# ---------------------------------------------------------------------------
+# hot-load / publish / rollback through the WeightStore plane
+# ---------------------------------------------------------------------------
+
+class TestAdapterStore:
+    def test_publish_then_pin_loads_latest(self, gpt, tmp_path):
+        bank = AdapterBank(gpt, capacity=2, rank=4,
+                           store_dir=str(tmp_path))
+        assert not bank.available('ad0')
+        v1 = bank.publish('ad0', _factors(bank, 1))
+        assert bank.available('ad0')           # servable from the store
+        assert bank.lookup('ad0') is None      # but NOT resident yet
+        slot, ver = bank.pin('ad0')            # lazy load on first pin
+        assert ver == v1
+        assert bank.lookup('ad0') == (slot, v1)
+
+    def test_publish_v2_never_touches_pinned_v1_slot(self, gpt, tmp_path):
+        """The rollback-safety core: v1 keeps decoding bit-exact out of
+        its own slot while v2 lands in a FRESH slot for new pins."""
+        bank = AdapterBank(gpt, capacity=3, rank=4,
+                           store_dir=str(tmp_path))
+        v1 = bank.publish('ad0', _factors(bank, 1))
+        s1, _ = bank.pin('ad0')                # in-flight request on v1
+        site = next(iter(bank.sites))
+        a_v1 = np.asarray(bank.device_arrays()['factors'][site]['a'][s1])
+        v2 = bank.publish('ad0', _factors(bank, 2))
+        # publish is lazy: nothing moved until someone pins
+        assert np.array_equal(
+            np.asarray(bank.device_arrays()['factors'][site]['a'][s1]),
+            a_v1)
+        s2, ver2 = bank.pin('ad0')
+        assert ver2 == v2 and s2 != s1
+        # v1's slot bytes are still exactly v1's
+        assert np.array_equal(
+            np.asarray(bank.device_arrays()['factors'][site]['a'][s1]),
+            a_v1)
+        assert bank.stats()['resident']['ad0']['version'] == v2
+        bank.unpin(s1)
+        bank.unpin(s2)
+
+    def test_corrupt_manifest_quarantined_bank_keeps_serving(
+            self, gpt, tmp_path):
+        """A corrupt v2 payload: pin() quarantines it with an
+        adapter_load_reject event and keeps serving resident v1 —
+        the fleet never swaps onto bytes that fail their sha256."""
+        bank = AdapterBank(gpt, capacity=2, rank=4,
+                           store_dir=str(tmp_path))
+        v1 = bank.publish('ad0', _factors(bank, 1))
+        bank.unpin(bank.pin('ad0')[0])         # v1 resident
+        v2 = bank.publish('ad0', _factors(bank, 2))
+        payload = tmp_path / 'ad0' / f'step_{v2}' / 'tree.npz'
+        raw = bytearray(payload.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF             # one flipped bit
+        payload.write_bytes(bytes(raw))
+        log = obs.get_event_log()
+        ev0 = len(log.events())
+        slot, ver = bank.pin('ad0')
+        assert ver == v1                       # still serving v1
+        evs = _events_since(log, ev0, 'adapter_load_reject')
+        assert len(evs) == 1 and evs[0]['attrs']['version'] == v2
+        store = WeightStore(str(tmp_path / 'ad0'))
+        assert store.quarantined() == [v2]
+        # quarantine sticks: the next pin never re-probes v2
+        ev1 = len(log.events())
+        assert bank.pin('ad0')[1] == v1
+        assert not _events_since(log, ev1, 'adapter_load_reject')
+
+    def test_corrupt_only_version_is_typed_unavailable(self, gpt,
+                                                       tmp_path):
+        bank = AdapterBank(gpt, capacity=2, rank=4,
+                           store_dir=str(tmp_path))
+        v1 = bank.publish('ad0', _factors(bank, 1))
+        payload = tmp_path / 'ad0' / f'step_{v1}' / 'tree.npz'
+        raw = bytearray(payload.read_bytes())
+        raw[0] ^= 0xFF
+        payload.write_bytes(bytes(raw))
+        with pytest.raises(AdapterUnavailable):
+            bank.pin('ad0')
+        assert not bank.available('ad0')       # quarantine made it moot
+
+    def test_bad_adapter_id_rejected_before_touching_disk(self, gpt,
+                                                          tmp_path):
+        bank = AdapterBank(gpt, capacity=2, rank=4,
+                           store_dir=str(tmp_path))
+        with pytest.raises(ValueError, match='bad adapter id'):
+            bank.publish('../escape', make_adapter_factors(bank, 1))
+
+
+# ---------------------------------------------------------------------------
+# the engine: heterogeneous-adapter batched decode
+# ---------------------------------------------------------------------------
+
+class TestEngineAdapters:
+    def _engine(self, gpt, bank, **kw):
+        kw.setdefault('num_slots', 4)
+        kw.setdefault('max_length', 64)
+        kw.setdefault('decode_block', 2)
+        return InferenceEngine(gpt, adapter_bank=bank, **kw)
+
+    def test_mixed_batch_bit_identical_to_each_adapter_alone(self, gpt):
+        """THE acceptance bar: one mixed wave (base + ad0 + ad1 in the
+        same decode block) produces, per request, exactly the tokens
+        that request gets when its adapter is served alone."""
+        prompts = _prompts([4, 6, 5, 7], seed=1)
+        ids = [None, 'ad0', 'ad1', 'ad0']
+        sp = [_sp(5)] * 4
+        # references: each adapter alone on its own engine + bank
+        refs = {}
+        for aid in ('ad0', 'ad1'):
+            eng = self._engine(gpt, _bank(gpt, 2))
+            refs[aid] = [h.tokens for h in
+                         eng.generate_many(prompts, sp, adapter_ids=aid)]
+        base_refs = [_ref_generate(gpt, p, 5) for p in prompts]
+        mixed = self._engine(gpt, _bank(gpt, 2)).generate_many(
+            prompts, sp, adapter_ids=ids)
+        for j, (h, aid) in enumerate(zip(mixed, ids)):
+            assert h.status == FINISHED
+            want = base_refs[j] if aid is None else refs[aid][j]
+            assert h.tokens == want, (j, aid)
+            assert h.adapter_id == aid
+        # the adapters actually did something: outputs differ per
+        # adapter on at least one shared prompt
+        assert refs['ad0'][1] != base_refs[1]
+        assert refs['ad0'][1] != refs['ad1'][1]
+
+    def test_base_requests_bit_identical_to_bank_less_engine(self, gpt):
+        """Attaching a bank must not perturb adapter-less traffic: the
+        slot-0 zero adapter gives an exactly-zero delta."""
+        prompts = _prompts([5, 3], seed=2)
+        sp = [_sp(4)] * 2
+        bare = InferenceEngine(gpt, num_slots=2, max_length=64,
+                               decode_block=2)
+        want = [h.tokens for h in bare.generate_many(prompts, sp)]
+        banked = self._engine(gpt, _bank(gpt, 2), num_slots=2)
+        got = [h.tokens for h in banked.generate_many(prompts, sp)]
+        assert got == want
+
+    def test_zero_recompiles_across_mixes_and_hot_load(self, gpt):
+        """After one mixed warmup wave: permuted mixes, base-only
+        waves, AND a hot adapter reload all replay the same programs —
+        python trace counters and the jit compile counter both flat."""
+        bank = _bank(gpt, 2)
+        eng = self._engine(gpt, bank)
+        prompts = _prompts([4, 5, 6, 4], seed=3)
+        sp = [_sp(4)] * 4
+        eng.generate_many(prompts, sp,
+                          adapter_ids=[None, 'ad0', 'ad1', 'ad0'])
+        traces = dict(eng.stats()['traces'])
+        compiles0 = obs.get_registry().value('paddle_jit_compiles_total')
+        eng.generate_many(prompts, sp,
+                          adapter_ids=['ad1', None, 'ad0', 'ad1'])
+        eng.generate_many(prompts, sp)                    # base-only
+        bank.load('ad0', make_adapter_factors(bank, 77), version=2)
+        bank.load('ad2', make_adapter_factors(bank, 78))  # fresh slot
+        eng.generate_many(prompts, sp,
+                          adapter_ids=['ad2', 'ad0', 'ad2', None])
+        assert eng.stats()['traces'] == traces
+        assert obs.get_registry().value('paddle_jit_compiles_total') \
+            == compiles0
+
+    def test_submit_validation(self, gpt):
+        bare = InferenceEngine(gpt, num_slots=2, max_length=64)
+        with pytest.raises(ValueError, match='adapter_bank'):
+            bare.submit([1, 2, 3], _sp(2), adapter_id='ad0')
+        banked = self._engine(gpt, _bank(gpt, 1))
+        with pytest.raises(AdapterUnavailable):
+            banked.submit([1, 2, 3], _sp(2), adapter_id='ghost')
+
+    def test_pins_released_and_stats_exposed(self, gpt):
+        bank = _bank(gpt, 2)
+        eng = self._engine(gpt, bank)
+        prompts = _prompts([4, 5], seed=4)
+        hs = eng.generate_many(prompts, [_sp(3)] * 2,
+                               adapter_ids=['ad0', 'ad1'])
+        assert all(h.status == FINISHED for h in hs)
+        assert all(h.adapter_version == 1 for h in hs)
+        st = eng.stats()['adapters']
+        assert st['pinned'] == 0               # every pin unwound
+        assert set(st['resident']) == {'ad0', 'ad1'}
+        reg = obs.get_registry()
+        assert reg.value('paddle_adapter_requests_total',
+                         adapter='ad0') >= 1
+
+    def test_hot_publish_in_flight_v1_bit_exact_new_requests_v2(
+            self, gpt, tmp_path):
+        """The engine-level hot-swap/rollback contract: publish v2
+        while a v1 request is mid-decode — the v1 request finishes with
+        EXACTLY the tokens a pure-v1 run gives; a request submitted
+        after the publish decodes under v2."""
+        prompt = _prompts([6], seed=5)[0]
+        f1 = _factors(AdapterBank(gpt, capacity=2, rank=4), 1)
+        f2 = _factors(AdapterBank(gpt, capacity=2, rank=4), 2)
+        # pure-v1 / pure-v2 references
+        tok = {}
+        for name, f in (('v1', f1), ('v2', f2)):
+            b = AdapterBank(gpt, capacity=2, rank=4)
+            b.load('ad0', f)
+            tok[name] = self._engine(gpt, b).generate_many(
+                [prompt], [_sp(8)], adapter_ids='ad0')[0].tokens
+        assert tok['v1'] != tok['v2']
+        # live run: v1 decoding when v2 publishes
+        bank = AdapterBank(gpt, capacity=3, rank=4,
+                           store_dir=str(tmp_path))
+        v1 = bank.publish('ad0', f1)
+        eng = self._engine(gpt, bank)
+        h1 = eng.submit(prompt, _sp(8), adapter_id='ad0')
+        for _ in range(3):
+            eng.step()                         # h1 is mid-decode on v1
+        assert h1.adapter_version == v1
+        v2 = bank.publish('ad0', f2)
+        h2 = eng.submit(prompt, _sp(8), adapter_id='ad0')
+        eng.run()
+        assert h1.status == FINISHED and h2.status == FINISHED
+        assert h1.tokens == tok['v1']          # bit-exact through swap
+        assert h2.adapter_version == v2
+        assert h2.tokens == tok['v2']
+        assert eng.stats()['adapters']['pinned'] == 0
+
+    def test_chunked_prefill_composes(self, gpt):
+        prompts = _prompts([17, 9], seed=6)
+        sp = [_sp(4)] * 2
+        want = [h.tokens for h in self._engine(
+            gpt, _bank(gpt, 2)).generate_many(
+                prompts, sp, adapter_ids=['ad0', 'ad1'])]
+        chunked = self._engine(gpt, _bank(gpt, 2),
+                               prefill_chunk_tokens=8)
+        got = [h.tokens for h in chunked.generate_many(
+            prompts, sp, adapter_ids=['ad0', 'ad1'])]
+        assert got == want
+
+    def test_speculative_decode_composes(self, gpt):
+        """Spec decode with adapters: the scope wraps ONLY the target
+        verify, so greedy outputs stay bit-identical to plain decode
+        under the same adapter (the spec contract, adapter or not)."""
+        paddle.seed(11)
+        draft = GPTForCausalLM(GPTConfig.tiny(num_hidden_layers=1)).eval()
+        prompts = _prompts([5, 7], seed=7)
+        sp = [_sp(6)] * 2
+        want = [h.tokens for h in self._engine(
+            gpt, _bank(gpt, 2), num_slots=2).generate_many(
+                prompts, sp, adapter_ids=['ad0', None])]
+        spec = self._engine(gpt, _bank(gpt, 2), num_slots=2,
+                            draft_model=draft, num_draft_tokens=3)
+        got = [h.tokens for h in spec.generate_many(
+            prompts, sp, adapter_ids=['ad0', None])]
+        assert got == want
+
+    def test_paged_pool_composes(self, gpt):
+        prompts = _prompts([6, 9], seed=8)
+        sp = [_sp(4)] * 2
+        want = [h.tokens for h in self._engine(
+            gpt, _bank(gpt, 2), num_slots=2).generate_many(
+                prompts, sp, adapter_ids=['ad0', 'ad1'])]
+        paged = self._engine(gpt, _bank(gpt, 2), num_slots=2,
+                             kv_page_size=8, kv_pages=24)
+        got = [h.tokens for h in paged.generate_many(
+            prompts, sp, adapter_ids=['ad0', 'ad1'])]
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: (adapter_id, version) namespacing
+# ---------------------------------------------------------------------------
+
+class TestPrefixCacheAdapterScope:
+    """The satellite-2 regression: two tenants with IDENTICAL prompts
+    but different adapters must never share a cached prefix (the KV
+    under an adapter carries that adapter's deltas); base requests keep
+    deduplicating exactly as before."""
+
+    def test_identical_prompts_different_adapters_never_share(self, gpt):
+        prompt = _prompts([12], seed=9)[0]
+        sp = _sp(4)
+        # alone references (no cache in play)
+        ref = {}
+        for aid in (None, 'ad0', 'ad1'):
+            eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                                  decode_block=2,
+                                  adapter_bank=_bank(gpt, 2))
+            ref[aid] = eng.generate_many([prompt], [sp],
+                                         adapter_ids=aid)[0].tokens
+        # slots = 2x requests so wave-2 admissions never reclaim the
+        # retained wave-1 prefixes under pool pressure
+        eng = InferenceEngine(gpt, num_slots=6, max_length=64,
+                              decode_block=2, prefix_cache=0.9,
+                              adapter_bank=_bank(gpt, 2))
+        ids = [None, 'ad0', 'ad1']
+        # wave 1 seeds three namespaces: same tokens, ZERO cross-hits
+        hs = eng.generate_many([prompt] * 3, [sp] * 3, adapter_ids=ids)
+        st = eng.stats()['prefix_cache']
+        assert st['hits'] == 0
+        # the base namespace is the root trie itself; each (adapter,
+        # version) pair got its OWN root
+        assert st['namespaces'] >= 2
+        assert [h.tokens for h in hs] == [ref[a] for a in ids]
+        # wave 2 hits WITHIN each namespace — outputs still bit-exact
+        hs2 = eng.generate_many([prompt] * 3, [sp] * 3, adapter_ids=ids)
+        assert eng.stats()['prefix_cache']['hits'] >= 3
+        assert [h.tokens for h in hs2] == [ref[a] for a in ids]
+
+    def test_base_requests_still_dedupe_on_a_banked_engine(self, gpt):
+        prompt = _prompts([12], seed=10)[0]
+        eng = InferenceEngine(gpt, num_slots=2, max_length=64,
+                              decode_block=2, prefix_cache=0.9,
+                              adapter_bank=_bank(gpt, 1))
+        h1 = eng.generate_many([prompt], [_sp(3)])[0]
+        h2 = eng.generate_many([prompt], [_sp(3)])[0]
+        assert h1.tokens == h2.tokens == _ref_generate(gpt, prompt, 3)
+        assert eng.stats()['prefix_cache']['hits'] >= 1
+
+    def test_publish_changes_namespace_old_kv_unreachable(self, gpt,
+                                                          tmp_path):
+        """Version rides the namespace key: after a publish, new
+        requests get a FRESH namespace — v1's cached prefixes (KV with
+        v1's deltas baked in) can never serve a v2 request."""
+        prompt = _prompts([12], seed=11)[0]
+        bank = AdapterBank(gpt, capacity=3, rank=4,
+                           store_dir=str(tmp_path))
+        bank.publish('ad0', _factors(bank, 1))
+        eng = InferenceEngine(gpt, num_slots=4, max_length=64,
+                              decode_block=2, prefix_cache=0.9,
+                              adapter_bank=bank)
+        eng.generate_many([prompt], [_sp(3)], adapter_ids='ad0')
+        eng.generate_many([prompt], [_sp(3)], adapter_ids='ad0')
+        hits1 = eng.stats()['prefix_cache']['hits']
+        assert hits1 >= 1                      # same version dedupes
+        bank.publish('ad0', _factors(bank, 2))
+        h = eng.generate_many([prompt], [_sp(3)], adapter_ids='ad0')[0]
+        assert eng.stats()['prefix_cache']['hits'] == hits1  # no hit
+        # and the output is v2's, proving no v1 KV leaked in
+        b2 = AdapterBank(gpt, capacity=2, rank=4)
+        b2.load('ad0', _factors(b2, 2))
+        want = InferenceEngine(gpt, num_slots=2, max_length=64,
+                               decode_block=2, adapter_bank=b2
+                               ).generate_many(
+            [prompt], [_sp(3)], adapter_ids='ad0')[0].tokens
+        assert h.tokens == want
+
+
+# ---------------------------------------------------------------------------
+# tenancy + router: adapter defaults, typed fast-fail
+# ---------------------------------------------------------------------------
+
+class TestRouterTenancyAdapters:
+    def test_parse_tenant_spec_adapter_field(self):
+        reg = parse_tenant_spec(
+            'paid:priority=high,adapter=ad0;free:priority=low')
+        assert reg.get('paid').adapter == 'ad0'
+        assert reg.get('free').adapter is None
+        assert reg.get('paid').spec()['adapter'] == 'ad0'
+        # round-trip: a spec()'d tenant re-parses to the same adapter
+        reparsed = TenantRegistry({'paid': reg.get('paid').spec()})
+        assert reparsed.get('paid').adapter == 'ad0'
+
+    def _router(self, gpt, tenants, bank=None, n=1):
+        kw = dict(num_slots=2, max_length=64, decode_block=2)
+        if bank is not None:
+            kw['adapter_bank'] = bank
+        return Router(ReplicaSet(gpt, n, **kw), tenants=tenants)
+
+    def test_tenant_default_adapter_applies_and_overrides(self, gpt):
+        bank = _bank(gpt, 2)
+        router = self._router(
+            gpt, 'paid:priority=high,adapter=ad0;free:priority=low',
+            bank=bank)
+        p = _prompts([4], seed=12)[0]
+        h_dflt = router.submit(p, _sp(3), tenant='paid')
+        h_ovr = router.submit(p, _sp(3), tenant='paid',
+                              adapter_id='ad1')
+        h_base = router.submit(p, _sp(3), tenant='free')
+        router.run()
+        assert h_dflt.adapter_id == 'ad0'
+        assert h_ovr.adapter_id == 'ad1'
+        assert h_base.adapter_id is None
+        assert all(h.status == FINISHED for h in (h_dflt, h_ovr, h_base))
+        assert h_dflt.adapter_version == 1
+        assert h_dflt.tokens != h_base.tokens
+
+    def test_unknown_adapter_fast_fails_typed_before_qos(self, gpt):
+        """The satellite-1 contract: a request for a missing adapter
+        rejects synchronously with reason='adapter_unavailable' and
+        consumes NO rate-bucket token and NO model work."""
+        bank = _bank(gpt, 1)
+        tenants = TenantRegistry(
+            {'metered': {'rate': 1.0, 'burst': 1.0, 'adapter': 'ghost'}})
+        router = self._router(gpt, tenants, bank=bank)
+        p = _prompts([4], seed=13)[0]
+        prefills0 = router._by_id[0].engine._counts['prefills']
+        with pytest.raises(AdmissionRejected) as ei:
+            router.submit(p, _sp(2), tenant='metered')
+        assert ei.value.reason == 'adapter_unavailable'
+        assert router._by_id[0].engine._counts['prefills'] == prefills0
+        assert router.stats()['rejected'] == {'adapter_unavailable': 1}
+        # the reject spent no rate token: an available-adapter request
+        # from the same 1-token bucket still goes through
+        h = router.submit(p, _sp(2), tenant='metered', adapter_id='ad0')
+        router.run()
+        assert h.status == FINISHED
+
+    def test_bank_less_fleet_rejects_adapter_requests(self, gpt):
+        router = self._router(gpt, 'paid:priority=high')
+        with pytest.raises(AdmissionRejected) as ei:
+            router.submit(_prompts([4], seed=14)[0], _sp(2),
+                          tenant='paid', adapter_id='ad0')
+        assert ei.value.reason == 'adapter_unavailable'
+
+
+# ---------------------------------------------------------------------------
+# loadgen: per-tenant adapter mixes
+# ---------------------------------------------------------------------------
+
+class TestLoadgenAdapterMixes:
+    def _trace(self, seed=42):
+        return loadgen.make_trace(
+            loadgen.PoissonSchedule(12.0), 6.0, seed=seed,
+            prompt_lengths=loadgen.FixedLength(6),
+            tenants=[
+                loadgen.TenantClass('paid', 2.0, 0, adapters=(
+                    ('ad0', 2.0), ('ad1', 1.0), (None, 1.0))),
+                loadgen.TenantClass('free', 1.0, 2)],
+            vocab_size=96)
+
+    def test_mix_validation(self):
+        with pytest.raises(ValueError, match='adapter mix'):
+            loadgen.TenantClass('t', adapters=(('ad0', 0.0),))
+        with pytest.raises(ValueError, match='adapter mix'):
+            loadgen.TenantClass('t', adapters=(('ad0',),))
+
+    def test_traces_bit_identical_from_one_seed(self):
+        t1, t2 = self._trace(), self._trace()
+        assert t1 == t2
+        assert self._trace(seed=43) != t1
+
+    def test_mix_draws_only_for_declaring_tenants(self):
+        trace = self._trace()
+        paid = [r for r in trace if r.tenant == 'paid']
+        free = [r for r in trace if r.tenant == 'free']
+        assert paid and free
+        assert all(r.adapter is None for r in free)
+        drawn = {r.adapter for r in paid}
+        assert {'ad0', 'ad1'} <= drawn         # mix actually mixes
+        # weights bite: ad0 (weight 2) drawn more than ad1 (weight 1)
+        n0 = sum(1 for r in paid if r.adapter == 'ad0')
+        n1 = sum(1 for r in paid if r.adapter == 'ad1')
+        assert n0 > n1
+
+    def test_trace_stats_by_adapter(self):
+        st = loadgen.trace_stats(self._trace())
+        by = st['by_adapter']
+        assert set(by) <= {'ad0', 'ad1'}
+        paid_with = sum(1 for r in self._trace()
+                        if r.adapter is not None)
+        assert sum(by.values()) == paid_with
+
+    def test_replay_threads_adapter_through_router(self, gpt):
+        """End-to-end: a mixed trace replays against a bank-attached
+        fleet — every adapter request decodes under its adapter, zero
+        drops."""
+        bank = _bank(gpt, 2)
+        trace = loadgen.make_trace(
+            loadgen.PoissonSchedule(6.0), 2.0, seed=5,
+            prompt_lengths=loadgen.FixedLength(5),
+            output_lengths=loadgen.FixedLength(3),
+            tenants=[loadgen.TenantClass('t', 1.0, 1, adapters=(
+                ('ad0', 1.0), (None, 1.0)))],
+            vocab_size=96)
+        router = Router(ReplicaSet(gpt, 1, num_slots=2, max_length=64,
+                                   decode_block=2, adapter_bank=bank))
+        rep = loadgen.LoadReplayer(router, trace, time_scale=0.05,
+                                   max_wall_s=60.0)
+        report = rep.run().report(slo_ttft_s=30.0)
+        assert report['completed'] == len(trace)
+        assert report['dropped'] == 0
+        served = obs.get_registry().value(
+            'paddle_adapter_requests_total', adapter='ad0')
+        want = sum(1 for r in trace if r.adapter == 'ad0')
+        assert want == 0 or served >= want
+
+
+# ---------------------------------------------------------------------------
+# bench guards (slow tier): the adapter_ab acceptance numbers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_adapter_ab_guard():
+    """Runs the real bench at reduced scale and asserts the ISSUE-19
+    acceptance fields: per-tenant parity vs alone, zero recompiles
+    across mixes + a hot swap, and mixed >= sequential throughput
+    structure present."""
+    import bench
+    out = bench.adapter_ab(num_adapters=2, requests_per_group=2,
+                           num_slots=3, max_length=64, decode_block=4,
+                           max_new=6, trials=1)
+    assert out['parity'] is True
+    assert out['recompiles_after_warmup'] == 0
+    assert out['jit_compiles_delta'] == 0
+    assert out['hot_swap_outputs_changed'] is True
+    assert out['hot_swap_others_bit_exact'] is True
+    assert out['tokens_per_sec_mixed'] > 0
+    assert out['tokens_per_sec_sequential'] > 0
+
+
+@pytest.mark.slow
+def test_bench_adapters_smoke_guard():
+    import bench
+    out = bench.adapters_smoke(duration_s=2.0, rate=6.0, seed=77,
+                               time_scale=0.1)
+    assert out['trace_deterministic'] is True
+    assert out['dropped'] == 0
+    assert out['completed'] == out['offered']
+    assert out['adapters_served'] >= 1

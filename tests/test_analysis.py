@@ -119,6 +119,8 @@ FIXTURE_SPECS = [
      'host_sync/good/paddle_tpu/serving/remote.py'),
     ('host-sync', 'host_sync/bad/paddle_tpu/serving/supervisor.py',
      'host_sync/good/paddle_tpu/serving/supervisor.py'),
+    ('host-sync', 'host_sync/bad/paddle_tpu/serving/adapters/bank.py',
+     'host_sync/good/paddle_tpu/serving/adapters/bank.py'),
     ('falsy-guard', 'falsy_guard/bad_falsy_or.py',
      'falsy_guard/good_is_none.py'),
     ('lock-order', 'lock_order/bad_locks.py', 'lock_order/good_locks.py'),

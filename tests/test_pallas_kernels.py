@@ -275,3 +275,72 @@ class TestPagedAttention:
                                  interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(base),
                                    rtol=2e-6, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# segmented LoRA adapter matmul (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+class TestAdapterMatmul:
+    """The fused gather+matmul over a packed adapter bank: pallas
+    (interpret mode) against the pure-lax reference, plus the slot-0
+    exactly-zero contract the engine's base-request parity rides on."""
+
+    def _case(self, b=4, t=1, h=16, r=4, o=24, c=3, seed=0):
+        from paddle_tpu.ops import pallas_kernels as pk
+        rng = np.random.default_rng(seed)
+        x = jnp.array(rng.standard_normal((b, t, h)).astype(np.float32))
+        a = rng.standard_normal((c + 1, h, r)).astype(np.float32) * 0.1
+        bb = rng.standard_normal((c + 1, r, o)).astype(np.float32) * 0.1
+        a[0], bb[0] = 0.0, 0.0              # slot 0: the zero base row
+        scale = rng.uniform(0.5, 2.0, (c + 1,)).astype(np.float32)
+        scale[0] = 0.0
+        rows = jnp.array(rng.integers(0, c + 1, (b,)), jnp.int32)
+        return pk, x, jnp.array(a), jnp.array(bb), rows, jnp.array(scale)
+
+    @pytest.mark.parametrize('t', [1, 8])
+    def test_pallas_matches_reference(self, t):
+        pk, x, a, b, rows, scale = self._case(t=t, seed=7)
+        got = pk.adapter_matmul(x, a, b, rows, scale, interpret=True)
+        ref = pk.adapter_matmul_reference(x, a, b, rows, scale)
+        assert got.shape == ref.shape == (x.shape[0], t, b.shape[2])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_slot_zero_rows_are_exactly_zero(self):
+        """Rows gathered from bank slot 0 must produce a bit-exact zero
+        delta (zero factors x zero scale), in BOTH implementations —
+        this is what makes adapter-less requests on a bank-attached
+        engine bit-identical to a bank-less one."""
+        pk, x, a, b, _, scale = self._case(b=3, seed=9)
+        rows = jnp.zeros((3,), jnp.int32)
+        for fn in (pk.adapter_matmul_reference,
+                   lambda *args: pk.adapter_matmul(*args, interpret=True)):
+            out = np.asarray(fn(x, a, b, rows, scale))
+            assert np.array_equal(out, np.zeros_like(out))
+
+    def test_mixed_rows_match_per_row_einsum(self):
+        """Each row's delta equals the plain x_i @ A[slot] @ B[slot] *
+        scale[slot] — the gather never leaks a neighbour's factors."""
+        pk, x, a, b, rows, scale = self._case(b=5, c=4, seed=11)
+        got = np.asarray(pk.adapter_matmul_reference(x, a, b, rows, scale))
+        for i in range(x.shape[0]):
+            s = int(rows[i])
+            want = (np.asarray(x[i], np.float32)
+                    @ np.asarray(a[s]) @ np.asarray(b[s])
+                    * float(scale[s]))
+            np.testing.assert_allclose(got[i], want, rtol=2e-5, atol=2e-5)
+
+    def test_dispatcher_falls_back_off_tpu(self):
+        pk, x, a, b, rows, scale = self._case(seed=3)
+        if jax.default_backend() == 'tpu':
+            pytest.skip('fallback path is for non-TPU backends')
+        got = pk.adapter_matmul(x, a, b, rows, scale)
+        ref = pk.adapter_matmul_reference(x, a, b, rows, scale)
+        assert np.array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_preserves_input_dtype(self):
+        pk, x, a, b, rows, scale = self._case(seed=5)
+        xh = x.astype(jnp.bfloat16)
+        out = pk.adapter_matmul(xh, a, b, rows, scale, interpret=True)
+        assert out.dtype == jnp.bfloat16
