@@ -2557,12 +2557,70 @@ def goodput_fault_ledger(steps=12, step_sleep=0.02, backoff_s=0.3):
     return report
 
 
+def reqledger_overhead_ab(trials=3, n_requests=12, max_new=8):
+    """Request-ledger on vs off A/B on a routed serving trace (also
+    imported by the tier-1 <3% overhead guard). Both arms run the SAME
+    router/engine path; only the per-request ledger toggles — the ratio
+    isolates what phase bookkeeping (queue spans, per-round fair-share
+    attribution, finalize) costs the serving hot loop. Min-of-
+    adjacent-pair ratios, same estimator as the scrape guard
+    (best-of-N across arms reports phantom overhead on a loaded
+    1-core box)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import (InferenceEngine, Replica, Router,
+                                    SamplingParams)
+
+    model = _serving_model()
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, model.config.vocab_size, (s,)).tolist()
+               for s in ([5, 9, 13, 7, 11, 6] * n_requests)[:n_requests]]
+
+    def run_arm():
+        eng = InferenceEngine(model, num_slots=4, max_length=64,
+                              decode_block=8)
+        router = Router([Replica(0, eng)])
+        t0 = time.perf_counter()
+        handles = [router.submit(
+            p, SamplingParams(max_new_tokens=max_new, eos_token_id=-1))
+            for p in prompts]
+        while not all(h.done for h in handles):
+            router.step()
+        wall = time.perf_counter() - t0
+        toks = sum(len(h.tokens) for h in handles)
+        return toks / wall if wall > 0 else 0.0
+
+    led = obs.get_request_ledger()
+    was_on = led.is_enabled
+    ratios = []
+    best_on = best_off = 0.0
+    try:
+        run_arm()                      # warm compile caches off-ledger
+        for _ in range(trials):
+            led.disable()
+            off = run_arm()
+            led.enable()
+            on = run_arm()
+            best_off = max(best_off, off)
+            best_on = max(best_on, on)
+            if on:
+                ratios.append(off / on)
+    finally:
+        led.enable() if was_on else led.disable()
+    overhead = min(ratios) - 1 if ratios else float('inf')
+    return {
+        'ledger_tokens_per_sec': best_on,
+        'plain_tokens_per_sec': best_off,
+        'overhead_pct': round(overhead * 100, 2),
+    }
+
+
 def _phase_goodput():
     """Goodput/MFU phase: ledger overhead A/B, the MFU cross-check, and
     the fault-injected ledger-closure run — the tier-1 guards pin
     overhead <3%, MFU agreement <10%, and closure-within-1% on CPU."""
     out = {}
     for key, fn in (('goodput_overhead', goodput_overhead_ab),
+                    ('reqledger_overhead', reqledger_overhead_ab),
                     ('gpt_mfu', goodput_gpt_mfu),
                     ('fault_ledger', goodput_fault_ledger)):
         try:
@@ -3108,6 +3166,12 @@ def main():
             print(json.dumps({'adapters_smoke': adapters_smoke()}))
         else:
             print(json.dumps(_phase_adapters()))
+        return 0
+    if len(sys.argv) >= 2 and sys.argv[1] == 'reqledger_overhead_ab':
+        # `bench.py reqledger_overhead_ab`: the request-ledger on/off
+        # A/B on a routed serving trace (tier-1 guards <3%)
+        print(json.dumps(
+            {'reqledger_overhead': reqledger_overhead_ab()}))
         return 0
     if len(sys.argv) >= 3 and sys.argv[1] == '--coldstart-child':
         if os.environ.get('BENCH_FORCE_CPU'):

@@ -86,6 +86,15 @@ HOT_SCOPES = {
     'paddle_tpu/serving/adapters/bank.py': ('AdapterBank.',),
     'paddle_tpu/serving/adapters/apply.py': ('linear_hook',
                                              'adapter_scope.'),
+    # the request ledger (ISSUE 20) is written from INSIDE the engine
+    # step / router failover loops: queue transitions at every
+    # scheduler pass, per-round fair-share attribution after every
+    # decode round, finalize on every retire. Its books are host-side
+    # floats BY DESIGN — any device read creeping into add()/
+    # note_round()/finalize_record() stalls every decode round of
+    # every request, which is exactly the tail it exists to explain
+    'paddle_tpu/observability/reqledger.py': ('RequestRecord.',
+                                              'RequestLedger.'),
 }
 
 _NP_ROOTS = frozenset(('np', 'numpy', 'onp'))

@@ -38,6 +38,22 @@ class ReplayOutcome:
     reason: str = ''             # shed reason / error type
     ttft_s: Optional[float] = None
     tokens: int = 0
+    # per-phase seconds from the request ledger (reqledger.PHASES plus
+    # 'residual'), when the ledger was enabled during the replay; the
+    # report's decomposition columns come from here
+    phases: Optional[dict] = None
+
+
+def _reap_phases(h) -> Optional[dict]:
+    """Pull the finalized phase waterfall off a handle's ledger record
+    (None when the ledger is disabled or the record never finalized)."""
+    rec = getattr(h, '_ledger_rec', None)
+    if rec is None or rec.t_done is None:
+        return None
+    summ = rec.summary()
+    phases = dict(summ['phases'])
+    phases['residual'] = summ['residual_s']
+    return phases
 
 
 class ReplayReport:
@@ -76,6 +92,25 @@ class ReplayReport:
                    and o.ttft_s is not None and o.ttft_s <= slo_ttft_s)
         return good / len(self.outcomes)
 
+    def phase_decomposition(self) -> dict:
+        """Per-phase p50/p99/mean seconds across outcomes that carry a
+        ledger waterfall — the report's "where did the time go" columns.
+        Empty when the request ledger was disabled during the replay."""
+        books = [o.phases for o in self.outcomes if o.phases]
+        if not books:
+            return {}
+        names = sorted({p for b in books for p in b})
+        out = {}
+        for p in names:
+            vals = sorted(b.get(p, 0.0) for b in books)
+            n = len(vals)
+            out[p] = {
+                'p50_s': round(vals[min(int(0.50 * n), n - 1)], 6),
+                'p99_s': round(vals[min(int(0.99 * n), n - 1)], 6),
+                'mean_s': round(sum(vals) / n, 6),
+            }
+        return out
+
     def report(self, slo_ttft_s: float) -> dict:
         ttfts = self._ttfts()
 
@@ -87,6 +122,7 @@ class ReplayReport:
 
         attainment = self.slo_attainment(slo_ttft_s)
         rep_hours = self.replica_seconds / 3600.0
+        phases = self.phase_decomposition()
         return {
             'offered': len(self.outcomes),
             'completed': self.count('completed'),
@@ -104,6 +140,10 @@ class ReplayReport:
                 round(attainment / rep_hours, 2) if rep_hours > 0
                 else None,
             'truncated': self.truncated,
+            # per-phase latency decomposition (request ledger); {} when
+            # the ledger was off — the headline numbers above never
+            # depend on it
+            'phases': phases,
         }
 
 
@@ -185,11 +225,13 @@ class LoadReplayer:
                             req, 'failed',
                             reason=type(h.error).__name__
                             if h.error is not None else 'untyped',
-                            tokens=len(h.tokens)))
+                            tokens=len(h.tokens),
+                            phases=_reap_phases(h)))
                     else:
                         outcomes.append(ReplayOutcome(
                             req, 'completed', ttft_s=h.ttft,
-                            tokens=len(h.tokens)))
+                            tokens=len(h.tokens),
+                            phases=_reap_phases(h)))
                 live = still
             if i >= n and not live:
                 break
@@ -210,7 +252,8 @@ class LoadReplayer:
             else:
                 out = 'dangling'   # counts in ReplayReport.dropped
             outcomes.append(ReplayOutcome(
-                req, out, ttft_s=h.ttft, tokens=len(h.tokens)))
+                req, out, ttft_s=h.ttft, tokens=len(h.tokens),
+                phases=_reap_phases(h)))
         outcomes.sort(key=lambda o: o.request.index)
         return ReplayReport(outcomes, self._clock() - t0,
                             replica_seconds, self.time_scale,

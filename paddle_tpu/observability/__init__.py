@@ -53,6 +53,9 @@ from .cost import (CatalogedJit, MfuWindow, ProgramCatalog, ProgramRecord,
                    roofline_summary, get_catalog as program_catalog)
 from .goodput import (CATEGORIES as GOODPUT_CATEGORIES, GoodputLedger,
                       get_ledger)
+from .reqledger import (BLOCKED_REASONS, PHASES as REQUEST_PHASES,
+                        RequestLedger, RequestRecord,
+                        get_ledger as get_request_ledger)
 from .flight import FlightRecorder, get_flight_recorder
 from .server import (ObservabilityServer, clear_degraded, degraded_states,
                      hang_suspected, health, note_degraded, note_progress,
@@ -60,6 +63,7 @@ from .server import (ObservabilityServer, clear_degraded, degraded_states,
 from . import cost as _cost
 from . import flight as _flight
 from . import goodput as _goodput
+from . import reqledger as _reqledger
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'DEFAULT_BUCKETS',
@@ -82,6 +86,8 @@ __all__ = [
     'program_catalog',
     'aggregate_mfu', 'device_peaks', 'record_roofline', 'roofline_summary',
     'GOODPUT_CATEGORIES', 'GoodputLedger', 'get_ledger',
+    'BLOCKED_REASONS', 'REQUEST_PHASES', 'RequestLedger',
+    'RequestRecord', 'get_request_ledger',
     'FlightRecorder', 'get_flight_recorder',
     'ObservabilityServer', 'clear_degraded', 'degraded_states',
     'hang_suspected', 'health', 'note_degraded', 'note_progress',
@@ -97,3 +103,4 @@ install()
 _cost.install()
 _flight.install()
 _goodput.install()
+_reqledger.install()

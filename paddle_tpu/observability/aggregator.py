@@ -38,6 +38,8 @@ from ..analysis.runtime import concurrency as _concurrency
 #: per-process bound on retained events/spans (oldest dropped) — the
 #: aggregator is a view, not an archive
 MAX_EVENTS_PER_PROCESS = 65536
+#: per-process bound on retained request-ledger waterfalls
+MAX_REQUESTS_PER_PROCESS = 4096
 #: clock-pair samples retained per process for the skew estimate
 MAX_CLOCK_PAIRS = 64
 
@@ -61,6 +63,8 @@ class Aggregator:
         self._applied: Dict[str, Set[int]] = {}
         self._states: Dict[str, Dict[str, Any]] = {}
         self._events: Dict[str, collections.deque] = {}
+        # finalized request-ledger waterfalls, per shipping process
+        self._requests: Dict[str, collections.deque] = {}
         self._clock_pairs: Dict[str, collections.deque] = {}
         self._last_segment_wall: Dict[str, float] = {}
         self._quarantined: List[str] = []
@@ -187,6 +191,10 @@ class Aggregator:
                 state = self._states[uid] = wire.new_state(
                     uid, process_index=len(self._states))
             wire.fold_metrics_delta(state, seg['records'], seq)
+        elif seg['kind'] == wire.KIND_REQUESTS:
+            buf = self._requests.setdefault(
+                uid, collections.deque(maxlen=MAX_REQUESTS_PER_PROCESS))
+            buf.extend(seg['records'])
         else:   # events / spans share the per-process timeline buffer
             buf = self._events.setdefault(
                 uid, collections.deque(maxlen=MAX_EVENTS_PER_PROCESS))
@@ -208,8 +216,27 @@ class Aggregator:
 
     def process_uids(self) -> List[str]:
         with self._lock:
-            keys = set(self._states) | set(self._events)
+            keys = (set(self._states) | set(self._events)
+                    | set(self._requests))
             return sorted(keys)
+
+    def requests(self, trace_id=None) -> List[Dict[str, Any]]:
+        """Fleet-merged finalized request-ledger waterfalls (oldest
+        first by finish wall time), each tagged with the process that
+        shipped it. `trace_id` filters to one request's record(s) — the
+        `/requests` → `/fleet/trace` drill-down."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for uid, buf in self._requests.items():
+                for r in buf:
+                    if (trace_id is not None
+                            and r.get('request_id') != trace_id):
+                        continue
+                    rr = dict(r)
+                    rr['process_uid'] = uid
+                    out.append(rr)
+        out.sort(key=lambda r: r.get('wall_ts') or 0.0)
+        return out
 
     def per_process_snapshots(self) -> Dict[str, Dict[str, Any]]:
         """Each process's accumulated metrics as a snapshot-shaped doc,
@@ -294,14 +321,17 @@ class Aggregator:
         with self._lock:
             per_proc = {uid: list(buf)
                         for uid, buf in self._events.items()}
+            per_proc_reqs = {uid: list(buf)
+                             for uid, buf in self._requests.items()}
         rows: List[Dict[str, Any]] = []     # (corrected wall ts, event)
         tracks: List[Dict[str, Any]] = []
         t_min: Optional[float] = None
-        for pid, uid in enumerate(sorted(per_proc)):
+        all_uids = sorted(set(per_proc) | set(per_proc_reqs))
+        for pid, uid in enumerate(all_uids):
             off = offsets.get(uid, 0.0)
             tids: Set[int] = set()
             kept = []
-            for e in per_proc[uid]:
+            for e in per_proc.get(uid, ()):
                 if trace_id is not None and (
                         (e.get('attrs') or {}).get('request_id')
                         != trace_id):
@@ -311,6 +341,29 @@ class Aggregator:
                 tids.add(e.get('tid', 0))
                 if t_min is None or wall < t_min:
                     t_min = wall
+            # request-ledger phase annotations: each finalized record's
+            # waterfall renders as `req.<phase>` slices on a synthetic
+            # per-request track, skew-corrected exactly like spans (the
+            # record's 'ts' rides the span clock)
+            for r in per_proc_reqs.get(uid, ()):
+                rid = r.get('request_id')
+                if trace_id is not None and rid != trace_id:
+                    continue
+                base = float(r.get('ts', 0.0))
+                tid = -1 - (int(rid or 0) % 97)
+                for s in r.get('segments', ()):
+                    e = {'name': f'req.{s["phase"]}', 'ph': 'X',
+                         'ts': base + float(s['start_s']),
+                         'dur': float(s['dur_s']), 'tid': tid,
+                         'attrs': {'request_id': rid,
+                                   'phase': s['phase'],
+                                   'outcome': r.get('outcome'),
+                                   'failovers': r.get('failovers')}}
+                    wall = e['ts'] + off
+                    kept.append((wall, e))
+                    tids.add(tid)
+                    if t_min is None or wall < t_min:
+                        t_min = wall
             rows.extend((wall, pid, e) for wall, e in kept)
             if kept:
                 tracks.append({'pid': pid, 'uid': uid, 'tids': tids,

@@ -148,6 +148,13 @@ EVENT_SCHEMA: Dict[str, str] = {
                      'newcomer',
     'adapter_load_reject': 'adapter manifest failed verification; '
                            'version quarantined, bank keeps serving',
+    'adapter_bank_saturated': 'adapter bank full of referenced slots; '
+                              'request requeued (adapter_pinned) '
+                              'instead of failed',
+    # per-request latency ledger (observability/reqledger.py)
+    'request_slow': 'request finished over the slow threshold '
+                    '(N x the ttft_p99 SLO); carries the dominant '
+                    'phase as the suspected driver',
 }
 
 

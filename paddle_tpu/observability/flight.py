@@ -49,7 +49,7 @@ TRIGGER_EVENTS = frozenset((
     'serving_request_failed', 'checkpoint_corrupt',
     'router_failover_storm', 'donation_quarantined',
     'sanitizer_violation', 'slo_breach', 'segment_quarantined',
-    'replica_crash', 'replica_quarantined',
+    'replica_crash', 'replica_quarantined', 'request_slow',
 ))
 
 
@@ -212,6 +212,19 @@ class FlightRecorder:
             except Exception:
                 _metrics.count_suppressed('flight.bundle_section')
                 # partial bundle beats none mid-crash
+            try:
+                # per-request phase waterfalls: which requests were slow
+                # at the moment of the incident and WHERE their
+                # milliseconds went (the request_slow trigger's own
+                # evidence section — the bundle answers "why" without a
+                # live /requests endpoint)
+                from .reqledger import get_ledger as _get_reqledger
+                with open(os.path.join(path, 'requests.json'),
+                          'w') as f:
+                    json.dump(_get_reqledger().report(), f, indent=1,
+                              default=str)
+            except Exception:
+                _metrics.count_suppressed('flight.bundle_section')
             try:
                 # serving prefix-cache posture: what was retained /
                 # pinned when the anomaly fired (an eviction storm or a

@@ -224,6 +224,7 @@ class _Handler(BaseHTTPRequestHandler):
                 '/programs': self._programs, '/goodput': self._goodput,
                 '/fleet/metrics': self._fleet_metrics,
                 '/fleet/trace': self._fleet_trace, '/slo': self._slo,
+                '/requests': self._requests,
             }.get(route)
             if handler is None:
                 self._send(f'unknown route {route}\n', status=404)
@@ -237,7 +238,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _index(self):
         self._send('paddle_tpu observability: /metrics /healthz /summary '
                    '/events /trace /programs /goodput /fleet/metrics '
-                   '/fleet/trace /slo\n')
+                   '/fleet/trace /slo /requests\n')
 
     def _metrics(self):
         from .exporters import to_prometheus_text
@@ -289,6 +290,17 @@ class _Handler(BaseHTTPRequestHandler):
         if types:
             wanted = set(t for t in types.split(',') if t)
             events = [e for e in events if e.get('name') in wanted]
+        trace_id = q.get('trace_id')
+        if trace_id is not None:
+            try:
+                trace_id = int(trace_id)
+            except ValueError:
+                pass   # string trace ids pass through as-is
+            # one request's events — the /requests drill-down (the same
+            # request_id attr convention /fleet/trace stitches on)
+            events = [e for e in events
+                      if (e.get('attrs') or {}).get('request_id')
+                      == trace_id]
         self._send(''.join(json.dumps(e) + '\n' for e in events[-n:]),
                    content_type='application/jsonl')
 
@@ -359,6 +371,33 @@ class _Handler(BaseHTTPRequestHandler):
                        + '\n', content_type='application/json')
         else:
             self._send(ledger.report_text() + '\n')
+
+    def _requests(self):
+        """The per-request latency ledger: ?top=N caps the slowest-K
+        waterfalls returned (default all retained); the payload carries
+        the per-phase p50/p99 decomposition and the p99-driver ranking
+        — 'where did my p99 go' as data. When a fleet aggregator is
+        registered, its merged cross-process waterfalls ride along
+        under 'fleet'."""
+        from .reqledger import get_ledger
+        q = self._query()
+        top = None
+        if q.get('top'):
+            try:
+                top = max(int(q['top']), 0)
+            except ValueError:
+                self._send(f'bad top= {q["top"]!r} (want an int)\n',
+                           status=400)
+                return
+        body = get_ledger().report(top=top)
+        from .aggregator import get_aggregator
+        agg = get_aggregator()
+        if agg is not None:
+            agg.poll()
+            fleet = agg.requests()
+            body['fleet'] = fleet[-(top or len(fleet) or 1):]
+        self._send(json.dumps(body, indent=1, default=str) + '\n',
+                   content_type='application/json')
 
 
 class ObservabilityServer:

@@ -97,12 +97,18 @@ class Shipper:
             # the same instant stamps wall and mono: the skew-estimation
             # pair every segment of this batch carries
             from .events import _now
+            from .reqledger import get_ledger as _get_reqledger
             wall_ts, mono_ts = time.time(), _now()
+            # finalized request waterfalls ship as their own kind; their
+            # 'ts' fields ride the span clock, so the aggregator's skew
+            # offsets project them onto the fleet timeline unchanged
+            requests = _get_reqledger().drain_wire_records()
             paths: List[str] = []
             total_bytes = 0
             for kind, records in ((wire.KIND_METRICS, delta),
                                   (wire.KIND_EVENTS, instants),
-                                  (wire.KIND_SPANS, spans)):
+                                  (wire.KIND_SPANS, spans),
+                                  (wire.KIND_REQUESTS, requests)):
                 if not records:
                     continue
                 self._seq += 1

@@ -49,7 +49,12 @@ WIRE_VERSION = 1
 KIND_METRICS = 'metrics'
 KIND_EVENTS = 'events'
 KIND_SPANS = 'spans'
-KINDS = frozenset((KIND_METRICS, KIND_EVENTS, KIND_SPANS))
+#: finalized per-request latency-ledger records (reqledger waterfalls):
+#: the aggregator merges them per process so `/requests` and
+#: `stitch_trace` phase annotations work fleet-wide
+KIND_REQUESTS = 'requests'
+KINDS = frozenset((KIND_METRICS, KIND_EVENTS, KIND_SPANS,
+                   KIND_REQUESTS))
 
 #: committed segment files (everything else in a spool dir is ignored)
 SEGMENT_SUFFIX = '.jsonl'

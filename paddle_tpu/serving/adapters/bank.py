@@ -52,10 +52,15 @@ class AdapterUnavailable(KeyError):
     store empty/corrupt, or bank full of pinned slots). The router maps
     this onto `AdmissionRejected(reason='adapter_unavailable')`."""
 
-    def __init__(self, adapter_id: str, detail: str = ''):
+    def __init__(self, adapter_id: str, detail: str = '',
+                 transient: bool = False):
         super().__init__(adapter_id)
         self.adapter_id = adapter_id
         self.detail = detail
+        # transient=True marks back-pressure (bank full of PINNED
+        # slots): pins free as requests retire, so the engine requeues
+        # instead of failing — queue_wait books as 'adapter_pinned'
+        self.transient = transient
 
     def __str__(self):
         base = f'adapter {self.adapter_id!r} unavailable'
@@ -231,7 +236,8 @@ class AdapterBank:
         if not victims:
             raise AdapterUnavailable(
                 adapter_id, f'bank full: all {self.capacity} slots '
-                            f'pinned by in-flight requests')
+                            f'pinned by in-flight requests',
+                transient=True)
         victim = min(victims, key=lambda s: self._lru[s])
         old = self._keys[victim]
         _obs.emit('adapter_evict', adapter=old, slot=victim,

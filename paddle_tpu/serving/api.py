@@ -126,6 +126,10 @@ class RequestHandle:
         self.adapter_id: Optional[str] = None
         self.adapter_version: Optional[int] = None
         self._adapter_pin: Optional[int] = None
+        # per-request latency ledger record (observability.reqledger);
+        # None when the ledger is disabled. Owned by whatever thread
+        # drives this handle (engine loop / router / mirror updater).
+        self._ledger_rec = None
 
     @property
     def trace_id(self) -> int:
@@ -137,16 +141,26 @@ class RequestHandle:
     def _emit(self, token: int, now: float):
         if self._t_first is None:
             self._t_first = now
+            if self._ledger_rec is not None:
+                self._ledger_rec.mark_first(now)
         self.tokens.append(int(token))
 
     def _finish(self, now: Optional[float] = None):
         self.status = FINISHED
         self._t_done = time.perf_counter() if now is None else now
+        if self._ledger_rec is not None:
+            from ..observability import reqledger as _reqledger
+            _reqledger.get_ledger().finalize(self, now=self._t_done,
+                                             outcome='completed')
 
     def _fail(self, exc: BaseException):
         self.status = FAILED
         self.error = exc
         self._t_done = time.perf_counter()
+        if self._ledger_rec is not None:
+            from ..observability import reqledger as _reqledger
+            _reqledger.get_ledger().finalize(self, now=self._t_done,
+                                             outcome='failed')
 
     # -- user-side views ---------------------------------------------------
     @property

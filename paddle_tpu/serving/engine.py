@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import reqledger as _reqledger
 from ..jit import functional_state
 from ..nlp.generation import _NEG_INF, cached_forward
 from ..resilience import RetryPolicy, call_with_retry
@@ -934,6 +935,10 @@ class InferenceEngine:
             # trace id (request_id) threads every span/event it touches
             h._queue_span = _obs.Span('serving.queue',
                                       request_id=h.request_id).begin()
+        if _reqledger.enabled():
+            rec = _reqledger.get_ledger().open_for(h)
+            if rec is not None:
+                rec.queue_enter(h._t_submit, 'priority_queued')
         self.scheduler.submit(h)
         return h
 
@@ -1202,11 +1207,24 @@ class InferenceEngine:
         n = len(self._slot_req)
         if not np.any(self._active):
             return n            # chunk-prefill-only progress this round
+        t_round0 = time.perf_counter()
         if self.draft_model is not None:
             toks, counts = self._spec_round()
         else:
             toks, counts = self._decode_round()
         now = time.perf_counter()
+        # ledger BEFORE the emission loop, so the round that produced a
+        # request's first token still lands in its TTFT sub-book
+        # (mark_first fires inside _emit below). Waterfall book: every
+        # active participant waited the full round wall; fair-share
+        # book: the wall splits evenly, closing to the engine decode
+        # wall.
+        round_recs = [h._ledger_rec for slot, h in self._slot_req.items()
+                      if self._active[slot]]
+        _reqledger.get_ledger().note_round(
+            now - t_round0, round_recs,
+            'spec_verify' if self.draft_model is not None else 'decode',
+            now=now, absorb=True)
         self._counts['decode_rounds'] += 1
         if _obs.enabled():
             self._m_rounds.inc()
@@ -1440,6 +1458,26 @@ class InferenceEngine:
             self.prefix_cache.evict_lru()
         return self.pool.alloc()
 
+    def _requeue_blocked(self, handles, reason: str):
+        """Requeue (queue FRONT, original order, first-submit timestamp
+        preserved) and sample the blocking reason into each request's
+        ledger record: elapsed queue time settles under the reason that
+        was just observed, and a fresh interval opens."""
+        now = time.perf_counter()
+        for h in handles:
+            rec = h._ledger_rec
+            if rec is not None:
+                if rec._q_mark is None and now > rec._last_touch:
+                    # this handle reached _begin_request (queue_exit
+                    # ran) before the seat aborted: the aborted seating
+                    # work — an adapter store load that found the bank
+                    # full, the page-reservation walk — is admission
+                    # time, not a residual
+                    rec.add('admission', now - rec._last_touch, now=now)
+                rec.queue_block(now, reason)
+        for back in reversed(handles):
+            self.scheduler.requeue(back)
+
     def _admit(self):
         admitted = self.scheduler.admissible(self._effective_free(),
                                              self._admission_cost)
@@ -1452,8 +1490,7 @@ class InferenceEngine:
                 # not a failure — THIS handle and everything behind it
                 # in the popped batch go back to the queue front in
                 # order (admissible() already removed them)
-                for back in reversed(admitted[idx:]):
-                    self.scheduler.requeue(back)
+                self._requeue_blocked(admitted[idx:], 'pool_exhausted')
                 break
             try:
                 self._begin_request(slot, h)
@@ -1468,10 +1505,23 @@ class InferenceEngine:
                           request_id=h.request_id,
                           queued=self.scheduler.queue_depth,
                           detail=str(exc))
-                for back in reversed(admitted[idx:]):
-                    self.scheduler.requeue(back)
+                self._requeue_blocked(admitted[idx:], 'pool_exhausted')
                 break
             except Exception as exc:
+                from .adapters.bank import AdapterUnavailable
+                if isinstance(exc, AdapterUnavailable) \
+                        and exc.transient:
+                    # adapter bank momentarily full of PINNED slots:
+                    # pins free as in-flight requests retire, so this
+                    # is back-pressure, not a failure — requeue just
+                    # this handle and keep admitting the rest
+                    self.pool.free(slot)
+                    _obs.emit('adapter_bank_saturated',
+                              request_id=h.request_id,
+                              adapter_id=h.adapter_id,
+                              detail=str(exc))
+                    self._requeue_blocked([h], 'adapter_pinned')
+                    continue
                 # REQUEST-level failure: free the slot, fail the handle,
                 # keep the engine serving everyone else
                 if slot in self._slot_req:
@@ -1499,6 +1549,7 @@ class InferenceEngine:
         ps = self.pool.page_size
         node, cursor = None, 0
         if self.prefix_cache is not None:
+            t_pfx = time.perf_counter()
             node, matched = self.prefix_cache.lookup(
                 h.prompt_tokens, namespace=self._prefix_ns(h))
             if node is not None:
@@ -1510,6 +1561,9 @@ class InferenceEngine:
                     node = None
                 else:
                     self.prefix_cache.acquire(node)
+            if h._ledger_rec is not None:
+                t1 = time.perf_counter()
+                h._ledger_rec.add('prefix_lookup', t1 - t_pfx, now=t1)
         try:
             if node is not None:
                 self.pool.attach_prefix(slot, node.slot, cursor // ps)
@@ -1556,6 +1610,12 @@ class InferenceEngine:
         attach + page reservation), then either whole-prompt prefill
         (short cold prompts — the PR-4 path, one compile per bucket) or
         enter the chunked-prefill state machine."""
+        t_adm0 = time.perf_counter()
+        rec = h._ledger_rec
+        pfx0 = 0.0
+        if rec is not None:
+            rec.queue_exit(t_adm0)   # queue_wait ends; admission begins
+            pfx0 = rec.phases['prefix_lookup']
         s = len(h.prompt_tokens)
         cursor = 0
         src = slot
@@ -1585,6 +1645,7 @@ class InferenceEngine:
                 h._prefix_node = node
                 h._prefix_len = cursor
         elif self.prefix_cache is not None:
+            t_pfx = time.perf_counter()
             node, matched = self.prefix_cache.lookup(
                 h.prompt_tokens, namespace=self._prefix_ns(h))
             if node is not None:
@@ -1593,6 +1654,9 @@ class InferenceEngine:
                 h._prefix_len = matched
                 cursor = matched
                 src = node.slot
+            if rec is not None:
+                t1 = time.perf_counter()
+                rec.add('prefix_lookup', t1 - t_pfx, now=t1)
         if h._queue_span is not None:
             h._queue_span.end()   # admission closes the queue span
             h._queue_span = None
@@ -1602,6 +1666,13 @@ class InferenceEngine:
         # swap requires a drained engine, so every token this request
         # emits decodes under this version
         h.weight_version = self.weight_version
+        if rec is not None:
+            # admission = seating work since queue exit, minus the
+            # prefix-lookup seconds already booked inside this window
+            # (phases stay non-overlapping in seconds)
+            t1 = time.perf_counter()
+            rec.add('admission', (t1 - t_adm0)
+                    - (rec.phases['prefix_lookup'] - pfx0), now=t1)
         if node is not None:
             _obs.emit('prefix_hit', request_id=h.request_id,
                       matched=h._prefix_len, prompt_len=s, slot=slot)
@@ -1631,9 +1702,19 @@ class InferenceEngine:
         self._prefilling[slot] = [h, cursor, src]
         self._counts['chunked_prefills'] += 1
 
+    def _note_prefill(self, h: RequestHandle, t0: float):
+        """Ledger: the prefill that just ran books as `prefill` for its
+        owner and `prefill_wait` for every OTHER seated request — the
+        chunked-prefill convoy, named instead of smeared."""
+        now = time.perf_counter()
+        _reqledger.get_ledger().note_prefill(
+            now - t0, h._ledger_rec,
+            [o._ledger_rec for o in self._slot_req.values()], now=now)
+
     def _whole_prefill(self, slot: int, h: RequestHandle):
         s = len(h.prompt_tokens)
         bucket = self.pool.bucket_for(s)
+        t_pf0 = time.perf_counter()
         with _obs.span('serving.prefill', request_id=h.request_id,
                        bucket=bucket, slot=slot, prompt_len=s):
             ids = np.zeros((1, bucket), np.int32)
@@ -1656,6 +1737,7 @@ class InferenceEngine:
                     self._params, self._frozen, self._buffers, ids_dev,
                     *self._adapter_args(slot)))
         self.pool.note_written(slot, s)
+        self._note_prefill(h, t_pf0)
         self._counts['prefills'] += 1
         self._counts['prefill_tokens'] += s
         if _obs.enabled():
@@ -1692,6 +1774,7 @@ class InferenceEngine:
         # window always fits and pad queries stay above the prompt
         start = min(cursor, self.pool.max_length - bucket)
         window = h.prompt_tokens[start:start + bucket]
+        t_pf0 = time.perf_counter()
         with _obs.span('serving.prefill_chunk', request_id=h.request_id,
                        bucket=bucket, slot=slot, start=start,
                        cursor=cursor, prompt_len=s):
@@ -1724,6 +1807,7 @@ class InferenceEngine:
                     *self._adapter_args(slot)))
         new_cursor = min(start + bucket, s)
         self.pool.note_written(slot, new_cursor)
+        self._note_prefill(h, t_pf0)
         self._prefilling[slot][1] = new_cursor
         self._prefilling[slot][2] = slot   # later chunks extend own row
         self._counts['chunk_rounds'] += 1
@@ -1769,6 +1853,7 @@ class InferenceEngine:
         s = len(h.prompt_tokens)
         bucket = self.pool.bucket_for(s)
         d_params, d_frozen, d_buffers = self._draft_state
+        t_pf0 = time.perf_counter()
         with _obs.span('serving.draft_prefill', request_id=h.request_id,
                        bucket=bucket, slot=slot):
             ids = np.zeros((1, bucket), np.int32)
@@ -1777,6 +1862,7 @@ class InferenceEngine:
                                       site='serving.h2d')
             self.draft_pool.set_row(slot, self._draft_prefill_jit(
                 d_params, d_frozen, d_buffers, ids_dev))
+        self._note_prefill(h, t_pf0)
 
     def _retire(self, slot: int, h: RequestHandle, now: float):
         h._finish(now)

@@ -52,6 +52,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import observability as _obs
+from ..observability import reqledger as _reqledger
 from ..analysis.runtime import concurrency as _concurrency
 from ..resilience.retry import (FatalError, TransientError,
                                 register_transient)
@@ -366,6 +367,13 @@ class RemoteReplica:
         if priority is not None:
             h.priority = int(priority)
         h.adapter_id = adapter_id
+        if _reqledger.enabled():
+            # the PARENT keeps this request's ledger record (the child's
+            # engine ships its own over the wire plane; the mirror's is
+            # what the Router adopts and the client sees)
+            rec = _reqledger.get_ledger().open_for(h)
+            if rec is not None:
+                rec.queue_enter(h._t_submit, 'priority_queued')
         rid = res.get('rid')
         with self._lock:
             self._handles[int(rid)] = h
@@ -376,7 +384,36 @@ class RemoteReplica:
         mirror updates from the response. A connection failure
         propagates (transient by type) so `Router.step` runs its normal
         evict-and-resubmit failover — crash isolation, same code path."""
+        t0 = time.perf_counter()
         res = self._rpc.call('step')
+        t1 = time.perf_counter()
+        # ledger attribution uses PRE-update mirror statuses and runs
+        # BEFORE _apply_updates: the round that produces a first token
+        # must land in that request's TTFT sub-book (mark_first fires
+        # inside _emit during the update apply). Each RUNNING mirror's
+        # timeline tiles exactly: the parent-loop gap since its last
+        # touch books as decode (the request was mid-decode, waiting
+        # for its replica's turn), the framing surplus as
+        # rpc_transport, the child's reported step wall as decode
+        # (fair-share + engine-wall books ride note_round). QUEUED
+        # mirrors stay in queue_wait.
+        step_wall = float(res.get('step_wall_s') or 0.0)
+        rpc_surplus = max((t1 - t0) - step_wall, 0.0)
+        with self._lock:
+            running = [h._ledger_rec for h in self._handles.values()
+                       if h.status == RUNNING]
+        t_round0 = t1 - step_wall
+        for rec in running:
+            if rec is None:
+                continue
+            gap = (t0 - rec._last_touch)
+            if gap > 0.0:
+                rec.add('decode', gap, now=t0)
+            if rpc_surplus > 0.0:
+                rec.add('rpc_transport', rpc_surplus,
+                        now=min(t0 + rpc_surplus, t_round0))
+        _reqledger.get_ledger().note_round(step_wall, running,
+                                           'decode', now=t1)
         return self._apply_updates(res)
 
     def _apply_updates(self, res: Dict[str, Any]) -> int:
@@ -386,6 +423,14 @@ class RemoteReplica:
                 h = self._handles.get(int(rid_s))
                 if h is None:
                     continue
+                status = upd.get('status')
+                if (h.status == QUEUED and h._ledger_rec is not None
+                        and status in (RUNNING, FINISHED, FAILED)):
+                    # first round the child reported it past the queue:
+                    # the mirror's queue_wait ends here — BEFORE the
+                    # token emit below fires mark_first, so the final
+                    # queue interval still lands in the TTFT sub-book
+                    h._ledger_rec.queue_exit(now)
                 toks = upd.get('tokens', [])
                 for tok in toks[len(h.tokens):]:
                     h._emit(tok, now)
@@ -393,7 +438,6 @@ class RemoteReplica:
                     h.weight_version = upd['weight_version']
                 if upd.get('adapter_version') is not None:
                     h.adapter_version = upd['adapter_version']
-                status = upd.get('status')
                 if status == RUNNING and h.status == QUEUED:
                     h.status = RUNNING
                 elif status == FINISHED and not h.done:

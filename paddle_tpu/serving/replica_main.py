@@ -37,6 +37,7 @@ import os
 import socket
 import sys
 import threading
+import time
 import traceback
 from typing import Any, Dict, Optional
 
@@ -160,8 +161,14 @@ class _ReplicaServer:
 
     def rpc_step(self, **_):
         with self._elock:
+            t0 = time.perf_counter()
             progressed = self.engine.step() if self.engine.has_work else 0
-            out = {'progressed': progressed, 'updates': self._updates()}
+            # reported so the parent's mirror ledger can split this
+            # round into decode (child wall) vs rpc_transport (framing
+            # + socket surplus measured around the call)
+            step_wall = time.perf_counter() - t0
+            out = {'progressed': progressed, 'updates': self._updates(),
+                   'step_wall_s': step_wall}
             self._prune_done()
             return out
 

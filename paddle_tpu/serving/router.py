@@ -259,6 +259,11 @@ class RouterHandle:
         self._finalized = False
         self._t_submit = time.perf_counter()
         self._t_first: Optional[float] = None
+        # the per-request latency ledger record: adopted from the FIRST
+        # engine placement (rebased to router submit so QoS/pick time
+        # books as admission) and carried across failovers — one
+        # waterfall spans replicas
+        self._ledger_rec = None
 
     @property
     def tokens(self) -> List[int]:
@@ -723,6 +728,24 @@ class Router:
                                          priority=rh.priority,
                                          adapter_id=rh.adapter_id)
         rh.replica_id = replica.id
+        rec = rh._ledger_rec
+        if rec is None:
+            # first placement: adopt the record engine.submit opened,
+            # re-anchored at ROUTER submit — the tenancy/QoS checks and
+            # replica pick in between book as admission
+            rec = getattr(rh.inner, '_ledger_rec', None)
+            if rec is not None:
+                rec.rebase_submit(rh._t_submit)
+                rec.tenant = rh.tenant
+                rh._ledger_rec = rec
+        else:
+            # failover: drop the fresh record this submit opened and
+            # keep the ORIGINAL following the request — the waterfall
+            # spans replicas
+            rh.inner._ledger_rec = rec
+            rec.failovers = rh.failovers
+        if rec is not None:
+            rec.replica_id = replica.id
 
     # ------------------------------------------------------------------
     # the iteration loop
@@ -822,6 +845,13 @@ class Router:
         rh._finalized = True
         self.tenants.get(rh.tenant).in_flight -= 1
         self._counts[outcome] += 1
+        if rh.inner is not None and rh.inner._ledger_rec is not None:
+            # completed/engine-failed requests already closed their
+            # record via the handle hooks (finalize is idempotent);
+            # this catches router-level failures (_error set with the
+            # inner handle merely evicted, never failed)
+            from ..observability import reqledger as _reqledger
+            _reqledger.get_ledger().finalize(rh.inner, outcome=outcome)
         if _obs.enabled():
             self._m_requests.labels(tenant=rh.tenant,
                                     outcome=outcome).inc()
@@ -849,12 +879,28 @@ class Router:
             rh = by_inner.get(id(h))
             if rh is None:
                 continue   # an engine-level handle the router never saw
+            rec = rh._ledger_rec
+            t_det = time.perf_counter()
+            if rec is not None:
+                if rec._q_mark is not None:
+                    # the victim was still queued on the dead replica:
+                    # its wait so far stays queue_wait
+                    rec.queue_exit(t_det)
+                else:
+                    # mid-decode victim: the gap since its last round
+                    # IS the failure-detection window
+                    rec.add('failover_resubmit',
+                            t_det - rec._last_touch, now=t_det)
             err = self._wrap(replica, exc)
             if not transient or rh.failovers >= self.max_failovers:
                 rh._error = err
                 continue
             target = self._pick_replica(exclude=(replica,))
             if target is None:
+                if rec is not None:
+                    # time from here to the failed-request reap books
+                    # under the reason the victim actually died of
+                    rec.queue_enter(t_det, 'no_healthy_replica')
                 rh._error = ReplicaFailure(
                     replica.id,
                     f'replica {replica.id} failed and no healthy '
@@ -870,6 +916,17 @@ class Router:
                     f'failover resubmission to replica {target.id} '
                     f'failed: {place_exc}')
                 rh._error.__cause__ = place_exc
+                continue
+            if rec is not None:
+                # re-placement work (re-submit incl. prompt re-prep on
+                # the target) books as failover_resubmit, then the
+                # request re-queues — behind the survivor's own load,
+                # or breaker-gated if the target is probing
+                t2 = time.perf_counter()
+                rec.add('failover_resubmit', t2 - t_det, now=t2)
+                rec.queue_enter(
+                    t2, 'breaker_open' if not replica.breaker.admits()
+                    else 'priority_queued')
 
     @staticmethod
     def _wrap(replica: Replica, exc: BaseException) -> ReplicaFailure:

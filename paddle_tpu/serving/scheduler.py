@@ -78,7 +78,11 @@ class FCFSScheduler:
         """Put an already-admitted handle back at the queue FRONT (the
         engine could not actually seat it — e.g. the free slot it was
         promised got pinned by a prefix-cache hit in the same admission
-        pass). Front insertion preserves FCFS-within-class order."""
+        pass, or its page reservation hit PagePoolExhausted). Front
+        insertion preserves FCFS-within-class order, and the handle's
+        `_t_submit` is deliberately NOT touched: queue_wait, ttft, and
+        the starvation guard all measure from FIRST submit, however many
+        times the request bounces back (test_reqledger pins this)."""
         self._queue.insert(0, handle)
         self._note_depth()
 

@@ -37,6 +37,14 @@ from typing import Callable, Dict, Optional
 from .api import PRIORITY_NAMES, PRIORITY_NORMAL
 
 
+# the CLOSED vocabulary of typed rejection reasons. Kept in lockstep
+# with the request-ledger's BLOCKED_REASONS (a rejected request never
+# gets a ledger record — it cost nothing, which is the point — but
+# dashboards join the two vocabularies when explaining tail behavior).
+REJECT_REASONS = ('rate_limited', 'concurrency', 'shed',
+                  'no_healthy_replica', 'adapter_unavailable')
+
+
 class AdmissionRejected(RuntimeError):
     """Typed admission rejection (rate limit, concurrency cap, load
     shed, or no healthy replica). Always raised synchronously from
@@ -45,6 +53,10 @@ class AdmissionRejected(RuntimeError):
 
     def __init__(self, tenant: str, reason: str,
                  retry_after_s: Optional[float] = None, detail: str = ''):
+        if reason not in REJECT_REASONS:
+            raise ValueError(
+                f'unknown rejection reason {reason!r}; the vocabulary '
+                f'is closed: {REJECT_REASONS}')
         self.tenant = tenant
         self.reason = reason
         self.retry_after_s = retry_after_s

@@ -1,0 +1,39 @@
+"""True-positive fixtures for host-sync over the request-ledger scope
+(parsed only, never imported). The file path mirrors the real
+hot-scope config (`paddle_tpu/observability/reqledger.py` + the
+`RequestRecord.` / `RequestLedger.` prefixes): add()/note_round()/
+finalize_record() run inside the engine step and router failover
+loops, so an unannotated device read here stalls every decode round
+of every in-flight request."""
+import numpy as np
+import jax
+
+
+class RequestRecord:
+    def add(self, phase, dur):
+        # snippet 1: "durations" must be host floats already — reading
+        # one off a device array is a d2h sync per phase charge
+        self.phases[phase] += dur.item()
+
+    def mark_first(self, token):
+        # snippet 2: materializing the emitted token to stamp TTFT
+        # forces a copy on the first-token round
+        self.first_token = int(np.asarray(token)[0])
+
+
+class RequestLedger:
+    def note_round(self, dur, recs, step_out):
+        # snippet 3: blocking on the step output to time the round
+        # defeats async dispatch — the wall clock is the timer here
+        step_out.block_until_ready()
+        for r in recs:
+            r.add('decode', dur / len(recs))
+
+    def finalize_record(self, rec, logits):
+        # snippet 4: per-element device read while closing the books
+        rec.last_logit = float(logits[-1])
+        self._window.append(rec.summary())
+
+    def report(self, arrays):
+        # snippet 5: device_get is a sync however it is spelled
+        return jax.device_get(arrays)

@@ -1,0 +1,42 @@
+"""True-negative fixtures for host-sync over the request-ledger scope:
+host-float bookkeeping, perf_counter timing, and syncs outside the
+scope prefixes."""
+import time
+
+import numpy as np
+
+
+class RequestRecord:
+    def add(self, phase, dur, now=None):
+        # snippet 1: the books are plain host floats — dict writes and
+        # float adds never touch the device
+        self.phases[phase] += float(dur)
+        self._last_touch = time.perf_counter() if now is None else now
+
+    def queue_exit(self, now):
+        # snippet 2: queue accounting is wall-clock arithmetic, not a
+        # device read
+        if self._q_mark is not None:
+            self.blocked[self._q_reason] = \
+                self.blocked.get(self._q_reason, 0.0) + (now - self._q_mark)
+            self._q_mark = None
+
+
+class RequestLedger:
+    def note_round(self, dur, recs):
+        # snippet 3: fair-share attribution divides a host-measured
+        # wall duration — no array in sight
+        share = dur / max(len(recs), 1)
+        for r in recs:
+            r.add('decode', share)
+
+    def report(self, top=8):
+        # snippet 4: quantiles over host floats from the window
+        window = sorted(s['e2e_s'] for s in self._window)
+        return {'p99_s': window[int(0.99 * (len(window) - 1))]
+                if window else None}
+
+
+def summarize_batch(tokens):
+    # snippet 5: module-level helper, outside the ledger class prefixes
+    return int(np.asarray(tokens).sum())

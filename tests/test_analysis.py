@@ -121,6 +121,8 @@ FIXTURE_SPECS = [
      'host_sync/good/paddle_tpu/serving/supervisor.py'),
     ('host-sync', 'host_sync/bad/paddle_tpu/serving/adapters/bank.py',
      'host_sync/good/paddle_tpu/serving/adapters/bank.py'),
+    ('host-sync', 'host_sync/bad/paddle_tpu/observability/reqledger.py',
+     'host_sync/good/paddle_tpu/observability/reqledger.py'),
     ('falsy-guard', 'falsy_guard/bad_falsy_or.py',
      'falsy_guard/good_is_none.py'),
     ('lock-order', 'lock_order/bad_locks.py', 'lock_order/good_locks.py'),

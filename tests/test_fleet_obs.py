@@ -35,6 +35,8 @@ def _private_source(n=5, trace_id=77):
     """A private registry + event log pre-loaded with known truth, so
     shipper tests never ride the process-global telemetry (whose
     background churn would make deltas nondeterministic)."""
+    from paddle_tpu.observability.reqledger import get_ledger
+    get_ledger().drain_wire_records()   # earlier tests' finished requests
     reg = MetricsRegistry(process_index=0)
     reg.counter('paddle_fleet_test_total', 'fleet-plane test counter').inc(n)
     reg.gauge('paddle_fleet_test_gauge', 'fleet-plane test gauge').set(2.5)
